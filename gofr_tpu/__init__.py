@@ -1,0 +1,46 @@
+"""gofr_tpu — a TPU-native application framework.
+
+A brand-new framework with the capability surface of GoFr (an opinionated Go
+microservice framework; see SURVEY.md for the structural analysis of the
+reference at /root/reference) plus a first-class TPU inference stack that the
+reference never had: JAX/XLA models, GSPMD sharding over device meshes,
+dynamic-batching serving, and pallas TPU kernels.
+
+Public surface (mirrors the reference's ``pkg/gofr`` top level,
+reference ``gofr.go:35-52``):
+
+    from gofr_tpu import App
+
+    app = App()
+
+    @app.get("/hello")
+    def hello(ctx):
+        return f"Hello {ctx.param('name') or 'World'}!"
+
+    app.run()
+"""
+
+from gofr_tpu.version import FRAMEWORK_VERSION
+
+__version__ = FRAMEWORK_VERSION
+
+# Lazy imports keep `import gofr_tpu` cheap (no jax import until the TPU
+# surface is touched) while still exposing the GoFr-shaped top level.
+_LAZY = {
+    "App": ("gofr_tpu.app", "App"),
+    "new_cmd": ("gofr_tpu.app", "new_cmd"),
+    "Context": ("gofr_tpu.context", "Context"),
+    "Migrate": ("gofr_tpu.migration", "Migrate"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'gofr_tpu' has no attribute {name!r}")
+
+
+__all__ = ["App", "new_cmd", "Context", "Migrate", "FRAMEWORK_VERSION"]

@@ -1,0 +1,27 @@
+"""Structured leveled logging (reference: ``pkg/gofr/logging``).
+
+Leveled JSON logger with terminal pretty-printing, stdout/stderr split at
+ERROR, file logger for CLI apps, and hot-swappable level — the capability set
+of the reference's ``logging/logger.go`` + ``logging/dynamicLevelLogger.go``.
+"""
+
+from gofr_tpu.logging.level import Level, level_from_string
+from gofr_tpu.logging.logger import (
+    Logger,
+    PrettyPrint,
+    new_file_logger,
+    new_logger,
+    new_logger_from_env,
+)
+from gofr_tpu.logging.remote import RemoteLevelLogger
+
+__all__ = [
+    "Level",
+    "level_from_string",
+    "Logger",
+    "PrettyPrint",
+    "new_logger",
+    "new_logger_from_env",
+    "new_file_logger",
+    "RemoteLevelLogger",
+]

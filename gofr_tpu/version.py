@@ -1,0 +1,3 @@
+"""Framework version constant (reference: ``pkg/gofr/version/version.go:3``)."""
+
+FRAMEWORK_VERSION = "0.1.0-dev"

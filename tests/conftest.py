@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+All tests run on CPU with 8 virtual XLA devices so multi-chip sharding
+(dp/tp/sp/ep meshes) is exercised without TPU hardware — the
+`xla_force_host_platform_device_count` trick the driver also uses for the
+multi-chip dry run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import socket
+import threading
+
+import pytest
+
+
+@pytest.fixture
+def free_port():
+    def _get():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    return _get
+
+
+@pytest.fixture
+def mock_config():
+    from gofr_tpu.config import MockConfig
+
+    def _make(values=None):
+        return MockConfig(values or {})
+
+    return _make

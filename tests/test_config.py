@@ -1,0 +1,70 @@
+"""Config layer tests (behavioral parity with reference ``config/godotenv_test.go``)."""
+
+import os
+
+from gofr_tpu.config import MockConfig, new_env_file
+
+
+def _write(path, content):
+    with open(path, "w") as fp:
+        fp.write(content)
+
+
+def test_env_file_loads_and_reads(tmp_path, monkeypatch):
+    monkeypatch.delenv("APP_ENV", raising=False)
+    monkeypatch.delenv("TEST_KEY_A", raising=False)
+    _write(tmp_path / ".env", "TEST_KEY_A=hello\n# comment\nTEST_KEY_B=1\n")
+    cfg = new_env_file(str(tmp_path))
+    assert cfg.get("TEST_KEY_A") == "hello"
+    assert cfg.get_or_default("MISSING_KEY_XYZ", "fallback") == "fallback"
+    monkeypatch.delenv("TEST_KEY_A", raising=False)
+    monkeypatch.delenv("TEST_KEY_B", raising=False)
+
+
+def test_process_env_wins_over_base_file(tmp_path, monkeypatch):
+    monkeypatch.delenv("APP_ENV", raising=False)
+    monkeypatch.setenv("TEST_KEY_C", "from-process")
+    _write(tmp_path / ".env", "TEST_KEY_C=from-file\n")
+    cfg = new_env_file(str(tmp_path))
+    assert cfg.get("TEST_KEY_C") == "from-process"
+
+
+def test_local_env_overlay_overrides(tmp_path, monkeypatch):
+    """Overlay semantics: .local.env overrides .env (godotenv.go:50-63)."""
+    monkeypatch.delenv("APP_ENV", raising=False)
+    monkeypatch.delenv("TEST_KEY_D", raising=False)
+    _write(tmp_path / ".env", "TEST_KEY_D=base\n")
+    _write(tmp_path / ".local.env", "TEST_KEY_D=local\n")
+    cfg = new_env_file(str(tmp_path))
+    assert cfg.get("TEST_KEY_D") == "local"
+    monkeypatch.delenv("TEST_KEY_D", raising=False)
+
+
+def test_app_env_overlay(tmp_path, monkeypatch):
+    monkeypatch.setenv("APP_ENV", "stage")
+    monkeypatch.delenv("TEST_KEY_E", raising=False)
+    _write(tmp_path / ".env", "TEST_KEY_E=base\n")
+    _write(tmp_path / ".stage.env", "TEST_KEY_E=stage\n")
+    _write(tmp_path / ".local.env", "TEST_KEY_E=local\n")
+    cfg = new_env_file(str(tmp_path))
+    assert cfg.get("TEST_KEY_E") == "stage"
+    monkeypatch.delenv("TEST_KEY_E", raising=False)
+
+
+def test_quotes_and_export_prefix(tmp_path, monkeypatch):
+    monkeypatch.delenv("APP_ENV", raising=False)
+    for k in ("TEST_KEY_F", "TEST_KEY_G"):
+        monkeypatch.delenv(k, raising=False)
+    _write(tmp_path / ".env", 'export TEST_KEY_F="quoted value"\nTEST_KEY_G=plain # trailing\n')
+    cfg = new_env_file(str(tmp_path))
+    assert cfg.get("TEST_KEY_F") == "quoted value"
+    assert cfg.get("TEST_KEY_G") == "plain"
+    for k in ("TEST_KEY_F", "TEST_KEY_G"):
+        monkeypatch.delenv(k, raising=False)
+
+
+def test_mock_config():
+    cfg = MockConfig({"A": "1"})
+    assert cfg.get("A") == "1"
+    assert cfg.get("B") is None
+    assert cfg.get_or_default("B", "x") == "x"

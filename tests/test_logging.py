@@ -1,0 +1,98 @@
+"""Logging tests (parity with reference ``logging/logger_test.go`` patterns:
+assert on captured output, level filtering, stdout/stderr split)."""
+
+import io
+import json
+
+import pytest
+
+from gofr_tpu.logging import Level, Logger, level_from_string, new_file_logger
+
+
+def make_logger(level=Level.INFO, terminal=False):
+    out, err = io.StringIO(), io.StringIO()
+    return Logger(level=level, out=out, err=err, is_terminal=terminal), out, err
+
+
+def test_json_output_and_level_filtering():
+    log, out, err = make_logger(Level.INFO)
+    log.debug("hidden")
+    log.info("visible", 42)
+    lines = out.getvalue().strip().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["level"] == "INFO"
+    assert rec["message"] == "visible 42"
+    assert err.getvalue() == ""
+
+
+def test_error_goes_to_stderr():
+    log, out, err = make_logger()
+    log.error("boom")
+    assert out.getvalue() == ""
+    assert json.loads(err.getvalue())["level"] == "ERROR"
+
+
+def test_formatting_variants():
+    log, out, _ = make_logger()
+    log.infof("x=%d y=%s", 7, "a")
+    assert json.loads(out.getvalue())["message"] == "x=7 y=a"
+
+
+def test_structured_payload_serialized():
+    class Payload:
+        def __init__(self):
+            self.query = "SELECT 1"
+            self.duration = 3
+
+    log, out, _ = make_logger()
+    log.info(Payload())
+    msg = json.loads(out.getvalue())["message"]
+    assert msg == {"query": "SELECT 1", "duration": 3}
+
+
+def test_pretty_print_on_terminal():
+    class Payload:
+        def pretty_print(self, fp):
+            fp.write("PRETTY!\n")
+
+    log, out, _ = make_logger(terminal=True)
+    log.info(Payload())
+    assert "PRETTY!" in out.getvalue()
+    assert "INFO" in out.getvalue()
+
+
+def test_change_level():
+    log, out, _ = make_logger(Level.ERROR)
+    log.info("nope")
+    log.change_level(Level.DEBUG)
+    log.debug("yes")
+    assert "nope" not in out.getvalue()
+    assert "yes" in out.getvalue()
+
+
+def test_fatal_raises_system_exit():
+    log, _, err = make_logger()
+    with pytest.raises(SystemExit):
+        log.fatal("dying")
+    assert "dying" in err.getvalue()
+
+
+def test_level_from_string():
+    assert level_from_string("debug") == Level.DEBUG
+    assert level_from_string("WARN") == Level.WARN
+    assert level_from_string("bogus") == Level.INFO
+    assert level_from_string(None) == Level.INFO
+
+
+def test_file_logger(tmp_path):
+    path = tmp_path / "cmd.log"
+    log = new_file_logger(str(path))
+    log.info("to file")
+    log._out.flush()
+    assert "to file" in path.read_text()
+
+
+def test_silent_file_logger_when_no_path():
+    log = new_file_logger("")
+    log.info("discarded")  # must not raise

@@ -1,0 +1,100 @@
+"""Metrics tests (parity with reference ``metrics/register_test.go`` behaviors)."""
+
+import io
+
+from gofr_tpu.logging import Level, Logger
+from gofr_tpu.metrics import Manager, render_prometheus
+
+
+def make_manager():
+    out, err = io.StringIO(), io.StringIO()
+    log = Logger(level=Level.DEBUG, out=out, err=err, is_terminal=False)
+    return Manager(logger=log), out, err
+
+
+def test_counter_roundtrip():
+    m, _, _ = make_manager()
+    m.new_counter("reqs", "request count")
+    m.increment_counter("reqs", "path", "/hello", "method", "GET")
+    m.increment_counter("reqs", "path", "/hello", "method", "GET")
+    text = render_prometheus(m)
+    assert 'reqs{method="GET",path="/hello"} 2.0' in text
+
+
+def test_unregistered_metric_logs_error_not_raise():
+    m, _, err = make_manager()
+    m.increment_counter("nope")
+    assert "not registered" in err.getvalue()
+
+
+def test_duplicate_registration_logs_error():
+    m, _, err = make_manager()
+    m.new_counter("dup")
+    m.new_counter("dup")
+    assert "already registered" in err.getvalue()
+
+
+def test_wrong_type_recording():
+    m, _, err = make_manager()
+    m.new_counter("c1")
+    m.set_gauge("c1", 5.0)
+    assert "not of type" in err.getvalue()
+
+
+def test_odd_labels_logged():
+    m, _, err = make_manager()
+    m.new_counter("c2")
+    m.increment_counter("c2", "only-key")
+    assert "key/value" in err.getvalue()
+
+
+def test_gauge_set_overwrites():
+    m, _, _ = make_manager()
+    m.new_gauge("hbm_used", "bytes")
+    m.set_gauge("hbm_used", 10.0, "chip", "0")
+    m.set_gauge("hbm_used", 20.0, "chip", "0")
+    assert 'hbm_used{chip="0"} 20.0' in render_prometheus(m)
+
+
+def test_updown_counter():
+    m, _, _ = make_manager()
+    m.new_updown_counter("inflight")
+    m.delta_updown_counter("inflight", 2)
+    m.delta_updown_counter("inflight", -1)
+    assert "inflight 1.0" in render_prometheus(m)
+
+
+def test_histogram_buckets_cumulative():
+    m, _, _ = make_manager()
+    m.new_histogram("lat", "latency", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        m.record_histogram("lat", v)
+    text = render_prometheus(m)
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1.0"} 2' in text
+    assert 'lat_bucket{le="10.0"} 3' in text
+    assert 'lat_bucket{le="+Inf"} 4' in text
+    assert "lat_count 4" in text
+    assert "lat_sum 55.55" in text
+
+
+def test_histogram_le_inclusive():
+    m, _, _ = make_manager()
+    m.new_histogram("h2", buckets=[1.0, 2.0])
+    m.record_histogram("h2", 1.0)  # exactly on a bound → le="1.0"
+    assert 'h2_bucket{le="1.0"} 1' in render_prometheus(m)
+
+
+def test_cardinality_warning():
+    m, out, _ = make_manager()
+    m.new_counter("wide")
+    for i in range(25):
+        m.increment_counter("wide", "id", str(i))
+    assert "high cardinality" in out.getvalue()
+
+
+def test_runtime_metrics_present():
+    m, _, _ = make_manager()
+    text = render_prometheus(m, app_name="test-app")
+    assert "process_threads" in text
+    assert 'app_info{app="test-app"' in text
