@@ -1,0 +1,55 @@
+"""HTTP server example (reference ``examples/http-server/main.go``).
+
+Routes: /hello (query param), /params/{id} (path param), /bind (JSON bind),
+/error (typed error → status), /redis + /sql when those datasources are
+configured. Run with `python main.py`; serves :8000 (override HTTP_PORT).
+"""
+
+import os
+import sys
+from dataclasses import dataclass
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.errors import ErrorEntityNotFound
+
+
+@dataclass
+class Person:
+    name: str = ""
+    age: int = 0
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.get("/hello")
+    def hello(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    @app.get("/params/{id}")
+    def params(ctx):
+        return {"id": ctx.path_param("id")}
+
+    @app.post("/bind")
+    def bind(ctx):
+        person = ctx.bind(Person)
+        return {"name": person.name, "age": person.age}
+
+    @app.get("/error")
+    def error(ctx):
+        raise ErrorEntityNotFound("id", ctx.param("id") or "unknown")
+
+    @app.get("/trace")
+    def trace(ctx):
+        with ctx.trace("example-work"):
+            total = sum(range(1000))
+        return {"sum": total}
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,28 @@
+"""CLI example (reference ``examples/using-cmd/main.go``): subcommands with
+flags binding into params."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import new_cmd
+
+
+def main():
+    app = new_cmd(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.sub_command("^hello")
+    def hello(ctx):
+        name = ctx.param("name") or "World"
+        return f"Hello {name}!"
+
+    @app.sub_command("^params")
+    def params(ctx):
+        return {"flags": {k: ctx.param(k) for k in ("a", "b", "verbose")}}
+
+    return app
+
+
+if __name__ == "__main__":
+    raise SystemExit(main().run())

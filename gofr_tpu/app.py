@@ -1,0 +1,301 @@
+"""The App (reference ``pkg/gofr/gofr.go:35-170``).
+
+Owns config, container, router, middleware, and all servers. Lifecycle:
+
+* ``App()`` — load ``configs/`` dotenv, create the container (datasources by
+  config), initialise tracing (reference ``New()``, ``gofr.go:62-96``);
+* route verbs ``get/post/put/patch/delete`` usable directly or as
+  decorators (reference ``gofr.go:202-219``);
+* ``run()`` — start metrics server (:2121), HTTP server (:8000), gRPC server
+  (:9000, only when a service is registered), and subscriber loops, then
+  block until SIGINT/SIGTERM and shut down gracefully — the drain the
+  reference lacks (``gofr.go:169`` blocks forever; SURVEY §3.1).
+
+Default ports mirror the reference's ``default.go:3-7``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+from typing import Callable, Optional
+
+from gofr_tpu.config.env import new_env_file
+from gofr_tpu.container import Container
+from gofr_tpu.handler import alive_handler, favicon_handler, health_handler, wrap_handler
+from gofr_tpu.http.middleware import (
+    apikey_auth_middleware,
+    basic_auth_middleware,
+    cors_middleware,
+    logging_middleware,
+    metrics_middleware,
+    oauth_middleware,
+    tracer_middleware,
+)
+from gofr_tpu.http.router import Router
+from gofr_tpu.http.server import HTTPServer
+from gofr_tpu.logging import Logger, level_from_string
+from gofr_tpu.tracing import Tracer, exporter_from_config, set_tracer
+
+DEFAULT_HTTP_PORT = 8000
+DEFAULT_GRPC_PORT = 9000
+DEFAULT_METRICS_PORT = 2121
+
+
+class App:
+    def __init__(self, config_dir: str = "./configs", config=None) -> None:
+        bootstrap_logger = Logger()
+        self.config = config if config is not None else new_env_file(config_dir, bootstrap_logger)
+        self.container = Container.create(self.config)
+        self.logger = self.container.logger
+        self.logger.change_level(
+            level_from_string(self.config.get("LOG_LEVEL"), self.logger.level)
+        )
+
+        tracer = Tracer(
+            service_name=self.container.app_name,
+            exporter=exporter_from_config(self.config, self.logger),
+        )
+        set_tracer(tracer)
+        self._tracer = tracer
+
+        self.router = Router(logger=self.logger)
+        # Default chain, reference http/router.go:23-28.
+        self.router.use_middleware(
+            tracer_middleware(tracer),
+            logging_middleware(self.logger),
+            cors_middleware(),
+            metrics_middleware(self.container.metrics),
+        )
+
+        self.http_port = int(self.config.get_or_default("HTTP_PORT", str(DEFAULT_HTTP_PORT)))
+        self.metrics_port = int(
+            self.config.get_or_default("METRICS_PORT", str(DEFAULT_METRICS_PORT))
+        )
+        self.grpc_port = int(self.config.get_or_default("GRPC_PORT", str(DEFAULT_GRPC_PORT)))
+
+        from gofr_tpu.subscriber import SubscriptionManager
+
+        self._subscriptions = SubscriptionManager(self.container)
+        self._grpc_services: list = []
+        self._grpc_server = None
+        self._http_server: Optional[HTTPServer] = None
+        self._metrics_server: Optional[HTTPServer] = None
+        self._stop_event: Optional[asyncio.Event] = None
+
+    # -- routing (reference gofr.go:202-227) -------------------------------
+
+    def add_route(self, method: str, path: str, handler: Callable) -> None:
+        self.router.add(method, path, wrap_handler(handler, self.container))
+
+    def _verb(self, method: str, path: str, handler: Optional[Callable]):
+        if handler is not None:
+            self.add_route(method, path, handler)
+            return handler
+
+        def decorator(fn: Callable):
+            self.add_route(method, path, fn)
+            return fn
+
+        return decorator
+
+    def get(self, path: str, handler: Optional[Callable] = None):
+        return self._verb("GET", path, handler)
+
+    def post(self, path: str, handler: Optional[Callable] = None):
+        return self._verb("POST", path, handler)
+
+    def put(self, path: str, handler: Optional[Callable] = None):
+        return self._verb("PUT", path, handler)
+
+    def patch(self, path: str, handler: Optional[Callable] = None):
+        return self._verb("PATCH", path, handler)
+
+    def delete(self, path: str, handler: Optional[Callable] = None):
+        return self._verb("DELETE", path, handler)
+
+    def use_middleware(self, *mws) -> None:
+        """Custom middleware (reference ``gofr.go:372``)."""
+        self.router.use_middleware(*mws)
+
+    # -- auth enablers (reference gofr.go:310-344) -------------------------
+
+    def enable_basic_auth(self, users: dict[str, str]) -> None:
+        self.router.use_middleware(basic_auth_middleware(users=users))
+
+    def enable_basic_auth_with_validator(self, validate_func) -> None:
+        self.router.use_middleware(
+            basic_auth_middleware(validate_func=validate_func, container=self.container)
+        )
+
+    def enable_api_key_auth(self, *keys: str) -> None:
+        self.router.use_middleware(apikey_auth_middleware(keys=keys))
+
+    def enable_api_key_auth_with_validator(self, validate_func) -> None:
+        self.router.use_middleware(
+            apikey_auth_middleware(validate_func=validate_func, container=self.container)
+        )
+
+    def enable_oauth(self, jwks_url: str, refresh_interval_s: float = 300.0) -> None:
+        from gofr_tpu.http.middleware import JWKSProvider
+
+        provider = JWKSProvider(jwks_url, refresh_interval_s, logger=self.logger)
+        provider.start()
+        self.router.use_middleware(oauth_middleware(jwks=provider))
+
+    # -- pubsub / services / migrations ------------------------------------
+
+    def subscribe(self, topic: str, handler: Optional[Callable] = None):
+        """Register a subscription handler (reference ``gofr.go:346-354``)."""
+        if handler is not None:
+            self._subscriptions.register(topic, handler)
+            return handler
+
+        def decorator(fn: Callable):
+            self._subscriptions.register(topic, fn)
+            return fn
+
+        return decorator
+
+    def add_http_service(self, name: str, address: str, *options) -> None:
+        """Register a downstream service client (reference ``gofr.go:189-199``)."""
+        from gofr_tpu.service import new_http_service
+
+        if name in self.container.services:
+            self.logger.warnf("service %s already registered; overwriting", name)
+        self.container.services[name] = new_http_service(
+            address,
+            self.logger,
+            self.container.metrics,
+            *options,
+        )
+
+    def migrate(self, migrations: dict) -> None:
+        """Run versioned migrations (reference ``gofr.go:243-248``)."""
+        from gofr_tpu.migration import run as run_migrations
+
+        try:
+            run_migrations(migrations, self.container)
+        except Exception:
+            import traceback
+
+            self.logger.errorf("migration panicked:\n%s", traceback.format_exc())
+
+    def add_rest_handlers(self, entity) -> None:
+        """Auto-register CRUD routes for a dataclass entity
+        (reference ``gofr.go:356-369``)."""
+        from gofr_tpu.crud import register_crud_handlers
+
+        register_crud_handlers(self, entity)
+
+    def register_service(self, add_servicer_fn, servicer) -> None:
+        """Register a gRPC service (reference ``gofr.go:55-59``). The server
+        starts only if at least one service is registered
+        (``gofr.go:150-157``)."""
+        self._grpc_services.append((add_servicer_fn, servicer))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _install_wellknown(self) -> None:
+        self.add_route("GET", "/.well-known/health", health_handler(self.container))
+        self.add_route("GET", "/.well-known/alive", alive_handler)
+        self.add_route("GET", "/favicon.ico", favicon_handler)
+
+    async def start(self) -> None:
+        """Bind all servers (ephemeral-port friendly); used by run() and tests."""
+        self._install_wellknown()
+        self.container.mark_started()
+
+        self._metrics_server = HTTPServer(
+            self._metrics_handler(), port=self.metrics_port, logger=self.logger
+        )
+        await self._metrics_server.start()
+        self.metrics_port = self._metrics_server.port
+        self.logger.infof("metrics server started on :%d/metrics", self.metrics_port)
+
+        self._http_server = HTTPServer(self.router, port=self.http_port, logger=self.logger)
+        await self._http_server.start()
+        self.http_port = self._http_server.port
+
+        if self._grpc_services:
+            from gofr_tpu.grpc.server import GRPCServer
+
+            self._grpc_server = GRPCServer(
+                self.grpc_port, self.logger, self.container
+            )
+            for add_fn, servicer in self._grpc_services:
+                self._grpc_server.register(add_fn, servicer)
+            await self._grpc_server.start()
+            self.grpc_port = self._grpc_server.port
+
+        if self.container.tpu is not None and hasattr(self.container.tpu, "start"):
+            await self.container.tpu.start()
+
+        self._subscriptions.start()
+
+    async def stop(self) -> None:
+        await self._subscriptions.stop()
+        if self.container.tpu is not None and hasattr(self.container.tpu, "stop"):
+            await self.container.tpu.stop()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop()
+        for server in (self._http_server, self._metrics_server):
+            if server is not None:
+                await server.shutdown()
+        await self.container.close()
+        self._tracer.shutdown()
+
+    async def _run_async(self) -> None:
+        await self.start()
+        self._stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, self._stop_event.set)
+            except NotImplementedError:
+                pass
+        await self._stop_event.wait()
+        self.logger.info("shutting down gracefully")
+        await self.stop()
+
+    def run(self) -> None:
+        """Blocking entrypoint (reference ``gofr.go:114-170``)."""
+        try:
+            asyncio.run(self._run_async())
+        except KeyboardInterrupt:
+            pass
+
+    # -- metrics endpoint ---------------------------------------------------
+
+    def _metrics_handler(self):
+        from gofr_tpu.http.proto import Response
+        from gofr_tpu.metrics import render_prometheus
+
+        container = self.container
+
+        async def handler(raw) -> Response:
+            path = raw.target.split("?")[0]
+            if path == "/metrics":
+                container.push_system_metrics()
+                body = render_prometheus(container.metrics, app_name=container.app_name)
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "text/plain; version=0.0.4"},
+                    body=body.encode(),
+                )
+            if path == "/.well-known/alive":
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=b'{"status":"UP"}',
+                )
+            return Response(status=404, headers={}, body=b"404 page not found")
+
+        return handler
+
+
+def new_cmd(config_dir: str = "./configs"):
+    """CLI app factory (reference ``gofr.go:99-111``)."""
+    from gofr_tpu.cli import CMDApp
+
+    return CMDApp(config_dir=config_dir)

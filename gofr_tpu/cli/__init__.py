@@ -1,0 +1,12 @@
+"""CLI app (reference: ``pkg/gofr/cmd.go`` + ``pkg/gofr/cmd/``).
+
+``new_cmd()`` builds an app whose routes are regex-matched subcommands over
+``sys.argv``; flags become params and bind reflectively into dataclasses
+(reference ``cmd.go:27-69``, ``cmd/request.go:25-117``). Output goes to
+stdout, errors to stderr (``cmd/responder.go:8-19``). Logs go to
+``CMD_LOGS_FILE`` (reference ``gofr.go:99-111``).
+"""
+
+from gofr_tpu.cli.cmd import CMDApp, CMDRequest, CMDResponder
+
+__all__ = ["CMDApp", "CMDRequest", "CMDResponder"]

@@ -1,0 +1,5 @@
+"""Dependency-injection container (reference: ``pkg/gofr/container``)."""
+
+from gofr_tpu.container.container import Container
+
+__all__ = ["Container"]

@@ -1,0 +1,195 @@
+"""The central DI container (reference ``container/container.go:26-131``).
+
+Owns the logger, metrics manager, and every configured datasource; creates
+each from config at boot exactly like the reference's ``Create``
+(``container/container.go:56-131``): Redis/SQL/PubSub gated on their env
+keys, plus the net-new TPU backend gated on ``TPU_ENABLED``/``TPU_MODEL``
+(SURVEY §2.6: the TPU client is a container member like ``SQL``/``Redis``).
+Aggregate health mirrors ``container/health.go:8-28``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from gofr_tpu.config.env import Config
+from gofr_tpu.logging import Level, Logger, RemoteLevelLogger, level_from_string
+from gofr_tpu.metrics import Manager, new_metrics_manager
+
+
+class Container:
+    def __init__(self, config: Config, logger: Optional[Logger] = None) -> None:
+        self.config = config
+        self.app_name = config.get_or_default("APP_NAME", "gofr-tpu-app")
+        self.app_version = config.get_or_default("APP_VERSION", "dev")
+        self.logger: Logger = logger or Logger(
+            level=level_from_string(config.get("LOG_LEVEL"), Level.INFO)
+        )
+        self.metrics: Manager = new_metrics_manager(self.logger)
+
+        self.sql = None
+        self.redis = None
+        self.pubsub = None
+        self.mongo = None  # injected seam (reference datasource/mongo.go:8)
+        self.tpu = None  # net-new: TPU inference backend (SURVEY §2.6)
+        self.services: dict[str, Any] = {}  # name → service.HTTP clients
+
+        self._remote_logger: Optional[RemoteLevelLogger] = None
+
+    # -- creation (reference container/container.go:41-131) --------------
+
+    @classmethod
+    def create(cls, config: Config, logger: Optional[Logger] = None) -> "Container":
+        c = cls(config, logger=logger)
+        c.logger.infof(
+            "container created for app %s (version %s)", c.app_name, c.app_version
+        )
+
+        remote_url = config.get_or_default("REMOTE_LOG_URL", "")
+        if remote_url:
+            interval = float(config.get_or_default("REMOTE_LOG_FETCH_INTERVAL", "15"))
+            c._remote_logger = RemoteLevelLogger(c.logger, remote_url, interval)
+            c._remote_logger.start()
+
+        c.register_framework_metrics()
+
+        # Datasources are created lazily-by-config, each in its own module so
+        # a missing backend never breaks boot (reference logs and continues).
+        from gofr_tpu.datasource.redis import new_redis_from_config
+
+        c.redis = new_redis_from_config(config, c.logger, c.metrics)
+
+        from gofr_tpu.datasource.sql import new_sql_from_config
+
+        c.sql = new_sql_from_config(config, c.logger, c.metrics)
+
+        from gofr_tpu.datasource.pubsub import new_pubsub_from_config
+
+        c.pubsub = new_pubsub_from_config(config, c.logger, c.metrics)
+
+        from gofr_tpu.serving.backend import new_tpu_from_config
+
+        c.tpu = new_tpu_from_config(config, c.logger, c.metrics)
+        return c
+
+    def use_mongo(self, client) -> None:
+        """User-injected Mongo driver (reference ``gofr.go:376-378``)."""
+        self.mongo = client
+
+    # -- service registry (reference gofr.go:189-199) ---------------------
+
+    def get_http_service(self, name: str):
+        return self.services.get(name)
+
+    def get_publisher(self):
+        return self.pubsub
+
+    def get_subscriber(self):
+        return self.pubsub
+
+    # -- framework metrics (reference container/container.go:143-172) -----
+
+    def register_framework_metrics(self) -> None:
+        m = self.metrics
+        # System / app metrics.
+        m.new_gauge("app_go_routines", "number of async tasks + threads")
+        m.new_gauge("app_sys_memory_alloc", "resident memory bytes")
+        m.new_gauge("app_sys_total_alloc", "total allocated bytes")
+        m.new_gauge("app_go_numGC", "gc collection count")
+        m.new_gauge("app_go_sys", "runtime sys bytes")
+        # HTTP server/client (buckets follow container.go:153-154).
+        http_buckets = (0.001, 0.003, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30)
+        m.new_histogram("app_http_response", "HTTP server response time in s", http_buckets)
+        m.new_histogram(
+            "app_http_service_response", "outbound HTTP client response time in s", http_buckets
+        )
+        # Redis / SQL (container.go:158-163).
+        m.new_histogram(
+            "app_redis_stats", "redis command duration in ms",
+            (0.05, 0.075, 0.1, 0.125, 0.15, 0.2, 0.3, 0.5, 0.75, 1, 2, 3),
+        )
+        m.new_histogram(
+            "app_sql_stats", "sql query duration in ms",
+            (0.05, 0.075, 0.1, 0.125, 0.15, 0.2, 0.3, 0.5, 0.75, 1, 2, 3, 4, 5, 7.5, 10),
+        )
+        m.new_gauge("app_sql_open_connections", "open sql connections")
+        m.new_gauge("app_sql_inUse_connections", "in-use sql connections")
+        # PubSub.
+        m.new_counter("app_pubsub_publish_total_count", "messages published")
+        m.new_counter("app_pubsub_publish_success_count", "publish successes")
+        m.new_counter("app_pubsub_subscribe_total_count", "subscribe polls")
+        m.new_counter("app_pubsub_subscribe_success_count", "messages handled")
+        # Net-new TPU serving metrics (SURVEY §2.6 per-chip observability).
+        m.new_gauge("app_tpu_queue_depth", "dynamic batcher queue depth")
+        m.new_gauge("app_tpu_hbm_used_bytes", "per-chip HBM in use")
+        m.new_gauge("app_tpu_kv_slots_in_use", "KV-cache slots occupied")
+        m.new_histogram(
+            "app_tpu_infer_latency", "device execute latency in s",
+            (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2, 5),
+        )
+        m.new_histogram(
+            "app_tpu_batch_size", "executed batch sizes",
+            (1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        m.new_counter("app_tpu_tokens_generated", "tokens generated")
+
+    def push_system_metrics(self) -> None:
+        """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""
+        import gc
+        import threading
+
+        self.metrics.set_gauge("app_go_routines", threading.active_count())
+        try:
+            with open("/proc/self/statm") as fp:
+                rss = int(fp.read().split()[1]) * 4096
+        except Exception:
+            rss = 0
+        self.metrics.set_gauge("app_sys_memory_alloc", rss)
+        self.metrics.set_gauge("app_go_numGC", sum(s.get("collections", 0) for s in gc.get_stats()))
+
+    # -- health (reference container/health.go:8-28) ----------------------
+
+    def health(self) -> dict:
+        out: dict[str, Any] = {
+            "name": self.app_name,
+            "version": self.app_version,
+            "status": "UP",
+            "startedAt": getattr(self, "_started_at", ""),
+        }
+        details: dict[str, Any] = {}
+        for name in ("sql", "redis", "pubsub", "tpu", "mongo"):
+            ds = getattr(self, name)
+            if ds is None:
+                continue
+            try:
+                check = ds.health_check()
+            except Exception as exc:
+                check = {"status": "DOWN", "error": str(exc)}
+            details[name] = check
+            if check.get("status") != "UP":
+                out["status"] = "DEGRADED"
+        for svc_name, svc in self.services.items():
+            try:
+                details[f"service:{svc_name}"] = svc.health_check()
+            except Exception as exc:
+                details[f"service:{svc_name}"] = {"status": "DOWN", "error": str(exc)}
+                out["status"] = "DEGRADED"
+        out["details"] = details
+        return out
+
+    def mark_started(self) -> None:
+        self._started_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    async def close(self) -> None:
+        for name in ("sql", "redis", "pubsub", "tpu"):
+            ds = getattr(self, name)
+            if ds is not None and hasattr(ds, "close"):
+                try:
+                    res = ds.close()
+                    if hasattr(res, "__await__"):
+                        await res
+                except Exception:
+                    pass
+        if self._remote_logger is not None:
+            self._remote_logger.stop()

@@ -1,0 +1,34 @@
+"""Pub/Sub datasource (reference: ``pkg/gofr/datasource/pubsub``).
+
+``Message`` doubles as the request object for subscription handlers exactly
+like the reference (``pubsub/message.go:8-52``): ``bind`` JSON-decodes the
+payload and ``param("topic")`` returns the topic. Backends: an in-process
+broker (always available; the seam the reference fills with Kafka/GCP/MQTT),
+selected via ``PUBSUB_BACKEND`` (reference ``container/container.go:85-130``).
+External brokers log-and-skip when their clients aren't present.
+"""
+
+from gofr_tpu.datasource.pubsub.base import Message, PubSubLog
+from gofr_tpu.datasource.pubsub.inproc import InProcBroker
+
+__all__ = ["Message", "PubSubLog", "InProcBroker", "new_pubsub_from_config"]
+
+
+def new_pubsub_from_config(config, logger=None, metrics=None):
+    """Backend switch (reference ``container/container.go:85-130``)."""
+    backend = (config.get_or_default("PUBSUB_BACKEND", "") or "").upper()
+    if not backend:
+        return None
+    if backend == "INPROC":
+        return InProcBroker(logger=logger, metrics=metrics)
+    if backend in ("KAFKA", "GOOGLE", "MQTT"):
+        if logger is not None:
+            logger.errorf(
+                "PUBSUB_BACKEND=%s requires an external client library not "
+                "present in this environment; use INPROC or install the client",
+                backend,
+            )
+        return None
+    if logger is not None:
+        logger.errorf("unsupported PUBSUB_BACKEND %s", backend)
+    return None

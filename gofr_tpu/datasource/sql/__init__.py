@@ -1,0 +1,21 @@
+"""SQL datasource (reference: ``pkg/gofr/datasource/sql``)."""
+
+from gofr_tpu.datasource.sql.db import DB, Tx, new_sql_from_config
+from gofr_tpu.datasource.sql.query_builder import (
+    delete_by_query,
+    insert_query,
+    select_by_query,
+    select_query,
+    update_by_query,
+)
+
+__all__ = [
+    "DB",
+    "Tx",
+    "new_sql_from_config",
+    "insert_query",
+    "select_query",
+    "select_by_query",
+    "update_by_query",
+    "delete_by_query",
+]

@@ -1,0 +1,90 @@
+"""Framework error types.
+
+Mirrors the reference's HTTP error vocabulary (``pkg/gofr/http/errors.go``):
+typed errors that carry their HTTP status so the responder can map
+error → status without stringly-typed checks. Any exception exposing a
+``status_code`` attribute is honored by the responder
+(reference ``http/responder.go:53-74``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+class GofrError(Exception):
+    """Base class; responder maps subclasses via ``status_code``."""
+
+    status_code: int = 500
+
+
+class ErrorEntityNotFound(GofrError):
+    """404 — entity lookup miss (reference ``http/errors.go`` EntityNotFound)."""
+
+    status_code = 404
+
+    def __init__(self, name: str, value: str) -> None:
+        super().__init__(f"No entity found with {name}: {value}")
+        self.name = name
+        self.value = value
+
+
+class ErrorEntityAlreadyExists(GofrError):
+    status_code = 409
+
+    def __init__(self) -> None:
+        super().__init__("entity already exists")
+
+
+class ErrorInvalidParam(GofrError):
+    """400 — invalid parameter(s)."""
+
+    status_code = 400
+
+    def __init__(self, params: Sequence[str] = ()) -> None:
+        self.params = list(params)
+        count = len(self.params)
+        super().__init__(f"'{count}' invalid parameter(s): {', '.join(self.params)}")
+
+
+class ErrorMissingParam(GofrError):
+    status_code = 400
+
+    def __init__(self, params: Sequence[str] = ()) -> None:
+        self.params = list(params)
+        count = len(self.params)
+        super().__init__(f"'{count}' missing parameter(s): {', '.join(self.params)}")
+
+
+class ErrorInvalidRoute(GofrError):
+    status_code = 404
+
+    def __init__(self) -> None:
+        super().__init__("route not registered")
+
+
+class ErrorRequestTimeout(GofrError):
+    status_code = 408
+
+    def __init__(self) -> None:
+        super().__init__("request timed out")
+
+
+class ErrorPanicRecovery(GofrError):
+    """500 — handler raised an unexpected exception
+    (reference ``http/middleware/logger.go:121-146``)."""
+
+    status_code = 500
+
+    def __init__(self) -> None:
+        super().__init__("some unexpected error has occurred")
+
+
+class ErrorServiceUnavailable(GofrError):
+    status_code = 503
+
+    def __init__(self, dependency: str = "") -> None:
+        msg = "service unavailable"
+        if dependency:
+            msg += f": {dependency}"
+        super().__init__(msg)

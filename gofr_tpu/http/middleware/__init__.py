@@ -1,0 +1,26 @@
+"""Server middleware (reference: ``pkg/gofr/http/middleware``).
+
+All middleware are ``mw(next) -> handler`` over async
+``handler(RawRequest) -> Response`` — the analog of the reference's
+``func(http.Handler) http.Handler``. The default chain is
+Tracer → Logging → CORS → Metrics (reference ``http/router.go:23-28``).
+"""
+
+from gofr_tpu.http.middleware.tracer import tracer_middleware
+from gofr_tpu.http.middleware.logging_mw import logging_middleware
+from gofr_tpu.http.middleware.metrics_mw import metrics_middleware
+from gofr_tpu.http.middleware.cors import cors_middleware
+from gofr_tpu.http.middleware.basic_auth import basic_auth_middleware
+from gofr_tpu.http.middleware.apikey_auth import apikey_auth_middleware
+from gofr_tpu.http.middleware.oauth import oauth_middleware, JWKSProvider
+
+__all__ = [
+    "tracer_middleware",
+    "logging_middleware",
+    "metrics_middleware",
+    "cors_middleware",
+    "basic_auth_middleware",
+    "apikey_auth_middleware",
+    "oauth_middleware",
+    "JWKSProvider",
+]

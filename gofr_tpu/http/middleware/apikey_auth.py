@@ -1,0 +1,41 @@
+"""API-key auth middleware (reference ``http/middleware/apikey_auth.go:11-57``).
+
+Checks ``X-API-KEY`` against a static key list or a validator function.
+"""
+
+from __future__ import annotations
+
+import json
+
+from gofr_tpu.http.proto import Response
+from gofr_tpu.http.middleware.basic_auth import EXEMPT_PREFIXES
+
+
+def apikey_auth_middleware(keys=(), validate_func=None, container=None):
+    keyset = set(keys)
+
+    def mw(next_handler):
+        async def handler(raw):
+            path = raw.target.split("?")[0]
+            if any(path.startswith(p) for p in EXEMPT_PREFIXES):
+                return await next_handler(raw)
+            key = raw.headers.get("x-api-key", "")
+            if validate_func is not None:
+                ok = (
+                    validate_func(container, key)
+                    if container is not None
+                    else validate_func(key)
+                )
+            else:
+                ok = key in keyset
+            if not ok:
+                return Response(
+                    status=401,
+                    headers={"Content-Type": "application/json"},
+                    body=json.dumps({"error": {"message": "Unauthorized"}}).encode(),
+                )
+            return await next_handler(raw)
+
+        return handler
+
+    return mw

@@ -1,0 +1,57 @@
+"""Basic auth middleware (reference ``http/middleware/basic_auth.go:18-73``).
+
+Validates ``Authorization: Basic`` against a static user→password map or a
+user-supplied validate function. Well-known probe routes are exempt
+(reference ``http/middleware/validate.go:5-7``).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from gofr_tpu.http.proto import Response
+
+EXEMPT_PREFIXES = ("/.well-known/",)
+
+
+def _unauthorized(msg: str = "Unauthorized") -> Response:
+    return Response(
+        status=401,
+        headers={"Content-Type": "application/json", "WWW-Authenticate": "Basic"},
+        body=json.dumps({"error": {"message": msg}}).encode(),
+    )
+
+
+def basic_auth_middleware(users: dict[str, str] | None = None, validate_func=None, container=None):
+    def mw(next_handler):
+        async def handler(raw):
+            path = raw.target.split("?")[0]
+            if any(path.startswith(p) for p in EXEMPT_PREFIXES):
+                return await next_handler(raw)
+            header = raw.headers.get("authorization", "")
+            if not header.startswith("Basic "):
+                return _unauthorized()
+            try:
+                decoded = base64.b64decode(header[6:]).decode("utf-8")
+                username, _, password = decoded.partition(":")
+            except Exception:
+                return _unauthorized("invalid authorization header")
+            if validate_func is not None:
+                # Reference passes the container to custom validators
+                # (gofr.go:316 EnableBasicAuthWithValidator).
+                ok = (
+                    validate_func(container, username, password)
+                    if container is not None
+                    else validate_func(username, password)
+                )
+                if not ok:
+                    return _unauthorized()
+            elif users is None or users.get(username) != password:
+                return _unauthorized()
+            raw.ctx_data["auth.user"] = username
+            return await next_handler(raw)
+
+        return handler
+
+    return mw

@@ -1,0 +1,160 @@
+"""OAuth / JWT middleware (reference ``http/middleware/oauth.go:22-194``).
+
+* :class:`JWKSProvider` refreshes a JWKS endpoint on a background daemon
+  thread and caches RSA public keys by ``kid``
+  (reference ``oauth.go:53-86,94-140``);
+* the middleware parses ``Authorization: Bearer`` JWTs (RS256 via the
+  ``cryptography`` package, HS256 via hmac for shared-secret setups),
+  validates signature + ``exp``, and stashes claims in the request context
+  under ``"JWTClaims"`` (reference ``oauth.go:143-194``).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.request
+
+from gofr_tpu.http.proto import Response
+from gofr_tpu.http.middleware.basic_auth import EXEMPT_PREFIXES
+
+
+def _b64url_decode(segment: str) -> bytes:
+    pad = "=" * (-len(segment) % 4)
+    return base64.urlsafe_b64decode(segment + pad)
+
+
+def _b64url_to_int(segment: str) -> int:
+    return int.from_bytes(_b64url_decode(segment), "big")
+
+
+class JWKSProvider:
+    """kid → RSA public key cache with periodic refresh."""
+
+    def __init__(self, jwks_url: str, refresh_interval_s: float = 300.0, logger=None) -> None:
+        self._url = jwks_url
+        self._interval = refresh_interval_s
+        self._logger = logger
+        self._keys: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.refresh()
+        self._thread = threading.Thread(target=self._run, name="jwks-refresh", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.refresh()
+
+    def refresh(self) -> None:
+        try:
+            with urllib.request.urlopen(self._url, timeout=5) as resp:
+                jwks = json.loads(resp.read().decode())
+            keys = {}
+            for jwk in jwks.get("keys", []):
+                if jwk.get("kty") != "RSA":
+                    continue
+                try:
+                    from cryptography.hazmat.primitives.asymmetric.rsa import (
+                        RSAPublicNumbers,
+                    )
+
+                    pub = RSAPublicNumbers(
+                        e=_b64url_to_int(jwk["e"]), n=_b64url_to_int(jwk["n"])
+                    ).public_key()
+                    keys[jwk.get("kid", "")] = pub
+                except Exception:
+                    continue
+            with self._lock:
+                self._keys = keys
+        except Exception as exc:
+            if self._logger is not None:
+                self._logger.debugf("JWKS refresh failed: %s", exc)
+
+    def key(self, kid: str):
+        with self._lock:
+            return self._keys.get(kid)
+
+
+def _verify_jwt(token: str, *, jwks: JWKSProvider | None, hs_secret: bytes | None):
+    """Returns claims dict or raises ValueError."""
+    try:
+        header_b64, payload_b64, sig_b64 = token.split(".")
+        header = json.loads(_b64url_decode(header_b64))
+        payload = json.loads(_b64url_decode(payload_b64))
+        signature = _b64url_decode(sig_b64)
+    except Exception as exc:
+        raise ValueError("malformed token") from exc
+
+    alg = header.get("alg")
+    signing_input = f"{header_b64}.{payload_b64}".encode()
+    if alg == "RS256":
+        if jwks is None:
+            raise ValueError("no JWKS provider configured")
+        key = jwks.key(header.get("kid", ""))
+        if key is None:
+            raise ValueError("unknown key id")
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+        from cryptography.exceptions import InvalidSignature
+
+        try:
+            key.verify(signature, signing_input, padding.PKCS1v15(), hashes.SHA256())
+        except InvalidSignature as exc:
+            raise ValueError("invalid signature") from exc
+    elif alg == "HS256":
+        if hs_secret is None:
+            raise ValueError("no shared secret configured")
+        expected = hmac.new(hs_secret, signing_input, hashlib.sha256).digest()
+        if not hmac.compare_digest(expected, signature):
+            raise ValueError("invalid signature")
+    else:
+        raise ValueError(f"unsupported alg {alg}")
+
+    exp = payload.get("exp")
+    if exp is not None and time.time() > float(exp):
+        raise ValueError("token expired")
+    return payload
+
+
+def oauth_middleware(jwks: JWKSProvider | None = None, hs_secret: bytes | None = None):
+    def mw(next_handler):
+        async def handler(raw):
+            path = raw.target.split("?")[0]
+            if any(path.startswith(p) for p in EXEMPT_PREFIXES):
+                return await next_handler(raw)
+            header = raw.headers.get("authorization", "")
+            if not header.startswith("Bearer "):
+                return _unauthorized("authorization header missing")
+            try:
+                claims = _verify_jwt(header[7:], jwks=jwks, hs_secret=hs_secret)
+            except ValueError as exc:
+                return _unauthorized(str(exc))
+            # Claims key matches the reference's JWTClaim context key
+            # (oauth.go:22-24) so handlers find them under one name.
+            raw.ctx_data["JWTClaims"] = claims
+            return await next_handler(raw)
+
+        return handler
+
+    return mw
+
+
+def _unauthorized(msg: str) -> Response:
+    return Response(
+        status=401,
+        headers={"Content-Type": "application/json"},
+        body=json.dumps({"error": {"message": msg}}).encode(),
+    )

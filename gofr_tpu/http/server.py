@@ -1,0 +1,140 @@
+"""Asyncio HTTP/1.1 server.
+
+Plays the role of ``net/http.Server`` in the reference (``httpServer.go:12-36``)
+but adds what the reference lacks: graceful shutdown with connection draining
+(the reference's ``Run()`` blocks forever, ``gofr.go:169`` — SURVEY §3.1 flags
+this as a gap the build must close, since queued batched inference makes
+drain-on-shutdown mandatory).
+
+* per-connection read deadline mirroring the reference's 5s
+  ``ReadHeaderTimeout`` (``httpServer.go:27``);
+* keep-alive with pipelined sequential requests;
+* the handler is ``async fn(RawRequest) -> Response``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional
+
+from gofr_tpu.http.proto import (
+    ProtocolError,
+    RawRequest,
+    Response,
+    read_request,
+    serialize_response,
+)
+
+Handler = Callable[[RawRequest], Awaitable[Response]]
+
+READ_HEADER_TIMEOUT_S = 5.0  # reference httpServer.go:27
+KEEPALIVE_IDLE_TIMEOUT_S = 75.0
+
+
+class HTTPServer:
+    def __init__(
+        self,
+        handler: Handler,
+        port: int,
+        host: str = "0.0.0.0",
+        logger=None,
+    ) -> None:
+        self._handler = handler
+        self.host = host
+        self.port = port
+        self._logger = logger
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: set[asyncio.StreamWriter] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, host=self.host, port=self.port
+        )
+        # Port 0 → pick the bound port back up for tests.
+        sock = self._server.sockets[0]
+        self.port = sock.getsockname()[1]
+        if self._logger is not None:
+            self._logger.infof("HTTP server started on :%d", self.port)
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def shutdown(self) -> None:
+        """Stop accepting, then drain open connections."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for writer in list(self._conns):
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _serve_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        self._conns.add(writer)
+        try:
+            first = True
+            while True:
+                timeout = READ_HEADER_TIMEOUT_S if first else KEEPALIVE_IDLE_TIMEOUT_S
+                try:
+                    raw = await asyncio.wait_for(read_request(reader, peer=peer), timeout)
+                except asyncio.TimeoutError:
+                    break
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                except ProtocolError as exc:
+                    writer.write(
+                        serialize_response(
+                            Response(
+                                status=exc.status,
+                                headers={"Content-Type": "text/plain"},
+                                body=str(exc).encode(),
+                            ),
+                            keep_alive=False,
+                        )
+                    )
+                    await _safe_drain(writer)
+                    break
+                if raw is None:
+                    break
+                first = False
+
+                try:
+                    resp = await self._handler(raw)
+                except Exception as exc:  # framework-level last resort
+                    if self._logger is not None:
+                        self._logger.errorf("unhandled server error: %s", exc)
+                    resp = Response(
+                        status=500,
+                        headers={"Content-Type": "application/json"},
+                        body=b'{"error":{"message":"Internal Server Error"}}',
+                    )
+
+                keep = raw.keep_alive
+                writer.write(
+                    serialize_response(
+                        resp, head_only=(raw.method == "HEAD"), keep_alive=keep
+                    )
+                )
+                if not await _safe_drain(writer):
+                    break
+                if not keep:
+                    break
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def _safe_drain(writer: asyncio.StreamWriter) -> bool:
+    try:
+        await writer.drain()
+        return True
+    except (ConnectionResetError, BrokenPipeError):
+        return False

@@ -18,7 +18,7 @@ class Level(enum.IntEnum):
         """ANSI 256-color code for terminal pretty printing
         (reference ``logging/level.go:33-50``)."""
         return {
-            Level.DEBUG: 256,  # default
+            Level.DEBUG: 7,  # light grey
             Level.INFO: 6,  # cyan
             Level.NOTICE: 6,
             Level.WARN: 3,  # yellow

@@ -15,8 +15,8 @@ Capability parity with the reference's ``logging/logger.go:17-196``:
 
 from __future__ import annotations
 
-import io
 import json
+import os
 import sys
 import threading
 import time
@@ -85,7 +85,12 @@ class Logger:
             return
         fp = self._err if level >= Level.ERROR else self._out
         if fmt is not None:
-            message: Any = (fmt % args) if args else fmt
+            # Never let a bad format string crash the caller (Go's Sprintf
+            # contract: formatting errors degrade, they don't panic).
+            try:
+                message: Any = (fmt % args) if args else fmt
+            except (TypeError, ValueError):
+                message = f"{fmt} {args!r}"
         elif len(args) == 1:
             message = args[0]
         else:
@@ -192,7 +197,7 @@ def new_file_logger(path: str) -> Logger:
     discarding output when ``CMD_LOGS_FILE`` is unset.
     """
     if not path:
-        sink: TextIO = io.StringIO()
+        sink: TextIO = open(os.devnull, "w", encoding="utf-8")
     else:
         sink = open(path, "a", encoding="utf-8")
     return Logger(level=Level.INFO, out=sink, err=sink, is_terminal=False)

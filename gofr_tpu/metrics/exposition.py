@@ -67,9 +67,8 @@ def render_prometheus(manager: Manager, app_name: str = "gofr-tpu-app") -> str:
                 cumulative = 0
                 for bound, c in zip(inst.buckets, counts):
                     cumulative += c
-                    out.append(
-                        f"{inst.name}_bucket{_fmt_labels(key, f'le=\"{bound}\"')} {cumulative}\n"
-                    )
+                    le = 'le="' + str(bound) + '"'
+                    out.append(f"{inst.name}_bucket{_fmt_labels(key, le)} {cumulative}\n")
                 cumulative += counts[-1]
                 out.append(
                     f"{inst.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} {cumulative}\n"

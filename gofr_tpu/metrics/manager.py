@@ -13,9 +13,9 @@ Capability parity with the reference's ``metrics/register.go:15-270``:
 * gauges are *settable* synchronous gauges keyed by label set — the reference
   built a custom callback gauge for exactly this (``register.go:41-43``).
 
-TPU-first deltas: recording is lock-striped and allocation-light so it can sit
-on the request/decode hot path, and the serving engine registers per-chip
-gauges (queue depth, HBM used) on the same registry.
+TPU-first deltas: locking is per-instrument so unrelated metrics never
+contend on the request/decode hot path, and the serving engine registers
+per-chip gauges (queue depth, HBM used) on the same registry.
 """
 
 from __future__ import annotations
@@ -151,36 +151,53 @@ class Manager:
         if inst is None:
             self._log_error(f"metrics {name} is not registered")
             return None
-        if not isinstance(inst, cls) or type(inst) is not cls:
+        # Exact-type match: an UpDownCounter may not be used as a Counter.
+        if type(inst) is not cls:
             self._log_error(f"metrics {name} is not of type {cls.__name__}")
             return None
         return inst
 
     def increment_counter(self, name: str, *labels) -> None:
         inst = self._get(name, Counter)
-        if inst is not None:
-            self._record(inst, lambda: inst.add(1.0, labels))
+        if inst is None:
+            return
+        try:
+            inst.add(1.0, labels)
+        except ValueError as exc:
+            self._log_error(f"metrics {name}: {exc}")
+            return
+        self._check_cardinality(inst)
 
     def delta_updown_counter(self, name: str, value: float, *labels) -> None:
         inst = self._get(name, UpDownCounter)
-        if inst is not None:
-            self._record(inst, lambda: inst.add(value, labels))
+        if inst is None:
+            return
+        try:
+            inst.add(value, labels)
+        except ValueError as exc:
+            self._log_error(f"metrics {name}: {exc}")
+            return
+        self._check_cardinality(inst)
 
     def record_histogram(self, name: str, value: float, *labels) -> None:
         inst = self._get(name, Histogram)
-        if inst is not None:
-            self._record(inst, lambda: inst.record(value, labels))
+        if inst is None:
+            return
+        try:
+            inst.record(value, labels)
+        except ValueError as exc:
+            self._log_error(f"metrics {name}: {exc}")
+            return
+        self._check_cardinality(inst)
 
     def set_gauge(self, name: str, value: float, *labels) -> None:
         inst = self._get(name, Gauge)
-        if inst is not None:
-            self._record(inst, lambda: inst.set(value, labels))
-
-    def _record(self, inst: _Instrument, fn) -> None:
+        if inst is None:
+            return
         try:
-            fn()
+            inst.set(value, labels)
         except ValueError as exc:
-            self._log_error(f"metrics {inst.name}: {exc}")
+            self._log_error(f"metrics {name}: {exc}")
             return
         self._check_cardinality(inst)
 

@@ -1,0 +1,5 @@
+"""Versioned data migrations (reference: ``pkg/gofr/migration``)."""
+
+from gofr_tpu.migration.migration import Migrate, MigrationDatasources, run
+
+__all__ = ["Migrate", "MigrationDatasources", "run"]

@@ -1,0 +1,8 @@
+"""TPU serving stack (net-new; SURVEY §2.6).
+
+The graft the reference never had: a JAX/XLA inference backend living in the
+container like any other datasource (``TPU()`` member), a dynamic batcher
+coalescing concurrent requests into padded executions, a slot-based KV cache
+for autoregressive decode, and per-chip observability on the framework
+metrics registry.
+"""

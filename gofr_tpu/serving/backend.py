@@ -1,0 +1,28 @@
+"""TPU backend container member (net-new; SURVEY §2.6 maps it onto the
+reference's datasource idiom: config-gated init in the container like
+``container/container.go:81-83``, health check like ``sql/health.go:27``).
+
+``new_tpu_from_config`` is the container seam. It is gated on ``TPU_MODEL``
+so apps that don't serve models never import jax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
+    model = config.get_or_default("TPU_MODEL", "")
+    if not model:
+        return None
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    try:
+        engine = InferenceEngine.from_config(config, logger=logger, metrics=metrics)
+        if logger is not None:
+            logger.infof("TPU backend initialised with model %s", model)
+        return engine
+    except Exception as exc:
+        if logger is not None:
+            logger.errorf("could not initialise TPU backend: %s", exc)
+        return None

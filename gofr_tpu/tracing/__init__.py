@@ -1,0 +1,37 @@
+"""Distributed tracing (reference: OTel wiring in ``gofr.go:250-300`` +
+``http/middleware/tracer.go`` + ``exporter.go``).
+
+A lightweight native tracer: W3C ``traceparent`` propagation, contextvar-scoped
+spans, and pluggable batch exporters (console, Zipkin-JSON over HTTP — the
+shape of the reference's custom exporter, ``exporter.go:58-130``).
+"""
+
+from gofr_tpu.tracing.tracer import (
+    Span,
+    Tracer,
+    current_span,
+    extract_traceparent,
+    get_tracer,
+    inject_traceparent,
+    set_tracer,
+)
+from gofr_tpu.tracing.exporter import (
+    ConsoleExporter,
+    NoopExporter,
+    ZipkinExporter,
+    exporter_from_config,
+)
+
+__all__ = [
+    "Span",
+    "Tracer",
+    "current_span",
+    "get_tracer",
+    "set_tracer",
+    "extract_traceparent",
+    "inject_traceparent",
+    "ConsoleExporter",
+    "NoopExporter",
+    "ZipkinExporter",
+    "exporter_from_config",
+]

@@ -1,0 +1,125 @@
+"""Trace exporters (reference ``exporter.go:22-130`` + ``gofr.go:250-300``).
+
+Completed spans are queued and shipped by a background daemon thread in
+Zipkin-style JSON batches — the exact shape of the reference's custom
+exporter (``exporter.go:58-96`` builds ``[{id, traceId, parentId, name,
+timestamp, duration, tags}]``). Console and noop exporters cover dev/test.
+
+Selection mirrors the reference's env switch (``gofr.go:251-253``):
+``TRACE_EXPORTER`` ∈ {zipkin, console, none} + ``TRACER_URL``.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.request
+
+
+class NoopExporter:
+    def export(self, span, service_name: str) -> None:  # noqa: ARG002
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+
+class ConsoleExporter:
+    def __init__(self, logger=None) -> None:
+        self._logger = logger
+
+    def export(self, span, service_name: str) -> None:
+        line = {
+            "traceId": span.trace_id,
+            "id": span.span_id,
+            "parentId": span.parent_id,
+            "name": span.name,
+            "durationUs": span.duration_us,
+            "service": service_name,
+            "tags": {str(k): str(v) for k, v in span.attributes.items()},
+        }
+        if self._logger is not None:
+            self._logger.debug(line)
+        else:
+            print(json.dumps(line))
+
+
+class ZipkinExporter:
+    """Batching Zipkin-JSON HTTP exporter (reference ``exporter.go:48-130``)."""
+
+    def __init__(self, url: str, logger=None, batch_size: int = 64, flush_interval_s: float = 2.0) -> None:
+        self._url = url
+        self._logger = logger
+        self._batch_size = batch_size
+        self._interval = flush_interval_s
+        self._queue: queue.Queue = queue.Queue(maxsize=4096)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="trace-exporter", daemon=True)
+        self._thread.start()
+
+    def export(self, span, service_name: str) -> None:
+        try:
+            self._queue.put_nowait((span, service_name))
+        except queue.Full:
+            pass  # drop rather than block the request path
+
+    def _convert(self, span, service_name: str) -> dict:
+        # Zipkin span JSON (reference exporter.go:58-96).
+        out = {
+            "traceId": span.trace_id,
+            "id": span.span_id,
+            "name": span.name,
+            "timestamp": span.start_ns // 1000,
+            "duration": span.duration_us,
+            "localEndpoint": {"serviceName": service_name},
+            "tags": {str(k): str(v) for k, v in span.attributes.items()},
+        }
+        if span.parent_id:
+            out["parentId"] = span.parent_id
+        return out
+
+    def _run(self) -> None:
+        batch: list[dict] = []
+        while not self._stop.is_set():
+            try:
+                span, svc = self._queue.get(timeout=self._interval)
+                batch.append(self._convert(span, svc))
+            except queue.Empty:
+                pass
+            if batch and (len(batch) >= self._batch_size or self._queue.empty()):
+                self._post(batch)
+                batch = []
+        while not self._queue.empty():
+            span, svc = self._queue.get_nowait()
+            batch.append(self._convert(span, svc))
+        if batch:
+            self._post(batch)
+
+    def _post(self, batch: list[dict]) -> None:
+        try:
+            req = urllib.request.Request(
+                self._url,
+                data=json.dumps(batch).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=5).read()
+        except Exception as exc:
+            if self._logger is not None:
+                self._logger.debugf("trace export failed: %s", exc)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def exporter_from_config(config, logger=None):
+    """Reference ``gofr.go:250-300``: TRACE_EXPORTER + TRACER_URL select the sink."""
+    name = (config.get_or_default("TRACE_EXPORTER", "") or "").lower()
+    url = config.get_or_default("TRACER_URL", "")
+    if name in ("zipkin", "gofr", "jaeger") and url:
+        return ZipkinExporter(url, logger=logger)
+    if name == "console":
+        return ConsoleExporter(logger=logger)
+    return NoopExporter()

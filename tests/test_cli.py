@@ -1,0 +1,81 @@
+"""CLI app tests (reference ``cmd_test.go`` patterns: route matching, flag
+parsing, stdout/stderr split)."""
+
+import io
+from dataclasses import dataclass
+
+from gofr_tpu.cli import CMDApp, CMDRequest
+from gofr_tpu.config import MockConfig
+
+
+def make_app() -> CMDApp:
+    return CMDApp(config=MockConfig({}))
+
+
+def run(app, argv):
+    out, err = io.StringIO(), io.StringIO()
+    code = app.run(argv, out=out, err=err)
+    return code, out.getvalue(), err.getvalue()
+
+
+def test_subcommand_dispatch():
+    app = make_app()
+
+    @app.sub_command("^hello")
+    def hello(ctx):
+        return "Hello World!"
+
+    code, out, err = run(app, ["hello"])
+    assert (code, out.strip(), err) == (0, "Hello World!", "")
+
+
+def test_unknown_command():
+    app = make_app()
+    app.sub_command("^known", lambda ctx: "ok")
+    code, out, err = run(app, ["unknown"])
+    assert code == 1
+    assert "No Command Found!" in err
+
+
+def test_flags_become_params():
+    app = make_app()
+
+    @app.sub_command("^greet")
+    def greet(ctx):
+        return f"Hi {ctx.param('name')}, verbose={ctx.param('verbose')}"
+
+    code, out, _ = run(app, ["greet", "-name=Ada", "--verbose"])
+    assert "Hi Ada, verbose=true" in out
+
+
+def test_bind_dataclass():
+    @dataclass
+    class Args:
+        name: str = ""
+        count: int = 0
+
+    req = CMDRequest(["run", "-name=x", "-count=3"])
+    args = req.bind(Args)
+    assert args == Args(name="x", count=3)
+    assert req.command == "run"
+
+
+def test_handler_error_to_stderr():
+    app = make_app()
+
+    @app.sub_command("^fail")
+    def fail(ctx):
+        raise ValueError("boom")
+
+    code, out, err = run(app, ["fail"])
+    assert code == 1
+    assert "boom" in err
+    assert out == ""
+
+
+def test_regex_first_match_wins():
+    app = make_app()
+    app.sub_command("^job run", lambda ctx: "specific")
+    app.sub_command("^job", lambda ctx: "generic")
+    _, out, _ = run(app, ["job", "run"])
+    assert out.strip() == "specific"

@@ -1,0 +1,218 @@
+"""Datasource tests (reference patterns: sqlmock for SQL, miniredis for
+Redis, mocked brokers for pub/sub — SURVEY §4)."""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass, field
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.datasource.pubsub import InProcBroker, new_pubsub_from_config
+from gofr_tpu.datasource.redis import MiniRedis, Redis, new_redis_from_config
+from gofr_tpu.datasource.sql import (
+    delete_by_query,
+    insert_query,
+    new_sql_from_config,
+    select_by_query,
+    select_query,
+    update_by_query,
+)
+from gofr_tpu.logging import Level, Logger
+
+
+@dataclass
+class Employee:
+    id: int = 0
+    name: str = ""
+    dept_name: str = field(default="", metadata={"db": "department"})
+
+
+# ---------------- SQL ----------------
+
+
+@pytest.fixture
+def db():
+    cfg = MockConfig({"DB_DIALECT": "sqlite", "DB_NAME": ":memory:"})
+    db = new_sql_from_config(cfg)
+    assert db is not None
+    db.exec("CREATE TABLE employee (id INTEGER PRIMARY KEY, name TEXT, department TEXT)")
+    yield db
+    db.close()
+
+
+def test_sql_exec_query_roundtrip(db):
+    res = db.exec("INSERT INTO employee (name, department) VALUES (?, ?)", "Ada", "eng")
+    assert res.last_insert_id == 1
+    rows = db.query("SELECT * FROM employee")
+    assert rows == [{"id": 1, "name": "Ada", "department": "eng"}]
+    assert db.query_row("SELECT name FROM employee WHERE id = ?", 1) == {"name": "Ada"}
+    assert db.query_row("SELECT name FROM employee WHERE id = ?", 99) is None
+
+
+def test_sql_select_binds_dataclass(db):
+    db.exec("INSERT INTO employee (name, department) VALUES (?, ?)", "Ada", "eng")
+    out = db.select(Employee, "SELECT * FROM employee")
+    assert out == [Employee(id=1, name="Ada", dept_name="eng")]
+
+
+def test_sql_transactions_commit_and_rollback(db):
+    tx = db.begin()
+    tx.exec("INSERT INTO employee (name) VALUES (?)", "A")
+    tx.commit()
+    assert len(db.query("SELECT * FROM employee")) == 1
+
+    tx = db.begin()
+    tx.exec("INSERT INTO employee (name) VALUES (?)", "B")
+    tx.rollback()
+    assert len(db.query("SELECT * FROM employee")) == 1
+
+
+def test_sql_health(db):
+    h = db.health_check()
+    assert h["status"] == "UP"
+    assert h["details"]["dialect"] == "sqlite"
+
+
+def test_sql_unconfigured_returns_none():
+    assert new_sql_from_config(MockConfig({})) is None
+
+
+def test_query_builder_dialects():
+    assert (
+        insert_query("mysql", "user", ["id", "name"])
+        == "INSERT INTO `user` (`id`, `name`) VALUES (?, ?)"
+    )
+    assert (
+        insert_query("postgres", "user", ["id", "name"])
+        == 'INSERT INTO "user" ("id", "name") VALUES ($1, $2)'
+    )
+    assert select_query("mysql", "user") == "SELECT * FROM `user`"
+    assert (
+        select_by_query("postgres", "user", "id") == 'SELECT * FROM "user" WHERE "id" = $1'
+    )
+    assert (
+        update_by_query("mysql", "user", ["name"], "id")
+        == "UPDATE `user` SET `name` = ? WHERE `id` = ?"
+    )
+    assert (
+        delete_by_query("postgres", "user", "id") == 'DELETE FROM "user" WHERE "id" = $1'
+    )
+
+
+# ---------------- Redis ----------------
+
+
+@pytest.fixture
+def mini():
+    server = MiniRedis().start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture
+def redis_client(mini):
+    client = Redis("127.0.0.1", mini.port)
+    yield client
+    client.close()
+
+
+def test_redis_strings(redis_client):
+    assert redis_client.set("k", "v") == "OK"
+    assert redis_client.get("k") == "v"
+    assert redis_client.get("missing") is None
+    assert redis_client.delete("k") == 1
+    assert redis_client.exists("k") == 0
+
+
+def test_redis_incr_expire_ttl(redis_client):
+    assert redis_client.incr("n") == 1
+    assert redis_client.incr("n") == 2
+    assert redis_client.expire("n", 100) == 1
+    assert 0 < redis_client.ttl("n") <= 100
+
+
+def test_redis_hashes(redis_client):
+    redis_client.hset("h", "a", "1", "b", "2")
+    assert redis_client.hget("h", "a") == "1"
+    assert redis_client.hgetall("h") == {"a": "1", "b": "2"}
+    assert redis_client.hdel("h", "a") == 1
+
+
+def test_redis_lists_and_sets(redis_client):
+    redis_client.rpush("l", "1", "2", "3")
+    assert redis_client.lrange("l", 0, -1) == ["1", "2", "3"]
+    redis_client.sadd("s", "x", "y", "x")
+    assert sorted(redis_client.smembers("s")) == ["x", "y"]
+
+
+def test_redis_tx_pipeline(redis_client):
+    pipe = redis_client.tx_pipeline()
+    pipe.set("a", "1").hset("h2", "f", "v")
+    replies = pipe.exec()
+    assert len(replies) == 2
+    assert redis_client.get("a") == "1"
+
+
+def test_redis_health_and_logging(mini):
+    out = io.StringIO()
+    logger = Logger(level=Level.DEBUG, out=out, err=out, is_terminal=False)
+    cfg = MockConfig({"REDIS_HOST": "127.0.0.1", "REDIS_PORT": str(mini.port)})
+    client = new_redis_from_config(cfg, logger=logger)
+    assert client is not None
+    assert client.health_check()["status"] == "UP"
+    client.get("x")
+    assert "REDIS" in out.getvalue()
+    client.close()
+
+
+def test_redis_unreachable_returns_none():
+    cfg = MockConfig({"REDIS_HOST": "127.0.0.1", "REDIS_PORT": "1"})
+    out = io.StringIO()
+    logger = Logger(level=Level.DEBUG, out=out, err=out, is_terminal=False)
+    assert new_redis_from_config(cfg, logger=logger) is None
+    assert "could not connect" in out.getvalue()
+
+
+# ---------------- PubSub ----------------
+
+
+def test_inproc_publish_subscribe_commit():
+    broker = InProcBroker()
+    broker.publish("orders", b'{"id": 1}')
+    msg = broker.subscribe("orders", timeout=1)
+    assert msg is not None
+    assert msg.topic == "orders"
+    assert msg.json() == {"id": 1}
+    assert msg.param("topic") == "orders"
+    msg.commit()
+    assert msg.committed
+
+
+def test_inproc_subscribe_timeout_returns_none():
+    broker = InProcBroker()
+    assert broker.subscribe("empty", timeout=0.05) is None
+
+
+def test_pubsub_factory():
+    assert new_pubsub_from_config(MockConfig({})) is None
+    broker = new_pubsub_from_config(MockConfig({"PUBSUB_BACKEND": "INPROC"}))
+    assert isinstance(broker, InProcBroker)
+    out = io.StringIO()
+    logger = Logger(level=Level.DEBUG, out=out, err=out, is_terminal=False)
+    assert new_pubsub_from_config(MockConfig({"PUBSUB_BACKEND": "KAFKA"}), logger) is None
+    assert "KAFKA" in out.getvalue()
+
+
+def test_message_bind():
+    from gofr_tpu.datasource.pubsub.base import Message
+
+    @dataclass
+    class Order:
+        id: int = 0
+        item: str = ""
+
+    msg = Message("t", b'{"id": 7, "item": "gpu"}')
+    order = msg.bind(Order)
+    assert order == Order(id=7, item="gpu")

@@ -1,0 +1,232 @@
+"""HTTP spine integration tests.
+
+Mirrors the reference's example-app pattern (SURVEY §4): boot the real app
+in-process on ephemeral ports and assert over real HTTP via http.client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import threading
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MockConfig
+from gofr_tpu.errors import ErrorEntityNotFound
+from gofr_tpu.http.response import File, Raw, Redirect
+
+
+@dataclass
+class Person:
+    name: str = ""
+    age: int = 0
+
+
+class AppHarness:
+    """Runs an App's asyncio lifecycle on a background thread."""
+
+    def __init__(self, app: App) -> None:
+        self.app = app
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+
+    def __enter__(self) -> "AppHarness":
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(self.app.start(), self._loop).result(timeout=10)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        asyncio.run_coroutine_threadsafe(self.app.stop(), self._loop).result(timeout=10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self._loop.close()
+
+    def request(self, method: str, path: str, body=None, headers=None, port=None):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port or self.app.http_port, timeout=5
+        )
+        try:
+            payload = None
+            if body is not None:
+                payload = json.dumps(body).encode() if not isinstance(body, bytes) else body
+            conn.request(method, path, body=payload, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        finally:
+            conn.close()
+
+
+def make_app(**env) -> App:
+    cfg = {"HTTP_PORT": "0", "METRICS_PORT": "0", "APP_NAME": "test-app", **env}
+    return App(config=MockConfig(cfg))
+
+
+@pytest.fixture
+def app_harness():
+    app = make_app()
+
+    @app.get("/hello")
+    def hello(ctx):
+        return f"Hello {ctx.param('name') or 'World'}!"
+
+    @app.get("/items/{id}")
+    def item(ctx):
+        return {"id": ctx.path_param("id")}
+
+    @app.post("/people")
+    def create_person(ctx):
+        p = ctx.bind(Person)
+        return {"name": p.name, "age": p.age}
+
+    @app.delete("/items/{id}")
+    def delete_item(ctx):
+        return None
+
+    @app.get("/missing")
+    def missing(ctx):
+        raise ErrorEntityNotFound("id", "42")
+
+    @app.get("/crash")
+    def crash(ctx):
+        raise RuntimeError("kaboom")
+
+    @app.get("/raw")
+    def raw(ctx):
+        return Raw([1, 2, 3])
+
+    @app.get("/file")
+    def file(ctx):
+        return File(content=b"bytes!", content_type="text/plain")
+
+    @app.get("/redirect")
+    def redirect(ctx):
+        return Redirect("/hello")
+
+    @app.get("/async")
+    async def async_handler(ctx):
+        await asyncio.sleep(0.01)
+        return "async ok"
+
+    with AppHarness(app) as harness:
+        yield harness
+
+
+def test_hello_envelope(app_harness):
+    status, headers, body = app_harness.request("GET", "/hello?name=TPU")
+    assert status == 200
+    assert json.loads(body) == {"data": "Hello TPU!"}
+    assert headers.get("Content-Type") == "application/json"
+    assert headers.get("X-Correlation-ID")  # trace id surfaced
+
+
+def test_path_params(app_harness):
+    status, _, body = app_harness.request("GET", "/items/abc123")
+    assert status == 200
+    assert json.loads(body) == {"data": {"id": "abc123"}}
+
+
+def test_post_bind_and_201(app_harness):
+    status, _, body = app_harness.request(
+        "POST", "/people", body={"name": "Ada", "age": 36}
+    )
+    assert status == 201
+    assert json.loads(body) == {"data": {"name": "Ada", "age": 36}}
+
+
+def test_delete_204(app_harness):
+    status, _, body = app_harness.request("DELETE", "/items/1")
+    assert status == 204
+    assert body == b""
+
+
+def test_typed_error_maps_status(app_harness):
+    status, _, body = app_harness.request("GET", "/missing")
+    assert status == 404
+    assert json.loads(body) == {"error": {"message": "No entity found with id: 42"}}
+
+
+def test_panic_recovery_500(app_harness):
+    status, _, body = app_harness.request("GET", "/crash")
+    assert status == 500
+    assert json.loads(body)["error"]["message"] == "some unexpected error has occurred"
+
+
+def test_route_not_registered_404(app_harness):
+    status, _, body = app_harness.request("GET", "/nope")
+    assert status == 404
+    assert "error" in json.loads(body)
+
+
+def test_method_not_allowed_405(app_harness):
+    status, _, _ = app_harness.request("PUT", "/hello")
+    assert status == 405
+
+
+def test_raw_file_redirect(app_harness):
+    status, _, body = app_harness.request("GET", "/raw")
+    assert (status, json.loads(body)) == (200, [1, 2, 3])
+
+    status, headers, body = app_harness.request("GET", "/file")
+    assert (status, body) == (200, b"bytes!")
+    assert headers["Content-Type"] == "text/plain"
+
+    status, headers, _ = app_harness.request("GET", "/redirect")
+    assert status == 302
+    assert headers["Location"] == "/hello"
+
+
+def test_async_handler(app_harness):
+    status, _, body = app_harness.request("GET", "/async")
+    assert json.loads(body) == {"data": "async ok"}
+
+
+def test_wellknown_health_and_alive(app_harness):
+    status, _, body = app_harness.request("GET", "/.well-known/alive")
+    assert (status, json.loads(body)["data"]["status"]) == (200, "UP")
+
+    status, _, body = app_harness.request("GET", "/.well-known/health")
+    data = json.loads(body)["data"]
+    assert data["status"] == "UP"
+    assert data["name"] == "test-app"
+
+
+def test_cors_preflight(app_harness):
+    status, headers, _ = app_harness.request("OPTIONS", "/hello")
+    assert status == 200
+    assert headers["Access-Control-Allow-Origin"] == "*"
+
+
+def test_metrics_server_scrape(app_harness):
+    app_harness.request("GET", "/hello")  # generate a sample
+    status, headers, body = app_harness.request(
+        "GET", "/metrics", port=app_harness.app.metrics_port
+    )
+    assert status == 200
+    text = body.decode()
+    assert "app_http_response_bucket" in text
+    assert 'path="/hello"' in text
+    assert "process_threads" in text
+
+
+def test_keepalive_multiple_requests(app_harness):
+    conn = http.client.HTTPConnection("127.0.0.1", app_harness.app.http_port, timeout=5)
+    try:
+        for _ in range(3):
+            conn.request("GET", "/hello")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read()
+    finally:
+        conn.close()
+
+
+def test_favicon(app_harness):
+    status, headers, body = app_harness.request("GET", "/favicon.ico")
+    assert status == 200
+    assert headers["Content-Type"] == "image/x-icon"
+    assert body[:4] == b"\x00\x00\x01\x00"

@@ -1,0 +1,92 @@
+"""Migration runner tests (reference ``migration/migration_test.go`` behaviors)."""
+
+import io
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.container import Container
+from gofr_tpu.datasource.redis import MiniRedis, Redis
+from gofr_tpu.logging import Level, Logger
+from gofr_tpu.migration import Migrate, run
+
+
+def make_container(with_redis=False, mini=None):
+    cfg = {"DB_DIALECT": "sqlite", "DB_NAME": ":memory:"}
+    if with_redis:
+        cfg.update({"REDIS_HOST": "127.0.0.1", "REDIS_PORT": str(mini.port)})
+    out = io.StringIO()
+    logger = Logger(level=Level.DEBUG, out=out, err=out, is_terminal=False)
+    c = Container.create(MockConfig(cfg), logger=logger)
+    return c, out
+
+
+def test_migrations_run_in_order_and_track():
+    c, _ = make_container()
+    order = []
+
+    migrations = {
+        2: Migrate(up=lambda ds: order.append(2)),
+        1: Migrate(
+            up=lambda ds: (
+                order.append(1),
+                ds.sql.exec("CREATE TABLE t1 (id INTEGER)"),
+            )
+        ),
+    }
+    run(migrations, c)
+    assert order == [1, 2]
+    rows = c.sql.query("SELECT version FROM gofr_migrations ORDER BY version")
+    assert [r["version"] for r in rows] == [1, 2]
+
+
+def test_migrations_idempotent_on_rerun():
+    c, _ = make_container()
+    count = {"n": 0}
+    migrations = {1: Migrate(up=lambda ds: count.__setitem__("n", count["n"] + 1))}
+    run(migrations, c)
+    run(migrations, c)
+    assert count["n"] == 1
+
+
+def test_failed_migration_rolls_back_and_raises():
+    c, _ = make_container()
+
+    def bad(ds):
+        ds.sql.exec("CREATE TABLE will_rollback (id INTEGER)")
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        run({1: Migrate(up=bad)}, c)
+    # Not recorded as applied; rerun executes it again.
+    assert c.sql.query("SELECT * FROM gofr_migrations") == []
+
+
+def test_invalid_version_rejected():
+    c, _ = make_container()
+    with pytest.raises(ValueError):
+        run({0: Migrate(up=lambda ds: None)}, c)
+    with pytest.raises(ValueError):
+        run({-5: Migrate(up=lambda ds: None)}, c)
+
+
+def test_redis_tracking():
+    mini = MiniRedis().start()
+    try:
+        c, _ = make_container(with_redis=True, mini=mini)
+        run({1: Migrate(up=lambda ds: ds.redis.set("migrated", "yes"))}, c)
+        assert c.redis.get("migrated") == "yes"
+        assert "1" in c.redis.hgetall("gofr_migrations")
+        # Re-run skips.
+        run({1: Migrate(up=lambda ds: ds.redis.set("migrated", "twice"))}, c)
+        assert c.redis.get("migrated") == "yes"
+    finally:
+        mini.stop()
+
+
+def test_no_datasources_warns_and_skips():
+    out = io.StringIO()
+    logger = Logger(level=Level.DEBUG, out=out, err=out, is_terminal=False)
+    c = Container.create(MockConfig({}), logger=logger)
+    run({1: Migrate(up=lambda ds: None)}, c)
+    assert "no datasources" in out.getvalue()
