@@ -1,0 +1,27 @@
+"""Model zoo (net-new; SURVEY §2.6 / BASELINE.json configs).
+
+Families required by BASELINE.json: a Llama-style decoder LM (flagship,
+config 5), BERT-style encoder (config 3), and ResNet-50 (config 2) — each a
+pure-JAX functional model (init/apply over pytrees) designed for the MXU:
+bf16 params, f32 accumulation, scan-over-layers, static shapes.
+"""
+
+from gofr_tpu.models.transformer import (
+    TransformerConfig,
+    init_transformer,
+    transformer_forward,
+    transformer_decode_step,
+    transformer_prefill,
+)
+from gofr_tpu.models.registry import get_model, list_models, register_model
+
+__all__ = [
+    "TransformerConfig",
+    "init_transformer",
+    "transformer_forward",
+    "transformer_prefill",
+    "transformer_decode_step",
+    "get_model",
+    "list_models",
+    "register_model",
+]

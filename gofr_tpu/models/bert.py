@@ -1,0 +1,132 @@
+"""BERT-style bidirectional encoder (BASELINE.json config 3: embedding
+endpoint over gRPC with dynamic batching).
+
+Pure-JAX, scan-over-layers, bf16 with f32 softmax/pooling. ``bert_embed``
+returns mean-pooled, L2-normalised sentence embeddings — the serving payload
+for the embedding endpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_len: int = 512
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_bert(key: jax.Array, cfg: BertConfig) -> dict:
+    D, H, hd, F, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+    ks = jax.random.split(key, 10)
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5
+        ).astype(cfg.dtype)
+
+    return {
+        "tok_embed": dense(ks[0], (cfg.vocab_size, D), D),
+        "pos_embed": dense(ks[1], (cfg.max_len, D), D),
+        "embed_norm_w": jnp.ones((D,), dtype=cfg.dtype),
+        "embed_norm_b": jnp.zeros((D,), dtype=cfg.dtype),
+        "layers": {
+            "wq": dense(ks[2], (L, D, H * hd), D),
+            "wk": dense(ks[3], (L, D, H * hd), D),
+            "wv": dense(ks[4], (L, D, H * hd), D),
+            "wo": dense(ks[5], (L, H * hd, D), D),
+            "w_in": dense(ks[6], (L, D, F), D),
+            "w_out": dense(ks[7], (L, F, D), F),
+            "norm1_w": jnp.ones((L, D), dtype=cfg.dtype),
+            "norm1_b": jnp.zeros((L, D), dtype=cfg.dtype),
+            "norm2_w": jnp.ones((L, D), dtype=cfg.dtype),
+            "norm2_b": jnp.zeros((L, D), dtype=cfg.dtype),
+        },
+    }
+
+
+def bert_param_specs(cfg: BertConfig) -> dict:
+    return {
+        "tok_embed": P("tp", None),
+        "pos_embed": P(None, None),
+        "embed_norm_w": P(None),
+        "embed_norm_b": P(None),
+        "layers": {
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w_in": P(None, None, "tp"),
+            "w_out": P(None, "tp", None),
+            "norm1_w": P(None, None),
+            "norm1_b": P(None, None),
+            "norm2_w": P(None, None),
+            "norm2_b": P(None, None),
+        },
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def bert_forward(
+    params: dict, tokens: jnp.ndarray, mask: jnp.ndarray, cfg: BertConfig
+) -> jnp.ndarray:
+    """tokens, mask: [b, s] (mask 1 = real token) → hidden states [b, s, D]."""
+    b, s = tokens.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    x = params["tok_embed"][tokens] + params["pos_embed"][None, :s]
+    x = layer_norm(x, params["embed_norm_w"], params["embed_norm_b"], cfg.norm_eps)
+
+    attn_mask = jnp.broadcast_to(mask[:, None, :].astype(bool), (b, s, s))
+
+    def body(x, lp):
+        h = jnp.einsum("bsd,dh->bsh", x, lp["wq"]).reshape(b, s, H, hd)
+        k = jnp.einsum("bsd,dh->bsh", x, lp["wk"]).reshape(b, s, H, hd)
+        v = jnp.einsum("bsd,dh->bsh", x, lp["wv"]).reshape(b, s, H, hd)
+        a = attention(h, k, v, causal=False, mask=attn_mask)
+        x = layer_norm(
+            x + jnp.einsum("bsh,hd->bsd", a.reshape(b, s, H * hd), lp["wo"]),
+            lp["norm1_w"],
+            lp["norm1_b"],
+            cfg.norm_eps,
+        )
+        ffn = jnp.einsum(
+            "bsf,fd->bsd",
+            jax.nn.gelu(jnp.einsum("bsd,df->bsf", x, lp["w_in"])),
+            lp["w_out"],
+        )
+        x = layer_norm(x + ffn, lp["norm2_w"], lp["norm2_b"], cfg.norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def bert_embed(
+    params: dict, tokens: jnp.ndarray, mask: jnp.ndarray, cfg: BertConfig
+) -> jnp.ndarray:
+    """Mean-pooled L2-normalised embeddings [b, D] in f32."""
+    hidden = bert_forward(params, tokens, mask, cfg).astype(jnp.float32)
+    m = mask[:, :, None].astype(jnp.float32)
+    pooled = jnp.sum(hidden * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

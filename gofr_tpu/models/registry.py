@@ -1,0 +1,128 @@
+"""Named model registry (the serving engine resolves ``TPU_MODEL`` here).
+
+Entries bundle a config with init/apply functions so the engine and bench
+code are model-agnostic. Sizes: ``*-tiny`` for tests/compile checks,
+``llama-1b`` fits a single v5e chip in bf16 for benchmarking, ``llama-3-8b``
+is the flagship target config (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from gofr_tpu.models.transformer import TransformerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    family: str  # "llm" | "encoder" | "vision"
+    config: Any
+    init: Callable
+    eos_token: int = 2
+
+    def describe(self) -> dict:
+        return {"name": self.name, "family": self.family}
+
+
+_REGISTRY: dict[str, ModelSpec] = {}
+
+
+def register_model(spec: ModelSpec) -> None:
+    _REGISTRY[spec.name] = spec
+
+
+def get_model(name: str) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name]
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _register_llms() -> None:
+    from gofr_tpu.models.transformer import init_transformer
+
+    llm_configs = {
+        # Flagship target: Llama-3-8B dims (BASELINE.json config 5).
+        "llama-3-8b": TransformerConfig(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_len=8192, rope_theta=500000.0,
+        ),
+        # ~1.1B config that fits one v5e chip comfortably for benching.
+        "llama-1b": TransformerConfig(
+            vocab_size=32768, d_model=2048, n_layers=22, n_heads=16,
+            n_kv_heads=4, d_ff=5632, max_len=4096, rope_theta=500000.0,
+        ),
+        # Test-size models (fast CPU compile).
+        "llama-tiny": TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=256, max_len=256, rope_theta=10000.0,
+        ),
+        "moe-tiny": TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=256, max_len=256, rope_theta=10000.0,
+            n_experts=4, n_experts_active=2,
+        ),
+    }
+    for name, cfg in llm_configs.items():
+        register_model(
+            ModelSpec(name=name, family="llm", config=cfg, init=init_transformer)
+        )
+
+
+def _register_encoders() -> None:
+    from gofr_tpu.models.bert import BertConfig, init_bert
+
+    register_model(
+        ModelSpec(
+            name="bert-base",
+            family="encoder",
+            config=BertConfig(),
+            init=init_bert,
+        )
+    )
+    register_model(
+        ModelSpec(
+            name="bert-tiny",
+            family="encoder",
+            config=BertConfig(
+                vocab_size=512, d_model=128, n_layers=2, n_heads=4, d_ff=256,
+                max_len=128,
+            ),
+            init=init_bert,
+        )
+    )
+
+
+def _register_vision() -> None:
+    from gofr_tpu.models.resnet import ResNetConfig, init_resnet
+
+    register_model(
+        ModelSpec(
+            name="resnet-50",
+            family="vision",
+            config=ResNetConfig(),
+            init=init_resnet,
+        )
+    )
+    register_model(
+        ModelSpec(
+            name="resnet-tiny",
+            family="vision",
+            config=ResNetConfig(stage_sizes=(1, 1, 1, 1), width=16, num_classes=10),
+            init=init_resnet,
+        )
+    )
+
+
+_register_llms()
+_register_encoders()
+_register_vision()

@@ -1,0 +1,115 @@
+"""ResNet-50 (BASELINE.json config 2: image classification over HTTP POST).
+
+Pure-JAX bottleneck ResNet in NHWC (TPU's native conv layout). Inference-mode
+batch norm (folded scale/bias applied with stored moments) — the serving
+path; training-mode BN is out of scope for an inference benchmark model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+
+def _conv_init(key, shape):
+    fan_in = shape[0] * shape[1] * shape[2]
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * (2.0 / fan_in) ** 0.5)
+
+
+def _bn_params(c):
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def init_resnet(key: jax.Array, cfg: ResNetConfig) -> dict:
+    keys = iter(jax.random.split(key, 256))
+    params: dict = {
+        "stem": {
+            "conv": _conv_init(next(keys), (7, 7, 3, cfg.width)),
+            "bn": _bn_params(cfg.width),
+        },
+        "stages": [],
+    }
+    in_ch = cfg.width
+    for stage_idx, n_blocks in enumerate(cfg.stage_sizes):
+        out_ch = cfg.width * (2**stage_idx) * 4
+        mid_ch = cfg.width * (2**stage_idx)
+        blocks = []
+        for block_idx in range(n_blocks):
+            block = {
+                "conv1": _conv_init(next(keys), (1, 1, in_ch, mid_ch)),
+                "bn1": _bn_params(mid_ch),
+                "conv2": _conv_init(next(keys), (3, 3, mid_ch, mid_ch)),
+                "bn2": _bn_params(mid_ch),
+                "conv3": _conv_init(next(keys), (1, 1, mid_ch, out_ch)),
+                "bn3": _bn_params(out_ch),
+            }
+            if block_idx == 0:
+                block["proj"] = _conv_init(next(keys), (1, 1, in_ch, out_ch))
+                block["proj_bn"] = _bn_params(out_ch)
+            blocks.append(block)
+            in_ch = out_ch
+        params["stages"].append(blocks)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (in_ch, cfg.num_classes)) * in_ch**-0.5),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def _bn(x, p, eps=1e-5):
+    inv = jax.lax.rsqrt(p["var"] + eps) * p["scale"]
+    return x * inv + (p["bias"] - p["mean"] * inv)
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+
+
+def _bottleneck(x, block, stride):
+    out = jax.nn.relu(_bn(_conv(x, block["conv1"]), block["bn1"]))
+    out = jax.nn.relu(_bn(_conv(out, block["conv2"], stride=stride), block["bn2"]))
+    out = _bn(_conv(out, block["conv3"]), block["bn3"])
+    if "proj" in block:
+        x = _bn(_conv(x, block["proj"], stride=stride), block["proj_bn"])
+    return jax.nn.relu(out + x)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def resnet_forward(params: dict, images: jnp.ndarray, cfg: ResNetConfig) -> jnp.ndarray:
+    """images: [b, 224, 224, 3] (any HxW divisible by 32) → logits [b, classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x = jax.nn.relu(_bn(x, params["stem"]["bn"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for stage_idx, blocks in enumerate(params["stages"]):
+        for block_idx, block in enumerate(blocks):
+            stride = 2 if (stage_idx > 0 and block_idx == 0) else 1
+            x = _bottleneck(x, block, stride)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ params["head"]["w"] + params["head"]["b"]

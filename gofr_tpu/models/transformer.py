@@ -1,0 +1,344 @@
+"""Llama-family decoder-only transformer (flagship model).
+
+TPU-first design decisions:
+
+* **Scan over layers** — per-layer params are stacked along a leading axis
+  and iterated with ``lax.scan``, so the program XLA compiles is one layer
+  body regardless of depth (fast compiles, perfect for pjit);
+* **bf16 params / f32 accumulation** — matmuls run on the MXU in bf16 with
+  ``preferred_element_type=f32`` where it matters (attention softmax, loss);
+* **GQA + RoPE + RMSNorm + SwiGLU** (Llama-3 architecture), optional
+  **MoE** FFN (top-k routing over stacked experts) so expert parallelism is
+  a first-class sharding axis;
+* **Functional KV cache** threaded through prefill/decode (see
+  ``gofr_tpu/ops/kv_cache.py``).
+
+Partition specs for every param live next to the model
+(:func:`transformer_param_specs`) keyed by logical mesh axes ``dp``/``tp``
+— the scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gofr_tpu.ops.attention import attention, decode_attention
+from gofr_tpu.ops.kv_cache import KVCache
+from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    # MoE: n_experts == 0 → dense SwiGLU FFN.
+    n_experts: int = 0
+    n_experts_active: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Random-init params as a pytree with stacked per-layer leaves."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense_init(key, shape, fan_in):
+        scale = fan_in**-0.5
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
+            cfg.dtype
+        )
+
+    D, H, KV, hd, F, L = (
+        cfg.d_model,
+        cfg.n_heads,
+        cfg.n_kv_heads,
+        cfg.head_dim,
+        cfg.d_ff,
+        cfg.n_layers,
+    )
+    ks = jax.random.split(k_layers, 12)
+    layers: dict[str, jnp.ndarray] = {
+        "wq": dense_init(ks[0], (L, D, H * hd), D),
+        "wk": dense_init(ks[1], (L, D, KV * hd), D),
+        "wv": dense_init(ks[2], (L, D, KV * hd), D),
+        "wo": dense_init(ks[3], (L, H * hd, D), H * hd),
+        "attn_norm": jnp.ones((L, D), dtype=cfg.dtype),
+        "mlp_norm": jnp.ones((L, D), dtype=cfg.dtype),
+    }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers.update(
+            router=dense_init(ks[4], (L, D, E), D),
+            w_gate=dense_init(ks[5], (L, E, D, F), D),
+            w_up=dense_init(ks[6], (L, E, D, F), D),
+            w_down=dense_init(ks[7], (L, E, F, D), F),
+        )
+    else:
+        layers.update(
+            w_gate=dense_init(ks[5], (L, D, F), D),
+            w_up=dense_init(ks[6], (L, D, F), D),
+            w_down=dense_init(ks[7], (L, F, D), F),
+        )
+    return {
+        "embed": dense_init(k_embed, (cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), dtype=cfg.dtype),
+        "lm_head": dense_init(k_head, (D, cfg.vocab_size), D),
+    }
+
+
+def transformer_param_specs(cfg: TransformerConfig) -> dict:
+    """PartitionSpecs over logical axes ('dp', 'tp') for every param leaf.
+
+    Megatron-style: attention QKV column-parallel / O row-parallel over
+    ``tp``; FFN gate/up column-parallel, down row-parallel; embeddings and
+    lm_head vocab-parallel; norms replicated. MoE experts sharded over
+    ``tp`` on the expert axis (expert parallelism rides the model axis).
+    """
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+    }
+    if cfg.is_moe:
+        layers.update(
+            router=P(None, None, None),
+            w_gate=P(None, "tp", None, None),
+            w_up=P(None, "tp", None, None),
+            w_down=P(None, "tp", None, None),
+        )
+    else:
+        layers.update(
+            w_gate=P(None, None, "tp"),
+            w_up=P(None, None, "tp"),
+            w_down=P(None, "tp", None),
+        )
+    return {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def kv_cache_specs() -> KVCache:
+    """Cache layout [L, slots, len, kv_heads, hd]: kv_heads over ``tp``."""
+    return KVCache(
+        k=P(None, None, None, "tp", None),
+        v=P(None, None, None, "tp", None),
+        lengths=P(None),
+    )
+
+
+# ---------------------------------------------------------------------------
+# layer body (shared by train/prefill/decode)
+# ---------------------------------------------------------------------------
+
+
+def _ffn_dense(x, lp, cfg):
+    gate = jnp.einsum("bsd,df->bsf", x, lp["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"])
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+
+
+def _ffn_moe(x, lp, cfg):
+    """Top-k MoE FFN. x: [b, s, D]. Dense-einsum formulation: every expert
+    computes, weighted by routing probs — the XLA-friendly formulation for
+    small expert counts (no ragged dispatch); capacity-based a2a dispatch is
+    the scale-out variant (see parallel/moe_dispatch)."""
+    b, s, D = x.shape
+    router_logits = jnp.einsum("bsd,de->bse", x, lp["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, cfg.n_experts_active)
+    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    # weights[b,s,E]: zero except the chosen experts.
+    weights = jnp.zeros_like(probs).at[
+        jnp.arange(b)[:, None, None],
+        jnp.arange(s)[None, :, None],
+        topk_idx,
+    ].set(topk_probs)
+    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
+    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    hidden = jax.nn.silu(gate) * up
+    out = jnp.einsum("bsef,efd->bsed", hidden, lp["w_down"])
+    return jnp.einsum("bsed,bse->bsd", out, weights.astype(x.dtype))
+
+
+def _layer_prefill(x, lp, cfg, cos, sin, positions, mask):
+    """One decoder layer over a full sequence. Returns (x, (k, v))."""
+    b, s, _ = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(b, s, H, hd)
+    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(b, s, KV, hd)
+    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    attn = attention(q, k, v, causal=True, mask=mask)
+    x = x + jnp.einsum("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+    return x + ffn, (k, v)
+
+
+# ---------------------------------------------------------------------------
+# public forwards
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg", "remat"))
+def transformer_forward(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: TransformerConfig,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Training/eval forward: tokens [b, s] → logits [b, s, vocab] (f32)."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    def body(x, lp):
+        out, _ = _layer_prefill(x, lp, cfg, cos, sin, positions, mask=None)
+        return out, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+
+def transformer_prefill(
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cache: KVCache,
+    slots: jnp.ndarray,
+    cfg: TransformerConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Serving prefill: right-padded prompt batch → last-token logits +
+    populated cache.
+
+    tokens: [b, s_pad]; lengths: [b] true lengths; slots: [b] cache slots.
+    """
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    # Padding mask: key positions beyond each sequence's length are invalid.
+    mask = (jnp.arange(s)[None, :] < lengths[:, None])[:, None, :]  # [b,1,s]
+    mask = jnp.broadcast_to(mask, (b, s, s))
+
+    def body(x, lp):
+        out, kv = _layer_prefill(x, lp, cfg, cos, sin, positions, mask=mask)
+        return out, kv
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    # ks: [L, b, s, KV, hd] → write each sequence's prefix into its slot.
+    pad_len = cache.max_len - s
+    ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad_len), (0, 0), (0, 0)))
+    vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad_len), (0, 0), (0, 0)))
+    new_k = cache.k.at[:, slots].set(ks)
+    new_v = cache.v.at[:, slots].set(vs)
+    cache = cache._replace(k=new_k, v=new_v)
+    cache = cache._replace(lengths=cache.lengths.at[slots].set(lengths.astype(jnp.int32)))
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    logits = jnp.einsum("bd,dv->bv", x_last, params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def transformer_decode_step(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+    active: jnp.ndarray,
+    cfg: TransformerConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step over ALL cache slots (static batch = n_slots).
+
+    tokens: [n_slots] current token per slot (anything for inactive slots);
+    active: [n_slots] bool — only active slots get their K/V write kept and
+    their length bumped; inactive rows are wasted FLOPs, which is the right
+    trade on TPU (static shapes, no gather/scatter of the cache, the whole
+    [L, S, max_len, KV, hd] buffers update in place via donation).
+    Returns ([n_slots, vocab] logits, updated cache).
+    """
+    S = cache.n_slots
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens]  # [S, D]
+    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+
+    positions = cache.lengths  # [S] — write position for each slot's new token
+    # Inactive slots write at their current position too, but the write lands
+    # beyond the valid prefix (attention masks by lengths) and the length is
+    # not bumped, so it is harmless and overwritten on activation.
+    slot_idx = jnp.arange(S)
+
+    def body(x, scanned):
+        lp, ck, cv = scanned  # ck/cv: [S, max_len, KV, hd] for this layer
+        h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
+        q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
+        k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
+        v = jnp.einsum("bd,dh->bh", h, lp["wv"]).reshape(S, KV, hd)
+        pos2 = positions[:, None]  # [S, 1]
+        q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
+        k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
+        ck = ck.at[slot_idx, positions].set(k)
+        cv = cv.at[slot_idx, positions].set(v)
+        attn = decode_attention(q, ck, cv, positions + 1)
+        x = x + jnp.einsum("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
+        h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)
+        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+        x = x + ffn[:, 0]
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    cache = cache._replace(
+        k=new_k,
+        v=new_v,
+        lengths=cache.lengths + active.astype(jnp.int32),
+    )
+    x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]
+    logits = jnp.einsum("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def count_params(params: dict) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,23 @@
+"""TPU-first neural net ops (net-new; no reference analog — SURVEY §2.6).
+
+Functional JAX ops designed for the MXU/XLA compilation model: static
+shapes, fused elementwise tails, bf16 matmul paths with f32 accumulation,
+and kernel-ready layouts (last dim a multiple of 128 where it matters).
+"""
+
+from gofr_tpu.ops.norms import rms_norm, layer_norm
+from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
+from gofr_tpu.ops.attention import attention, decode_attention
+from gofr_tpu.ops.kv_cache import KVCache
+from gofr_tpu.ops.sampling import sample_logits
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "attention",
+    "decode_attention",
+    "KVCache",
+    "sample_logits",
+]

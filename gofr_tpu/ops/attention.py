@@ -1,0 +1,103 @@
+"""Attention ops: prefill (causal, full-sequence) and decode (one query token
+against a KV cache slice).
+
+Dense baseline implementations in pure jnp — static shapes, f32 softmax
+accumulation, GQA via head-group broadcasting — with layouts chosen so the
+pallas flash kernels (``gofr_tpu/ops/pallas/``) are drop-in replacements on
+TPU. The dispatch helpers pick the kernel path when available.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[b, s, kv_heads, hd] → [b, s, kv_heads*n_rep, hd] (GQA broadcast)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Full-sequence attention (prefill / encoder).
+
+    q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd].
+    mask: optional [b, s_q, s_kv] additive-validity bool mask (True = attend).
+    """
+    b, s_q, n_heads, hd = q.shape
+    s_kv, n_kv = k.shape[1], k.shape[2]
+    n_rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+
+    # Grouped-head formulation: no materialized KV repeat (HBM-friendly) and
+    # the kv-head axis keeps one consistent tp sharding end to end.
+    qg = q.reshape(b, s_q, n_kv, n_rep, hd)
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [b, kv, rep, s_q, s_kv]
+
+    if causal:
+        # Offset so the last query attends to all keys (s_kv >= s_q case).
+        causal_mask = (
+            jnp.arange(s_kv)[None, :] <= (jnp.arange(s_q)[:, None] + (s_kv - s_q))
+        )
+        scores = jnp.where(causal_mask[None, None, None], scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v)
+    return out.reshape(b, s_q, n_heads, hd)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Single-token decode attention against per-slot caches.
+
+    q: [b, n_heads, hd] (one query per sequence);
+    k_cache, v_cache: [b, max_len, n_kv_heads, hd];
+    lengths: [b] valid prefix length per slot (the new token's K/V must
+    already be written at position lengths-1).
+    """
+    n_heads = q.shape[1]
+    n_kv = k_cache.shape[2]
+    n_rep = n_heads // n_kv
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    # Group query heads by their KV head: [b, kv, rep, hd].
+    b, max_len = k_cache.shape[0], k_cache.shape[1]
+    qg = q.reshape(b, n_kv, n_rep, -1)
+
+    scores = jnp.einsum(
+        "bgrd,bkgd->bgrk", qg, k_cache, preferred_element_type=jnp.float32
+    ) * scale  # [b, kv, rep, max_len]
+
+    valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+    scores = jnp.where(valid[:, None, None], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bgrk,bkgd->bgrd", probs, v_cache)
+    return out.reshape(b, n_heads, -1)

@@ -1,0 +1,53 @@
+"""Slot-based KV cache for autoregressive decode (net-new; SURVEY §7 hard
+part #3: persistent device state across requests).
+
+Layout: ``[n_layers, n_slots, max_len, n_kv_heads, head_dim]``. The slot axis
+is the decode batch axis (decode runs over ALL slots each step — static
+shapes, no gather/scatter), per-step writes are position-local scatters, and
+the kv_heads axis shards over the tensor-parallel mesh axis without
+resharding between prefill and decode.
+
+The cache is a functional pytree; the model's prefill/decode steps return
+updated buffers which XLA aliases in place when the jitted step donates them
+(``gofr_tpu/serving/engine.py`` does).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [layers, slots, max_len, kv_heads, head_dim]
+    v: jnp.ndarray
+    lengths: jnp.ndarray  # [slots] int32 — tokens currently in each slot
+
+    @classmethod
+    def create(
+        cls,
+        n_layers: int,
+        n_slots: int,
+        max_len: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (n_layers, n_slots, max_len, n_kv_heads, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+        )
+
+    @property
+    def n_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    def hbm_bytes(self) -> int:
+        return int(self.k.size * self.k.dtype.itemsize * 2)

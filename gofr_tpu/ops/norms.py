@@ -1,0 +1,30 @@
+"""Normalization ops.
+
+Computed in f32 regardless of input dtype (bf16-safe), cast back on exit so
+XLA fuses the whole op into neighboring matmuls.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm (Llama-family). ``weight`` has shape [d_model]."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    """LayerNorm (BERT-family)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (out * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)

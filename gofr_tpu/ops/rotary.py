@@ -1,0 +1,33 @@
+"""Rotary position embeddings (RoPE), Llama-style half-rotation layout."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 500000.0) -> tuple:
+    """Precompute (cos, sin) tables of shape [max_len, head_dim//2] in f32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_len, head_dim//2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray
+) -> jnp.ndarray:
+    """Apply RoPE.
+
+    x: [batch, seq, heads, head_dim]; positions: [batch, seq] absolute
+    positions (gathered into the tables — decode passes per-slot offsets).
+    """
+    dtype = x.dtype
+    cos_p = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+    sin_p = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1
+    )
+    return out.astype(dtype)

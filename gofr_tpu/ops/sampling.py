@@ -1,0 +1,44 @@
+"""Token sampling: greedy / temperature / top-k / top-p inside jit
+(static control flow — all branches computed, selected by where)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """Sample token ids from [batch, vocab] logits.
+
+    temperature == 0 → greedy. top_k/top_p filter before sampling. These are
+    Python-static knobs: changing them recompiles, which is the right trade
+    for a serving engine with a handful of sampling configs.
+    """
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits.astype(jnp.float32) / temperature
+
+    needs_sort = (top_k > 0 and top_k < logits.shape[-1]) or top_p < 1.0
+    if needs_sort:
+        # One descending sort shared by both filters.
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k > 0 and top_k < logits.shape[-1]:
+            kth = sorted_logits[:, top_k - 1][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # Keep the smallest prefix with cumulative prob >= top_p.
+            cutoff_idx = jnp.argmax(cum >= top_p, axis=-1)
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

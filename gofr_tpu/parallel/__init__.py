@@ -1,0 +1,20 @@
+"""Parallelism layer (net-new; SURVEY §2.6).
+
+The reference's "distributed backend" is service networking; here the
+intra-pod story is XLA collectives compiled in by GSPMD: pick a
+``jax.sharding.Mesh``, annotate param/activation shardings, jit. Axes:
+
+* ``dp`` — data parallel (batch);
+* ``tp`` — tensor parallel (attention heads / FFN hidden / vocab), also
+  carrying sequence-parallel activations and expert-parallel MoE weights;
+* ``pp`` — pipeline stages (``gofr_tpu.parallel.pipeline``).
+
+Cross-host (DCN) coordination reuses the service tier (SURVEY §2.6 "DCN
+tier") — jax.distributed for the runtime, the framework's HTTP client for
+app-level routing.
+"""
+
+from gofr_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
+from gofr_tpu.parallel.sharding import shard_pytree, make_train_step
+
+__all__ = ["make_mesh", "mesh_axis_sizes", "shard_pytree", "make_train_step"]

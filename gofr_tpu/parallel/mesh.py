@@ -1,0 +1,33 @@
+"""Device mesh construction.
+
+``make_mesh({"dp": 2, "tp": 4})`` reshapes the visible devices into a named
+:class:`jax.sharding.Mesh`. Axis order follows the dict order — put the
+fastest-varying (innermost, highest-bandwidth ICI) axis last, which is where
+``tp`` belongs on a TPU slice.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axes: Mapping[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    want = math.prod(axes.values())
+    if want > len(devices):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {want} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:want]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

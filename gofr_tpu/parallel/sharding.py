@@ -1,0 +1,152 @@
+"""Sharded placement + the sharded training step.
+
+The scaling-book recipe made concrete: params get NamedShardings from the
+model's partition specs, the batch shards over ``dp``, activations carry
+sequence-parallel constraints over ``tp``, and one ``jax.jit`` with
+donate/out shardings compiles the whole update — XLA inserts the
+all-reduces/all-gathers over ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """device_put every leaf with the NamedSharding from its matching spec."""
+    return jax.tree_util.tree_map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        tree,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross entropy. logits [b, s, V] f32, targets [b, s]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    tgt_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(tgt_logp)
+
+
+def make_train_step(
+    cfg,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+    sp: bool = True,
+    remat: bool = False,
+) -> tuple[Callable, Callable, optax.GradientTransformation]:
+    """Build (init_state, train_step) for the flagship transformer over
+    ``mesh`` with dp/tp (+sequence-parallel activations, +expert-parallel
+    MoE weights when the config has experts).
+
+    Returns ``(init_state_fn, train_step_fn, optimizer)``:
+    ``init_state_fn(key) -> (params, opt_state)`` sharded onto the mesh;
+    ``train_step_fn(params, opt_state, tokens) -> (loss, params, opt_state)``
+    jitted with donated state.
+    """
+    from gofr_tpu.models.transformer import (
+        init_transformer,
+        transformer_param_specs,
+        _layer_prefill,
+    )
+    from gofr_tpu.ops.norms import rms_norm
+    from gofr_tpu.ops.rotary import rope_frequencies
+
+    optimizer = optax.adamw(learning_rate)
+    param_specs = transformer_param_specs(cfg)
+
+    def forward(params, tokens):
+        b, s = tokens.shape
+        x = params["embed"][tokens]
+        cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        def constrain(h):
+            if sp:
+                # Sequence-parallel residual stream: tokens sharded over tp
+                # between attention/FFN blocks (Megatron-SP shape).
+                return jax.lax.with_sharding_constraint(
+                    h, NamedSharding(mesh, P("dp", "tp", None))
+                )
+            return jax.lax.with_sharding_constraint(
+                h, NamedSharding(mesh, P("dp", None, None))
+            )
+
+        def body(x, lp):
+            out, _ = _layer_prefill(x, lp, cfg, cos, sin, positions, mask=None)
+            return constrain(out), None
+
+        if remat:
+            body = jax.checkpoint(body)
+        x = constrain(x)
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens[:, :-1])
+        return cross_entropy_loss(logits, tokens[:, 1:])
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return loss, params, opt_state
+
+    def _opt_specs(params_specs):
+        # AdamW state embeds copies of the param tree (mu/nu); any subtree of
+        # the opt state that IS the param tree gets the param specs
+        # leaf-for-leaf (matched structurally, not by shape — wq/wo have
+        # identical shapes but transposed shardings). Scalars replicate.
+        sample_params = jax.eval_shape(lambda k: init_transformer(k, cfg),
+                                       jax.random.PRNGKey(0))
+        opt_shape = jax.eval_shape(optimizer.init, sample_params)
+        params_treedef = jax.tree_util.tree_structure(sample_params)
+
+        def is_param_tree(x):
+            try:
+                return jax.tree_util.tree_structure(x) == params_treedef
+            except Exception:
+                return False
+
+        children, treedef = jax.tree_util.tree_flatten(
+            opt_shape, is_leaf=is_param_tree
+        )
+        mapped = [params_specs if is_param_tree(c) else P() for c in children]
+        return jax.tree_util.tree_unflatten(treedef, mapped)
+
+    opt_specs = _opt_specs(param_specs)
+    param_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    opt_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), opt_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    data_sharding = NamedSharding(mesh, P("dp", None))
+
+    init_jit = jax.jit(
+        lambda key: init_transformer(key, cfg), out_shardings=param_shardings
+    )
+    opt_init_jit = jax.jit(optimizer.init, out_shardings=opt_shardings)
+
+    def init_state(key):
+        params = init_jit(key)
+        opt_state = opt_init_jit(params)
+        return params, opt_state
+
+    step_jit = jax.jit(
+        train_step,
+        in_shardings=(param_shardings, opt_shardings, data_sharding),
+        out_shardings=(NamedSharding(mesh, P()), param_shardings, opt_shardings),
+        donate_argnums=(0, 1),
+    )
+    return init_state, step_jit, optimizer

@@ -8,7 +8,12 @@ multi-chip dry run.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set: the environment pre-sets JAX_PLATFORMS=axon and an axon
+# sitecustomize registers the TPU plugin unless PALLAS_AXON_POOL_IPS is
+# cleared before the interpreter starts. Tests always target the virtual
+# CPU mesh; run pytest via `PALLAS_AXON_POOL_IPS= python -m pytest` (or rely
+# on jax not being imported before this conftest runs).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,183 @@
+"""Model correctness tests on the CPU backend.
+
+The load-bearing test is prefill+decode vs. full-forward equivalence: the
+serving path (KV cache, RoPE offsets, padding masks) must reproduce the
+training path logits token for token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models.bert import bert_embed, bert_forward, init_bert
+from gofr_tpu.models.registry import get_model, list_models
+from gofr_tpu.models.resnet import init_resnet, resnet_forward
+from gofr_tpu.models.transformer import (
+    count_params,
+    init_transformer,
+    transformer_decode_step,
+    transformer_forward,
+    transformer_prefill,
+)
+from gofr_tpu.ops.kv_cache import KVCache
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    spec = get_model("llama-tiny")
+    cfg = spec.config
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_registry_contents():
+    names = list_models()
+    for expected in ("llama-3-8b", "llama-1b", "llama-tiny", "moe-tiny", "bert-base", "resnet-50"):
+        assert expected in names
+    with pytest.raises(KeyError):
+        get_model("nope")
+
+
+def test_forward_shapes_and_finiteness(tiny):
+    cfg, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    logits = transformer_forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_param_count_flagship_configs():
+    cfg8b = get_model("llama-3-8b").config
+    # Count without materializing: eval_shape.
+    shapes = jax.eval_shape(lambda k: init_transformer(k, cfg8b), jax.random.PRNGKey(0))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes))
+    assert 7.5e9 < n < 8.7e9  # Llama-3-8B ballpark (incl. untied lm_head)
+
+
+def test_prefill_decode_matches_full_forward():
+    """Serving path == training path, token for token (f32 so the comparison
+    is precision-tight; bf16 paths diverge only by rounding)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(get_model("llama-tiny").config, dtype=jnp.float32)
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    b, prompt_len, gen_len = 2, 10, 5
+    total = prompt_len + gen_len
+    key = jax.random.PRNGKey(2)
+    tokens = jax.random.randint(key, (b, total), 0, cfg.vocab_size)
+
+    # Ground truth: full causal forward over the whole sequence.
+    full_logits = transformer_forward(params, tokens, cfg)
+
+    # Serving path: prefill the prompt, then decode one token at a time
+    # (teacher-forced with the same tokens so logits must match).
+    cache = KVCache.create(
+        cfg.n_layers, n_slots=4, max_len=64, n_kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.head_dim, dtype=cfg.dtype,
+    )
+    slots = jnp.array([0, 2])  # non-contiguous slots on purpose
+    lengths = jnp.array([prompt_len, prompt_len])
+    logits_p, cache = transformer_prefill(
+        params, tokens[:, :prompt_len], lengths, cache, slots, cfg
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p),
+        np.asarray(full_logits[:, prompt_len - 1]),
+        rtol=1e-4, atol=1e-4,
+    )
+
+    # Decode runs over ALL slots; place each sequence's token at its slot and
+    # mark only those slots active.
+    active = jnp.zeros((4,), dtype=bool).at[slots].set(True)
+    for step in range(gen_len):
+        pos = prompt_len + step
+        slot_tokens = jnp.zeros((4,), dtype=tokens.dtype).at[slots].set(tokens[:, pos])
+        logits_d, cache = transformer_decode_step(
+            params, slot_tokens, cache, active, cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_d[slots]),
+            np.asarray(full_logits[:, pos]),
+            rtol=1e-4, atol=1e-4,
+            err_msg=f"decode step {step} diverged from full forward",
+        )
+    assert cache.lengths[0] == prompt_len + gen_len
+    assert cache.lengths[1] == 0  # inactive slot length untouched
+
+
+def test_prefill_respects_padding(tiny):
+    """Right-padded short prompt must give same last-token logits as unpadded."""
+    cfg, params = tiny
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 6), 0, cfg.vocab_size)
+    cache = KVCache.create(cfg.n_layers, 2, 32, cfg.n_kv_heads, cfg.head_dim, cfg.dtype)
+    logits_a, _ = transformer_prefill(
+        params, tokens, jnp.array([6]), cache, jnp.array([0]), cfg
+    )
+    padded = jnp.pad(tokens, ((0, 0), (0, 4)))  # junk zeros after the prompt
+    logits_b, _ = transformer_prefill(
+        params, padded, jnp.array([6]), cache, jnp.array([1]), cfg
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_moe_forward_runs():
+    spec = get_model("moe-tiny")
+    cfg = spec.config
+    params = init_transformer(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+    logits = transformer_forward(params, tokens, cfg)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_bert_embed():
+    spec = get_model("bert-tiny")
+    cfg = spec.config
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    mask = jnp.ones((2, 16), dtype=jnp.int32)
+    emb = bert_embed(params, tokens, mask, cfg)
+    assert emb.shape == (2, cfg.d_model)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(emb), axis=-1), 1.0, rtol=1e-5
+    )
+
+
+def test_bert_mask_changes_output():
+    spec = get_model("bert-tiny")
+    cfg = spec.config
+    params = init_bert(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+    full = bert_embed(params, tokens, jnp.ones((1, 8), jnp.int32), cfg)
+    half = bert_embed(
+        params, tokens, jnp.array([[1, 1, 1, 1, 0, 0, 0, 0]], jnp.int32), cfg
+    )
+    assert not np.allclose(np.asarray(full), np.asarray(half), atol=1e-3)
+
+
+def test_resnet_forward():
+    spec = get_model("resnet-tiny")
+    cfg = spec.config
+    params = init_resnet(jax.random.PRNGKey(0), cfg)
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits = resnet_forward(params, images, cfg)
+    assert logits.shape == (2, cfg.num_classes)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_sampling():
+    from gofr_tpu.ops.sampling import sample_logits
+
+    logits = jnp.array([[0.0, 10.0, 0.0, 0.0], [0.0, 0.0, 0.0, 10.0]])
+    greedy = sample_logits(logits, jax.random.PRNGKey(0), temperature=0.0)
+    assert greedy.tolist() == [1, 3]
+    sampled = sample_logits(
+        logits, jax.random.PRNGKey(0), temperature=1.0, top_k=1
+    )
+    assert sampled.tolist() == [1, 3]  # top_k=1 → argmax regardless of temp
