@@ -1,0 +1,97 @@
+"""Headline benchmark — flagship LLM serving throughput on TPU.
+
+Boots the serving engine (continuous batching, fused decode+sample, donated
+KV cache) with the largest Llama-family config that fits the available chip,
+runs concurrent generation, and prints ONE JSON line:
+
+    {"metric": "decode_tokens_per_sec_per_chip", "value": N,
+     "unit": "tok/s/chip", "vs_baseline": N/1000}
+
+``vs_baseline``: the reference (GoFr) publishes no perf numbers
+(BASELINE.md), so the denominator is a fixed 1000 tok/s/chip nominal
+target for a ~1B bf16 model on one v5e — chosen once so the ratio is
+comparable across rounds. Details (TTFT p50/p99, per-request rates) go to
+stderr.
+
+Env knobs: BENCH_MODEL (default llama-1b on TPU, llama-tiny on CPU),
+BENCH_REQUESTS (default 16), BENCH_NEW_TOKENS (default 128),
+BENCH_SLOTS (default 8), BENCH_MAX_LEN (default 1024).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "8"))
+    max_len = int(os.environ.get("BENCH_MAX_LEN", "1024"))
+
+    log(f"bench: platform={platform} model={model} requests={n_requests} "
+        f"new_tokens={new_tokens} slots={n_slots}")
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    t0 = time.time()
+    engine = InferenceEngine(
+        model, n_slots=n_slots, max_len=max_len, tokenizer=ByteTokenizer()
+    )
+    engine.start_sync()
+    log(f"engine up in {time.time() - t0:.1f}s")
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * 3  # ~135 bytes
+
+    # Warmup: compile prefill + decode once.
+    t0 = time.time()
+    engine.generate_sync(prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False)
+    log(f"warmup (compile) in {time.time() - t0:.1f}s")
+
+    # Measured run: n_requests concurrent, engine batches them over n_slots.
+    t0 = time.time()
+    reqs = [
+        engine.submit_generate(
+            prompt, max_new_tokens=new_tokens, temperature=0.0, stop_on_eos=False
+        )
+        for _ in range(n_requests)
+    ]
+    results = [r.future.result(timeout=1800) for r in reqs]
+    wall = time.time() - t0
+
+    total_tokens = sum(len(r.token_ids) for r in results)
+    tps = total_tokens / wall
+    ttfts = sorted(r.ttft_s * 1e3 for r in results)
+    p50 = statistics.median(ttfts)
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    log(f"generated {total_tokens} tokens in {wall:.2f}s → {tps:.1f} tok/s/chip")
+    log(f"TTFT p50={p50:.1f}ms p99={p99:.1f}ms (includes queueing behind "
+        f"{n_requests} concurrent requests on {n_slots} slots)")
+
+    engine.stop_sync()
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tps, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tps / 1000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""gRPC inference server example (BASELINE.json config 5 shape: LLM chat
+over gRPC unary + stream; reference analog ``examples/grpc-server``).
+
+Serves gofr.tpu.Inference on :9000 plus the HTTP health surface on :8000.
+Model selected by TPU_MODEL in configs/.env (llama-tiny by default so the
+example runs anywhere; set llama-1b/llama-3-8b on real hardware).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.grpc import add_inference_service
+from gofr_tpu.grpc.inference import InferenceServicer
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    engine = app.container.tpu
+    if engine is None:
+        raise SystemExit("set TPU_MODEL in configs/.env")
+    app.register_service(add_inference_service, InferenceServicer(engine))
+
+    @app.get("/models")
+    def models(ctx):
+        return ctx.tpu.health_check()["details"]
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,33 @@
+"""HTTP inference example (BASELINE.json config 2 shape: image classify over
+HTTP POST, plus text generate).
+
+POST /generate {"prompt": "...", "max_new_tokens": 32}
+POST /classify {"image": [[...]]} (HxWx3 nested lists)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.post("/generate")
+    async def generate(ctx):
+        body = ctx.request.json()
+        return await ctx.infer(
+            body.get("prompt", ""),
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            temperature=float(body.get("temperature", 0.0)),
+            stop_on_eos=False,
+        )
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -1,0 +1,53 @@
+"""Offline batch inference over pub/sub (BASELINE.json config 4 shape:
+subscriber → batch infer → publisher; reference analog
+``examples/using-subscriber`` + ``using-publisher``).
+
+Consumes JSON {"id": ..., "prompt": ...} messages from topic ``infer-requests``,
+generates, and publishes {"id", "text"} to ``infer-responses``.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.subscribe("infer-requests")
+    async def handle(ctx):
+        payload = ctx.request.json()
+        result = await ctx.infer(
+            payload.get("prompt", ""), max_new_tokens=16, stop_on_eos=False
+        )
+        ctx.publish(
+            "infer-responses",
+            json.dumps({"id": payload.get("id"), "text": result["text"]}).encode(),
+        )
+
+    @app.post("/submit")
+    def submit(ctx):
+        body = ctx.request.json()
+        ctx.publish("infer-requests", json.dumps(body).encode())
+        return {"queued": True}
+
+    @app.get("/results")
+    def results(ctx):
+        out = []
+        while True:
+            msg = ctx.pubsub.subscribe("infer-responses", timeout=0.05)
+            if msg is None:
+                break
+            msg.commit()
+            out.append(json.loads(msg.value))
+        return out
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

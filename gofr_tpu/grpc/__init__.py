@@ -1,0 +1,18 @@
+"""gRPC server tier (reference: ``pkg/gofr/grpc.go`` + ``grpc/log.go``).
+
+An asyncio gRPC server with recovery + logging interceptors (the reference's
+interceptor chain, ``grpc.go:23-26``), started only when services are
+registered (``gofr.go:150-157``). Ships a built-in inference service
+(unary + server-streaming generate, embed, classify) using JSON-over-bytes
+messages — no codegen toolchain required in this environment.
+"""
+
+from gofr_tpu.grpc.server import GRPCServer, json_method_handlers
+from gofr_tpu.grpc.inference import add_inference_service, InferenceClient
+
+__all__ = [
+    "GRPCServer",
+    "json_method_handlers",
+    "add_inference_service",
+    "InferenceClient",
+]

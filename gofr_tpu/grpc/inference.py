@@ -1,0 +1,135 @@
+"""Built-in gRPC inference service (BASELINE.json configs 3 and 5:
+BERT embeddings over gRPC unary, Llama chat over gRPC stream).
+
+Service ``gofr.tpu.Inference`` with JSON messages:
+
+* ``Generate``  (unary)  {prompt, max_new_tokens?, temperature?} →
+  {text, tokens, ttft_ms}
+* ``GenerateStream`` (server streaming) same request → stream of
+  {token, text} chunks then a final {done: true, ttft_ms, tokens}
+* ``Embed``    (unary)  {text} → {embedding}
+* ``Classify`` (unary)  {image: [[...]] nested lists or flat+shape} →
+  {class, logits}
+* ``Health``   (unary)  {} → container health
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from gofr_tpu.grpc.server import json_method_handlers
+
+SERVICE = "gofr.tpu.Inference"
+
+
+class InferenceServicer:
+    def __init__(self, engine, tokenizer=None) -> None:
+        self.engine = engine
+        self.tokenizer = tokenizer or engine.tokenizer
+
+    async def Generate(self, request, context):
+        result = await self.engine.generate(
+            request.get("prompt", ""),
+            max_new_tokens=int(request.get("max_new_tokens", 128)),
+            temperature=float(request.get("temperature", 0.0)),
+            stop_on_eos=bool(request.get("stop_on_eos", True)),
+        )
+        return {
+            "text": result.text,
+            "tokens": len(result.token_ids),
+            "ttft_ms": round(result.ttft_s * 1e3, 2),
+            "tokens_per_sec": round(result.tokens_per_sec, 2),
+        }
+
+    async def GenerateStream(self, request, context):
+        import time
+
+        start = time.time()
+        first_at = None
+        n = 0
+        async for tok in self.engine.generate_stream(
+            request.get("prompt", ""),
+            max_new_tokens=int(request.get("max_new_tokens", 128)),
+            temperature=float(request.get("temperature", 0.0)),
+            stop_on_eos=bool(request.get("stop_on_eos", False)),
+        ):
+            if first_at is None:
+                first_at = time.time()
+            n += 1
+            piece = self.tokenizer.decode([tok]) if self.tokenizer else ""
+            yield {"token": tok, "text": piece}
+        yield {
+            "done": True,
+            "tokens": n,
+            "ttft_ms": round(((first_at or time.time()) - start) * 1e3, 2),
+        }
+
+    async def Embed(self, request, context):
+        emb = await self.engine.embed(request.get("text", ""))
+        return {"embedding": np.asarray(emb).tolist()}
+
+    async def Classify(self, request, context):
+        image = np.asarray(request.get("image"), dtype=np.float32)
+        if "shape" in request:
+            image = image.reshape(request["shape"])
+        logits = await self.engine.classify(image)
+        return {"class": int(np.argmax(logits)), "logits": np.asarray(logits).tolist()}
+
+    async def Health(self, request, context):
+        return self.engine.health_check()
+
+
+def add_inference_service(server, servicer: InferenceServicer, container=None) -> None:
+    handler = json_method_handlers(
+        SERVICE,
+        unary={
+            "Generate": servicer.Generate,
+            "Embed": servicer.Embed,
+            "Classify": servicer.Classify,
+            "Health": servicer.Health,
+        },
+        streams={"GenerateStream": servicer.GenerateStream},
+    )
+    server.add_generic_rpc_handlers((handler,))
+
+
+class InferenceClient:
+    """Minimal sync client for the JSON inference service (tests/bench)."""
+
+    def __init__(self, address: str) -> None:
+        self._channel = grpc.insecure_channel(address)
+
+    def _unary(self, method: str, payload: dict) -> dict:
+        fn = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda o: json.dumps(o).encode(),
+            response_deserializer=lambda b: json.loads(b or b"{}"),
+        )
+        return fn(payload)
+
+    def generate(self, prompt: str, **kw) -> dict:
+        return self._unary("Generate", {"prompt": prompt, **kw})
+
+    def generate_stream(self, prompt: str, **kw):
+        fn = self._channel.unary_stream(
+            f"/{SERVICE}/GenerateStream",
+            request_serializer=lambda o: json.dumps(o).encode(),
+            response_deserializer=lambda b: json.loads(b or b"{}"),
+        )
+        yield from fn({"prompt": prompt, **kw})
+
+    def embed(self, text: str) -> dict:
+        return self._unary("Embed", {"text": text})
+
+    def classify(self, image) -> dict:
+        return self._unary("Classify", {"image": np.asarray(image).tolist()})
+
+    def health(self) -> dict:
+        return self._unary("Health", {})
+
+    def close(self) -> None:
+        self._channel.close()

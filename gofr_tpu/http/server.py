@@ -45,6 +45,8 @@ class HTTPServer:
         self._logger = logger
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: set[asyncio.StreamWriter] = set()
+        self._inflight: set[asyncio.StreamWriter] = set()  # mid-request conns
+        self.drain_timeout_s = 30.0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -63,15 +65,45 @@ class HTTPServer:
             await self._server.serve_forever()
 
     async def shutdown(self) -> None:
-        """Stop accepting, then drain open connections."""
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        """Stop accepting, close IDLE connections, drain in-flight requests.
+
+        Idle keep-alive connections sit in a read for up to 75s, so their
+        transports close immediately; connections with a request mid-handler
+        get up to ``drain_timeout_s`` to finish and flush their response
+        (queued batched inference makes this drain mandatory — SURVEY §7).
+        Requires Python ≥3.12 semantics for ``Server.wait_closed()`` (waits
+        for handlers); on older runtimes the explicit in-flight poll below
+        still provides the drain.
+        """
+        if self._server is None:
+            return
+        self._server.close()
         for writer in list(self._conns):
+            if writer in self._inflight:
+                continue
             try:
                 writer.close()
             except Exception:
                 pass
+        deadline = asyncio.get_running_loop().time() + self.drain_timeout_s
+        while self._inflight and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        if self._inflight:
+            if self._logger is not None:
+                self._logger.warnf(
+                    "shutdown drain timed out with %d in-flight request(s); closing",
+                    len(self._inflight),
+                )
+            for writer in list(self._inflight):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+        except asyncio.TimeoutError:
+            if self._logger is not None:
+                self._logger.warn("wait_closed timed out; continuing shutdown")
 
     async def _serve_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         peer = writer.get_extra_info("peername")
@@ -103,26 +135,29 @@ class HTTPServer:
                     break
                 first = False
 
+                self._inflight.add(writer)
                 try:
-                    resp = await self._handler(raw)
-                except Exception as exc:  # framework-level last resort
-                    if self._logger is not None:
-                        self._logger.errorf("unhandled server error: %s", exc)
-                    resp = Response(
-                        status=500,
-                        headers={"Content-Type": "application/json"},
-                        body=b'{"error":{"message":"Internal Server Error"}}',
-                    )
+                    try:
+                        resp = await self._handler(raw)
+                    except Exception as exc:  # framework-level last resort
+                        if self._logger is not None:
+                            self._logger.errorf("unhandled server error: %s", exc)
+                        resp = Response(
+                            status=500,
+                            headers={"Content-Type": "application/json"},
+                            body=b'{"error":{"message":"Internal Server Error"}}',
+                        )
 
-                keep = raw.keep_alive
-                writer.write(
-                    serialize_response(
-                        resp, head_only=(raw.method == "HEAD"), keep_alive=keep
+                    keep = raw.keep_alive
+                    writer.write(
+                        serialize_response(
+                            resp, head_only=(raw.method == "HEAD"), keep_alive=keep
+                        )
                     )
-                )
-                if not await _safe_drain(writer):
-                    break
-                if not keep:
+                    drained = await _safe_drain(writer)
+                finally:
+                    self._inflight.discard(writer)
+                if not drained or not keep:
                     break
         finally:
             self._conns.discard(writer)

@@ -1,0 +1,125 @@
+"""Dynamic batching (net-new; SURVEY §2.6 maps it onto the reference's
+middleware-chain idiom).
+
+Coalesces concurrent requests into padded batch executions: a request queue
+drained by a worker that flushes on **size** (max_batch reached) or
+**deadline** (max_wait elapsed since the oldest pending request), padding to
+power-of-two buckets so XLA reuses a small set of compiled shapes.
+
+Thread-based (device calls block anyway): async callers get a
+``concurrent.futures.Future`` they can await via ``asyncio.wrap_future``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+
+def pad_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket ≥ n (last bucket caps)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class _Pending:
+    payload: Any
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.time)
+
+
+class DynamicBatcher:
+    """Generic size/deadline batcher.
+
+    ``execute(payloads) -> results`` runs on the worker thread; one result
+    per payload, order-preserving. Exceptions fail the whole flush's futures.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[list], list],
+        max_batch: int = 8,
+        max_wait_s: float = 0.005,
+        metrics=None,
+        name: str = "batcher",
+        max_queue: int = 1024,
+    ) -> None:
+        self._execute = execute
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self._metrics = metrics
+        self._name = name
+        self._queue: queue.Queue[_Pending] = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name=f"batcher-{self._name}", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def submit(self, payload: Any) -> Future:
+        """Enqueue; raises queue.Full on overload (caller maps to 429)."""
+        pending = _Pending(payload)
+        self._queue.put_nowait(pending)
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_queue_depth", self._queue.qsize(), "batcher", self._name
+            )
+        return pending.future
+
+    # -- worker -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self._collect()
+            if not batch:
+                continue
+            if self._metrics is not None:
+                self._metrics.record_histogram(
+                    "app_tpu_batch_size", len(batch), "batcher", self._name
+                )
+                self._metrics.set_gauge(
+                    "app_tpu_queue_depth", self._queue.qsize(), "batcher", self._name
+                )
+            try:
+                results = self._execute([p.payload for p in batch])
+                for pending, result in zip(batch, results):
+                    pending.future.set_result(result)
+            except Exception as exc:
+                for pending in batch:
+                    if not pending.future.done():
+                        pending.future.set_exception(exc)
+
+    def _collect(self) -> list[_Pending]:
+        """Block for the first request, then drain until size or deadline."""
+        try:
+            first = self._queue.get(timeout=0.1)
+        except queue.Empty:
+            return []
+        batch = [first]
+        deadline = first.enqueued_at + self.max_wait_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch

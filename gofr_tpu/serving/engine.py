@@ -1,0 +1,616 @@
+"""The TPU inference engine (net-new; SURVEY §2.6).
+
+The container's ``tpu`` member (role of ``gofr.TPU()`` in the north star):
+owns the model params on device, the jitted prefill/decode steps, the slot
+KV cache, and the scheduler that turns concurrent requests into batched
+device executions.
+
+Design:
+
+* **LLM family — continuous batching.** A dedicated scheduler thread admits
+  pending prompts into free KV slots (prefill, bucketed padding) and steps
+  ALL slots through one fused decode+sample kernel per token. Device-side
+  sampling (per-slot temperature array + greedy mask inside the jit) means
+  only ``[n_slots] int32`` crosses the host boundary per step. Cache buffers
+  are donated so XLA updates them in place.
+* **Encoder / vision families — dynamic batching.** Requests coalesce in a
+  :class:`DynamicBatcher` (size/deadline flush) and execute as one padded
+  batch.
+* **Observability** rides the framework metrics registry: queue depth, KV
+  slots in use, batch sizes, infer latency, tokens generated, HBM gauges.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import numpy as np
+
+from gofr_tpu.serving.batcher import DynamicBatcher, pad_bucket
+from gofr_tpu.serving.tokenizer import tokenizer_from_config
+
+_PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    token_ids: list[int]
+    prompt_tokens: int
+    ttft_s: float
+    duration_s: float
+
+    @property
+    def tokens_per_sec(self) -> float:
+        gen = max(len(self.token_ids), 1)
+        return gen / self.duration_s if self.duration_s > 0 else 0.0
+
+
+@dataclass
+class _ActiveSeq:
+    request: "_GenRequest"
+    last_token: int
+    n_generated: int = 0
+    started_at: float = field(default_factory=time.time)
+    first_token_at: Optional[float] = None
+
+
+@dataclass
+class _GenRequest:
+    prompt_ids: list[int]
+    max_new_tokens: int
+    temperature: float
+    stop_on_eos: bool
+    stream: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.time)
+    token_ids: list[int] = field(default_factory=list)
+    ttft_s: float = 0.0
+
+
+class InferenceEngine:
+    """One loaded model + its serving machinery."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        n_slots: int = 8,
+        max_len: int = 1024,
+        max_batch: int = 8,
+        max_wait_s: float = 0.005,
+        window_k: int = 8,
+        top_k: int = 0,
+        logger=None,
+        metrics=None,
+        tokenizer=None,
+        seed: int = 0,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from gofr_tpu.models.registry import get_model
+
+        self._jax, self._jnp = jax, jnp
+        self.model_name = model_name
+        self.spec = get_model(model_name)
+        self.family = self.spec.family
+        self.cfg = self.spec.config
+        self._logger = logger
+        self._metrics = metrics
+        self._top_k = top_k
+        self.tokenizer = tokenizer
+
+        t0 = time.time()
+        self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
+        if logger is not None:
+            n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
+            logger.infof(
+                "model %s initialised: %.2fB params in %.1fs",
+                model_name, n_params / 1e9, time.time() - t0,
+            )
+
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._running = False
+
+        if self.family == "llm":
+            from gofr_tpu.ops.kv_cache import KVCache
+
+            self.max_len = min(max_len, self.cfg.max_len)
+            self.n_slots = n_slots
+            self.window_k = max(1, window_k)
+            self.cache = KVCache.create(
+                self.cfg.n_layers, n_slots, self.max_len,
+                self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+            )
+            self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
+            self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
+            self._work = threading.Event()
+            self._sched: Optional[threading.Thread] = None
+            self._tokens_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
+            self._build_llm_steps()
+        elif self.family == "encoder":
+            self.max_len = min(max_len, self.cfg.max_len)
+            self._build_encoder_step()
+            self._batcher = DynamicBatcher(
+                self._execute_embed, max_batch=max_batch, max_wait_s=max_wait_s,
+                metrics=metrics, name="embed",
+            )
+        elif self.family == "vision":
+            self._build_vision_step()
+            self._batcher = DynamicBatcher(
+                self._execute_classify, max_batch=max_batch, max_wait_s=max_wait_s,
+                metrics=metrics, name="classify",
+            )
+        else:
+            raise ValueError(f"unknown model family {self.family}")
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, config, logger=None, metrics=None) -> "InferenceEngine":
+        """Container seam: all knobs are TPU_* env keys (the datasource
+        config idiom, reference ``sql/sql.go:109-118``)."""
+        return cls(
+            config.get_or_default("TPU_MODEL", "llama-tiny"),
+            n_slots=int(config.get_or_default("TPU_KV_SLOTS", "8")),
+            max_len=int(config.get_or_default("TPU_MAX_LEN", "1024")),
+            max_batch=int(config.get_or_default("TPU_MAX_BATCH", "8")),
+            max_wait_s=float(config.get_or_default("TPU_BATCH_WAIT_MS", "5")) / 1e3,
+            window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
+            top_k=int(config.get_or_default("TPU_TOP_K", "0")),
+            logger=logger,
+            metrics=metrics,
+            tokenizer=tokenizer_from_config(config, logger),
+        )
+
+    def _build_llm_steps(self) -> None:
+        jax, jnp = self._jax, self._jnp
+        from gofr_tpu.models.transformer import (
+            transformer_decode_step,
+            transformer_prefill,
+        )
+        cfg, top_k = self.cfg, self._top_k
+
+        def sample(logits, key, temps, greedy):
+            greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-4)[:, None]
+            if top_k > 0:
+                sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+                kth = sorted_l[:, top_k - 1][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+            return jnp.where(greedy, greedy_tok, sampled)
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def prefill_step(params, tokens, cache, lengths, slots, key, temps, greedy):
+            logits, cache = transformer_prefill(
+                params, tokens, lengths, cache, slots, cfg
+            )
+            return sample(logits, key, temps, greedy), cache
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def merge_tokens(all_tokens, slots, new_tokens):
+            return all_tokens.at[slots].set(new_tokens)
+
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(2,))
+        def decode_window(params, tokens, cache, active, key, temps, greedy, k):
+            """Run k decode steps entirely on device; emit the k tokens that
+            ENTER each step (so a freshly prefilled slot's first token is
+            emitted by its first window) and carry the (k+1)-th as next
+            input. One host fetch per k tokens — the host↔device roundtrip
+            (≈100ms through a network-attached relay, SURVEY §7 hard part
+            #1: batch at the boundary) amortizes k-fold."""
+
+            def body(carry, _):
+                tokens, cache, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = transformer_decode_step(
+                    params, tokens, cache, active, cfg
+                )
+                nxt = sample(logits, sub, temps, greedy)
+                return (nxt, cache, key), tokens
+
+            (final, cache, _), emitted = jax.lax.scan(
+                body, (tokens, cache, key), length=k
+            )
+            return emitted, final, cache
+
+        self._prefill_step = prefill_step
+        self._merge_tokens = merge_tokens
+        self._decode_window = decode_window
+
+    def _build_encoder_step(self) -> None:
+        from gofr_tpu.models.bert import bert_embed
+
+        cfg = self.cfg
+        self._embed_step = self._jax.jit(
+            lambda params, tokens, mask: bert_embed(params, tokens, mask, cfg)
+        )
+
+    def _build_vision_step(self) -> None:
+        from gofr_tpu.models.resnet import resnet_forward
+
+        cfg = self.cfg
+        self._classify_step = self._jax.jit(
+            lambda params, images: resnet_forward(params, images, cfg)
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self.start_sync()
+
+    def start_sync(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        if self.family == "llm":
+            self._sched = threading.Thread(
+                target=self._scheduler_loop, name="tpu-scheduler", daemon=True
+            )
+            self._sched.start()
+        else:
+            self._batcher.start()
+
+    async def stop(self) -> None:
+        self.stop_sync()
+
+    def stop_sync(self) -> None:
+        self._running = False
+        if self.family == "llm":
+            self._work.set()
+            if self._sched is not None:
+                self._sched.join(timeout=10)
+                self._sched = None
+        else:
+            self._batcher.stop()
+
+    def close(self) -> None:
+        self.stop_sync()
+
+    # ------------------------------------------------------------------
+    # LLM scheduler (continuous batching)
+    # ------------------------------------------------------------------
+
+    def _scheduler_loop(self) -> None:
+        while self._running:
+            admitted = self._admit_pending()
+            any_active = any(s is not None for s in self._slots)
+            if not any_active:
+                if not admitted:
+                    self._work.wait(timeout=0.02)
+                    self._work.clear()
+                continue
+            self._decode_window_once()
+        # Drain: fail whatever is still queued.
+        while not self._pending.empty():
+            try:
+                req = self._pending.get_nowait()
+                req.future.set_exception(RuntimeError("engine stopped"))
+                req.stream.put(None)
+            except queue.Empty:
+                break
+
+    def _admit_pending(self) -> bool:
+        """Prefill a batch of pending requests into free slots.
+
+        The sampled first tokens stay ON DEVICE (merged into the decode
+        token vector) — no host roundtrip between prefill and decode."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free or self._pending.empty():
+            return False
+        batch: list[tuple[int, _GenRequest]] = []
+        while len(batch) < len(free):
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            batch.append((free[len(batch)], req))
+        if not batch:
+            return False
+
+        jnp = self._jnp
+        # Overlong prompts truncate to leave room for generation + one
+        # window of overshoot (lengths advance k per window while active).
+        max_prompt_allowed = self.max_len - 1 - self.window_k
+        max_prompt = max(len(r.prompt_ids) for _, r in batch)
+        bucket = pad_bucket(
+            min(max_prompt, max_prompt_allowed),
+            tuple(b for b in _PREFILL_BUCKETS if b < self.max_len)
+            or (max_prompt_allowed,),
+        )
+        bucket = min(bucket, max_prompt_allowed)
+        # Fixed batch dimension (= n_slots): one compile per prompt bucket.
+        # Unused rows repeat row 0 (duplicate slot writes are idempotent —
+        # identical values to the same slot).
+        B = self.n_slots
+        tokens = np.zeros((B, bucket), dtype=np.int32)
+        lengths = np.zeros((B,), dtype=np.int32)
+        slots = np.zeros((B,), dtype=np.int32)
+        temps = np.ones((B,), dtype=np.float32)
+        greedy = np.ones((B,), dtype=bool)
+        for i, (slot, req) in enumerate(batch):
+            ids = req.prompt_ids[-bucket:]
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+            slots[i] = slot
+            temps[i] = req.temperature
+            greedy[i] = req.temperature <= 0
+            # Clamp generation budget so window overshoot can't overrun the
+            # cache (admission-time guard; see decode_window docstring).
+            room = self.max_len - 1 - len(ids) - self.window_k
+            req.max_new_tokens = max(1, min(req.max_new_tokens, room))
+        for i in range(len(batch), B):
+            tokens[i] = tokens[0]
+            lengths[i] = lengths[0]
+            slots[i] = slots[0]
+            temps[i] = temps[0]
+            greedy[i] = greedy[0]
+
+        self._key, sub = self._jax.random.split(self._key)
+        t0 = time.time()
+        first_tokens, self.cache = self._prefill_step(
+            self.params, jnp.asarray(tokens), self.cache, jnp.asarray(lengths),
+            jnp.asarray(slots), sub, jnp.asarray(temps), jnp.asarray(greedy),
+        )
+        self._tokens_dev = self._merge_tokens(
+            self._tokens_dev, jnp.asarray(slots), first_tokens
+        )
+        if self._metrics is not None:
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", time.time() - t0, "kind", "prefill"
+            )
+            self._metrics.record_histogram(
+                "app_tpu_batch_size", len(batch), "batcher", "prefill"
+            )
+
+        for slot, req in batch:
+            self._slots[slot] = _ActiveSeq(request=req, last_token=-1)
+        self._update_slot_gauges()
+        return True
+
+    def _decode_window_once(self) -> None:
+        """One k-step device window + a single host fetch of [k, S] tokens."""
+        jnp = self._jnp
+        active = np.zeros((self.n_slots,), dtype=bool)
+        temps = np.ones((self.n_slots,), dtype=np.float32)
+        greedy = np.ones((self.n_slots,), dtype=bool)
+        for i, seq in enumerate(self._slots):
+            if seq is not None:
+                active[i] = True
+                temps[i] = max(seq.request.temperature, 0.0)
+                greedy[i] = seq.request.temperature <= 0
+
+        self._key, sub = self._jax.random.split(self._key)
+        t0 = time.time()
+        emitted, self._tokens_dev, self.cache = self._decode_window(
+            self.params, self._tokens_dev, self.cache, jnp.asarray(active),
+            sub, jnp.asarray(temps), jnp.asarray(greedy), k=self.window_k,
+        )
+        emitted_host = np.asarray(emitted)  # [k, S] — the one roundtrip
+        if self._metrics is not None:
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", time.time() - t0, "kind", "decode_window"
+            )
+
+        now = time.time()
+        for i, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            if seq.request.ttft_s == 0.0:
+                seq.request.ttft_s = now - seq.request.enqueued_at
+                seq.first_token_at = now
+            for step in range(self.window_k):
+                tok = int(emitted_host[step, i])
+                seq.last_token = tok
+                seq.n_generated += 1
+                self._emit_token(seq, tok)
+                if self._finished(seq):
+                    self._retire(i, seq)
+                    self._slots[i] = None
+                    break
+        self._update_slot_gauges()
+
+    def _emit_token(self, seq: _ActiveSeq, tok: int) -> None:
+        seq.request.token_ids.append(tok)
+        seq.request.stream.put(tok)
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_tokens_generated", "model", self.model_name
+            )
+
+    def _finished(self, seq: _ActiveSeq) -> bool:
+        req = seq.request
+        eos = self.tokenizer.eos_id if self.tokenizer is not None else -1
+        if req.stop_on_eos and req.token_ids and req.token_ids[-1] == eos:
+            return True
+        if len(req.token_ids) >= req.max_new_tokens:
+            return True
+        prompt_len = min(len(req.prompt_ids), self.max_len - 1)
+        return prompt_len + len(req.token_ids) >= self.max_len - 1
+
+    def _retire(self, slot: int, seq: _ActiveSeq) -> None:
+        req = seq.request
+        req.stream.put(None)  # stream sentinel
+        result = GenerationResult(
+            text=self.tokenizer.decode(req.token_ids) if self.tokenizer else "",
+            token_ids=list(req.token_ids),
+            prompt_tokens=len(req.prompt_ids),
+            ttft_s=req.ttft_s,
+            duration_s=time.time() - req.enqueued_at,
+        )
+        if not req.future.done():
+            req.future.set_result(result)
+
+    def _update_slot_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        in_use = sum(1 for s in self._slots if s is not None)
+        self._metrics.set_gauge("app_tpu_kv_slots_in_use", in_use, "model", self.model_name)
+        self._metrics.set_gauge(
+            "app_tpu_queue_depth", self._pending.qsize(), "batcher", "generate"
+        )
+        try:
+            stats = self._jax.local_devices()[0].memory_stats() or {}
+            if "bytes_in_use" in stats:
+                self._metrics.set_gauge(
+                    "app_tpu_hbm_used_bytes", stats["bytes_in_use"], "chip", "0"
+                )
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # public LLM API
+    # ------------------------------------------------------------------
+
+    def submit_generate(
+        self,
+        prompt: str | list[int],
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        stop_on_eos: bool = True,
+    ) -> _GenRequest:
+        if self.family != "llm":
+            raise RuntimeError(f"model {self.model_name} is not a generative LLM")
+        if not self._running:
+            raise RuntimeError("engine not started")
+        ids = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        req = _GenRequest(
+            prompt_ids=ids,
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            stop_on_eos=stop_on_eos,
+        )
+        self._pending.put_nowait(req)
+        self._work.set()
+        return req
+
+    def generate_sync(self, prompt, timeout: float = 300.0, **kw) -> GenerationResult:
+        return self.submit_generate(prompt, **kw).future.result(timeout=timeout)
+
+    async def generate(self, prompt, **kw) -> GenerationResult:
+        req = self.submit_generate(prompt, **kw)
+        return await asyncio.wrap_future(req.future)
+
+    async def generate_stream(self, prompt, **kw):
+        """Async iterator over generated token ids."""
+        req = self.submit_generate(prompt, **kw)
+        loop = asyncio.get_running_loop()
+        while True:
+            tok = await loop.run_in_executor(None, req.stream.get)
+            if tok is None:
+                return
+            yield tok
+
+    # ------------------------------------------------------------------
+    # encoder / vision APIs (dynamic batching)
+    # ------------------------------------------------------------------
+
+    def _execute_embed(self, texts: list) -> list:
+        jnp = self._jnp
+        encoded = [
+            self.tokenizer.encode(t)[: self.max_len] if isinstance(t, str) else list(t)
+            for t in texts
+        ]
+        bucket = pad_bucket(max(len(e) for e in encoded), _PREFILL_BUCKETS)
+        bucket = min(bucket, self.max_len)
+        tokens = np.zeros((len(encoded), bucket), dtype=np.int32)
+        mask = np.zeros((len(encoded), bucket), dtype=np.int32)
+        for i, ids in enumerate(encoded):
+            ids = ids[:bucket]
+            tokens[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1
+        t0 = time.time()
+        out = np.asarray(
+            self._embed_step(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+        )
+        if self._metrics is not None:
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", time.time() - t0, "kind", "embed"
+            )
+        return [out[i] for i in range(len(encoded))]
+
+    def _execute_classify(self, images: list) -> list:
+        jnp = self._jnp
+        batch = np.stack([np.asarray(img, dtype=np.float32) for img in images])
+        t0 = time.time()
+        logits = np.asarray(self._classify_step(self.params, jnp.asarray(batch)))
+        if self._metrics is not None:
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", time.time() - t0, "kind", "classify"
+            )
+        return [logits[i] for i in range(len(images))]
+
+    def embed_sync(self, text, timeout: float = 60.0) -> np.ndarray:
+        return self._batcher.submit(text).result(timeout=timeout)
+
+    async def embed(self, text) -> np.ndarray:
+        return await asyncio.wrap_future(self._batcher.submit(text))
+
+    def classify_sync(self, image, timeout: float = 60.0) -> np.ndarray:
+        return self._batcher.submit(image).result(timeout=timeout)
+
+    async def classify(self, image) -> np.ndarray:
+        return await asyncio.wrap_future(self._batcher.submit(image))
+
+    # ------------------------------------------------------------------
+    # generic dispatch + health (container contract)
+    # ------------------------------------------------------------------
+
+    async def infer(self, inputs: Any, model: str = "", **kw) -> Any:
+        """`ctx.infer` seam: dispatch on family."""
+        if self.family == "llm":
+            result = await self.generate(inputs, **kw)
+            return {
+                "text": result.text,
+                "tokens": len(result.token_ids),
+                "ttft_ms": round(result.ttft_s * 1e3, 2),
+            }
+        if self.family == "encoder":
+            emb = await self.embed(inputs)
+            return {"embedding": emb.tolist()}
+        vec = await self.classify(inputs)
+        return {"logits": vec.tolist(), "class": int(np.argmax(vec))}
+
+    def infer_sync(self, inputs: Any, model: str = "", **kw) -> Any:
+        if self.family == "llm":
+            result = self.generate_sync(inputs, **kw)
+            return {
+                "text": result.text,
+                "tokens": len(result.token_ids),
+                "ttft_ms": round(result.ttft_s * 1e3, 2),
+            }
+        if self.family == "encoder":
+            return {"embedding": self.embed_sync(inputs).tolist()}
+        vec = self.classify_sync(inputs)
+        return {"logits": vec.tolist(), "class": int(np.argmax(vec))}
+
+    def health_check(self) -> dict:
+        devices = self._jax.devices()
+        details: dict[str, Any] = {
+            "model": self.model_name,
+            "family": self.family,
+            "devices": [str(d) for d in devices],
+            "running": self._running,
+        }
+        if self.family == "llm":
+            details["kv_slots"] = {
+                "total": self.n_slots,
+                "in_use": sum(1 for s in self._slots if s is not None),
+            }
+            details["max_len"] = self.max_len
+        return {"status": "UP" if self._running or devices else "DOWN", "details": details}

@@ -1,0 +1,66 @@
+"""Tokenizers for the serving engine.
+
+Default is a self-contained byte-level tokenizer (zero-egress environment: no
+downloadable vocabularies), with special tokens at the top of the byte range:
+ids 0..255 = raw bytes, 256 = BOS, 257 = EOS, 258 = PAD. Any model with
+vocab ≥ 259 can serve text through it. A HuggingFace tokenizer can be
+swapped in via ``TPU_TOKENIZER=<path>`` when local vocab files exist.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    pad_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    bos_id = 256
+    eos_id = 257
+    pad_id = 258
+    vocab_size = 259
+
+    def encode(self, text: str) -> list[int]:
+        return [self.bos_id] + list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", "replace")
+
+
+def tokenizer_from_config(config, logger=None) -> Tokenizer:
+    path = config.get_or_default("TPU_TOKENIZER", "")
+    if path:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+
+            class _HF:
+                bos_id = tok.bos_token_id or 0
+                eos_id = tok.eos_token_id or 0
+                pad_id = tok.pad_token_id or tok.eos_token_id or 0
+
+                def encode(self, text: str) -> list[int]:
+                    return tok.encode(text)
+
+                def decode(self, ids) -> str:
+                    return tok.decode(list(ids), skip_special_tokens=True)
+
+            return _HF()
+        except Exception as exc:
+            if logger is not None:
+                logger.errorf(
+                    "could not load tokenizer %s (%s); using byte tokenizer",
+                    path,
+                    exc,
+                )
+    return ByteTokenizer()

@@ -10,6 +10,7 @@ import asyncio
 import http.client
 import json
 import threading
+import time
 from dataclasses import dataclass
 
 import pytest
@@ -223,6 +224,38 @@ def test_keepalive_multiple_requests(app_harness):
             resp.read()
     finally:
         conn.close()
+
+
+def test_shutdown_drains_inflight_request():
+    """A request mid-handler at shutdown still gets its response."""
+    import concurrent.futures
+
+    app = make_app()
+
+    @app.get("/slow")
+    async def slow(ctx):
+        await asyncio.sleep(0.8)
+        return "made it"
+
+    harness = AppHarness(app)
+    harness.__enter__()
+    try:
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            fut = pool.submit(harness.request, "GET", "/slow")
+            time.sleep(0.2)  # request is in-flight now
+            stop = pool.submit(
+                lambda: asyncio.run_coroutine_threadsafe(
+                    harness.app.stop(), harness._loop
+                ).result(timeout=15)
+            )
+            status, _, body = fut.result(timeout=15)
+            stop.result(timeout=15)
+        assert status == 200
+        assert json.loads(body) == {"data": "made it"}
+    finally:
+        harness._loop.call_soon_threadsafe(harness._loop.stop)
+        harness._thread.join(timeout=5)
+        harness._loop.close()
 
 
 def test_favicon(app_harness):

@@ -1,0 +1,243 @@
+"""Serving engine tests: continuous batching, dynamic batcher, ctx.infer,
+and the gRPC inference service — all on the CPU backend with tiny models
+(the stub-backend strategy SURVEY §4 prescribes)."""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.serving.batcher import DynamicBatcher, pad_bucket
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def llm_engine():
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=4, max_len=128, tokenizer=ByteTokenizer()
+    )
+    eng.start_sync()
+    yield eng
+    eng.stop_sync()
+
+
+def test_pad_bucket():
+    assert pad_bucket(3, (16, 32, 64)) == 16
+    assert pad_bucket(17, (16, 32, 64)) == 32
+    assert pad_bucket(999, (16, 32, 64)) == 64
+
+
+def test_dynamic_batcher_flush_by_size_and_deadline():
+    batches = []
+
+    def execute(payloads):
+        batches.append(len(payloads))
+        return [p * 2 for p in payloads]
+
+    b = DynamicBatcher(execute, max_batch=4, max_wait_s=0.02)
+    b.start()
+    futures = [b.submit(i) for i in range(4)]
+    assert [f.result(timeout=5) for f in futures] == [0, 2, 4, 6]
+    assert batches[0] == 4  # size-triggered flush
+
+    f = b.submit(10)
+    assert f.result(timeout=5) == 20  # deadline-triggered flush of 1
+    assert batches[-1] == 1
+    b.stop()
+
+
+def test_dynamic_batcher_execute_error_fails_futures():
+    def execute(payloads):
+        raise RuntimeError("device on fire")
+
+    b = DynamicBatcher(execute, max_batch=2, max_wait_s=0.01)
+    b.start()
+    f = b.submit(1)
+    with pytest.raises(RuntimeError, match="device on fire"):
+        f.result(timeout=5)
+    b.stop()
+
+
+def test_generate_deterministic_greedy(llm_engine):
+    r1 = llm_engine.generate_sync("hello", max_new_tokens=8, temperature=0.0,
+                                  stop_on_eos=False)
+    r2 = llm_engine.generate_sync("hello", max_new_tokens=8, temperature=0.0,
+                                  stop_on_eos=False)
+    assert r1.token_ids == r2.token_ids
+    assert len(r1.token_ids) == 8
+    assert r1.ttft_s > 0
+
+
+def test_concurrent_requests_share_slots(llm_engine):
+    reqs = [
+        llm_engine.submit_generate(f"prompt {i}", max_new_tokens=6,
+                                   temperature=0.5, stop_on_eos=False)
+        for i in range(8)  # 2x the slot count → queueing works
+    ]
+    results = [r.future.result(timeout=120) for r in reqs]
+    assert all(len(r.token_ids) == 6 for r in results)
+
+
+def test_generation_independent_of_batch_composition(llm_engine):
+    """A request's tokens must not change with co-scheduled traffic."""
+    solo = llm_engine.generate_sync("isolation", max_new_tokens=6,
+                                    temperature=0.0, stop_on_eos=False)
+    reqs = [
+        llm_engine.submit_generate("isolation", max_new_tokens=6,
+                                   temperature=0.0, stop_on_eos=False)
+        for _ in range(4)
+    ]
+    noise = [
+        llm_engine.submit_generate(f"noise {i}", max_new_tokens=6,
+                                   temperature=0.9, stop_on_eos=False)
+        for i in range(4)
+    ]
+    for r in reqs:
+        assert r.future.result(timeout=120).token_ids == solo.token_ids
+    for r in noise:
+        r.future.result(timeout=120)
+
+
+def test_streaming(llm_engine):
+    async def run():
+        toks = []
+        async for tok in llm_engine.generate_stream(
+            "stream me", max_new_tokens=5, temperature=0.0, stop_on_eos=False
+        ):
+            toks.append(tok)
+        return toks
+
+    toks = asyncio.run(run())
+    assert len(toks) == 5
+
+
+def test_llm_health(llm_engine):
+    h = llm_engine.health_check()
+    assert h["status"] == "UP"
+    assert h["details"]["kv_slots"]["total"] == 4
+
+
+def test_encoder_family():
+    eng = InferenceEngine("bert-tiny", tokenizer=ByteTokenizer())
+    eng.start_sync()
+    try:
+        a = eng.embed_sync("the cat sat")
+        b = eng.embed_sync("the cat sat")
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+        assert a.shape == (128,)
+    finally:
+        eng.stop_sync()
+
+
+def test_vision_family():
+    eng = InferenceEngine("resnet-tiny")
+    eng.start_sync()
+    try:
+        out = eng.classify_sync(np.random.RandomState(0).randn(64, 64, 3))
+        assert out.shape == (10,)
+    finally:
+        eng.stop_sync()
+
+
+def test_engine_from_config_and_container():
+    from gofr_tpu.container import Container
+
+    cfg = MockConfig({
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+    })
+    c = Container.create(cfg)
+    assert c.tpu is not None
+    assert c.tpu.n_slots == 2
+    c.tpu.start_sync()
+    try:
+        out = c.tpu.infer_sync("hi", max_new_tokens=3, stop_on_eos=False)
+        assert out["tokens"] == 3
+        health = c.health()
+        assert "tpu" in health["details"]
+    finally:
+        c.tpu.stop_sync()
+
+
+def test_ctx_infer_through_http_app(free_port):
+    """ctx.infer end to end through the HTTP surface."""
+    import http.client
+    import json as jsonlib
+
+    from gofr_tpu import App
+
+    app = App(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+    }))
+
+    @app.post("/generate")
+    async def generate(ctx):
+        body = ctx.request.json()
+        return await ctx.infer(
+            body.get("prompt", ""), max_new_tokens=4, stop_on_eos=False
+        )
+
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=30)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=60)
+        conn.request(
+            "POST", "/generate", body=jsonlib.dumps({"prompt": "hey"}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        data = jsonlib.loads(resp.read())
+        assert resp.status == 201
+        assert data["data"]["tokens"] == 4
+        assert "ttft_ms" in data["data"]
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+
+
+def test_grpc_inference_service():
+    """gRPC unary + streaming against a real server."""
+    from gofr_tpu.grpc import GRPCServer, InferenceClient, add_inference_service
+    from gofr_tpu.grpc.inference import InferenceServicer
+    from gofr_tpu.logging import Logger, Level
+    import io
+
+    eng = InferenceEngine("llama-tiny", n_slots=2, max_len=64,
+                          tokenizer=ByteTokenizer())
+    eng.start_sync()
+    logger = Logger(level=Level.DEBUG, out=io.StringIO(), err=io.StringIO(),
+                    is_terminal=False)
+
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    server = GRPCServer(0, logger)
+    server.register(add_inference_service, InferenceServicer(eng))
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=30)
+    try:
+        client = InferenceClient(f"127.0.0.1:{server.port}")
+        out = client.generate("hello grpc", max_new_tokens=4, stop_on_eos=False)
+        assert out["tokens"] == 4
+        assert out["ttft_ms"] > 0
+
+        chunks = list(client.generate_stream("stream", max_new_tokens=3))
+        assert chunks[-1]["done"] is True
+        assert chunks[-1]["tokens"] == 3
+
+        health = client.health()
+        assert health["status"] == "UP"
+        client.close()
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(0), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+        eng.stop_sync()
