@@ -1,0 +1,33 @@
+"""CRUD generator example (reference ``examples/using-add-rest-handlers``):
+a dataclass entity gets five SQL-backed REST routes, created via migration."""
+
+import os
+import sys
+from dataclasses import dataclass
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App, Migrate
+
+
+@dataclass
+class User:
+    id: int = 0
+    name: str = ""
+    age: int = 0
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    app.migrate({
+        1: Migrate(up=lambda ds: ds.sql.exec(
+            "CREATE TABLE IF NOT EXISTS user "
+            "(id INTEGER PRIMARY KEY, name TEXT, age INTEGER)"
+        )),
+    })
+    app.add_rest_handlers(User)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

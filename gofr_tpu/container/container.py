@@ -171,9 +171,11 @@ class Container:
                 out["status"] = "DEGRADED"
         for svc_name, svc in self.services.items():
             try:
-                details[f"service:{svc_name}"] = svc.health_check()
+                check = svc.health_check()
             except Exception as exc:
-                details[f"service:{svc_name}"] = {"status": "DOWN", "error": str(exc)}
+                check = {"status": "DOWN", "error": str(exc)}
+            details[f"service:{svc_name}"] = check
+            if check.get("status") != "UP":
                 out["status"] = "DEGRADED"
         out["details"] = details
         return out

@@ -1,0 +1,5 @@
+"""File helpers (reference: ``pkg/gofr/file``)."""
+
+from gofr_tpu.file.zip import Zip, ZipBombError
+
+__all__ = ["Zip", "ZipBombError"]

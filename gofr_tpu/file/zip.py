@@ -1,0 +1,48 @@
+"""In-memory zip handling with a decompression-bomb guard
+(reference ``file/zip.go:13-109``: 100MB total cap).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import zipfile
+
+MAX_TOTAL_UNCOMPRESSED = 100 * 1024 * 1024  # reference file/zip.go:13-15
+
+
+class ZipBombError(Exception):
+    status_code = 413
+
+    def __init__(self) -> None:
+        super().__init__("zip contents exceed the 100MB safety limit")
+
+
+class Zip:
+    """Reads a zip archive fully into memory, per-file bytes by name."""
+
+    def __init__(self, content: bytes) -> None:
+        self.files: dict[str, bytes] = {}
+        with zipfile.ZipFile(io.BytesIO(content)) as zf:
+            total = sum(info.file_size for info in zf.infolist())
+            if total > MAX_TOTAL_UNCOMPRESSED:
+                raise ZipBombError()
+            for info in zf.infolist():
+                if info.is_dir():
+                    continue
+                self.files[info.filename] = zf.read(info)
+
+    def create_local_copies(self, dest_dir: str) -> list[str]:
+        """Write contents to disk (reference ``file/file.go:3-24``), guarding
+        against path traversal."""
+        written = []
+        for name, data in self.files.items():
+            safe = os.path.normpath(name)
+            if safe.startswith("..") or os.path.isabs(safe):
+                continue
+            path = os.path.join(dest_dir, safe)
+            os.makedirs(os.path.dirname(path) or dest_dir, exist_ok=True)
+            with open(path, "wb") as fp:
+                fp.write(data)
+            written.append(path)
+        return written

@@ -151,11 +151,21 @@ class GRPCServer:
         self._server = grpc.aio.server(
             interceptors=[_LoggingInterceptor(self._logger)]
         )
+        import inspect
+
         for add_fn, servicer in self._registrations:
+            # Two calling conventions: this framework's
+            # add_fn(server, servicer, container) vs protoc codegen's
+            # add_*_to_server(servicer, server). Decide by arity, not by
+            # catching TypeError (which would swallow real bugs in add_fn).
             try:
+                n_params = len(inspect.signature(add_fn).parameters)
+            except (TypeError, ValueError):
+                n_params = 3
+            if n_params >= 3:
                 add_fn(self._server, servicer, self.container)
-            except TypeError:
-                add_fn(servicer, self._server)  # codegen signature
+            else:
+                add_fn(servicer, self._server)
         bound = self._server.add_insecure_port(f"[::]:{self.port}")
         self.port = bound
         await self._server.start()

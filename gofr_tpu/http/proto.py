@@ -68,10 +68,12 @@ class Response:
         self.headers[key] = value
 
 
-async def read_request(reader, peer=None) -> Optional[RawRequest]:
+async def read_request(reader, peer=None, first_line: Optional[bytes] = None) -> Optional[RawRequest]:
     """Parse one request off the stream. Returns None on clean EOF before a
-    request line; raises ProtocolError on malformed input."""
-    line = await reader.readline()
+    request line; raises ProtocolError on malformed input. ``first_line``
+    lets the server read the request line itself (to detect when a request
+    starts arriving) and hand the rest off here."""
+    line = first_line if first_line is not None else await reader.readline()
     if not line:
         return None
     if len(line) > MAX_REQUEST_LINE:

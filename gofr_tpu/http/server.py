@@ -112,31 +112,42 @@ class HTTPServer:
             first = True
             while True:
                 timeout = READ_HEADER_TIMEOUT_S if first else KEEPALIVE_IDLE_TIMEOUT_S
+                # Read the request line here so the connection counts as
+                # in-flight from the first byte of a request — a slow upload
+                # mid-shutdown drains instead of being reset.
                 try:
-                    raw = await asyncio.wait_for(read_request(reader, peer=peer), timeout)
-                except asyncio.TimeoutError:
+                    line = await asyncio.wait_for(reader.readline(), timeout)
+                except (asyncio.TimeoutError, ConnectionResetError):
                     break
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                if not line:
                     break
-                except ProtocolError as exc:
-                    writer.write(
-                        serialize_response(
-                            Response(
-                                status=exc.status,
-                                headers={"Content-Type": "text/plain"},
-                                body=str(exc).encode(),
-                            ),
-                            keep_alive=False,
-                        )
-                    )
-                    await _safe_drain(writer)
-                    break
-                if raw is None:
-                    break
-                first = False
-
                 self._inflight.add(writer)
                 try:
+                    try:
+                        raw = await asyncio.wait_for(
+                            read_request(reader, peer=peer, first_line=line),
+                            READ_HEADER_TIMEOUT_S,
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    except (asyncio.IncompleteReadError, ConnectionResetError):
+                        break
+                    except ProtocolError as exc:
+                        writer.write(
+                            serialize_response(
+                                Response(
+                                    status=exc.status,
+                                    headers={"Content-Type": "text/plain"},
+                                    body=str(exc).encode(),
+                                ),
+                                keep_alive=False,
+                            )
+                        )
+                        await _safe_drain(writer)
+                        break
+                    if raw is None:
+                        break
+                    first = False
                     try:
                         resp = await self._handler(raw)
                     except Exception as exc:  # framework-level last resort

@@ -1,0 +1,35 @@
+"""Inter-service HTTP client (reference: ``pkg/gofr/service``, ~1,061 LoC).
+
+Decorator-pattern client: ``new_http_service(addr, logger, metrics,
+*options)`` folds ``Option`` wrappers over a base client (reference
+``service/new.go:68-87``, ``service/options.go:3-5``). Options: circuit
+breaker, health config, retries, API-key/basic/OAuth auth, default headers.
+"""
+
+from gofr_tpu.service.client import HTTPService, Response, new_http_service
+from gofr_tpu.service.circuit_breaker import (
+    CircuitBreakerConfig,
+    CircuitOpenError,
+)
+from gofr_tpu.service.options import (
+    APIKeyConfig,
+    BasicAuthConfig,
+    DefaultHeaders,
+    HealthConfig,
+    OAuthConfig,
+    RetryConfig,
+)
+
+__all__ = [
+    "HTTPService",
+    "Response",
+    "new_http_service",
+    "CircuitBreakerConfig",
+    "CircuitOpenError",
+    "APIKeyConfig",
+    "BasicAuthConfig",
+    "OAuthConfig",
+    "DefaultHeaders",
+    "HealthConfig",
+    "RetryConfig",
+]

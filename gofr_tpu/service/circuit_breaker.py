@@ -1,0 +1,128 @@
+"""Circuit breaker option (reference ``service/circuit_breaker.go:12-212``).
+
+Closed → Open after ``threshold`` consecutive failures; while Open, calls
+fast-fail with :class:`CircuitOpenError` and a background ticker probes the
+health endpoint every ``interval`` seconds to auto-close (reference
+``circuit_breaker.go:57-96,106-118``); a request-path probe also closes the
+circuit when a live call succeeds after recovery.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+class CircuitOpenError(Exception):
+    def __init__(self) -> None:
+        super().__init__("circuit breaker is open; service unavailable")
+        self.status_code = 503
+
+
+@dataclass
+class CircuitBreakerConfig:
+    threshold: int = 5
+    interval_s: float = 10.0
+
+    def add_option(self, svc):
+        return _CircuitBreakerService(svc, self.threshold, self.interval_s)
+
+
+class _CircuitBreakerService:
+    """Wraps an HTTPService; delegates everything else."""
+
+    def __init__(self, inner, threshold: int, interval_s: float) -> None:
+        self._inner = inner
+        self._threshold = threshold
+        self._interval = interval_s
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._open = False
+        self._opened_at = 0.0
+        self._stop = threading.Event()
+        self._ticker: threading.Thread | None = None
+
+    # delegate attribute access (decorator pattern without inheritance)
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._open
+
+    def _record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._open:
+                self._open = False
+        self._stop_ticker()
+
+    def _record_failure(self) -> None:
+        start_ticker = False
+        with self._lock:
+            self._failures += 1
+            if self._failures > self._threshold and not self._open:
+                self._open = True
+                self._opened_at = time.time()
+                start_ticker = True
+        if start_ticker:
+            self._start_ticker()
+
+    def _start_ticker(self) -> None:
+        """Health-probe loop to auto-close (reference ``:106-118``)."""
+        self._stop.clear()
+        self._ticker = threading.Thread(
+            target=self._probe_loop, name="circuit-breaker-probe", daemon=True
+        )
+        self._ticker.start()
+
+    def _stop_ticker(self) -> None:
+        self._stop.set()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            if self._healthy():
+                self._record_success()
+                return
+
+    def _healthy(self) -> bool:
+        try:
+            return self._inner.health_check().get("status") == "UP"
+        except Exception:
+            return False
+
+    def request(self, method: str, path: str, **kw):
+        if self.is_open:
+            # Recovery probe on the request path (reference :149-156).
+            if self._healthy():
+                self._record_success()
+            else:
+                raise CircuitOpenError()
+        try:
+            resp = self._inner.request(method, path, **kw)
+        except Exception:
+            self._record_failure()
+            raise
+        if resp.status_code >= 500:
+            self._record_failure()
+        else:
+            self._record_success()
+        return resp
+
+    # verb helpers must route through the breaker's request()
+    def get(self, path, params=None, headers=None):
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
+
+    def put(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
+
+    def patch(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
+
+    def delete(self, path, params=None, body=None, headers=None):
+        return self.request("DELETE", path, params=params, body=body, headers=headers)

@@ -1,0 +1,158 @@
+"""Base HTTP service client.
+
+Reference behavior (``service/new.go:26-211``): per-call span with
+traceparent injection, ``app_http_service_response`` histogram, structured
+request logs, ``Response{body, status_code}`` + header access, and a
+``.well-known/alive`` health probe consumed by the container's aggregate
+health (``container/health.go:23-25``).
+"""
+
+from __future__ import annotations
+
+import json as jsonlib
+import time
+from typing import Any, Mapping, Optional
+
+import httpx
+
+from gofr_tpu.tracing import get_tracer, inject_traceparent
+
+
+class Response:
+    def __init__(self, body: bytes, status_code: int, headers: Mapping[str, str]) -> None:
+        self.body = body
+        self.status_code = status_code
+        self._headers = dict(headers)
+
+    def get_header(self, key: str) -> str:
+        return self._headers.get(key, self._headers.get(key.lower(), ""))
+
+    def json(self) -> Any:
+        return jsonlib.loads(self.body or b"null")
+
+
+class ServiceLog:
+    """Structured outbound-call log (reference ``service/logger.go:13-37``)."""
+
+    def __init__(self, method: str, url: str, status: int, duration_us: int, trace_id: str) -> None:
+        self.method = method
+        self.url = url
+        self.status = status
+        self.duration = duration_us
+        self.trace_id = trace_id
+
+    def to_log_dict(self) -> dict:
+        return {
+            "method": self.method, "uri": self.url, "response_code": self.status,
+            "response_time": self.duration, "trace_id": self.trace_id,
+        }
+
+    def pretty_print(self, fp) -> None:
+        fp.write(
+            f"\x1b[38;5;8mSVC\x1b[0m {self.duration:>8}µs {self.status} "
+            f"{self.method} {self.url}\n"
+        )
+
+
+class HTTPService:
+    """Concrete client; options wrap/extend it (``AddOption`` pattern)."""
+
+    def __init__(self, address: str, logger=None, metrics=None, timeout: float = 30.0) -> None:
+        self.address = address.rstrip("/")
+        self._logger = logger
+        self._metrics = metrics
+        self._client = httpx.Client(timeout=timeout)
+        self.health_endpoint = ".well-known/alive"  # reference service/health.go:18-20
+
+    # -- core request (reference service/new.go:135-192) ------------------
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        *,
+        params: Optional[Mapping[str, Any]] = None,
+        headers: Optional[Mapping[str, str]] = None,
+        body: Optional[bytes] = None,
+        json: Any = None,
+    ) -> Response:
+        url = f"{self.address}/{path.lstrip('/')}" if path else self.address
+        hdrs = dict(headers or {})
+        span = get_tracer().start_span(
+            f"http-service {method} {url}", attributes={"http.url": url}
+        )
+        inject_traceparent(hdrs, span)
+        start = time.time()
+        status = 0
+        try:
+            try:
+                resp = self._client.request(
+                    method, url, params=params, headers=hdrs, content=body, json=json
+                )
+            except httpx.TransportError as exc:
+                # Downstream unreachable → typed 503, not an anonymous 500
+                # (the responder honors status_code; the breaker still counts
+                # the raised error as a failure).
+                from gofr_tpu.errors import ErrorServiceUnavailable
+
+                raise ErrorServiceUnavailable(f"{self.address}: {exc}") from exc
+            status = resp.status_code
+            return Response(resp.content, resp.status_code, resp.headers)
+        finally:
+            elapsed = time.time() - start
+            span.set_attribute("http.status_code", status)
+            span.end()
+            if self._metrics is not None:
+                self._metrics.record_histogram(
+                    "app_http_service_response", elapsed,
+                    "path", f"{self.address}/{path.lstrip('/')}", "method", method,
+                    "status", str(status),
+                )
+            log = ServiceLog(method, url, status, int(elapsed * 1e6), span.trace_id)
+            if self._logger is not None:
+                if status == 0 or status >= 500:
+                    self._logger.error(log)
+                else:
+                    self._logger.debug(log)
+
+    # -- verb helpers (reference service/new.go:89-133) --------------------
+
+    def get(self, path: str, params=None, headers=None) -> Response:
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
+
+    def put(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
+
+    def patch(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
+
+    def delete(self, path: str, params=None, body=None, headers=None) -> Response:
+        return self.request("DELETE", path, params=params, body=body, headers=headers)
+
+    # -- health (reference service/health.go) ------------------------------
+
+    def health_check(self) -> dict:
+        try:
+            resp = self.get(self.health_endpoint)
+            if resp.status_code < 400:
+                return {"status": "UP", "details": {"host": self.address}}
+            return {
+                "status": "DOWN",
+                "details": {"host": self.address, "error": f"status {resp.status_code}"},
+            }
+        except Exception as exc:
+            return {"status": "DOWN", "details": {"host": self.address, "error": str(exc)}}
+
+    def close(self) -> None:
+        self._client.close()
+
+
+def new_http_service(address: str, logger=None, metrics=None, *options) -> HTTPService:
+    """Factory folding option decorators (reference ``service/new.go:68-87``)."""
+    svc = HTTPService(address, logger=logger, metrics=metrics)
+    for option in options:
+        svc = option.add_option(svc)
+    return svc

@@ -1,0 +1,179 @@
+"""Service client option decorators (reference ``service/options.go:3-5`` +
+the per-option files: ``apikey_auth.go``, ``basic_auth.go``, ``oauth.go``,
+``health_config.go``, ``default_headers.go``)."""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+
+class _HeaderInjector:
+    """Shared shape: wraps a service and injects headers per request."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _headers(self) -> dict:
+        return {}
+
+    def request(self, method: str, path: str, *, headers=None, **kw):
+        merged = {**self._headers(), **(headers or {})}
+        return self._inner.request(method, path, headers=merged, **kw)
+
+    def get(self, path, params=None, headers=None):
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
+
+    def put(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
+
+    def patch(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
+
+    def delete(self, path, params=None, body=None, headers=None):
+        return self.request("DELETE", path, params=params, body=body, headers=headers)
+
+
+@dataclass
+class APIKeyConfig:
+    """X-API-KEY header on every call (reference ``service/apikey_auth.go``)."""
+
+    api_key: str
+
+    def add_option(self, svc):
+        cfg = self
+
+        class _Svc(_HeaderInjector):
+            def _headers(self) -> dict:
+                return {"X-API-KEY": cfg.api_key}
+
+        return _Svc(svc)
+
+
+@dataclass
+class BasicAuthConfig:
+    """Authorization: Basic (reference ``service/basic_auth.go``)."""
+
+    username: str
+    password: str
+
+    def add_option(self, svc):
+        token = base64.b64encode(
+            f"{self.username}:{self.password}".encode()
+        ).decode()
+
+        class _Svc(_HeaderInjector):
+            def _headers(self) -> dict:
+                return {"Authorization": f"Basic {token}"}
+
+        return _Svc(svc)
+
+
+@dataclass
+class OAuthConfig:
+    """Client-credentials bearer token with caching + refresh
+    (reference ``service/oauth.go:15-33``)."""
+
+    token_url: str
+    client_id: str
+    client_secret: str
+    scopes: tuple = ()
+    _cache: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _token(self) -> str:
+        with self._lock:
+            tok = self._cache.get("token")
+            if tok and time.time() < self._cache.get("expiry", 0) - 30:
+                return tok
+            import json as jsonlib
+            import urllib.parse
+            import urllib.request
+
+            data = urllib.parse.urlencode({
+                "grant_type": "client_credentials",
+                "client_id": self.client_id,
+                "client_secret": self.client_secret,
+                **({"scope": " ".join(self.scopes)} if self.scopes else {}),
+            }).encode()
+            with urllib.request.urlopen(
+                urllib.request.Request(self.token_url, data=data), timeout=10
+            ) as resp:
+                payload = jsonlib.loads(resp.read())
+            self._cache["token"] = payload["access_token"]
+            self._cache["expiry"] = time.time() + float(payload.get("expires_in", 3600))
+            return self._cache["token"]
+
+    def add_option(self, svc):
+        cfg = self
+
+        class _Svc(_HeaderInjector):
+            def _headers(self) -> dict:
+                return {"Authorization": f"Bearer {cfg._token()}"}
+
+        return _Svc(svc)
+
+
+@dataclass
+class DefaultHeaders:
+    headers: Mapping[str, str]
+
+    def add_option(self, svc):
+        cfg = self
+
+        class _Svc(_HeaderInjector):
+            def _headers(self) -> dict:
+                return dict(cfg.headers)
+
+        return _Svc(svc)
+
+
+@dataclass
+class HealthConfig:
+    """Override the health endpoint (reference ``service/health_config.go``)."""
+
+    endpoint: str = ".well-known/alive"
+
+    def add_option(self, svc):
+        svc.health_endpoint = self.endpoint.lstrip("/")
+        return svc
+
+
+@dataclass
+class RetryConfig:
+    """Retry 5xx / connection errors with exponential backoff (net-new;
+    the reference leaves retries to the caller)."""
+
+    max_retries: int = 3
+    backoff_s: float = 0.1
+
+    def add_option(self, svc):
+        cfg = self
+
+        class _Svc(_HeaderInjector):
+            def request(self, method: str, path: str, **kw):
+                last_exc: Optional[Exception] = None
+                for attempt in range(cfg.max_retries + 1):
+                    try:
+                        resp = self._inner.request(method, path, **kw)
+                        if resp.status_code < 500 or attempt == cfg.max_retries:
+                            return resp
+                    except Exception as exc:  # connection errors
+                        last_exc = exc
+                        if attempt == cfg.max_retries:
+                            raise
+                    time.sleep(cfg.backoff_s * (2**attempt))
+                if last_exc is not None:
+                    raise last_exc
+                return resp
+
+        return _Svc(svc)

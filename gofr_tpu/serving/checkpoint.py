@@ -1,0 +1,50 @@
+"""Model checkpoint save/restore (net-new; SURVEY §5 maps the reference's
+durable-progress machinery — migrations/offsets — onto model state: the
+serving engine restores params from ``TPU_CHECKPOINT`` at boot instead of
+random init, and training loops snapshot params+opt state).
+
+Backed by orbax (the TPU-ecosystem checkpointer): sharded-aware save and
+restore so multi-chip params round-trip without gathering to one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=force)
+
+
+def restore_checkpoint(path: str, like: Any | None = None) -> Any:
+    """Restore; ``like`` (a pytree of arrays or ShapeDtypeStructs, possibly
+    with shardings) guides layout + placement when given."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(path, target=like)
+        return ckptr.restore(path)
+
+
+def maybe_restore_params(config, params: Any, logger=None) -> Any:
+    """Engine boot seam: replace random-init params with a checkpoint when
+    ``TPU_CHECKPOINT`` points at one."""
+    path = config.get_or_default("TPU_CHECKPOINT", "") if config is not None else ""
+    if not path:
+        return params
+    try:
+        restored = restore_checkpoint(path, like=params)
+        if logger is not None:
+            logger.infof("restored model params from %s", path)
+        return restored
+    except Exception as exc:
+        if logger is not None:
+            logger.errorf("could not restore checkpoint %s: %s", path, exc)
+        return params

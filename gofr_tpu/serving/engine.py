@@ -73,6 +73,9 @@ class _GenRequest:
     enqueued_at: float = field(default_factory=time.time)
     token_ids: list[int] = field(default_factory=list)
     ttft_s: float = 0.0
+    # Prompt length actually in the cache (set at admission; prompts longer
+    # than the prefill bucket are truncated).
+    effective_prompt_len: int = 0
 
 
 class InferenceEngine:
@@ -88,6 +91,7 @@ class InferenceEngine:
         max_wait_s: float = 0.005,
         window_k: int = 8,
         top_k: int = 0,
+        mesh=None,
         logger=None,
         metrics=None,
         tokenizer=None,
@@ -107,9 +111,27 @@ class InferenceEngine:
         self._metrics = metrics
         self._top_k = top_k
         self.tokenizer = tokenizer
+        self.mesh = mesh  # multi-chip: NamedSharding placement over ICI
 
         t0 = time.time()
-        self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
+        if mesh is not None and self.family == "llm":
+            # Sharded init: params materialize directly onto the mesh with
+            # their Megatron-style partition specs — never gathered on one
+            # chip (an 8B model doesn't fit one v5e).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from gofr_tpu.models.transformer import transformer_param_specs
+
+            specs = transformer_param_specs(self.cfg)
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            self.params = jax.jit(
+                lambda k: self.spec.init(k, self.cfg), out_shardings=shardings
+            )(jax.random.PRNGKey(seed))
+        else:
+            self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
         if logger is not None:
             n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
             logger.infof(
@@ -126,10 +148,23 @@ class InferenceEngine:
             self.max_len = min(max_len, self.cfg.max_len)
             self.n_slots = n_slots
             self.window_k = max(1, window_k)
-            self.cache = KVCache.create(
+            make_cache = lambda: KVCache.create(  # noqa: E731
                 self.cfg.n_layers, n_slots, self.max_len,
                 self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
             )
+            if mesh is not None:
+                # KV heads shard over tp — same layout prefill and decode.
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from gofr_tpu.models.transformer import kv_cache_specs
+
+                cache_shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), kv_cache_specs(),
+                    is_leaf=lambda x: isinstance(x, PartitionSpec),
+                )
+                self.cache = jax.jit(make_cache, out_shardings=cache_shardings)()
+            else:
+                self.cache = make_cache()
             self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
             self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
             self._work = threading.Event()
@@ -159,9 +194,22 @@ class InferenceEngine:
     @classmethod
     def from_config(cls, config, logger=None, metrics=None) -> "InferenceEngine":
         """Container seam: all knobs are TPU_* env keys (the datasource
-        config idiom, reference ``sql/sql.go:109-118``)."""
-        return cls(
+        config idiom, reference ``sql/sql.go:109-118``).
+
+        ``TPU_MESH_TP=N`` serves tensor-parallel over N chips (ICI): params
+        Megatron-sharded, KV heads sharded, XLA inserts the collectives.
+        Data-parallel serving scale-out is engine replicas behind the
+        service tier (the DCN story, SURVEY §2.6), not a mesh axis here.
+        """
+        mesh = None
+        tp = int(config.get_or_default("TPU_MESH_TP", "1"))
+        if tp > 1:
+            from gofr_tpu.parallel import make_mesh
+
+            mesh = make_mesh({"tp": tp})
+        engine = cls(
             config.get_or_default("TPU_MODEL", "llama-tiny"),
+            mesh=mesh,
             n_slots=int(config.get_or_default("TPU_KV_SLOTS", "8")),
             max_len=int(config.get_or_default("TPU_MAX_LEN", "1024")),
             max_batch=int(config.get_or_default("TPU_MAX_BATCH", "8")),
@@ -172,6 +220,10 @@ class InferenceEngine:
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
         )
+        from gofr_tpu.serving.checkpoint import maybe_restore_params
+
+        engine.params = maybe_restore_params(config, engine.params, logger)
+        return engine
 
     def _build_llm_steps(self) -> None:
         jax, jnp = self._jax, self._jnp
@@ -294,14 +346,23 @@ class InferenceEngine:
                     self._work.clear()
                 continue
             self._decode_window_once()
-        # Drain: fail whatever is still queued.
+        # Drain: fail queued requests AND active slots so no awaiting caller
+        # hangs on an unresolved future / unterminated stream.
         while not self._pending.empty():
             try:
                 req = self._pending.get_nowait()
-                req.future.set_exception(RuntimeError("engine stopped"))
-                req.stream.put(None)
             except queue.Empty:
                 break
+            req.future.set_exception(RuntimeError("engine stopped"))
+            req.stream.put(None)
+        for i, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            req = seq.request
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("engine stopped"))
+            req.stream.put(None)
+            self._slots[i] = None
 
     def _admit_pending(self) -> bool:
         """Prefill a batch of pending requests into free slots.
@@ -326,12 +387,13 @@ class InferenceEngine:
         # window of overshoot (lengths advance k per window while active).
         max_prompt_allowed = self.max_len - 1 - self.window_k
         max_prompt = max(len(r.prompt_ids) for _, r in batch)
-        bucket = pad_bucket(
-            min(max_prompt, max_prompt_allowed),
-            tuple(b for b in _PREFILL_BUCKETS if b < self.max_len)
-            or (max_prompt_allowed,),
-        )
-        bucket = min(bucket, max_prompt_allowed)
+        # Bucket ladder always ends at max_prompt_allowed so prompts between
+        # the last power-of-two bucket and the cache limit aren't truncated
+        # below what fits.
+        buckets = tuple(
+            b for b in _PREFILL_BUCKETS if b < max_prompt_allowed
+        ) + (max_prompt_allowed,)
+        bucket = pad_bucket(min(max_prompt, max_prompt_allowed), buckets)
         # Fixed batch dimension (= n_slots): one compile per prompt bucket.
         # Unused rows repeat row 0 (duplicate slot writes are idempotent —
         # identical values to the same slot).
@@ -343,6 +405,7 @@ class InferenceEngine:
         greedy = np.ones((B,), dtype=bool)
         for i, (slot, req) in enumerate(batch):
             ids = req.prompt_ids[-bucket:]
+            req.effective_prompt_len = len(ids)
             tokens[i, : len(ids)] = ids
             lengths[i] = len(ids)
             slots[i] = slot
@@ -438,7 +501,7 @@ class InferenceEngine:
             return True
         if len(req.token_ids) >= req.max_new_tokens:
             return True
-        prompt_len = min(len(req.prompt_ids), self.max_len - 1)
+        prompt_len = req.effective_prompt_len or len(req.prompt_ids)
         return prompt_len + len(req.token_ids) >= self.max_len - 1
 
     def _retire(self, slot: int, seq: _ActiveSeq) -> None:
@@ -613,4 +676,4 @@ class InferenceEngine:
                 "in_use": sum(1 for s in self._slots if s is not None),
             }
             details["max_len"] = self.max_len
-        return {"status": "UP" if self._running or devices else "DOWN", "details": details}
+        return {"status": "UP" if self._running else "DOWN", "details": details}

@@ -45,9 +45,15 @@ def tokenizer_from_config(config, logger=None) -> Tokenizer:
             tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
 
             class _HF:
-                bos_id = tok.bos_token_id or 0
-                eos_id = tok.eos_token_id or 0
-                pad_id = tok.pad_token_id or tok.eos_token_id or 0
+                # Explicit None checks: id 0 is a real vocab token and a
+                # missing eos must disable eos-stopping, not stop on id 0.
+                bos_id = tok.bos_token_id if tok.bos_token_id is not None else -1
+                eos_id = tok.eos_token_id if tok.eos_token_id is not None else -1
+                pad_id = (
+                    tok.pad_token_id
+                    if tok.pad_token_id is not None
+                    else (tok.eos_token_id if tok.eos_token_id is not None else -1)
+                )
 
                 def encode(self, text: str) -> list[int]:
                     return tok.encode(text)

@@ -1,0 +1,18 @@
+"""Test utilities (reference: ``pkg/gofr/testutil``).
+
+The stdout/stderr capture harness (reference ``testutil/os.go:8-36``), a
+configurable mock logger (``testutil/mock_logger.go``), and ``CustomError``
+(``testutil/error.go``).
+"""
+
+from gofr_tpu.testutil.capture import stderr_output_for_func, stdout_output_for_func
+from gofr_tpu.testutil.mock_logger import CapturedLog, MockLogger
+from gofr_tpu.testutil.errors import CustomError
+
+__all__ = [
+    "stdout_output_for_func",
+    "stderr_output_for_func",
+    "MockLogger",
+    "CapturedLog",
+    "CustomError",
+]

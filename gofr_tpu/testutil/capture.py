@@ -1,0 +1,22 @@
+"""stdout/stderr capture harness (reference ``testutil/os.go:8-36``):
+run a function, return what it printed — used to assert on log output."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+from typing import Callable
+
+
+def stdout_output_for_func(fn: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        fn()
+    return buf.getvalue()
+
+
+def stderr_output_for_func(fn: Callable[[], None]) -> str:
+    buf = io.StringIO()
+    with contextlib.redirect_stderr(buf):
+        fn()
+    return buf.getvalue()

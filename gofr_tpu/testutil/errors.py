@@ -1,0 +1,6 @@
+"""CustomError (reference ``testutil/error.go:3-9``)."""
+
+
+class CustomError(Exception):
+    def __init__(self, message: str = "custom error") -> None:
+        super().__init__(message)

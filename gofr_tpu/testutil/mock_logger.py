@@ -1,0 +1,64 @@
+"""Capturing mock logger (reference ``testutil/mock_logger.go:19-37``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from gofr_tpu.logging.level import Level
+
+
+@dataclass
+class CapturedLog:
+    level: Level
+    message: Any
+
+
+class MockLogger:
+    """Records every call; assert via ``.logs`` / ``messages_at``."""
+
+    def __init__(self, level: Level = Level.DEBUG) -> None:
+        self.level = level
+        self.logs: list[CapturedLog] = []
+
+    def _record(self, level: Level, args, fmt=None) -> None:
+        if level < self.level:
+            return
+        if fmt is not None:
+            try:
+                msg: Any = (fmt % args) if args else fmt
+            except (TypeError, ValueError):
+                msg = f"{fmt} {args!r}"
+        elif len(args) == 1:
+            msg = args[0]
+        else:
+            msg = " ".join(str(a) for a in args)
+        self.logs.append(CapturedLog(level, msg))
+
+    def messages_at(self, level: Level) -> list:
+        return [log.message for log in self.logs if log.level == level]
+
+    def change_level(self, level: Level) -> None:
+        self.level = level
+
+    # leveled methods
+    def debug(self, *a): self._record(Level.DEBUG, a)
+    def debugf(self, fmt, *a): self._record(Level.DEBUG, a, fmt)
+    def log(self, *a): self._record(Level.INFO, a)
+    def logf(self, fmt, *a): self._record(Level.INFO, a, fmt)
+    def info(self, *a): self._record(Level.INFO, a)
+    def infof(self, fmt, *a): self._record(Level.INFO, a, fmt)
+    def notice(self, *a): self._record(Level.NOTICE, a)
+    def noticef(self, fmt, *a): self._record(Level.NOTICE, a, fmt)
+    def warn(self, *a): self._record(Level.WARN, a)
+    def warnf(self, fmt, *a): self._record(Level.WARN, a, fmt)
+    def error(self, *a): self._record(Level.ERROR, a)
+    def errorf(self, fmt, *a): self._record(Level.ERROR, a, fmt)
+
+    def fatal(self, *a):
+        self._record(Level.FATAL, a)
+        raise SystemExit(1)
+
+    def fatalf(self, fmt, *a):
+        self._record(Level.FATAL, a, fmt)
+        raise SystemExit(1)

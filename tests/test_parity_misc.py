@@ -1,0 +1,273 @@
+"""CRUD generator, auth middleware, file/zip, testutil, checkpoint tests."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import io
+import json
+import threading
+import time
+import zipfile
+from dataclasses import dataclass
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MockConfig
+
+
+@dataclass
+class Book:
+    id: int = 0
+    title: str = ""
+    author_name: str = ""
+
+
+class Harness:
+    def __init__(self, app):
+        self.app = app
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(self.app.start(), self._loop).result(10)
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.run_coroutine_threadsafe(self.app.stop(), self._loop).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self._loop.close()
+
+    def request(self, method, path, body=None, headers=None):
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", self.app.http_port, timeout=10)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            hdrs = {"Content-Type": "application/json", **(headers or {})}
+            conn.request(method, path, body=payload, headers=hdrs)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"null")
+        finally:
+            conn.close()
+
+
+# ---------------- CRUD generator ----------------
+
+
+def test_crud_full_lifecycle():
+    app = App(config=MockConfig({
+        "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "DB_DIALECT": "sqlite", "DB_NAME": ":memory:",
+    }))
+    app.container.sql.exec(
+        "CREATE TABLE book (id INTEGER PRIMARY KEY, title TEXT, author_name TEXT)"
+    )
+    app.add_rest_handlers(Book)
+
+    with Harness(app) as h:
+        status, body = h.request(
+            "POST", "/book", {"id": 1, "title": "Dune", "author_name": "Herbert"}
+        )
+        assert status == 201
+        assert "successfully created" in body["data"]
+
+        status, body = h.request("GET", "/book")
+        assert status == 200
+        assert body["data"] == [
+            {"id": 1, "title": "Dune", "author_name": "Herbert"}
+        ]
+
+        status, body = h.request("GET", "/book/1")
+        assert body["data"]["title"] == "Dune"
+
+        status, body = h.request(
+            "PUT", "/book/1", {"title": "Dune II", "author_name": "Herbert"}
+        )
+        assert "successfully updated" in body["data"]
+
+        status, body = h.request("GET", "/book/99")
+        assert status == 404
+
+        status, body = h.request("DELETE", "/book/1")
+        assert status == 204  # DELETE strips the body (responder.go:27-41)
+        status, _ = h.request("GET", "/book/1")
+        assert status == 404
+
+
+def test_crud_scan_entity_and_snake_case():
+    from gofr_tpu.crud import scan_entity, to_snake_case
+
+    assert to_snake_case("AuthorName") == "author_name"
+    assert to_snake_case("HTTPServer") == "http_server"
+    table, cols, pk = scan_entity(Book)
+    assert (table, pk) == ("book", "id")
+    assert cols == ["id", "title", "author_name"]
+    with pytest.raises(TypeError):
+        scan_entity(dict)
+
+
+# ---------------- auth middleware through the app ----------------
+
+
+def test_basic_auth_enabled_app():
+    app = App(config=MockConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    app.get("/secret", lambda ctx: "classified")
+    app.enable_basic_auth({"admin": "pw123"})
+    with Harness(app) as h:
+        status, _ = h.request("GET", "/secret")
+        assert status == 401
+        token = base64.b64encode(b"admin:pw123").decode()
+        status, body = h.request(
+            "GET", "/secret", headers={"Authorization": f"Basic {token}"}
+        )
+        assert (status, body["data"]) == (200, "classified")
+        # well-known stays open (reference validate.go:5-7)
+        status, _ = h.request("GET", "/.well-known/alive")
+        assert status == 200
+
+
+def test_api_key_auth_enabled_app():
+    app = App(config=MockConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+    app.get("/secret", lambda ctx: "classified")
+    app.enable_api_key_auth("key-1", "key-2")
+    with Harness(app) as h:
+        assert h.request("GET", "/secret")[0] == 401
+        status, _ = h.request("GET", "/secret", headers={"X-API-KEY": "key-2"})
+        assert status == 200
+
+
+def test_oauth_hs256_jwt_middleware():
+    from gofr_tpu.http.middleware import oauth_middleware
+
+    secret = b"shh"
+    app = App(config=MockConfig({"HTTP_PORT": "0", "METRICS_PORT": "0"}))
+
+    @app.get("/claims")
+    def claims(ctx):
+        return ctx.get("JWTClaims")
+
+    app.use_middleware(oauth_middleware(hs_secret=secret))
+
+    def make_jwt(payload: dict) -> str:
+        def b64(b: bytes) -> str:
+            return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+        header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        body = b64(json.dumps(payload).encode())
+        sig = hmac.new(secret, f"{header}.{body}".encode(), hashlib.sha256).digest()
+        return f"{header}.{body}.{b64(sig)}"
+
+    with Harness(app) as h:
+        assert h.request("GET", "/claims")[0] == 401
+        good = make_jwt({"sub": "ada", "exp": time.time() + 60})
+        status, body = h.request(
+            "GET", "/claims", headers={"Authorization": f"Bearer {good}"}
+        )
+        assert status == 200
+        assert body["data"]["sub"] == "ada"
+
+        expired = make_jwt({"sub": "ada", "exp": time.time() - 10})
+        status, body = h.request(
+            "GET", "/claims", headers={"Authorization": f"Bearer {expired}"}
+        )
+        assert status == 401
+        assert "expired" in body["error"]["message"]
+
+        tampered = good[:-4] + "AAAA"
+        assert h.request(
+            "GET", "/claims", headers={"Authorization": f"Bearer {tampered}"}
+        )[0] == 401
+
+
+# ---------------- file / zip ----------------
+
+
+def test_zip_roundtrip_and_local_copies(tmp_path):
+    from gofr_tpu.file import Zip
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("a.txt", "hello")
+        zf.writestr("sub/b.txt", "world")
+        zf.writestr("../evil.txt", "nope")
+    z = Zip(buf.getvalue())
+    assert z.files["a.txt"] == b"hello"
+    written = z.create_local_copies(str(tmp_path))
+    assert (tmp_path / "a.txt").read_text() == "hello"
+    assert (tmp_path / "sub" / "b.txt").read_text() == "world"
+    assert not (tmp_path.parent / "evil.txt").exists()
+    assert len(written) == 2
+
+
+def test_zip_bomb_guard():
+    from gofr_tpu.file import Zip, ZipBombError
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("big.bin", b"\0" * (101 * 1024 * 1024))
+    with pytest.raises(ZipBombError):
+        Zip(buf.getvalue())
+
+
+# ---------------- testutil ----------------
+
+
+def test_testutil_capture_and_mock_logger():
+    from gofr_tpu.logging import Level
+    from gofr_tpu.testutil import (
+        CustomError,
+        MockLogger,
+        stdout_output_for_func,
+    )
+
+    out = stdout_output_for_func(lambda: print("captured!"))
+    assert out == "captured!\n"
+
+    log = MockLogger()
+    log.infof("x=%d", 5)
+    log.error("bad")
+    assert log.messages_at(Level.INFO) == ["x=5"]
+    assert log.messages_at(Level.ERROR) == ["bad"]
+    with pytest.raises(SystemExit):
+        log.fatal("die")
+    assert str(CustomError("msg")) == "msg"
+
+
+# ---------------- checkpoint ----------------
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax
+    import numpy as np
+
+    from gofr_tpu.models.registry import get_model
+    from gofr_tpu.serving.checkpoint import (
+        maybe_restore_params,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    spec = get_model("llama-tiny")
+    params = spec.init(jax.random.PRNGKey(7), spec.config)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, params)
+    restored = restore_checkpoint(path, like=params)
+    np.testing.assert_array_equal(
+        np.asarray(params["layers"]["wq"], dtype=np.float32),
+        np.asarray(restored["layers"]["wq"], dtype=np.float32),
+    )
+
+    # Engine boot seam: TPU_CHECKPOINT swaps random init for the checkpoint.
+    other = spec.init(jax.random.PRNGKey(8), spec.config)
+    cfg = MockConfig({"TPU_CHECKPOINT": path})
+    swapped = maybe_restore_params(cfg, other)
+    np.testing.assert_array_equal(
+        np.asarray(swapped["layers"]["wq"], dtype=np.float32),
+        np.asarray(params["layers"]["wq"], dtype=np.float32),
+    )
