@@ -29,6 +29,10 @@ Handler = Callable[[RawRequest], Awaitable[Response]]
 
 READ_HEADER_TIMEOUT_S = 5.0  # reference httpServer.go:27
 KEEPALIVE_IDLE_TIMEOUT_S = 75.0
+# The reference sets only ReadHeaderTimeout; bodies may stream for as long
+# as they need. Bound them generously instead of inheriting the 5s header
+# budget (which would reset slow uploads mid-stream with no response).
+BODY_READ_TIMEOUT_S = 300.0
 
 
 class HTTPServer:
@@ -126,7 +130,7 @@ class HTTPServer:
                     try:
                         raw = await asyncio.wait_for(
                             read_request(reader, peer=peer, first_line=line),
-                            READ_HEADER_TIMEOUT_S,
+                            BODY_READ_TIMEOUT_S,
                         )
                     except asyncio.TimeoutError:
                         break

@@ -9,10 +9,29 @@ TPU. The dispatch helpers pick the kernel path when available.
 
 from __future__ import annotations
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# GOFR_TPU_FLASH: "1" force kernels (interpret-mode off-TPU), "0" force
+# dense, unset/"auto" → kernels on TPU backends only.
+_FLASH_ENV = os.environ.get("GOFR_TPU_FLASH", "auto")
+
+
+def _flash_enabled() -> bool:
+    if _FLASH_ENV == "1":
+        return True
+    if _FLASH_ENV == "0":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -33,12 +52,19 @@ def attention(
     causal: bool = True,
     mask: jnp.ndarray | None = None,
     scale: float | None = None,
+    kernel: bool | None = None,
 ) -> jnp.ndarray:
     """Full-sequence attention (prefill / encoder).
 
     q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd].
     mask: optional [b, s_q, s_kv] additive-validity bool mask (True = attend).
+    kernel: None → auto (pallas flash kernel on TPU when no custom mask);
+    the kernel path is differentiable (backward recomputes densely).
     """
+    if kernel is None:
+        kernel = _flash_enabled() and mask is None
+    if kernel and mask is None:
+        return _flash_attention_ad(q, k, v, causal, scale)
     b, s_q, n_heads, hd = q.shape
     s_kv, n_kv = k.shape[1], k.shape[2]
     n_rep = n_heads // n_kv
@@ -73,6 +99,7 @@ def decode_attention(
     lengths: jnp.ndarray,
     *,
     scale: float | None = None,
+    kernel: bool | None = None,
 ) -> jnp.ndarray:
     """Single-token decode attention against per-slot caches.
 
@@ -80,7 +107,16 @@ def decode_attention(
     k_cache, v_cache: [b, max_len, n_kv_heads, hd];
     lengths: [b] valid prefix length per slot (the new token's K/V must
     already be written at position lengths-1).
+    kernel: None → auto (pallas flash-decode kernel on TPU).
     """
+    if kernel is None:
+        kernel = _flash_enabled()
+    if kernel:
+        from gofr_tpu.ops.pallas import flash_decode
+
+        return flash_decode(
+            q, k_cache, v_cache, lengths, scale=scale, interpret=_interpret()
+        )
     n_heads = q.shape[1]
     n_kv = k_cache.shape[2]
     n_rep = n_heads // n_kv
@@ -101,3 +137,36 @@ def decode_attention(
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bgrk,bkgd->bgrd", probs, v_cache)
     return out.reshape(b, n_heads, -1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_ad(q, k, v, causal, scale):
+    """Flash forward, dense-recompute backward.
+
+    pallas_call has no reverse-mode rule, so the VJP re-derives gradients
+    from the dense formulation — training memory matches the dense path
+    while inference (no grad) gets the O(s) kernel.
+    """
+    from gofr_tpu.ops.pallas import flash_attention
+
+    return flash_attention(
+        q, k, v, causal=causal, scale=scale, interpret=_interpret()
+    )
+
+
+def _flash_ad_fwd(q, k, v, causal, scale):
+    return _flash_attention_ad(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_ad_bwd(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: attention(
+            q, k, v, causal=causal, scale=scale, kernel=False
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash_attention_ad.defvjp(_flash_ad_fwd, _flash_ad_bwd)

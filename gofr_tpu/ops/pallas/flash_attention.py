@@ -1,0 +1,187 @@
+"""Flash-attention prefill kernel (pallas TPU).
+
+Online-softmax attention with the kv axis as the innermost (sequential)
+grid dimension: running max / denominator / accumulator live in VMEM
+scratch and carry across kv iterations, so attention memory is O(block_q ×
+head_dim) instead of O(s²). Causal blocks above the diagonal are skipped
+entirely with ``pl.when`` (no DMA is wasted on them because their loads are
+predicated out with the compute).
+
+Layouts are chosen for the MXU: per grid step the kernel does two
+``[block_q, hd] × [hd, block_k]``-shaped matmuls in bf16 with f32
+accumulation. GQA is expressed in the BlockSpec index maps (q-head ih reads
+kv-head ih // n_rep), not by materialising repeated K/V.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, causal, s_q, s_kv, block_q, block_k, offset):
+    """Grid: (b, n_heads, q_blocks, kv_blocks); kv innermost."""
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    n_k = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Rows/cols in global (unpadded) coordinates. ``offset = s_kv - s_q``
+    # aligns the causal diagonal when the query is a suffix of the keys.
+    row0 = iq * block_q
+    col0 = ik * block_k
+    # Last kv block this q block attends to (causal); all blocks when not.
+    # Clamped to 0 so a q block with NO visible keys (s_q > s_kv suffix
+    # mismatch) still runs block 0 — the in-kernel mask zeroes it and
+    # _finish emits the guarded 0 rows instead of uninitialised memory.
+    if causal:
+        last_vis = jnp.clip(
+            (row0 + block_q - 1 + offset) // block_k, 0, n_k - 1
+        )
+        visible = ik <= last_vis
+    else:
+        last_vis = n_k - 1
+        visible = True
+
+    @pl.when(visible)
+    def _body():
+        q = q_ref[0, 0]  # [block_q, hd]
+        k = k_ref[0, 0]  # [block_k, hd]
+        v = v_ref[0, 0]  # [block_k, hd]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+
+        rows = row0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = cols < s_kv  # padded keys never attend
+        if causal:
+            mask = jnp.logical_and(mask, cols <= rows + offset)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [block_q, 128] (value replicated over lanes)
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)  # [block_q, 128]
+        # Explicit mask: a row whose whole block is masked has m_new =
+        # NEG_INF and exp(s - m_new) would be exp(0) = 1, not 0.
+        p = jnp.where(
+            mask, jnp.exp(s - m_new[:, :1]), 0.0
+        )  # [block_q, block_k] f32
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, hd]
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+
+    @pl.when(ik == last_vis)
+    def _finish():
+        l = l_ref[:, :1]
+        # Fully-masked rows (query padding) would divide by zero; emit 0.
+        out = jnp.where(l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
+    size = x.shape[axis]
+    rem = size % mult
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - rem)
+    return jnp.pad(x, pad)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flash attention. Same contract as ``ops.attention.attention``:
+
+    q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd];
+    causal offset so the last query row attends to all keys when s_kv > s_q.
+    Returns [b, s_q, n_heads, hd] in q.dtype.
+    """
+    b, s_q, n_heads, hd = q.shape
+    s_kv, n_kv = k.shape[1], k.shape[2]
+    n_rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+
+    block_q = min(block_q, max(s_q, 16))
+    block_k = min(block_k, max(s_kv, 16))
+
+    # [b, h, s, d] layout: heads as a grid dimension, rows contiguous.
+    qt = _pad_to(jnp.swapaxes(q, 1, 2), 2, block_q)
+    kt = _pad_to(jnp.swapaxes(k, 1, 2), 2, block_k)
+    vt = _pad_to(jnp.swapaxes(v, 1, 2), 2, block_k)
+    sq_p, sk_p = qt.shape[2], kt.shape[2]
+
+    grid = (b, n_heads, sq_p // block_q, sk_p // block_k)
+    kernel = functools.partial(
+        _kernel,
+        scale=scale, causal=causal, s_q=s_q, s_kv=s_kv,
+        block_q=block_q, block_k=block_k, offset=s_kv - s_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, hd),
+                lambda ib, ih, iq, ik: (ib, ih, iq, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda ib, ih, iq, ik, n_rep=n_rep: (ib, ih // n_rep, ik, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda ib, ih, iq, ik, n_rep=n_rep: (ib, ih // n_rep, ik, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd),
+            lambda ib, ih, iq, ik: (ib, ih, iq, 0),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, sq_p, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    return jnp.swapaxes(out[:, :, :s_q], 1, 2)

@@ -1,0 +1,149 @@
+"""Flash-decode kernel (pallas TPU): one query token per slot vs KV cache.
+
+Decode attention is HBM-bandwidth-bound: the whole valid cache prefix is
+read once per step. The win over the dense path is (a) per-slot lengths are
+prefetched to SMEM (``PrefetchScalarGridSpec``) so KV blocks beyond a
+slot's length are skipped — with continuous batching most slots are far
+shorter than max_len, so skipped blocks are most blocks — and (b) the
+online softmax never materialises [b, heads, max_len] score tensors in HBM.
+
+Cache layout is the engine's native ``[b, max_len, n_kv, hd]`` — no
+transpose copies on the hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _clamp_blk(ik, length, block_k):
+    """kv block index clamped to the slot's last valid block."""
+    return jnp.minimum(ik, jnp.maximum(0, (length - 1) // block_k))
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale, block_k):
+    """Grid: (b, kv_blocks); kv innermost, state carried in scratch."""
+    ib = pl.program_id(0)
+    ik = pl.program_id(1)
+    length = len_ref[ib]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    col0 = ik * block_k
+    last_vis = jnp.maximum(0, (length - 1) // block_k)
+
+    @pl.when(col0 < length)
+    def _body():
+        q = q_ref[0]  # [n_kv, rep, hd]
+        k = k_ref[0]  # [block_k, n_kv, hd]
+        v = v_ref[0]
+        n_kv, rep, _ = q.shape
+
+        s = jnp.einsum(
+            "grd,kgd->grk", q, k, preferred_element_type=jnp.float32
+        ) * scale  # [n_kv, rep, block_k]
+
+        cols = col0 + jax.lax.broadcasted_iota(
+            jnp.int32, (n_kv, rep, block_k), 2
+        )
+        mask = cols < length
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [n_kv, rep, 128]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[..., :1]), 0.0)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=2, keepdims=True)
+        m_ref[:] = m_new
+        pv = jnp.einsum(
+            "grk,kgd->grd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr[..., :1] + pv
+
+    @pl.when(ik == last_vis)
+    def _finish():
+        l = l_ref[:, :, :1]
+        out = jnp.where(l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def flash_decode(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Same contract as ``ops.attention.decode_attention``:
+
+    q: [b, n_heads, hd]; caches: [b, max_len, n_kv, hd]; lengths: [b]
+    (valid prefix; the current token's K/V already written at lengths-1).
+    Returns [b, n_heads, hd].
+    """
+    b, n_heads, hd = q.shape
+    max_len, n_kv = k_cache.shape[1], k_cache.shape[2]
+    n_rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+
+    block_k = min(block_k, max_len)
+    if max_len % block_k:
+        pad = block_k - max_len % block_k
+        cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        k_cache = jnp.pad(k_cache, cfg)
+        v_cache = jnp.pad(v_cache, cfg)
+        max_len += pad
+
+    qg = q.reshape(b, n_kv, n_rep, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, max_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, n_kv, n_rep, hd), lambda ib, ik, lens: (ib, 0, 0, 0)),
+            # Clamp the kv block index to the slot's last valid block: grid
+            # steps beyond a short slot's length re-"fetch" the same block,
+            # which the pallas pipeline elides (same index → no new DMA) —
+            # this is where the SMEM-prefetched lengths actually save HBM
+            # bandwidth, not just compute.
+            pl.BlockSpec((1, block_k, n_kv, hd), lambda ib, ik, lens: (
+                ib, _clamp_blk(ik, lens[ib], block_k), 0, 0)),
+            pl.BlockSpec((1, block_k, n_kv, hd), lambda ib, ik, lens: (
+                ib, _clamp_blk(ik, lens[ib], block_k), 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, n_kv, n_rep, hd), lambda ib, ik, lens: (ib, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((n_kv, n_rep, hd), jnp.float32),
+            pltpu.VMEM((n_kv, n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_kv, n_rep, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, hd), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+
+    return out.reshape(b, n_heads, hd)

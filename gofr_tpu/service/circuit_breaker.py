@@ -13,6 +13,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from gofr_tpu.service.wrapper import ServiceWrapper
+
 
 class CircuitOpenError(Exception):
     def __init__(self) -> None:
@@ -29,11 +31,11 @@ class CircuitBreakerConfig:
         return _CircuitBreakerService(svc, self.threshold, self.interval_s)
 
 
-class _CircuitBreakerService:
+class _CircuitBreakerService(ServiceWrapper):
     """Wraps an HTTPService; delegates everything else."""
 
     def __init__(self, inner, threshold: int, interval_s: float) -> None:
-        self._inner = inner
+        super().__init__(inner)
         self._threshold = threshold
         self._interval = interval_s
         self._lock = threading.Lock()
@@ -42,10 +44,6 @@ class _CircuitBreakerService:
         self._opened_at = 0.0
         self._stop = threading.Event()
         self._ticker: threading.Thread | None = None
-
-    # delegate attribute access (decorator pattern without inheritance)
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
 
     @property
     def is_open(self) -> bool:
@@ -63,7 +61,7 @@ class _CircuitBreakerService:
         start_ticker = False
         with self._lock:
             self._failures += 1
-            if self._failures > self._threshold and not self._open:
+            if self._failures >= self._threshold and not self._open:
                 self._open = True
                 self._opened_at = time.time()
                 start_ticker = True
@@ -110,19 +108,3 @@ class _CircuitBreakerService:
         else:
             self._record_success()
         return resp
-
-    # verb helpers must route through the breaker's request()
-    def get(self, path, params=None, headers=None):
-        return self.request("GET", path, params=params, headers=headers)
-
-    def post(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
-
-    def put(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
-
-    def patch(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
-
-    def delete(self, path, params=None, body=None, headers=None):
-        return self.request("DELETE", path, params=params, body=body, headers=headers)

@@ -10,15 +10,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Mapping, Optional
 
+from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
-class _HeaderInjector:
+
+class _HeaderInjector(ServiceWrapper):
     """Shared shape: wraps a service and injects headers per request."""
-
-    def __init__(self, inner) -> None:
-        self._inner = inner
-
-    def __getattr__(self, name):
-        return getattr(self._inner, name)
 
     def _headers(self) -> dict:
         return {}
@@ -26,21 +22,6 @@ class _HeaderInjector:
     def request(self, method: str, path: str, *, headers=None, **kw):
         merged = {**self._headers(), **(headers or {})}
         return self._inner.request(method, path, headers=merged, **kw)
-
-    def get(self, path, params=None, headers=None):
-        return self.request("GET", path, params=params, headers=headers)
-
-    def post(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
-
-    def put(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
-
-    def patch(self, path, params=None, body=None, json=None, headers=None):
-        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
-
-    def delete(self, path, params=None, body=None, headers=None):
-        return self.request("DELETE", path, params=params, body=body, headers=headers)
 
 
 @dataclass
@@ -144,7 +125,10 @@ class HealthConfig:
     endpoint: str = ".well-known/alive"
 
     def add_option(self, svc):
-        svc.health_endpoint = self.endpoint.lstrip("/")
+        # health_check() runs on the base client regardless of wrapping
+        # order, so the override must land on the innermost service — not
+        # on whatever wrapper happens to be outermost.
+        innermost(svc).health_endpoint = self.endpoint.lstrip("/")
         return svc
 
 

@@ -1,0 +1,44 @@
+"""Shared decorator shape for service client options (reference
+``service/options.go:3-5`` — options fold wrappers over the base client).
+
+Every option wrapper delegates unknown attributes to the wrapped service
+and routes the five verb helpers through its own ``request`` so a single
+override point intercepts all traffic.
+"""
+
+from __future__ import annotations
+
+
+def innermost(svc):
+    """Walk the ``_inner`` chain to the base HTTPService."""
+    while hasattr(svc, "_inner"):
+        svc = svc._inner
+    return svc
+
+
+class ServiceWrapper:
+    """Decorator base: wraps a service, delegates everything else."""
+
+    def __init__(self, inner) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def request(self, method: str, path: str, **kw):
+        return self._inner.request(method, path, **kw)
+
+    def get(self, path, params=None, headers=None):
+        return self.request("GET", path, params=params, headers=headers)
+
+    def post(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("POST", path, params=params, body=body, json=json, headers=headers)
+
+    def put(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
+
+    def patch(self, path, params=None, body=None, json=None, headers=None):
+        return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
+
+    def delete(self, path, params=None, body=None, headers=None):
+        return self.request("DELETE", path, params=params, body=body, headers=headers)

@@ -95,6 +95,16 @@ def test_health_check_and_override(upstream):
     svc3 = new_http_service("http://127.0.0.1:1")
     assert svc3.health_check()["status"] == "DOWN"
 
+    # Order-independence: HealthConfig must land on the base client even
+    # when another option has already wrapped it.
+    svc4 = new_http_service(
+        upstream.address, None, None, APIKeyConfig("k"), HealthConfig("/data")
+    )
+    assert svc4.health_check()["status"] == "UP"
+    from gofr_tpu.service.wrapper import innermost
+
+    assert innermost(svc4).health_endpoint == "data"
+
 
 def test_auth_options_inject_headers(upstream):
     svc = new_http_service(
@@ -122,7 +132,8 @@ def test_circuit_breaker_opens_and_recovers(upstream):
         CircuitBreakerConfig(threshold=2, interval_s=60),
     )
     upstream.state["fail"] = True
-    for _ in range(3):
+    # Opens after exactly `threshold` consecutive failures.
+    for _ in range(2):
         assert svc.get("/data").status_code == 500
     with pytest.raises(CircuitOpenError):
         svc.get("/data")
