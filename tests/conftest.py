@@ -8,17 +8,21 @@ multi-chip dry run.
 
 import os
 
-# Force-set: the environment pre-sets JAX_PLATFORMS=axon and an axon
-# sitecustomize registers the TPU plugin unless PALLAS_AXON_POOL_IPS is
-# cleared before the interpreter starts. Tests always target the virtual
-# CPU mesh; run pytest via `PALLAS_AXON_POOL_IPS= python -m pytest` (or rely
-# on jax not being imported before this conftest runs).
-os.environ["JAX_PLATFORMS"] = "cpu"
+# The environment pre-sets JAX_PLATFORMS=axon and a sitecustomize that
+# imports jax at interpreter startup, so env writes here are too late —
+# force the CPU backend through jax.config instead (valid until the first
+# backend is actually initialized, which no sitecustomize does).
+os.environ["JAX_PLATFORMS"] = "cpu"  # belt-and-braces for subprocesses
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import socket
 import threading
