@@ -114,34 +114,39 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
     }
 
 
-def transformer_param_specs(cfg: TransformerConfig) -> dict:
-    """PartitionSpecs over logical axes ('dp', 'tp') for every param leaf.
+def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
+    """PartitionSpecs over logical axes ('dp', 'tp', optionally 'pp') for
+    every param leaf.
 
     Megatron-style: attention QKV column-parallel / O row-parallel over
     ``tp``; FFN gate/up column-parallel, down row-parallel; embeddings and
     lm_head vocab-parallel; norms replicated. MoE experts sharded over
     ``tp`` on the expert axis (expert parallelism rides the model axis).
+    With ``pp`` the stacked layer axis (leading dim of every layer leaf)
+    shards over the pipeline axis — each stage owns a contiguous slice of
+    layers (see ``parallel/pipeline.py``).
     """
+    lax_ = "pp" if pp else None  # leading (layer) axis of stacked leaves
     layers = {
-        "wq": P(None, None, "tp"),
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
-        "wo": P(None, "tp", None),
-        "attn_norm": P(None, None),
-        "mlp_norm": P(None, None),
+        "wq": P(lax_, None, "tp"),
+        "wk": P(lax_, None, "tp"),
+        "wv": P(lax_, None, "tp"),
+        "wo": P(lax_, "tp", None),
+        "attn_norm": P(lax_, None),
+        "mlp_norm": P(lax_, None),
     }
     if cfg.is_moe:
         layers.update(
-            router=P(None, None, None),
-            w_gate=P(None, "tp", None, None),
-            w_up=P(None, "tp", None, None),
-            w_down=P(None, "tp", None, None),
+            router=P(lax_, None, None),
+            w_gate=P(lax_, "tp", None, None),
+            w_up=P(lax_, "tp", None, None),
+            w_down=P(lax_, "tp", None, None),
         )
     else:
         layers.update(
-            w_gate=P(None, None, "tp"),
-            w_up=P(None, None, "tp"),
-            w_down=P(None, "tp", None),
+            w_gate=P(lax_, None, "tp"),
+            w_up=P(lax_, None, "tp"),
+            w_down=P(lax_, "tp", None),
         )
     return {
         "embed": P("tp", None),
@@ -194,8 +199,13 @@ def _ffn_moe(x, lp, cfg):
     return jnp.einsum("bsed,bse->bsd", out, weights.astype(x.dtype))
 
 
-def _layer_prefill(x, lp, cfg, cos, sin, positions, mask):
-    """One decoder layer over a full sequence. Returns (x, (k, v))."""
+def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None):
+    """One decoder layer over a full sequence. Returns (x, (k, v)).
+
+    attn_fn: optional override for the attention call, e.g. a
+    context-parallel (ring/Ulysses) implementation — signature
+    ``attn_fn(q, k, v, mask)``.
+    """
     b, s, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -205,7 +215,10 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask):
     v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
-    attn = attention(q, k, v, causal=True, mask=mask)
+    if attn_fn is None:
+        attn = attention(q, k, v, causal=True, mask=mask)
+    else:
+        attn = attn_fn(q, k, v, mask)
     x = x + jnp.einsum("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)

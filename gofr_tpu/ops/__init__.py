@@ -10,8 +10,16 @@ from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
 from gofr_tpu.ops.attention import attention, decode_attention
 from gofr_tpu.ops.kv_cache import KVCache
 from gofr_tpu.ops.sampling import sample_logits
+from gofr_tpu.ops.ring_attention import (
+    context_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
+    "context_parallel_attention",
+    "ring_attention",
+    "ulysses_attention",
     "rms_norm",
     "layer_norm",
     "apply_rope",

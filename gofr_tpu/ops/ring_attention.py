@@ -1,0 +1,199 @@
+"""Context parallelism for long sequences: ring attention and Ulysses.
+
+The reference framework has no sequence/tensor code at all (SURVEY.md §2.6,
+§5 "Long-context"), so this subsystem is TPU-native net-new: it lets one
+logical attention call run over a sequence sharded across an ICI mesh axis,
+which is how the serving/training stack scales past single-chip HBM.
+
+Two interchangeable schemes, both written as collectives *inside*
+``jax.shard_map`` (so XLA lowers them onto ICI):
+
+* **Ring attention** (`ring_attention`) — K/V blocks rotate around the mesh
+  axis via ``lax.ppermute`` while each device keeps its resident Q block and
+  folds every visiting K/V block into a numerically-stable online softmax
+  (flash-style running max/sum in f32). Communication is overlap-friendly
+  nearest-neighbour traffic; memory stays O(s/n) per device.
+* **Ulysses** (`ulysses_attention`) — two ``lax.all_to_all`` reshards swap
+  the sequence sharding for a head sharding, run ordinary (flash-kernel
+  eligible) attention on the full sequence with ``heads/n`` local heads,
+  and swap back. Cheaper compute, all-to-all traffic; needs heads % n == 0.
+
+`context_parallel_attention` is the user-facing wrapper that builds the
+``shard_map`` over a mesh axis and dispatches to either scheme.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gofr_tpu.ops.attention import NEG_INF, attention, _repeat_kv
+
+
+def _grouped_scores(qg, k, scale):
+    """qg: [b, sq, g, r, d] grouped queries; k: [b, sk, g, d] → f32 scores
+    [b, g, r, sq, sk]."""
+    return (
+        jnp.einsum("bqgrd,bkgd->bgrqk", qg, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Blockwise ring attention over a sharded sequence axis.
+
+    Must be called inside ``shard_map`` with the sequence dimension of
+    q/k/v sharded over ``axis_name``. Shapes per device:
+    q: [b, s_loc, n_heads, hd]; k, v: [b, s_loc, n_kv_heads, hd].
+
+    Equal-size sequence chunks are assumed (s_global = n * s_loc), chunk i
+    living on mesh position i. Causal masking is done at global positions:
+    query p attends key t iff t <= p.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_loc, n_heads, hd = q.shape
+    n_kv = k.shape[2]
+    n_rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+
+    qg = q.reshape(b, s_loc, n_kv, n_rep, hd)
+
+    # Online-softmax state, all f32; pvary marks it device-varying over the
+    # ring axis so the fori_loop carry type matches the per-step outputs.
+    o = jnp.zeros((b, s_loc, n_kv, n_rep, hd), jnp.float32)
+    m = jnp.full((b, n_kv, n_rep, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, n_kv, n_rep, s_loc), jnp.float32)
+    o, m, l = (lax.pcast(x, axis_name, to="varying") for x in (o, m, l))
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    local_pos = jnp.arange(s_loc)
+
+    def step(t, carry):
+        k_blk, v_blk, o, m, l = carry
+        # After t rotations device `my_idx` holds chunk (my_idx - t) mod n.
+        kv_idx = (my_idx - t) % n
+        scores = _grouped_scores(qg, k_blk, scale)  # [b, g, r, sq, sk]
+        if causal:
+            q_pos = my_idx * s_loc + local_pos  # [sq]
+            kv_pos = kv_idx * s_loc + local_pos  # [sk]
+            mask = kv_pos[None, :] <= q_pos[:, None]  # [sq, sk]
+            scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+
+        blk_max = jnp.max(scores, axis=-1)  # [b, g, r, sq]
+        m_new = jnp.maximum(m, blk_max)
+        # Rows with no valid key yet keep m == NEG_INF; shift by a finite
+        # max to avoid (-inf) - (-inf) = NaN in the exp argument.
+        shift = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(scores - shift[..., None])  # [b, g, r, sq, sk]
+        if causal:
+            p = jnp.where(mask[None, None, None], p, 0.0)
+        alpha = jnp.exp(m - shift)  # [b, g, r, sq]
+        l = l * alpha + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bgrqk,bkgd->bqgrd", p, v_blk.astype(jnp.float32))
+        o = o * alpha.transpose(0, 3, 1, 2)[..., None] + pv
+        # The last iteration's rotation would be discarded — skip it (the
+        # predicate is the loop counter, uniform across devices, so the
+        # cond resolves identically everywhere).
+        k_blk, v_blk = lax.cond(
+            t < n - 1,
+            lambda kv: tuple(lax.ppermute(a, axis_name, perm) for a in kv),
+            lambda kv: kv,
+            (k_blk, v_blk),
+        )
+        return k_blk, v_blk, o, m_new, l
+
+    _, _, o, m, l = lax.fori_loop(0, n, step, (k, v, o, m, l))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, s_loc, n_heads, hd).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str,
+    causal: bool = True,
+    scale: float | None = None,
+    kernel: bool | None = None,
+) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Must be called inside ``shard_map`` with the sequence dimension sharded
+    over ``axis_name``. Reshards seq-parallel → head-parallel, runs dense or
+    flash attention on the full sequence, reshards back. Requires
+    n_heads % axis_size == 0; GQA K/V heads are broadcast up when the KV
+    head count does not divide the axis size.
+    """
+    n = lax.psum(1, axis_name)
+    n_heads, n_kv = q.shape[2], k.shape[2]
+    if n_heads % n:
+        raise ValueError(f"ulysses: n_heads={n_heads} not divisible by axis={n}")
+    if n_kv % n:
+        # Broadcast grouped KV heads so the head axis splits evenly.
+        rep = n // n_kv if n % n_kv == 0 else n_heads // n_kv
+        k = _repeat_kv(k, rep)
+        v = _repeat_kv(v, rep)
+
+    a2a = functools.partial(
+        lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    q, k, v = a2a(q), a2a(k), a2a(v)  # [b, s_full, h/n, hd]
+    out = attention(q, k, v, causal=causal, scale=scale, kernel=kernel)
+    return lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def context_parallel_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    impl: str = "ring",
+    causal: bool = True,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded across ``mesh[axis_name]``.
+
+    Takes/returns global arrays [b, s, h, hd]; s must divide evenly over
+    the axis. ``impl``: "ring" (ppermute blocks) or "ulysses" (all-to-all
+    head resharding).
+    """
+    if impl == "ring":
+        inner = functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal, scale=scale
+        )
+    elif impl == "ulysses":
+        inner = functools.partial(
+            ulysses_attention, axis_name=axis_name, causal=causal, scale=scale
+        )
+    else:
+        raise ValueError(f"unknown context-parallel impl {impl!r}")
+
+    spec = P(None, axis_name, None, None)
+    # Partial-manual: only the sequence axis goes manual; any other mesh
+    # axes (dp/tp/pp) stay auto so GSPMD keeps sharding the body's einsums.
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={axis_name},
+    )(q, k, v)

@@ -16,5 +16,13 @@ app-level routing.
 
 from gofr_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
 from gofr_tpu.parallel.sharding import shard_pytree, make_train_step
+from gofr_tpu.parallel.pipeline import pipeline_layer_fn, pipeline_spmd
 
-__all__ = ["make_mesh", "mesh_axis_sizes", "shard_pytree", "make_train_step"]
+__all__ = [
+    "make_mesh",
+    "mesh_axis_sizes",
+    "shard_pytree",
+    "make_train_step",
+    "pipeline_layer_fn",
+    "pipeline_spmd",
+]

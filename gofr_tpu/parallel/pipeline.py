@@ -1,0 +1,134 @@
+"""SPMD pipeline parallelism over a ``pp`` mesh axis.
+
+Net-new (SURVEY §2.6 — the reference has no model code). GPipe-style
+schedule expressed the TPU way: every stage is the *same* compiled program
+(one ``shard_map`` body), stacked layer params are sharded over ``pp`` on
+their leading (layer) axis, and activations hop stage→stage with
+``lax.ppermute`` — nearest-neighbour ICI traffic, no host involvement.
+
+The schedule runs ``M + S - 1`` ticks for M microbatches over S stages
+(the usual GPipe bubble). Each tick: stage 0 feeds the next microbatch,
+every stage applies its local slice of layers, the result hops forward.
+Because the tick loop is a static-bound ``fori_loop``, XLA compiles ONE
+tick body and the whole pipeline — including its backward pass, which JAX
+derives through the loop and the ppermutes — stays a single jitted program.
+
+Composition with other axes: the ``shard_map`` is *partial-manual* (only
+``pp`` is manual), so dp/tp/sp shardings keep flowing through the stage
+body under GSPMD as usual.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_spmd(
+    x: jnp.ndarray,
+    stage_params: Any,
+    extras: Any,
+    *,
+    axis_name: str,
+    n_microbatches: int,
+    stage_fn: Callable[[jnp.ndarray, Any, Any], jnp.ndarray],
+) -> jnp.ndarray:
+    """GPipe schedule; call inside ``shard_map`` with ``axis_name`` manual.
+
+    x: [b, ...] full batch (b % n_microbatches == 0); stage_params: this
+    stage's slice of the stacked layer params (leading layer axis sharded
+    over ``axis_name`` outside); extras: replicated side inputs handed to
+    every ``stage_fn`` call; stage_fn(act, stage_params, extras) -> act.
+
+    Returns [b, ...] — the last stage's outputs, made uniform across the
+    axis with one psum so downstream (final norm / head) code is ordinary.
+    """
+    S = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    M = n_microbatches
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by n_microbatches={M}")
+    x_micro = x.reshape(M, b // M, *x.shape[1:])
+
+    recv = jnp.zeros_like(x_micro[0])
+    outputs = jnp.zeros_like(x_micro)
+    recv, outputs = (
+        lax.pcast(t, axis_name, to="varying") for t in (recv, outputs)
+    )
+    # Forward hop i → i+1. The wraparound edge (last → 0) only carries
+    # values stage 0 never reads — it always feeds from x_micro.
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def tick(t, carry):
+        recv, outputs = carry
+        feed = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, M - 1), 0, keepdims=False
+        )
+        act_in = jnp.where(idx == 0, feed, recv)
+        act_out = stage_fn(act_in, stage_params, extras)
+        # Microbatch t reaches the last stage at tick t + S - 1.
+        out_idx = t - (S - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outputs, act_out, jnp.clip(out_idx, 0, M - 1), 0
+        )
+        outputs = jnp.where(out_idx >= 0, upd, outputs)
+        # Final tick's hop would be discarded — skip it (uniform predicate).
+        recv = lax.cond(
+            t < M + S - 2,
+            lambda a: lax.ppermute(a, axis_name, perm),
+            lambda a: a,
+            act_out,
+        )
+        return recv, outputs
+
+    _, outputs = lax.fori_loop(0, M + S - 1, tick, (recv, outputs))
+    outputs = jnp.where(idx == S - 1, outputs, 0.0)
+    # XLA:CPU's AllReducePromotion pass crashes on bf16 all-reduce
+    # (hlo_instruction.cc "Invalid binary instruction opcode copy"), so the
+    # virtual-device path psums in f32; TPU keeps the bf16 ICI transfer.
+    dtype = outputs.dtype
+    if dtype == jnp.bfloat16 and jax.default_backend() != "tpu":
+        outputs = lax.psum(outputs.astype(jnp.float32), axis_name).astype(dtype)
+    else:
+        outputs = lax.psum(outputs, axis_name)
+    return outputs.reshape(b, *x.shape[1:])
+
+
+def pipeline_layer_fn(
+    layers_fn: Callable[[jnp.ndarray, Any, Any], jnp.ndarray],
+    mesh: Mesh,
+    *,
+    axis_name: str = "pp",
+    n_microbatches: int = 4,
+) -> Callable[[jnp.ndarray, Any, Any], jnp.ndarray]:
+    """Wrap a per-layer-stack function into a pipelined one over ``mesh``.
+
+    ``layers_fn(x, stacked_layer_params, extras)`` must scan its local layer
+    stack (leading axis = layers). The returned callable takes *global*
+    arrays — stacked params over the full depth — and runs them pipelined
+    over ``mesh[axis_name]``; every other mesh axis stays auto (GSPMD).
+    """
+
+    def run(x, layer_params, extras):
+        inner = lambda x, lp, ex: pipeline_spmd(
+            x, lp, ex,
+            axis_name=axis_name,
+            n_microbatches=n_microbatches,
+            stage_fn=layers_fn,
+        )
+        layer_specs = jax.tree_util.tree_map(lambda _: P(axis_name), layer_params)
+        extra_specs = jax.tree_util.tree_map(lambda _: P(), extras)
+        return jax.shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(), layer_specs, extra_specs),
+            out_specs=P(),
+            axis_names={axis_name},
+        )(x, layer_params, extras)
+
+    return run

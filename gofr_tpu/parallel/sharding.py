@@ -28,6 +28,31 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     )
 
 
+def prune_specs(specs: Any, mesh: Mesh) -> Any:
+    """Drop axis names a mesh doesn't have from a PartitionSpec pytree.
+
+    Lets one canonical spec set (mentioning dp/tp/pp/…) serve any mesh —
+    a {"dp","pp"} mesh simply replicates the tp-annotated dims.
+    """
+    axes = set(mesh.axis_names)
+
+    def prune(spec):
+        out = []
+        for entry in spec:
+            if entry is None:
+                out.append(None)
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a in axes)
+                out.append(kept if kept else None)
+            else:
+                out.append(entry if entry in axes else None)
+        return P(*out)
+
+    return jax.tree_util.tree_map(
+        prune, specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
     """Mean next-token cross entropy. logits [b, s, V] f32, targets [b, s]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -41,10 +66,20 @@ def make_train_step(
     learning_rate: float = 3e-4,
     sp: bool = True,
     remat: bool = False,
+    cp_impl: str = "ring",
+    n_microbatches: int = 2,
 ) -> tuple[Callable, Callable, optax.GradientTransformation]:
     """Build (init_state, train_step) for the flagship transformer over
-    ``mesh`` with dp/tp (+sequence-parallel activations, +expert-parallel
-    MoE weights when the config has experts).
+    ``mesh``. Parallelism comes from the mesh's axis names:
+
+    * ``dp`` — batch sharding;
+    * ``tp`` — Megatron tensor parallel (+ sequence-parallel activation
+      constraints when ``sp``; MoE expert weights ride ``tp`` too);
+    * ``cp`` — context parallelism: the sequence dim shards over ``cp`` and
+      attention runs as ring/Ulysses collectives (``cp_impl``), see
+      ``ops/ring_attention.py``;
+    * ``pp`` — GPipe pipeline over the stacked layer axis with
+      ``n_microbatches`` microbatches, see ``parallel/pipeline.py``.
 
     Returns ``(init_state_fn, train_step_fn, optimizer)``:
     ``init_state_fn(key) -> (params, opt_state)`` sharded onto the mesh;
@@ -58,41 +93,106 @@ def make_train_step(
     )
     from gofr_tpu.ops.norms import rms_norm
     from gofr_tpu.ops.rotary import rope_frequencies
+    from gofr_tpu.parallel.mesh import mesh_axis_sizes
+
+    axes = mesh_axis_sizes(mesh)
+    use_pp = axes.get("pp", 1) > 1
+    use_cp = axes.get("cp", 1) > 1
+    if use_pp and use_cp:
+        raise NotImplementedError(
+            "pp + cp in one mesh is not supported: context-parallel "
+            "attention opens its own shard_map, which cannot nest inside "
+            "the pipeline's manual-pp region. Shard the sequence with "
+            "sp (over tp) alongside pp, or use cp without pp."
+        )
 
     optimizer = optax.adamw(learning_rate)
-    param_specs = transformer_param_specs(cfg)
+    param_specs = prune_specs(transformer_param_specs(cfg, pp=use_pp), mesh)
+
+    attn_fn = None
+    if use_cp:
+        from gofr_tpu.ops.ring_attention import context_parallel_attention
+
+        def attn_fn(q, k, v, mask):
+            assert mask is None, "cp training path has no padding mask"
+            return context_parallel_attention(
+                q, k, v, mesh, axis_name="cp", impl=cp_impl
+            )
+
+    # Mixed precision: master params live in f32 (stable AdamW moments, f32
+    # grad all-reduces); compute runs in cfg.dtype so the MXU sees bf16.
+    # XLA:CPU exception: its AllReducePromotion pass aborts on the bf16
+    # all-reduces a manual-pp program produces ("Invalid binary instruction
+    # opcode copy"), so the virtual-device pp path computes in f32 — the
+    # shardings exercised are identical, only the dtype differs.
+    compute_dtype = cfg.dtype
+    if use_pp and jax.default_backend() != "tpu":
+        compute_dtype = jnp.float32
+
+    def _to_compute(params):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(compute_dtype)
+            if x.dtype in (jnp.float32, jnp.bfloat16)
+            else x,
+            params,
+        )
 
     def forward(params, tokens):
+        params = _to_compute(params)
         b, s = tokens.shape
         x = params["embed"][tokens]
         cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
-        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        positions = jnp.arange(s)[None, :]  # [1, s], broadcasts over batch
 
         def constrain(h):
-            if sp:
+            if use_cp:
+                seq_ax = ("cp", "tp") if sp else "cp"
+            else:
                 # Sequence-parallel residual stream: tokens sharded over tp
                 # between attention/FFN blocks (Megatron-SP shape).
-                return jax.lax.with_sharding_constraint(
-                    h, NamedSharding(mesh, P("dp", "tp", None))
+                seq_ax = "tp" if sp else None
+            spec = prune_specs(P("dp", seq_ax, None), mesh)
+            if use_pp:
+                # Inside the pipeline's manual-pp region activations carry a
+                # vma over pp; a full-mesh NamedSharding conflicts with it,
+                # but a bare PartitionSpec resolves against the context mesh.
+                return jax.lax.with_sharding_constraint(h, spec)
+            return jax.lax.with_sharding_constraint(h, NamedSharding(mesh, spec))
+
+        def make_body(cos, sin, positions):
+            # One definition serves both the plain scan and the pipeline
+            # stage scan; RoPE tables come in as args because shard_map
+            # bodies must not close over tracers.
+            def body(x, lp):
+                out, _ = _layer_prefill(
+                    x, lp, cfg, cos, sin, positions, mask=None, attn_fn=attn_fn
                 )
-            return jax.lax.with_sharding_constraint(
-                h, NamedSharding(mesh, P("dp", None, None))
+                return constrain(out), None
+
+            return jax.checkpoint(body) if remat else body
+
+        if use_pp:
+            from gofr_tpu.parallel.pipeline import pipeline_layer_fn
+
+            def layers_fn(act, lp_stack, extras):
+                act, _ = jax.lax.scan(make_body(*extras), act, lp_stack)
+                return act
+
+            run = pipeline_layer_fn(
+                layers_fn, mesh, axis_name="pp", n_microbatches=n_microbatches
             )
-
-        def body(x, lp):
-            out, _ = _layer_prefill(x, lp, cfg, cos, sin, positions, mask=None)
-            return constrain(out), None
-
-        if remat:
-            body = jax.checkpoint(body)
-        x = constrain(x)
-        x, _ = jax.lax.scan(body, x, params["layers"])
+            x = run(x, params["layers"], (cos, sin, positions))
+        else:
+            x = constrain(x)
+            x, _ = jax.lax.scan(make_body(cos, sin, positions), x, params["layers"])
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)
         return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
     def loss_fn(params, tokens):
-        logits = forward(params, tokens[:, :-1])
-        return cross_entropy_loss(logits, tokens[:, 1:])
+        # Forward over the full sequence (keeps the seq dim divisible by
+        # cp/tp shards); the next-token shift happens at the loss.
+        logits = forward(params, tokens)
+        return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
@@ -131,11 +231,20 @@ def make_train_step(
         lambda s: NamedSharding(mesh, s), opt_specs,
         is_leaf=lambda x: isinstance(x, P),
     )
-    data_sharding = NamedSharding(mesh, P("dp", None))
-
-    init_jit = jax.jit(
-        lambda key: init_transformer(key, cfg), out_shardings=param_shardings
+    data_sharding = NamedSharding(
+        mesh, prune_specs(P("dp", "cp" if use_cp else None), mesh)
     )
+
+    def _init_master(key):
+        params = init_transformer(key, cfg)
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32)
+            if x.dtype == jnp.bfloat16
+            else x,
+            params,
+        )
+
+    init_jit = jax.jit(_init_master, out_shardings=param_shardings)
     opt_init_jit = jax.jit(optimizer.init, out_shardings=opt_shardings)
 
     def init_state(key):

@@ -1,0 +1,95 @@
+"""Pipeline (pp) and context (cp) parallel training on the 8-dev CPU mesh."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from gofr_tpu.models.registry import get_model
+from gofr_tpu.parallel import make_mesh, make_train_step, pipeline_layer_fn
+
+
+def _f32_tiny():
+    return dataclasses.replace(get_model("llama-tiny").config, dtype=jnp.float32)
+
+
+def test_pipeline_spmd_matches_sequential():
+    """A pipelined stack of elementwise 'layers' equals the plain scan."""
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    w = jax.random.normal(jax.random.PRNGKey(0), (8, 16))  # 8 layers, D=16
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))  # b=4
+
+    def layers_fn(act, lp_stack, extras):
+        def body(h, w_l):
+            return jnp.tanh(h * w_l[None, :]), None
+
+        act, _ = lax.scan(body, act, lp_stack)
+        return act
+
+    want, _ = lax.scan(lambda h, wl: (jnp.tanh(h * wl[None, :]), None), x, w)
+    run = pipeline_layer_fn(layers_fn, mesh, n_microbatches=2)
+    got = run(x, w, ())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_pipeline_train_step_matches_unpipelined_loss():
+    cfg = _f32_tiny()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+
+    mesh_ref = make_mesh({"dp": 1, "tp": 1}, devices=jax.devices()[:1])
+    init_ref, step_ref, _ = make_train_step(cfg, mesh_ref, sp=False)
+    p_ref, o_ref = init_ref(jax.random.PRNGKey(0))
+    loss_ref, _, _ = step_ref(p_ref, o_ref, tokens)
+
+    mesh_pp = make_mesh({"dp": 2, "pp": 2}, devices=jax.devices()[:4])
+    init_pp, step_pp, _ = make_train_step(cfg, mesh_pp, sp=False, n_microbatches=2)
+    p_pp, o_pp = init_pp(jax.random.PRNGKey(0))
+    assert p_pp["layers"]["wq"].sharding.spec[0] == "pp"
+    loss_pp, p_pp, o_pp = step_pp(p_pp, o_pp, tokens)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), rtol=1e-4)
+    # And training actually progresses.
+    loss2, _, _ = step_pp(p_pp, o_pp, tokens)
+    assert float(loss2) < float(loss_pp)
+
+
+@pytest.mark.parametrize("cp_impl", ["ring", "ulysses"])
+def test_cp_train_step_matches_uncp_loss(cp_impl):
+    cfg = _f32_tiny()
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+
+    mesh_ref = make_mesh({"dp": 1, "tp": 1}, devices=jax.devices()[:1])
+    init_ref, step_ref, _ = make_train_step(cfg, mesh_ref, sp=False)
+    p_ref, o_ref = init_ref(jax.random.PRNGKey(0))
+    loss_ref, _, _ = step_ref(p_ref, o_ref, tokens)
+
+    mesh_cp = make_mesh({"dp": 2, "cp": 4})
+    init_cp, step_cp, _ = make_train_step(
+        cfg, mesh_cp, sp=False, cp_impl=cp_impl
+    )
+    p_cp, o_cp = init_cp(jax.random.PRNGKey(0))
+    loss_cp, _, _ = step_cp(p_cp, o_cp, tokens)
+    np.testing.assert_allclose(float(loss_cp), float(loss_ref), rtol=1e-4)
+
+
+def test_pp_plus_cp_rejected():
+    cfg = _f32_tiny()
+    mesh = make_mesh({"dp": 2, "pp": 2, "cp": 2})
+    with pytest.raises(NotImplementedError, match="pp \\+ cp"):
+        make_train_step(cfg, mesh)
+
+
+def test_cp_with_tp_train_step():
+    """cp composes with tp (and sp constraints) in one mesh."""
+    cfg = _f32_tiny()
+    mesh = make_mesh({"dp": 2, "cp": 2, "tp": 2})
+    init_state, train_step, _ = make_train_step(cfg, mesh, sp=True)
+    params, opt_state = init_state(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+    loss, params, opt_state = train_step(params, opt_state, tokens)
+    assert np.isfinite(float(loss))
