@@ -118,6 +118,14 @@ class App:
         """Custom middleware (reference ``gofr.go:372``)."""
         self.router.use_middleware(*mws)
 
+    def use_mongo(self, client) -> None:
+        """Inject a Mongo driver (reference ``gofr.go:376-378``)."""
+        self.container.use_mongo(client)
+
+    def use_pubsub(self, client) -> None:
+        """Inject a pub/sub client for brokers without bundled drivers."""
+        self.container.use_pubsub(client)
+
     # -- auth enablers (reference gofr.go:310-344) -------------------------
 
     def enable_basic_auth(self, users: dict[str, str]) -> None:

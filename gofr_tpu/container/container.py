@@ -77,6 +77,11 @@ class Container:
         """User-injected Mongo driver (reference ``gofr.go:376-378``)."""
         self.mongo = client
 
+    def use_pubsub(self, client) -> None:
+        """User-injected pub/sub client (same seam as ``use_mongo`` — lets
+        apps wire a broker whose driver the framework doesn't bundle)."""
+        self.pubsub = client
+
     # -- service registry (reference gofr.go:189-199) ---------------------
 
     def get_http_service(self, name: str):
@@ -160,7 +165,10 @@ class Container:
         details: dict[str, Any] = {}
         for name in ("sql", "redis", "pubsub", "tpu", "mongo"):
             ds = getattr(self, name)
-            if ds is None:
+            if ds is None or not hasattr(ds, "health_check"):
+                # health_check is opt-in for injected clients (use_mongo /
+                # use_pubsub) — a minimal client must not flip the app to
+                # DEGRADED just for lacking one.
                 continue
             try:
                 check = ds.health_check()

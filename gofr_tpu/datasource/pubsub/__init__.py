@@ -2,16 +2,36 @@
 
 ``Message`` doubles as the request object for subscription handlers exactly
 like the reference (``pubsub/message.go:8-52``): ``bind`` JSON-decodes the
-payload and ``param("topic")`` returns the topic. Backends: an in-process
-broker (always available; the seam the reference fills with Kafka/GCP/MQTT),
-selected via ``PUBSUB_BACKEND`` (reference ``container/container.go:85-130``).
-External brokers log-and-skip when their clients aren't present.
+payload and ``param("topic")`` returns the topic. Backends, selected via
+``PUBSUB_BACKEND`` (reference ``container/container.go:85-130``):
+
+* ``INPROC`` — always-available in-process broker (tests/examples/offline
+  batch path);
+* ``MQTT`` — dependency-free MQTT 3.1.1 wire-protocol client
+  (``mqtt.py``); tested against ``testutil.mqtt_broker``;
+* ``KAFKA`` / ``GOOGLE`` — clients written against driver seams
+  (``kafka.py`` / ``google.py``); they raise
+  :class:`PubSubBackendUnavailable` when the driver library isn't
+  installed, mirroring how the reference's CI gates broker tests on
+  service containers (SURVEY §4).
 """
 
 from gofr_tpu.datasource.pubsub.base import Message, PubSubLog
 from gofr_tpu.datasource.pubsub.inproc import InProcBroker
+from gofr_tpu.datasource.pubsub.kafka import KafkaClient, PubSubBackendUnavailable
+from gofr_tpu.datasource.pubsub.google import GooglePubSubClient
+from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
 
-__all__ = ["Message", "PubSubLog", "InProcBroker", "new_pubsub_from_config"]
+__all__ = [
+    "Message",
+    "PubSubLog",
+    "InProcBroker",
+    "MQTTClient",
+    "KafkaClient",
+    "GooglePubSubClient",
+    "PubSubBackendUnavailable",
+    "new_pubsub_from_config",
+]
 
 
 def new_pubsub_from_config(config, logger=None, metrics=None):
@@ -19,15 +39,27 @@ def new_pubsub_from_config(config, logger=None, metrics=None):
     backend = (config.get_or_default("PUBSUB_BACKEND", "") or "").upper()
     if not backend:
         return None
-    if backend == "INPROC":
-        return InProcBroker(logger=logger, metrics=metrics)
-    if backend in ("KAFKA", "GOOGLE", "MQTT"):
+    try:
+        if backend == "INPROC":
+            return InProcBroker(logger=logger, metrics=metrics)
+        if backend == "MQTT":
+            from gofr_tpu.datasource.pubsub.mqtt import new_mqtt_from_config
+
+            return new_mqtt_from_config(config, logger=logger, metrics=metrics)
+        if backend == "KAFKA":
+            from gofr_tpu.datasource.pubsub.kafka import new_kafka_from_config
+
+            return new_kafka_from_config(config, logger=logger, metrics=metrics)
+        if backend == "GOOGLE":
+            from gofr_tpu.datasource.pubsub.google import new_google_from_config
+
+            return new_google_from_config(config, logger=logger, metrics=metrics)
+    except (PubSubBackendUnavailable, OSError, ValueError) as exc:
+        # Boot must not crash on a missing driver/broker or malformed
+        # numeric config — log and run without pub/sub, like the reference
+        # logs datasource connect errors.
         if logger is not None:
-            logger.errorf(
-                "PUBSUB_BACKEND=%s requires an external client library not "
-                "present in this environment; use INPROC or install the client",
-                backend,
-            )
+            logger.errorf("pub/sub backend %s unavailable: %s", backend, exc)
         return None
     if logger is not None:
         logger.errorf("unsupported PUBSUB_BACKEND %s", backend)

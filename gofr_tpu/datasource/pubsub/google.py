@@ -1,0 +1,212 @@
+"""Google Cloud Pub/Sub backend behind a driver seam.
+
+Reference: ``pkg/gofr/datasource/pubsub/google`` — GCP client with topic
+auto-create on publish/subscribe (``google.go:73-113``), subscription name
+``${SUB}-${topic}`` auto-created per topic (``google.go:115-166``), receive
+callback delivering one message per ``Subscribe`` call
+(``google.go:168-205``).
+
+Like the Kafka port, the client is written against a small seam
+(:class:`GooglePubSubDriver`): the default factory wires it from
+``google-cloud-pubsub`` when importable, otherwise raises
+:class:`PubSubBackendUnavailable`; tests inject an in-memory fake.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from gofr_tpu.datasource.pubsub.base import Message, PubSubLog
+from gofr_tpu.datasource.pubsub.kafka import PubSubBackendUnavailable
+
+
+class GooglePubSubDriver(Protocol):
+    """Thin driver surface the client needs (auto-create included)."""
+
+    def ensure_topic(self, topic: str) -> None: ...
+    def ensure_subscription(self, topic: str, subscription: str) -> None: ...
+    def publish(self, topic: str, value: bytes) -> None: ...
+    def pull_one(
+        self, subscription: str, timeout: float
+    ) -> Optional[tuple[bytes, "object"]]:
+        """Return (value, ack_handle) or None on timeout."""
+    def ack(self, subscription: str, ack_handle: "object") -> None: ...
+    def delete_topic(self, topic: str) -> None: ...
+    def ping(self) -> bool: ...
+    def close(self) -> None: ...
+
+
+class GooglePubSubClient:
+    def __init__(
+        self,
+        driver: GooglePubSubDriver,
+        subscription_name: str = "gofr-tpu",
+        project: str = "",
+        logger=None,
+        metrics=None,
+    ) -> None:
+        self._driver = driver
+        self._sub_name = subscription_name
+        self._project = project
+        self._logger = logger
+        self._metrics = metrics
+        self._known_topics: set[str] = set()
+        self._known_subs: set[str] = set()
+
+    def _sub_for(self, topic: str) -> str:
+        # Reference naming: ${SUBSCRIPTION}-${topic} (google.go:115-166).
+        return f"{self._sub_name}-{topic}"
+
+    def _ensure(self, topic: str, with_sub: bool) -> None:
+        if topic not in self._known_topics:
+            self._driver.ensure_topic(topic)
+            self._known_topics.add(topic)
+        if with_sub:
+            sub = self._sub_for(topic)
+            if sub not in self._known_subs:
+                self._driver.ensure_subscription(topic, sub)
+                self._known_subs.add(sub)
+
+    # -- Publisher ----------------------------------------------------------
+
+    def publish(self, topic: str, message: bytes) -> None:
+        if isinstance(message, str):
+            message = message.encode()
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_pubsub_publish_total_count", "topic", topic
+            )
+        self._ensure(topic, with_sub=False)
+        self._driver.publish(topic, message)
+        if self._logger is not None:
+            self._logger.debug(PubSubLog("PUB", topic, message, host=self._project))
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_pubsub_publish_success_count", "topic", topic
+            )
+
+    # -- Subscriber ---------------------------------------------------------
+
+    def subscribe(self, topic: str, timeout: Optional[float] = None) -> Optional[Message]:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_pubsub_subscribe_total_count", "topic", topic
+            )
+        self._ensure(topic, with_sub=True)
+        sub = self._sub_for(topic)
+        got = self._driver.pull_one(sub, timeout if timeout is not None else 0.5)
+        if got is None:
+            return None
+        value, handle = got
+        if self._logger is not None:
+            self._logger.debug(PubSubLog("SUB", topic, value, host=self._project))
+
+        def _commit() -> None:
+            self._driver.ack(sub, handle)
+            if self._metrics is not None:
+                self._metrics.increment_counter(
+                    "app_pubsub_subscribe_success_count", "topic", topic
+                )
+
+        return Message(topic=topic, value=value, committer=_commit)
+
+    # -- topic admin --------------------------------------------------------
+
+    def create_topic(self, name: str) -> None:
+        self._ensure(name, with_sub=False)
+
+    def delete_topic(self, name: str) -> None:
+        self._driver.delete_topic(name)
+        self._known_topics.discard(name)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def health_check(self) -> dict:
+        up = False
+        try:
+            up = self._driver.ping()
+        except Exception:  # noqa: BLE001
+            pass
+        return {
+            "status": "UP" if up else "DOWN",
+            "details": {"backend": "GOOGLE", "project": self._project},
+        }
+
+    def close(self) -> None:
+        self._driver.close()
+
+
+def new_google_from_config(config, logger=None, metrics=None) -> GooglePubSubClient:
+    """Wire the real google-cloud-pubsub driver (GOOGLE_PROJECT_ID,
+    GOOGLE_SUBSCRIPTION_NAME)."""
+    try:
+        from google.cloud import pubsub_v1  # type: ignore[import-not-found]
+    except ImportError as exc:
+        raise PubSubBackendUnavailable(
+            "PUBSUB_BACKEND=GOOGLE needs the 'google-cloud-pubsub' driver, "
+            "which is not installed in this environment. Use "
+            "PUBSUB_BACKEND=INPROC or MQTT, or inject a custom client."
+        ) from exc
+
+    project = config.get_or_default("GOOGLE_PROJECT_ID", "")
+    sub_name = config.get_or_default("GOOGLE_SUBSCRIPTION_NAME", "gofr-tpu")
+    publisher = pubsub_v1.PublisherClient()
+    subscriber = pubsub_v1.SubscriberClient()
+
+    class _Driver:
+        def ensure_topic(self, topic):
+            path = publisher.topic_path(project, topic)
+            try:
+                publisher.create_topic(name=path)
+            except Exception:  # noqa: BLE001 — AlreadyExists
+                pass
+
+        def ensure_subscription(self, topic, subscription):
+            try:
+                subscriber.create_subscription(
+                    name=subscriber.subscription_path(project, subscription),
+                    topic=publisher.topic_path(project, topic),
+                )
+            except Exception:  # noqa: BLE001 — AlreadyExists
+                pass
+
+        def publish(self, topic, value):
+            publisher.publish(publisher.topic_path(project, topic), value).result(10)
+
+        def pull_one(self, subscription, timeout):
+            from google.api_core import exceptions as gexc  # type: ignore
+
+            try:
+                resp = subscriber.pull(
+                    subscription=subscriber.subscription_path(project, subscription),
+                    max_messages=1,
+                    timeout=timeout,
+                )
+            except (gexc.DeadlineExceeded, gexc.RetryError):
+                # An empty poll surfaces as a deadline error, not an empty
+                # response — map it to the documented None-on-timeout.
+                return None
+            if not resp.received_messages:
+                return None
+            rm = resp.received_messages[0]
+            return rm.message.data, rm.ack_id
+
+        def ack(self, subscription, ack_handle):
+            subscriber.acknowledge(
+                subscription=subscriber.subscription_path(project, subscription),
+                ack_ids=[ack_handle],
+            )
+
+        def delete_topic(self, topic):
+            publisher.delete_topic(topic=publisher.topic_path(project, topic))
+
+        def ping(self):
+            return True
+
+        def close(self):
+            subscriber.close()
+
+    return GooglePubSubClient(
+        _Driver(), subscription_name=sub_name, project=project,
+        logger=logger, metrics=metrics,
+    )

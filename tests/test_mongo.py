@@ -1,0 +1,60 @@
+"""Mongo injection seam: Protocol conformance, CRUD double, container wiring."""
+
+from __future__ import annotations
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.container import Container
+from gofr_tpu.datasource.mongo import InMemoryMongo, Mongo
+
+
+def test_inmemory_mongo_satisfies_protocol():
+    assert isinstance(InMemoryMongo(), Mongo)
+
+
+def test_crud_roundtrip():
+    db = InMemoryMongo()
+    uid = db.insert_one("users", {"name": "ada", "role": "admin"})
+    db.insert_many("users", [{"name": "bo"}, {"name": "cy", "role": "admin"}])
+
+    out: list = []
+    db.find("users", {"role": "admin"}, out)
+    assert {d["name"] for d in out} == {"ada", "cy"}
+
+    one: dict = {}
+    db.find_one("users", {"name": "bo"}, one)
+    assert one["name"] == "bo"
+
+    assert db.update_by_id("users", uid, {"$set": {"role": "owner"}}) == 1
+    assert db.count_documents("users", {"role": "owner"}) == 1
+    assert db.update_many("users", {}, {"$set": {"active": True}}) == 3
+    db.update_one("users", {"name": "ada"}, {"$inc": {"logins": 2}})
+    one: dict = {}
+    one.clear(); db.find_one("users", {"name": "ada"}, one)
+    assert one["logins"] == 2
+    db.update_one("users", {"name": "ada"}, {"$unset": {"logins": ""}})
+    # Operator-less updates are rejected like real MongoDB.
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="operators"):
+        db.update_one("users", {"name": "ada"}, {"role": "boss"})
+    assert db.delete_one("users", {"name": "bo"}) == 1
+    assert db.delete_many("users", {}) == 2
+    db.drop("users")
+    assert db.count_documents("users", {}) == 0
+
+
+def test_container_injection_and_health():
+    c = Container(MockConfig({}))
+    db = InMemoryMongo()
+    c.use_mongo(db)
+    assert c.mongo is db
+    health = c.health()
+    assert health["details"]["mongo"]["status"] == "UP"
+
+
+def test_use_pubsub_injection():
+    from gofr_tpu.datasource.pubsub import InProcBroker
+
+    c = Container(MockConfig({}))
+    broker = InProcBroker()
+    c.use_pubsub(broker)
+    assert c.get_publisher() is broker and c.get_subscriber() is broker

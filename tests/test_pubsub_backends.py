@@ -1,0 +1,320 @@
+"""MQTT (real wire protocol vs in-proc broker), Kafka/Google (fake drivers),
+and the PUBSUB_BACKEND switch."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.datasource.pubsub import (
+    GooglePubSubClient,
+    KafkaClient,
+    MQTTClient,
+    PubSubBackendUnavailable,
+    new_pubsub_from_config,
+)
+from gofr_tpu.datasource.pubsub.mqtt import topic_matches
+from gofr_tpu.testutil.mqtt_broker import InProcMQTTBroker
+
+
+# ---------------------------------------------------------------------------
+# MQTT: real client ↔ real (in-process) broker over TCP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def broker():
+    with InProcMQTTBroker() as b:
+        yield b
+
+
+def _client(broker, **kw):
+    return MQTTClient(host=broker.host, port=broker.port, **kw)
+
+
+def test_mqtt_publish_subscribe_qos1(broker):
+    sub = _client(broker, client_id="sub")
+    pub = _client(broker, client_id="pub")
+    try:
+        assert sub.subscribe("orders", timeout=0.05) is None  # subscribes lazily
+        pub.publish("orders", b'{"id": 1}')
+        msg = sub.subscribe("orders", timeout=2.0)
+        assert msg is not None
+        assert msg.value == b'{"id": 1}'
+        assert msg.param("topic") == "orders"
+        assert msg.metadata["qos"] == "1"
+        msg.commit()  # sends PUBACK; must not raise
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_qos0_roundtrip(broker):
+    sub = _client(broker, client_id="sub0", qos=0)
+    pub = _client(broker, client_id="pub0", qos=0)
+    try:
+        assert sub.subscribe("t0", timeout=0.05) is None
+        pub.publish("t0", b"x")
+        msg = sub.subscribe("t0", timeout=2.0)
+        assert msg is not None and msg.value == b"x"
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_subscribe_with_function_and_unsubscribe(broker):
+    sub = _client(broker, client_id="cb")
+    pub = _client(broker, client_id="pub")
+    got = []
+    done = threading.Event()
+    try:
+        sub.subscribe_with_function("alerts", lambda m: (got.append(m), done.set()))
+        pub.publish("alerts", b"fire")
+        assert done.wait(2.0)
+        assert got[0].value == b"fire"
+
+        sub.unsubscribe("alerts")
+        pub.publish("alerts", b"after-unsub")
+        time.sleep(0.2)
+        assert len(got) == 1
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_wildcards(broker):
+    sub = _client(broker, client_id="wild")
+    pub = _client(broker, client_id="pub")
+    try:
+        assert sub.subscribe("sensors/+/temp", timeout=0.05) is None
+        pub.publish("sensors/a1/temp", b"21")
+        msg = sub.subscribe("sensors/+/temp", timeout=2.0)
+        assert msg is not None and msg.topic == "sensors/a1/temp"
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_overlapping_subscriptions_all_delivered(broker):
+    sub = _client(broker, client_id="multi")
+    pub = _client(broker, client_id="pub")
+    got_cb = []
+    done = threading.Event()
+    try:
+        sub.subscribe_with_function("#", lambda m: (got_cb.append(m), done.set()))
+        assert sub.subscribe("orders", timeout=0.05) is None  # queue sub too
+        pub.publish("orders", b"both")
+        assert done.wait(2.0)
+        msg = sub.subscribe("orders", timeout=2.0)
+        assert msg is not None and msg.value == b"both"  # queue got it too
+        assert got_cb[0].value == b"both"
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_callback_may_publish(broker):
+    """Handlers run off the reader thread, so QoS-1 publish from a callback
+    must not deadlock on its PUBACK."""
+    sub = _client(broker, client_id="replier")
+    pub = _client(broker, client_id="req")
+    done = threading.Event()
+
+    def handler(m):
+        sub.publish("replies", b"pong")  # QoS-1: waits for PUBACK
+        done.set()
+
+    try:
+        sub.subscribe_with_function("requests", handler)
+        assert pub.subscribe("replies", timeout=0.05) is None
+        pub.publish("requests", b"ping")
+        assert done.wait(5.0), "callback publish deadlocked"
+        reply = pub.subscribe("replies", timeout=2.0)
+        assert reply is not None and reply.value == b"pong"
+    finally:
+        sub.close()
+        pub.close()
+
+
+def test_mqtt_ping_and_health(broker):
+    c = _client(broker, client_id="hc")
+    try:
+        assert c.ping()
+        assert c.health_check()["status"] == "UP"
+    finally:
+        c.close()
+
+
+def test_topic_matches():
+    assert topic_matches("a/b", "a/b")
+    assert topic_matches("a/+", "a/b")
+    assert not topic_matches("a/+", "a/b/c")
+    assert topic_matches("a/#", "a/b/c")
+    assert topic_matches("#", "anything/at/all")
+    assert not topic_matches("a/b", "a")
+
+
+def test_mqtt_via_backend_switch(broker):
+    cfg = MockConfig({
+        "PUBSUB_BACKEND": "MQTT",
+        "MQTT_HOST": broker.host,
+        "MQTT_PORT": str(broker.port),
+    })
+    client = new_pubsub_from_config(cfg)
+    assert isinstance(client, MQTTClient)
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# Kafka: client logic over fake Reader/Writer/Admin (reference test pattern)
+# ---------------------------------------------------------------------------
+
+
+class _FakeKafka:
+    def __init__(self):
+        self.topics: dict[str, list[bytes]] = {}
+        self.commits: list[str] = []
+
+    def writer(self):
+        fake = self
+
+        class W:
+            def write(self, topic, value):
+                fake.topics.setdefault(topic, []).append(value)
+
+            def close(self):
+                pass
+
+        return W()
+
+    def reader_factory(self, topic):
+        fake = self
+
+        class R:
+            def read(self, timeout):
+                q = fake.topics.get(topic) or []
+                if not q:
+                    return None
+                value = q.pop(0)
+                return value, lambda: fake.commits.append(topic)
+
+            def close(self):
+                pass
+
+        return R()
+
+    def admin(self):
+        fake = self
+
+        class A:
+            def create_topic(self, name):
+                fake.topics.setdefault(name, [])
+
+            def delete_topic(self, name):
+                fake.topics.pop(name, None)
+
+            def ping(self):
+                return True
+
+        return A()
+
+
+def test_kafka_client_roundtrip_and_commit():
+    fake = _FakeKafka()
+    client = KafkaClient(
+        fake.writer(), fake.reader_factory, fake.admin(), brokers="fake:9092"
+    )
+    client.create_topic("orders")
+    client.publish("orders", b"o1")
+    msg = client.subscribe("orders")
+    assert msg is not None and msg.value == b"o1"
+    assert fake.commits == []  # commit only after handler success
+    msg.commit()
+    assert fake.commits == ["orders"]
+    assert client.subscribe("orders", timeout=0.01) is None
+    assert client.health_check()["status"] == "UP"
+    client.delete_topic("orders")
+    assert "orders" not in fake.topics
+    client.close()
+
+
+def test_kafka_without_driver_raises_clear_error():
+    cfg = MockConfig({"PUBSUB_BACKEND": "KAFKA"})
+    from gofr_tpu.datasource.pubsub.kafka import new_kafka_from_config
+
+    with pytest.raises(PubSubBackendUnavailable, match="kafka-python"):
+        new_kafka_from_config(cfg)
+    # The container-level switch degrades to None instead of crashing boot.
+    assert new_pubsub_from_config(cfg) is None
+
+
+# ---------------------------------------------------------------------------
+# Google Pub/Sub: client logic over a fake driver
+# ---------------------------------------------------------------------------
+
+
+class _FakeGoogleDriver:
+    def __init__(self):
+        self.topics: set[str] = set()
+        self.subs: dict[str, str] = {}  # sub → topic
+        self.pending: dict[str, list[bytes]] = {}
+        self.acked: list[object] = []
+
+    def ensure_topic(self, topic):
+        self.topics.add(topic)
+
+    def ensure_subscription(self, topic, subscription):
+        self.subs[subscription] = topic
+
+    def publish(self, topic, value):
+        for sub, t in self.subs.items():
+            if t == topic:
+                self.pending.setdefault(sub, []).append(value)
+        self.pending.setdefault(f"__topic__{topic}", []).append(value)
+
+    def pull_one(self, subscription, timeout):
+        q = self.pending.get(subscription) or []
+        if not q:
+            return None
+        value = q.pop(0)
+        return value, ("handle", value)
+
+    def ack(self, subscription, ack_handle):
+        self.acked.append(ack_handle)
+
+    def delete_topic(self, topic):
+        self.topics.discard(topic)
+
+    def ping(self):
+        return True
+
+    def close(self):
+        pass
+
+
+def test_google_client_auto_create_and_ack():
+    drv = _FakeGoogleDriver()
+    client = GooglePubSubClient(drv, subscription_name="svc", project="p1")
+    # Subscribe first: topic + subscription auto-created (reference
+    # google.go:115-166), named ${SUB}-${topic}.
+    assert client.subscribe("events", timeout=0.01) is None
+    assert "events" in drv.topics
+    assert drv.subs == {"svc-events": "events"}
+
+    client.publish("events", b"e1")
+    msg = client.subscribe("events")
+    assert msg is not None and msg.value == b"e1"
+    assert drv.acked == []
+    msg.commit()
+    assert drv.acked == [("handle", b"e1")]
+    assert client.health_check()["status"] == "UP"
+
+
+def test_google_without_driver_raises_clear_error():
+    from gofr_tpu.datasource.pubsub.google import new_google_from_config
+
+    with pytest.raises(PubSubBackendUnavailable, match="google-cloud-pubsub"):
+        new_google_from_config(MockConfig({}))
