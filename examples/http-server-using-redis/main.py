@@ -1,0 +1,37 @@
+"""Redis-backed HTTP server (reference ``examples/http-server-using-redis``).
+
+GET /redis/{key} reads a key, POST /redis stores {"key": ..., "value": ...}.
+Configure REDIS_HOST/REDIS_PORT; run a server with
+``python -m gofr_tpu.datasource.redis.miniredis`` or any real Redis.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.errors import ErrorEntityNotFound
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.get("/redis/{key}")
+    def get_key(ctx):
+        value = ctx.redis.get(ctx.path_param("key"))
+        if value is None:
+            raise ErrorEntityNotFound("key", ctx.path_param("key"))
+        return {"key": ctx.path_param("key"), "value": value}
+
+    @app.post("/redis")
+    def set_key(ctx):
+        body = ctx.request.json()
+        ctx.redis.set(body["key"], body["value"])
+        return {"stored": body["key"]}
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

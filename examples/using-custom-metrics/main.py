@@ -1,0 +1,47 @@
+"""Custom app metrics (reference ``examples/using-custom-metrics``).
+
+Registers counter/updown/histogram/gauge instruments at boot and records
+them from handlers; scrape them on the metrics port
+(``curl :2121/metrics``).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    m = app.container.metrics
+    m.new_counter("orders_created", "orders created via POST /order")
+    m.new_updown_counter("orders_open", "orders currently open")
+    m.new_histogram(
+        "order_value_dollars", "order value distribution",
+        buckets=[1, 5, 10, 50, 100, 500],
+    )
+    m.new_gauge("last_order_unix", "time of most recent order")
+
+    @app.post("/order")
+    def create_order(ctx):
+        body = ctx.request.json()
+        ctx.metrics.increment_counter("orders_created", "product", body["product"])
+        ctx.metrics.delta_updown_counter("orders_open", 1)
+        ctx.metrics.record_histogram("order_value_dollars", float(body["value"]))
+        ctx.metrics.set_gauge("last_order_unix", time.time())
+        return {"ok": True}
+
+    @app.delete("/order/{id}")
+    def close_order(ctx):
+        ctx.metrics.delta_updown_counter("orders_open", -1)
+        return None
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

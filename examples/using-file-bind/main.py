@@ -1,0 +1,41 @@
+"""Multipart file upload binding (reference ``examples/using-file-bind``).
+
+POST /upload with multipart/form-data: a ``file`` part binds to
+:class:`UploadedFile` and a ``name`` field binds by name — the dataclass
+walk the reference does in ``http/multipartFileBind.go``.
+"""
+
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.http.request import UploadedFile
+
+
+@dataclass
+class UploadForm:
+    name: str = ""
+    file: Optional[UploadedFile] = None
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.post("/upload")
+    def upload(ctx):
+        form = ctx.bind(UploadForm)
+        return {
+            "name": form.name,
+            "filename": form.file.filename if form.file else None,
+            "size": len(form.file.data) if form.file else 0,
+        }
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

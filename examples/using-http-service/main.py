@@ -1,0 +1,39 @@
+"""Inter-service HTTP client (reference ``examples/using-http-service``).
+
+Registers a downstream dependency at boot (``app.add_http_service``) and
+calls it from a handler via ``ctx.http_service`` — spans, logs, and the
+``app_http_service_response`` histogram come from the client stack; the
+dependency joins ``/.well-known/health``. DOWNSTREAM_ADDR points at the
+dependency (in the reference the example points at itself on localhost).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    downstream = app.container.config.get_or_default(
+        "DOWNSTREAM_ADDR", f"http://localhost:{app.http_port}"
+    )
+    app.add_http_service("catalog", downstream)
+
+    @app.get("/item")
+    def item(ctx):
+        # Proxy through the service client to the downstream /raw-item.
+        resp = ctx.http_service("catalog").get("/raw-item")
+        return {"downstream_status": resp.status_code, "body": resp.json()}
+
+    @app.get("/raw-item")
+    def raw_item(ctx):
+        return {"sku": "tpu-pod", "stock": 256}
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

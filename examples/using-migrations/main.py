@@ -1,0 +1,48 @@
+"""Versioned data migrations (reference ``examples/using-migrations``).
+
+``app.migrate({version: Migrate(up=...)})`` runs pending migrations in
+order inside transactions and records them in the ``gofr_migrations``
+table, so restarts resume where they left off (reference
+``migration/migration.go:12-79``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.migration import Migrate
+
+
+def create_employee_table(ds) -> None:
+    ds.sql.exec(
+        "CREATE TABLE IF NOT EXISTS employee "
+        "(id INTEGER PRIMARY KEY, name TEXT, dept TEXT)"
+    )
+
+
+def seed_employees(ds) -> None:
+    ds.sql.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "ada", "infra")
+    ds.sql.exec("INSERT INTO employee (name, dept) VALUES (?, ?)", "bo", "ml")
+
+
+ALL = {
+    20240226153000: Migrate(up=create_employee_table),
+    20240226153100: Migrate(up=seed_employees),
+}
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    app.migrate(ALL)
+
+    @app.get("/employees")
+    def employees(ctx):
+        return ctx.sql.query("SELECT id, name, dept FROM employee ORDER BY id")
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

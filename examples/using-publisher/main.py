@@ -1,0 +1,38 @@
+"""Publishing to pub/sub from HTTP handlers (reference
+``examples/using-publisher``): POST /publish-order forwards the JSON body
+to the ``order-logs`` topic; pair with ``using-subscriber`` for the
+consuming side.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+
+    @app.post("/publish-order")
+    def publish_order(ctx):
+        body = ctx.request.json()
+        ctx.publish("order-logs", json.dumps(body).encode())
+        return {"published": True}
+
+    @app.get("/peek")
+    def peek(ctx):
+        # Demo-only: drain one message so the example is self-contained.
+        msg = ctx.pubsub.subscribe("order-logs", timeout=0.05)
+        if msg is None:
+            return {"empty": True}
+        msg.commit()
+        return {"message": json.loads(msg.value)}
+
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -192,7 +192,7 @@ class Container:
         self._started_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
     async def close(self) -> None:
-        for name in ("sql", "redis", "pubsub", "tpu"):
+        for name in ("sql", "redis", "pubsub", "tpu", "mongo"):
             ds = getattr(self, name)
             if ds is not None and hasattr(ds, "close"):
                 try:

@@ -18,9 +18,16 @@ payload and ``param("topic")`` returns the topic. Backends, selected via
 
 from gofr_tpu.datasource.pubsub.base import Message, PubSubLog
 from gofr_tpu.datasource.pubsub.inproc import InProcBroker
-from gofr_tpu.datasource.pubsub.kafka import KafkaClient, PubSubBackendUnavailable
-from gofr_tpu.datasource.pubsub.google import GooglePubSubClient
-from gofr_tpu.datasource.pubsub.mqtt import MQTTClient
+from gofr_tpu.datasource.pubsub.kafka import (
+    KafkaClient,
+    PubSubBackendUnavailable,
+    new_kafka_from_config,
+)
+from gofr_tpu.datasource.pubsub.google import (
+    GooglePubSubClient,
+    new_google_from_config,
+)
+from gofr_tpu.datasource.pubsub.mqtt import MQTTClient, new_mqtt_from_config
 
 __all__ = [
     "Message",
@@ -43,21 +50,16 @@ def new_pubsub_from_config(config, logger=None, metrics=None):
         if backend == "INPROC":
             return InProcBroker(logger=logger, metrics=metrics)
         if backend == "MQTT":
-            from gofr_tpu.datasource.pubsub.mqtt import new_mqtt_from_config
-
             return new_mqtt_from_config(config, logger=logger, metrics=metrics)
         if backend == "KAFKA":
-            from gofr_tpu.datasource.pubsub.kafka import new_kafka_from_config
-
             return new_kafka_from_config(config, logger=logger, metrics=metrics)
         if backend == "GOOGLE":
-            from gofr_tpu.datasource.pubsub.google import new_google_from_config
-
             return new_google_from_config(config, logger=logger, metrics=metrics)
-    except (PubSubBackendUnavailable, OSError, ValueError) as exc:
-        # Boot must not crash on a missing driver/broker or malformed
-        # numeric config — log and run without pub/sub, like the reference
-        # logs datasource connect errors.
+    except Exception as exc:  # noqa: BLE001
+        # Boot must not crash on a missing driver/broker, malformed numeric
+        # config, or driver-native connect errors (kafka NoBrokersAvailable,
+        # google DefaultCredentialsError, …) — log and run without pub/sub,
+        # like the reference logs datasource connect errors and continues.
         if logger is not None:
             logger.errorf("pub/sub backend %s unavailable: %s", backend, exc)
         return None

@@ -201,7 +201,14 @@ def new_google_from_config(config, logger=None, metrics=None) -> GooglePubSubCli
             publisher.delete_topic(topic=publisher.topic_path(project, topic))
 
         def ping(self):
-            return True
+            # Real round trip: listing one topic exercises auth + network.
+            try:
+                list(publisher.list_topics(
+                    project=f"projects/{project}", page_size=1, timeout=2.0
+                ))
+                return True
+            except Exception:  # noqa: BLE001 — any driver error means DOWN
+                return False
 
         def close(self):
             subscriber.close()

@@ -147,6 +147,8 @@ class KafkaClient:
         for r in readers:
             r.close()
         self._writer.close()
+        if hasattr(self._admin, "close"):
+            self._admin.close()
 
 
 def new_kafka_from_config(config, logger=None, metrics=None) -> KafkaClient:
@@ -179,6 +181,9 @@ def new_kafka_from_config(config, logger=None, metrics=None) -> KafkaClient:
             producer.close()
 
     def _reader_factory(topic: str) -> Reader:
+        from kafka import TopicPartition
+        from kafka.structs import OffsetAndMetadata
+
         consumer = KafkaConsumer(
             topic,
             bootstrap_servers=brokers,
@@ -193,7 +198,13 @@ def new_kafka_from_config(config, logger=None, metrics=None) -> KafkaClient:
                                        max_records=1)
                 for records in polled.values():
                     for rec in records:
-                        return rec.value, consumer.commit
+                        # Commit ONLY this record's offset: a bare
+                        # consumer.commit() would commit the current
+                        # position past earlier uncommitted (failed)
+                        # messages, losing them.
+                        tp = TopicPartition(rec.topic, rec.partition)
+                        meta = OffsetAndMetadata(rec.offset + 1, "")
+                        return rec.value, lambda: consumer.commit({tp: meta})
                 return None
 
             def close(self) -> None:
@@ -213,6 +224,9 @@ def new_kafka_from_config(config, logger=None, metrics=None) -> KafkaClient:
 
         def ping(self) -> bool:
             return bool(self._client.describe_cluster())
+
+        def close(self) -> None:
+            self._client.close()
 
     return KafkaClient(
         _Writer(), _reader_factory, _Admin(), brokers=brokers,

@@ -62,7 +62,14 @@ def wrap_handler(fn: Callable, container) -> Callable:
 
 def health_handler(container):
     async def handler(ctx) -> dict:  # noqa: ARG001
-        return container.health()
+        import asyncio
+
+        # Health aggregation makes blocking HTTP probes to service
+        # dependencies; run it off the event loop or a dependency pointing
+        # back at this app (reference examples do exactly that) deadlocks.
+        return await asyncio.get_running_loop().run_in_executor(
+            None, container.health
+        )
 
     return handler
 

@@ -1,0 +1,195 @@
+"""Example apps as integration tests (SURVEY §4: the reference boots each
+example's real server in-process and asserts over localhost HTTP)."""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import importlib.util
+import json
+import os
+import threading
+import uuid
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def load_example(name: str):
+    path = os.path.join(EXAMPLES, name, "main.py")
+    spec = importlib.util.spec_from_file_location(
+        f"example_{name.replace('-', '_')}", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def clean_env(free_port):
+    """Examples load their configs/.env into os.environ — isolate each test
+    and pin ephemeral ports."""
+    snapshot = dict(os.environ)
+    os.environ["HTTP_PORT"] = str(free_port())
+    os.environ["METRICS_PORT"] = str(free_port())
+    yield
+    os.environ.clear()
+    os.environ.update(snapshot)
+
+
+class Harness:
+    """Runs an App's asyncio lifecycle on a background thread."""
+
+    def __init__(self, app) -> None:
+        self.app = app
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+
+    def __enter__(self):
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(self.app.start(), self._loop).result(10)
+        return self
+
+    def __exit__(self, *exc):
+        asyncio.run_coroutine_threadsafe(self.app.stop(), self._loop).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self._loop.close()
+
+    def request(self, method, path, body=None, headers=None, port=None):
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port or self.app.http_port, timeout=5
+        )
+        try:
+            payload = body
+            if body is not None and not isinstance(body, bytes):
+                payload = json.dumps(body).encode()
+            conn.request(method, path, body=payload, headers=headers or {})
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+
+def test_http_server_example():
+    app = load_example("http-server").main()
+    with Harness(app) as h:
+        status, body = h.request("GET", "/hello?name=TPU")
+        assert status == 200 and json.loads(body)["data"] == "Hello TPU!"
+        status, _ = h.request("GET", "/error")
+        assert status == 404
+
+
+def test_http_server_using_redis_example():
+    from gofr_tpu.datasource.redis.miniredis import MiniRedis
+
+    server = MiniRedis()
+    server.start()
+    os.environ["REDIS_HOST"] = "127.0.0.1"
+    os.environ["REDIS_PORT"] = str(server.port)
+    try:
+        app = load_example("http-server-using-redis").main()
+        with Harness(app) as h:
+            status, _ = h.request(
+                "POST", "/redis", body={"key": "greeting", "value": "hi"}
+            )
+            assert status == 201
+            status, body = h.request("GET", "/redis/greeting")
+            assert status == 200
+            assert json.loads(body)["data"]["value"] == "hi"
+            status, _ = h.request("GET", "/redis/missing")
+            assert status == 404
+    finally:
+        server.stop()
+
+
+def test_using_custom_metrics_example():
+    app = load_example("using-custom-metrics").main()
+    with Harness(app) as h:
+        for value in (3, 42):
+            status, _ = h.request(
+                "POST", "/order", body={"product": "tpu", "value": value}
+            )
+            assert status == 201
+        h.request("DELETE", "/order/1")
+        status, body = h.request(
+            "GET", "/metrics", port=app.metrics_port
+        )
+        text = body.decode()
+        assert status == 200
+        assert 'orders_created{product="tpu"} 2.0' in text
+        assert "orders_open 1.0" in text
+        assert "order_value_dollars_bucket" in text
+
+
+def test_using_file_bind_example():
+    app = load_example("using-file-bind").main()
+    boundary = uuid.uuid4().hex
+    payload = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="name"\r\n\r\n'
+        "report\r\n"
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="file"; filename="a.txt"\r\n'
+        "Content-Type: text/plain\r\n\r\n"
+        "hello world\r\n"
+        f"--{boundary}--\r\n"
+    ).encode()
+    with Harness(app) as h:
+        status, body = h.request(
+            "POST", "/upload", body=payload,
+            headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+        )
+        assert status == 201
+        data = json.loads(body)["data"]
+        assert data == {"name": "report", "filename": "a.txt", "size": 11}
+
+
+def test_using_http_service_example():
+    app = load_example("using-http-service").main()
+    with Harness(app) as h:
+        status, body = h.request("GET", "/item")
+        assert status == 200
+        data = json.loads(body)["data"]
+        assert data["downstream_status"] == 200
+        assert data["body"]["data"]["sku"] == "tpu-pod"
+        # Dependency shows up in aggregate health.
+        status, body = h.request("GET", "/.well-known/health")
+        assert "service:catalog" in json.loads(body)["data"]["details"]
+
+
+def test_using_migrations_example():
+    mod = load_example("using-migrations")
+    app = mod.main()
+    with Harness(app) as h:
+        status, body = h.request("GET", "/employees")
+        assert status == 200
+        rows = json.loads(body)["data"]
+        assert [r["name"] for r in rows] == ["ada", "bo"]
+        # Re-running migrations is a no-op (versions in gofr_migrations).
+        app.container.sql.exec("DELETE FROM employee WHERE name = ?", "bo")
+        from gofr_tpu.migration import run
+
+        run(mod.ALL, app.container)
+        rows = app.container.sql.query("SELECT name FROM employee")
+        assert [r["name"] for r in rows] == ["ada"]
+
+
+def test_using_publisher_example():
+    app = load_example("using-publisher").main()
+    with Harness(app) as h:
+        status, _ = h.request("POST", "/publish-order", body={"id": 7})
+        assert status == 201
+        status, body = h.request("GET", "/peek")
+        assert json.loads(body)["data"]["message"] == {"id": 7}
+        status, body = h.request("GET", "/peek")
+        assert json.loads(body)["data"] == {"empty": True}
+
+
+def test_using_cmd_example(capsys):
+    mod = load_example("using-cmd")
+    app = mod.main()
+    rc = app.run(["hello", "-name=TPU"])
+    assert rc == 0
+    assert "Hello TPU!" in capsys.readouterr().out
