@@ -202,10 +202,15 @@ def new_google_from_config(config, logger=None, metrics=None) -> GooglePubSubCli
 
         def ping(self):
             # Real round trip: listing one topic exercises auth + network.
+            # GAPIC signature: page_size must ride inside the request dict.
             try:
-                list(publisher.list_topics(
-                    project=f"projects/{project}", page_size=1, timeout=2.0
-                ))
+                next(
+                    iter(publisher.list_topics(
+                        request={"project": f"projects/{project}", "page_size": 1},
+                        timeout=2.0,
+                    )),
+                    None,
+                )
                 return True
             except Exception:  # noqa: BLE001 — any driver error means DOWN
                 return False

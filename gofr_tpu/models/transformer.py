@@ -157,10 +157,10 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
 
 
 def kv_cache_specs() -> KVCache:
-    """Cache layout [L, slots, len, kv_heads, hd]: kv_heads over ``tp``."""
+    """Cache layout [L, slots, kv_heads, len, hd]: kv_heads over ``tp``."""
     return KVCache(
-        k=P(None, None, None, "tp", None),
-        v=P(None, None, None, "tp", None),
+        k=P(None, None, "tp", None, None),
+        v=P(None, None, "tp", None, None),
         lengths=P(None),
     )
 
@@ -281,10 +281,13 @@ def transformer_prefill(
         return out, kv
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-    # ks: [L, b, s, KV, hd] → write each sequence's prefix into its slot.
+    # ks: [L, b, s, KV, hd] → heads-major [L, b, KV, s, hd], pad the seq dim
+    # to max_len, write each sequence's prefix into its slot.
     pad_len = cache.max_len - s
-    ks = jnp.pad(ks, ((0, 0), (0, 0), (0, pad_len), (0, 0), (0, 0)))
-    vs = jnp.pad(vs, ((0, 0), (0, 0), (0, pad_len), (0, 0), (0, 0)))
+    ks = jnp.swapaxes(ks, 2, 3)
+    vs = jnp.swapaxes(vs, 2, 3)
+    ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_len), (0, 0)))
+    vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_len), (0, 0)))
     new_k = cache.k.at[:, slots].set(ks)
     new_v = cache.v.at[:, slots].set(vs)
     cache = cache._replace(k=new_k, v=new_v)
@@ -310,7 +313,7 @@ def transformer_decode_step(
     active: [n_slots] bool — only active slots get their K/V write kept and
     their length bumped; inactive rows are wasted FLOPs, which is the right
     trade on TPU (static shapes, no gather/scatter of the cache, the whole
-    [L, S, max_len, KV, hd] buffers update in place via donation).
+    [L, S, KV, max_len, hd] buffers update in place via donation).
     Returns ([n_slots, vocab] logits, updated cache).
     """
     S = cache.n_slots
@@ -325,7 +328,7 @@ def transformer_decode_step(
     slot_idx = jnp.arange(S)
 
     def body(x, scanned):
-        lp, ck, cv = scanned  # ck/cv: [S, max_len, KV, hd] for this layer
+        lp, ck, cv = scanned  # ck/cv: [S, KV, max_len, hd] for this layer
         h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
         q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
         k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
@@ -333,8 +336,9 @@ def transformer_decode_step(
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
-        ck = ck.at[slot_idx, positions].set(k)
-        cv = cv.at[slot_idx, positions].set(v)
+        # Heads-major write: [slot, kv_head, position] ← [S, KV, hd].
+        ck = ck.at[slot_idx[:, None], jnp.arange(KV)[None, :], positions[:, None]].set(k)
+        cv = cv.at[slot_idx[:, None], jnp.arange(KV)[None, :], positions[:, None]].set(v)
         attn = decode_attention(q, ck, cv, positions + 1)
         x = x + jnp.einsum("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
         h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)

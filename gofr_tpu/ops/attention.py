@@ -104,7 +104,8 @@ def decode_attention(
     """Single-token decode attention against per-slot caches.
 
     q: [b, n_heads, hd] (one query per sequence);
-    k_cache, v_cache: [b, max_len, n_kv_heads, hd];
+    k_cache, v_cache: [b, n_kv_heads, max_len, hd] (heads-major — the
+    TPU-native cache layout, see ``ops/kv_cache.py``);
     lengths: [b] valid prefix length per slot (the new token's K/V must
     already be written at position lengths-1).
     kernel: None → auto (pallas flash-decode kernel on TPU).
@@ -118,24 +119,24 @@ def decode_attention(
             q, k_cache, v_cache, lengths, scale=scale, interpret=_interpret()
         )
     n_heads = q.shape[1]
-    n_kv = k_cache.shape[2]
+    n_kv = k_cache.shape[1]
     n_rep = n_heads // n_kv
     if scale is None:
         scale = q.shape[-1] ** -0.5
 
     # Group query heads by their KV head: [b, kv, rep, hd].
-    b, max_len = k_cache.shape[0], k_cache.shape[1]
+    b, max_len = k_cache.shape[0], k_cache.shape[2]
     qg = q.reshape(b, n_kv, n_rep, -1)
 
     scores = jnp.einsum(
-        "bgrd,bkgd->bgrk", qg, k_cache, preferred_element_type=jnp.float32
+        "bgrd,bgkd->bgrk", qg, k_cache, preferred_element_type=jnp.float32
     ) * scale  # [b, kv, rep, max_len]
 
     valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bgrk,bkgd->bgrd", probs, v_cache)
+    out = jnp.einsum("bgrk,bgkd->bgrd", probs, v_cache)
     return out.reshape(b, n_heads, -1)
 
 

@@ -1,11 +1,14 @@
 """Slot-based KV cache for autoregressive decode (net-new; SURVEY §7 hard
 part #3: persistent device state across requests).
 
-Layout: ``[n_layers, n_slots, max_len, n_kv_heads, head_dim]``. The slot axis
-is the decode batch axis (decode runs over ALL slots each step — static
-shapes, no gather/scatter), per-step writes are position-local scatters, and
-the kv_heads axis shards over the tensor-parallel mesh axis without
-resharding between prefill and decode.
+Layout: ``[n_layers, n_slots, n_kv_heads, max_len, head_dim]`` — heads-major,
+the TPU-native choice: the flash-decode kernel's per-head blocks
+``[block_k, head_dim]`` tile directly onto the (8, 128) VMEM layout (a
+heads-minor cache would need 1-sized blocks on the second-to-last dim,
+which pallas cannot tile). The slot axis is the decode batch axis (decode
+runs over ALL slots each step — static shapes, no gather/scatter), per-step
+writes are position-local scatters, and the kv_heads axis shards over the
+tensor-parallel mesh axis without resharding between prefill and decode.
 
 The cache is a functional pytree; the model's prefill/decode steps return
 updated buffers which XLA aliases in place when the jitted step donates them
@@ -20,7 +23,7 @@ import jax.numpy as jnp
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # [layers, slots, max_len, kv_heads, head_dim]
+    k: jnp.ndarray  # [layers, slots, kv_heads, max_len, head_dim]
     v: jnp.ndarray
     lengths: jnp.ndarray  # [slots] int32 — tokens currently in each slot
 
@@ -34,7 +37,7 @@ class KVCache(NamedTuple):
         head_dim: int,
         dtype=jnp.bfloat16,
     ) -> "KVCache":
-        shape = (n_layers, n_slots, max_len, n_kv_heads, head_dim)
+        shape = (n_layers, n_slots, n_kv_heads, max_len, head_dim)
         return cls(
             k=jnp.zeros(shape, dtype=dtype),
             v=jnp.zeros(shape, dtype=dtype),
@@ -47,7 +50,7 @@ class KVCache(NamedTuple):
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     def hbm_bytes(self) -> int:
         return int(self.k.size * self.k.dtype.itemsize * 2)

@@ -7,8 +7,17 @@ slot's length are skipped — with continuous batching most slots are far
 shorter than max_len, so skipped blocks are most blocks — and (b) the
 online softmax never materialises [b, heads, max_len] score tensors in HBM.
 
-Cache layout is the engine's native ``[b, max_len, n_kv, hd]`` — no
-transpose copies on the hot path.
+The kv-head axis is a grid dimension (like the head axis in
+``flash_attention``), so each grid step runs two plain
+``[rep, hd] × [hd, block_k]``-shaped MXU matmuls — Mosaic does not lower
+batched matmuls whose batch dims sit in different operand positions
+("batch dims must be equal"), which is exactly what a fused
+``[g, rep, hd] × [block_k, g, hd]`` contraction produces.
+
+Cache layout is the engine's native heads-major ``[b, n_kv, max_len, hd]``
+(``ops/kv_cache.py``): per-head blocks are then ``[block_k, hd]`` on the
+last two dims, which tiles onto VMEM — a heads-minor layout would need
+1-sized blocks on the second-to-last dim, which pallas cannot tile.
 """
 
 from __future__ import annotations
@@ -30,9 +39,9 @@ def _clamp_blk(ik, length, block_k):
 
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             scale, block_k):
-    """Grid: (b, kv_blocks); kv innermost, state carried in scratch."""
+    """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch."""
     ib = pl.program_id(0)
-    ik = pl.program_id(1)
+    ik = pl.program_id(2)
     length = len_ref[ib]
 
     @pl.when(ik == 0)
@@ -46,39 +55,38 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(col0 < length)
     def _body():
-        q = q_ref[0]  # [n_kv, rep, hd]
-        k = k_ref[0]  # [block_k, n_kv, hd]
-        v = v_ref[0]
-        n_kv, rep, _ = q.shape
+        q = q_ref[0, 0]      # [rep, hd]
+        k = k_ref[0, 0]      # [block_k, hd]
+        v = v_ref[0, 0]
+        rep = q.shape[0]
 
-        s = jnp.einsum(
-            "grd,kgd->grk", q, k, preferred_element_type=jnp.float32
-        ) * scale  # [n_kv, rep, block_k]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [rep, block_k]
 
-        cols = col0 + jax.lax.broadcasted_iota(
-            jnp.int32, (n_kv, rep, block_k), 2
-        )
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (rep, block_k), 1)
         mask = cols < length
         s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[:]  # [n_kv, rep, 128]
-        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_prev = m_ref[:]  # [rep, 128] (value replicated over lanes)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         corr = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new[..., :1]), 0.0)
-        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=2, keepdims=True)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
-        pv = jnp.einsum(
-            "grk,kgd->grd", p.astype(v.dtype), v,
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] = acc_ref[:] * corr[..., :1] + pv
+        )  # [rep, hd]
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
 
     @pl.when(ik == last_vis)
     def _finish():
-        l = l_ref[:, :, :1]
+        l = l_ref[:, :1]
         out = jnp.where(l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0)
-        o_ref[0] = out.astype(o_ref.dtype)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -96,12 +104,12 @@ def flash_decode(
 ) -> jnp.ndarray:
     """Same contract as ``ops.attention.decode_attention``:
 
-    q: [b, n_heads, hd]; caches: [b, max_len, n_kv, hd]; lengths: [b]
-    (valid prefix; the current token's K/V already written at lengths-1).
-    Returns [b, n_heads, hd].
+    q: [b, n_heads, hd]; caches: [b, n_kv, max_len, hd] (heads-major);
+    lengths: [b] (valid prefix; the current token's K/V already written at
+    lengths-1). Returns [b, n_heads, hd].
     """
     b, n_heads, hd = q.shape
-    max_len, n_kv = k_cache.shape[1], k_cache.shape[2]
+    n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     n_rep = n_heads // n_kv
     if scale is None:
         scale = hd**-0.5
@@ -109,7 +117,7 @@ def flash_decode(
     block_k = min(block_k, max_len)
     if max_len % block_k:
         pad = block_k - max_len % block_k
-        cfg = [(0, 0), (0, pad), (0, 0), (0, 0)]
+        cfg = [(0, 0), (0, 0), (0, pad), (0, 0)]
         k_cache = jnp.pad(k_cache, cfg)
         v_cache = jnp.pad(v_cache, cfg)
         max_len += pad
@@ -117,26 +125,28 @@ def flash_decode(
     qg = q.reshape(b, n_kv, n_rep, hd)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, max_len // block_k),
+        grid=(b, n_kv, max_len // block_k),
         in_specs=[
-            pl.BlockSpec((1, n_kv, n_rep, hd), lambda ib, ik, lens: (ib, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
+            ),
             # Clamp the kv block index to the slot's last valid block: grid
             # steps beyond a short slot's length re-"fetch" the same block,
             # which the pallas pipeline elides (same index → no new DMA) —
             # this is where the SMEM-prefetched lengths actually save HBM
             # bandwidth, not just compute.
-            pl.BlockSpec((1, block_k, n_kv, hd), lambda ib, ik, lens: (
-                ib, _clamp_blk(ik, lens[ib], block_k), 0, 0)),
-            pl.BlockSpec((1, block_k, n_kv, hd), lambda ib, ik, lens: (
-                ib, _clamp_blk(ik, lens[ib], block_k), 0, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
+                ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
+                ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, n_kv, n_rep, hd), lambda ib, ik, lens: (ib, 0, 0, 0)
+            (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((n_kv, n_rep, hd), jnp.float32),
-            pltpu.VMEM((n_kv, n_rep, 128), jnp.float32),
-            pltpu.VMEM((n_kv, n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep, hd), jnp.float32),
+            pltpu.VMEM((n_rep, 128), jnp.float32),
+            pltpu.VMEM((n_rep, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(

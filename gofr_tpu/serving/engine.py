@@ -141,6 +141,11 @@ class InferenceEngine:
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._running = False
+        self._fatal: Optional[BaseException] = None  # scheduler death reason
+        # Serializes submission against the scheduler's final drain, so a
+        # request can never be enqueued after the drain has already run.
+        self._submit_lock = threading.Lock()
+        self._drained = False
 
         if self.family == "llm":
             from gofr_tpu.ops.kv_cache import KVCache
@@ -308,6 +313,8 @@ class InferenceEngine:
         if self._running:
             return
         self._running = True
+        self._drained = False
+        self._fatal = None
         if self.family == "llm":
             self._sched = threading.Thread(
                 target=self._scheduler_loop, name="tpu-scheduler", daemon=True
@@ -337,31 +344,53 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _scheduler_loop(self) -> None:
-        while self._running:
-            admitted = self._admit_pending()
-            any_active = any(s is not None for s in self._slots)
-            if not any_active:
-                if not admitted:
-                    self._work.wait(timeout=0.02)
-                    self._work.clear()
-                continue
-            self._decode_window_once()
+        error: BaseException | None = None
+        try:
+            while self._running:
+                admitted = self._admit_pending()
+                any_active = any(s is not None for s in self._slots)
+                if not any_active:
+                    if not admitted:
+                        self._work.wait(timeout=0.02)
+                        self._work.clear()
+                    continue
+                self._decode_window_once()
+        except BaseException as exc:  # noqa: BLE001 — must not strand futures
+            # A scheduler crash (e.g. a kernel that fails to compile on this
+            # hardware) must fail every caller, not hang them until timeout.
+            error = exc
+            self._fatal = exc
+            self._running = False
+            if self._logger is not None:
+                self._logger.errorf("engine scheduler died: %s", exc)
         # Drain: fail queued requests AND active slots so no awaiting caller
-        # hangs on an unresolved future / unterminated stream.
-        while not self._pending.empty():
+        # hangs on an unresolved future / unterminated stream. The submit
+        # lock closes the race where a submitter enqueues between the
+        # scheduler's exit and this drain.
+        reason: BaseException = error or RuntimeError("engine stopped")
+
+        def _fail(req) -> None:
+            # done() + InvalidStateError guard: an async caller may have
+            # cancelled the future already.
             try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            req.future.set_exception(RuntimeError("engine stopped"))
+                if not req.future.done():
+                    req.future.set_exception(reason)
+            except Exception:  # noqa: BLE001 — cancelled concurrently
+                pass
             req.stream.put(None)
+
+        with self._submit_lock:
+            self._drained = True
+            while not self._pending.empty():
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                _fail(req)
         for i, seq in enumerate(self._slots):
             if seq is None:
                 continue
-            req = seq.request
-            if not req.future.done():
-                req.future.set_exception(RuntimeError("engine stopped"))
-            req.stream.put(None)
+            _fail(seq.request)
             self._slots[i] = None
 
     def _admit_pending(self) -> bool:
@@ -547,8 +576,6 @@ class InferenceEngine:
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
-        if not self._running:
-            raise RuntimeError("engine not started")
         ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -558,7 +585,14 @@ class InferenceEngine:
             temperature=temperature,
             stop_on_eos=stop_on_eos,
         )
-        self._pending.put_nowait(req)
+        # Check-and-enqueue under the drain lock: once the scheduler's final
+        # drain has run, nothing may land in the queue (it would hang).
+        with self._submit_lock:
+            if self._fatal is not None:
+                raise RuntimeError(f"engine scheduler died: {self._fatal}")
+            if not self._running or self._drained:
+                raise RuntimeError("engine not started")
+            self._pending.put_nowait(req)
         self._work.set()
         return req
 

@@ -1,0 +1,100 @@
+"""Native C++ BPE core vs the pure-Python oracle, plus serving integration."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from gofr_tpu.serving.native_tokenizer import (
+    BPETokenizer,
+    NativeBPE,
+    PyBPE,
+    build_native,
+    byte_vocab_with_merges,
+    load_bpe,
+    write_bpe_files,
+)
+
+MERGES = [
+    (b"t", b"h"),       # th
+    (b"th", b"e"),      # the
+    (b" ", b"the"),     # ␣the
+    (b"i", b"n"),       # in
+    (b"a", b"n"),       # an
+    (b"an", b"d"),      # and
+    (b" ", b"and"),     # ␣and
+    (b"e", b"r"),       # er
+]
+
+
+@pytest.fixture(scope="module")
+def bpe_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("bpe")
+    vocab = byte_vocab_with_merges(MERGES)
+    return write_bpe_files(vocab, MERGES, str(d))
+
+
+def test_python_core_merges(bpe_files):
+    py = PyBPE(*bpe_files)
+    ids = py.encode_bytes(b"the thin and")
+    # "the" must collapse to the single 'the' merge token (id 256+1).
+    assert py.id_to_token[ids[0]] == b"the"
+    assert b" and" in [py.id_to_token[i] for i in ids]
+    assert py.decode_bytes(ids) == b"the thin and"
+
+
+def test_native_builds_and_matches_python(bpe_files):
+    so = build_native()
+    assert so is not None, "g++ is baked into this image; build must succeed"
+    nat = NativeBPE(*bpe_files, so_path=so)
+    py = PyBPE(*bpe_files)
+    assert nat.vocab_size == py.vocab_size
+
+    rng = random.Random(0)
+    corpus = [
+        b"the quick brown fox jumps over the lazy dog",
+        b"and then there were none",
+        "héllo wörld — ünïcode".encode("utf-8"),
+        b"",
+        b"a",
+        bytes(rng.randrange(256) for _ in range(512)),
+    ]
+    for data in corpus:
+        assert nat.encode_bytes(data) == py.encode_bytes(data), data
+        assert nat.decode_bytes(py.encode_bytes(data)) == data
+
+
+def test_tokenizer_protocol_roundtrip(bpe_files):
+    tok = load_bpe(*bpe_files)
+    ids = tok.encode("the thin and")
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids + [tok.eos_id, tok.pad_id]) == "the thin and"
+
+
+def test_fallback_when_native_unavailable(bpe_files, monkeypatch):
+    import gofr_tpu.serving.native_tokenizer as nt
+
+    monkeypatch.setattr(nt, "build_native", lambda force=False: None)
+    tok = nt.load_bpe(*bpe_files)
+    assert not tok.is_native
+    assert tok.decode(tok.encode("the end")) == "the end"
+
+
+def test_native_tokenizer_drives_serving_engine(bpe_files):
+    """The BPE tokenizer slots into the engine exactly like ByteTokenizer —
+    vocab_size 267 fits the tiny models' 512 vocab."""
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    tok = load_bpe(*bpe_files)
+    assert tok.is_native
+    engine = InferenceEngine("llama-tiny", n_slots=2, max_len=64, tokenizer=tok)
+    engine.start_sync()
+    try:
+        out = engine.generate_sync(
+            "the and", max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        assert len(out.token_ids) == 4
+        assert isinstance(out.text, str)
+    finally:
+        engine.stop_sync()

@@ -63,8 +63,8 @@ def test_flash_decode_matches_dense(b, max_len, n_heads, n_kv, hd, lengths):
     key = jax.random.PRNGKey(2)
     kq, kk, kv = jax.random.split(key, 3)
     q = jax.random.normal(kq, (b, n_heads, hd))
-    k_cache = jax.random.normal(kk, (b, max_len, n_kv, hd))
-    v_cache = jax.random.normal(kv, (b, max_len, n_kv, hd))
+    k_cache = jax.random.normal(kk, (b, n_kv, max_len, hd))
+    v_cache = jax.random.normal(kv, (b, n_kv, max_len, hd))
     lens = jnp.array(lengths, dtype=jnp.int32)
 
     want = decode_attention(q, k_cache, v_cache, lens)
@@ -102,8 +102,8 @@ def test_flash_decode_zero_length_slot_is_finite():
     # Empty slots (length 0) must not poison the batch with NaNs.
     b, max_len, n_kv, hd = 2, 64, 2, 32
     q = jnp.ones((b, 4, hd))
-    k_cache = jnp.ones((b, max_len, n_kv, hd))
-    v_cache = jnp.ones((b, max_len, n_kv, hd))
+    k_cache = jnp.ones((b, n_kv, max_len, hd))
+    v_cache = jnp.ones((b, n_kv, max_len, hd))
     lens = jnp.array([0, 10], dtype=jnp.int32)
     got = flash_decode(q, k_cache, v_cache, lens, block_k=64, interpret=True)
     assert bool(jnp.isfinite(got).all())

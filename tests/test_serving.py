@@ -271,3 +271,28 @@ def test_grpc_inference_service():
         loop.call_soon_threadsafe(loop.stop)
         thread.join(timeout=5)
         eng.stop_sync()
+
+
+def test_scheduler_death_fails_futures_fast():
+    """A crash in the scheduler loop (e.g. a kernel that fails to compile on
+    real hardware) must fail pending futures and later submissions — not
+    strand callers until their timeout."""
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer()
+    )
+    eng._admit_pending = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    eng.start_sync()
+    try:
+        # Depending on who wins the race, the submit fails fast (scheduler
+        # already dead) or returns a future the drain fails — never a hang.
+        with pytest.raises(RuntimeError, match="boom|engine stopped|scheduler died"):
+            req = eng.submit_generate("hi", max_new_tokens=4, stop_on_eos=False)
+            req.future.result(timeout=10)
+        # Scheduler is dead now; new submissions fail immediately.
+        deadline = time.time() + 5
+        while eng._fatal is None and time.time() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(RuntimeError, match="scheduler died"):
+            eng.submit_generate("again")
+    finally:
+        eng.stop_sync()
