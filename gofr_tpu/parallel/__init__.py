@@ -17,6 +17,7 @@ app-level routing.
 from gofr_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
 from gofr_tpu.parallel.sharding import shard_pytree, make_train_step
 from gofr_tpu.parallel.pipeline import pipeline_layer_fn, pipeline_spmd
+from gofr_tpu.parallel.dcn import initialize_multihost, process_topology
 
 __all__ = [
     "make_mesh",
@@ -25,4 +26,6 @@ __all__ = [
     "make_train_step",
     "pipeline_layer_fn",
     "pipeline_spmd",
+    "initialize_multihost",
+    "process_topology",
 ]

@@ -1,0 +1,75 @@
+"""DCN tier: multi-host runtime init + cross-host coordination seams.
+
+SURVEY §2.6: intra-pod scaling is compiled XLA collectives over ICI
+(``parallel/sharding.py``); the cross-host (DCN) tier has two parts:
+
+* **Runtime**: ``jax.distributed`` — every host runs the same program,
+  one coordinator, and ``jax.devices()`` becomes the global device set so
+  meshes (and the collectives compiled over them) span hosts. This module
+  wraps the init with the framework's env-config idiom.
+* **App-level routing** reuses the service tier verbatim — the
+  inter-service HTTP client + circuit breaker (``gofr_tpu/service``) is
+  the cross-pod request path, exactly how the reference treats
+  cross-service communication.
+
+Config keys: ``DCN_COORDINATOR`` (host:port of process 0),
+``DCN_NUM_PROCESSES``, ``DCN_PROCESS_ID``. Absent config → single-host
+no-op, so the same binary runs laptop and pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize_multihost(
+    config=None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    logger=None,
+) -> bool:
+    """Initialize the multi-host JAX runtime; returns True if distributed.
+
+    Explicit args win over ``config`` keys. With neither, this is a no-op
+    (single host) — boot code can call it unconditionally.
+    """
+    if config is not None:
+        coordinator_address = coordinator_address or config.get_or_default(
+            "DCN_COORDINATOR", ""
+        )
+        if num_processes is None:
+            n = config.get_or_default("DCN_NUM_PROCESSES", "")
+            num_processes = int(n) if n else None
+        if process_id is None:
+            p = config.get_or_default("DCN_PROCESS_ID", "")
+            process_id = int(p) if p else None
+    if not coordinator_address:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    if logger is not None:
+        logger.infof(
+            "multi-host runtime up: process %s/%s via %s — %d global devices",
+            jax.process_index(), jax.process_count(), coordinator_address,
+            len(jax.devices()),
+        )
+    return True
+
+
+def process_topology() -> dict:
+    """Host-level topology for health/diagnostics endpoints."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }

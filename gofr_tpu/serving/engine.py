@@ -90,6 +90,7 @@ class InferenceEngine:
         max_batch: int = 8,
         max_wait_s: float = 0.005,
         window_k: int = 8,
+        pipeline_depth: int = 2,
         top_k: int = 0,
         mesh=None,
         logger=None,
@@ -153,6 +154,15 @@ class InferenceEngine:
             self.max_len = min(max_len, self.cfg.max_len)
             self.n_slots = n_slots
             self.window_k = max(1, window_k)
+            self.pipeline_depth = max(1, pipeline_depth)
+            reserve = 1 + (self.pipeline_depth + 1) * self.window_k
+            if self.max_len <= reserve:
+                raise ValueError(
+                    f"max_len={self.max_len} too small: need > {reserve} "
+                    f"(1 + (pipeline_depth+1)*window_k) so admission can "
+                    f"reserve pipelined-window overshoot room; lower "
+                    f"window_k/pipeline_depth or raise max_len"
+                )
             make_cache = lambda: KVCache.create(  # noqa: E731
                 self.cfg.n_layers, n_slots, self.max_len,
                 self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
@@ -220,6 +230,7 @@ class InferenceEngine:
             max_batch=int(config.get_or_default("TPU_MAX_BATCH", "8")),
             max_wait_s=float(config.get_or_default("TPU_BATCH_WAIT_MS", "5")) / 1e3,
             window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
+            pipeline_depth=int(config.get_or_default("TPU_PIPELINE_DEPTH", "2")),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
             logger=logger,
             metrics=metrics,
@@ -312,6 +323,12 @@ class InferenceEngine:
     def start_sync(self) -> None:
         if self._running:
             return
+        if self.family == "llm" and self._sched is not None:
+            # A crashed scheduler may still be mid-drain; let it finish
+            # before resetting flags, or its trailing `_drained = True`
+            # would permanently reject submissions on the restarted engine.
+            self._sched.join(timeout=10)
+            self._sched = None
         self._running = True
         self._drained = False
         self._fatal = None
@@ -345,16 +362,27 @@ class InferenceEngine:
 
     def _scheduler_loop(self) -> None:
         error: BaseException | None = None
+        # Windows are PIPELINED `pipeline_depth` deep: dispatch window n+D
+        # before fetching window n's tokens. The ~66ms host↔device roundtrip
+        # (network-attached relay) is latency, not bandwidth — overlapping
+        # D fetches with compute takes llama-1b from 518 (serial) to 987
+        # (D=1) tok/s/chip and beyond; the floor becomes device step time.
+        from collections import deque
+
+        inflight: deque = deque()  # (emitted_dev, slots_snapshot, t_dispatch)
         try:
             while self._running:
                 admitted = self._admit_pending()
                 any_active = any(s is not None for s in self._slots)
-                if not any_active:
+                if not any_active and not inflight:
                     if not admitted:
                         self._work.wait(timeout=0.02)
                         self._work.clear()
                     continue
-                self._decode_window_once()
+                if any_active:
+                    inflight.append(self._dispatch_window())
+                while len(inflight) > (self.pipeline_depth if any_active else 0):
+                    self._process_window(*inflight.popleft())
         except BaseException as exc:  # noqa: BLE001 — must not strand futures
             # A scheduler crash (e.g. a kernel that fails to compile on this
             # hardware) must fail every caller, not hang them until timeout.
@@ -379,6 +407,16 @@ class InferenceEngine:
                 pass
             req.stream.put(None)
 
+        # Block on in-flight windows first: returning from stop with device
+        # computations + async host copies still outstanding races
+        # interpreter teardown (observed as a runtime-client thread panic
+        # at exit).
+        while inflight:
+            emitted, _, _ = inflight.popleft()
+            try:
+                np.asarray(emitted)
+            except Exception:  # noqa: BLE001 — device may already be down
+                pass
         with self._submit_lock:
             self._drained = True
             while not self._pending.empty():
@@ -412,9 +450,13 @@ class InferenceEngine:
             return False
 
         jnp = self._jnp
-        # Overlong prompts truncate to leave room for generation + one
-        # window of overshoot (lengths advance k per window while active).
-        max_prompt_allowed = self.max_len - 1 - self.window_k
+        # Overlong prompts truncate to leave room for generation plus
+        # (depth+1) windows of overshoot: with D windows pipelined, lengths
+        # can advance up to (D+1)*k past a sequence's stopping point before
+        # the host notices.
+        max_prompt_allowed = (
+            self.max_len - 1 - (self.pipeline_depth + 1) * self.window_k
+        )
         max_prompt = max(len(r.prompt_ids) for _, r in batch)
         # Bucket ladder always ends at max_prompt_allowed so prompts between
         # the last power-of-two bucket and the cache limit aren't truncated
@@ -440,9 +482,12 @@ class InferenceEngine:
             slots[i] = slot
             temps[i] = req.temperature
             greedy[i] = req.temperature <= 0
-            # Clamp generation budget so window overshoot can't overrun the
-            # cache (admission-time guard; see decode_window docstring).
-            room = self.max_len - 1 - len(ids) - self.window_k
+            # Clamp generation budget so pipelined-window overshoot can't
+            # overrun the cache (admission-time guard; see _dispatch_window).
+            room = (
+                self.max_len - 1 - len(ids)
+                - (self.pipeline_depth + 1) * self.window_k
+            )
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
         for i in range(len(batch), B):
             tokens[i] = tokens[0]
@@ -473,8 +518,12 @@ class InferenceEngine:
         self._update_slot_gauges()
         return True
 
-    def _decode_window_once(self) -> None:
-        """One k-step device window + a single host fetch of [k, S] tokens."""
+    def _dispatch_window(self):
+        """Dispatch one k-step device window (non-blocking) and start the
+        async device→host copy of its [k, S] token block. Returns
+        ``(emitted_dev, slots_snapshot, t_dispatch)`` for _process_window —
+        the snapshot matters because by processing time a retired slot may
+        already hold a NEW request admitted in between."""
         jnp = self._jnp
         active = np.zeros((self.n_slots,), dtype=bool)
         temps = np.ones((self.n_slots,), dtype=np.float32)
@@ -491,15 +540,39 @@ class InferenceEngine:
             self.params, self._tokens_dev, self.cache, jnp.asarray(active),
             sub, jnp.asarray(temps), jnp.asarray(greedy), k=self.window_k,
         )
+        try:
+            emitted.copy_to_host_async()
+        except AttributeError:  # older jax / fake backends
+            pass
+        return emitted, list(self._slots), t0
+
+    def _process_window(self, emitted, snapshot, t0) -> None:
+        t_fetch = time.time()
         emitted_host = np.asarray(emitted)  # [k, S] — the one roundtrip
         if self._metrics is not None:
+            # decode_fetch = host-blocking time (what pipelining hides);
+            # decode_window_pipeline = dispatch→processed incl. D windows
+            # of pipeline queueing (NOT per-window device latency).
+            now_m = time.time()
             self._metrics.record_histogram(
-                "app_tpu_infer_latency", time.time() - t0, "kind", "decode_window"
+                "app_tpu_infer_latency", now_m - t_fetch, "kind", "decode_fetch"
+            )
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", now_m - t0,
+                "kind", "decode_window_pipeline",
             )
 
         now = time.time()
-        for i, seq in enumerate(self._slots):
+        for i, seq in enumerate(snapshot):
             if seq is None:
+                continue
+            if seq.request.future.done():
+                # Retired by an earlier window's processing (overshoot
+                # tokens — drop), or cancelled by the caller mid-flight:
+                # free the slot or it would stay active forever.
+                if self._slots[i] is seq:
+                    seq.request.stream.put(None)
+                    self._slots[i] = None
                 continue
             if seq.request.ttft_s == 0.0:
                 seq.request.ttft_s = now - seq.request.enqueued_at
@@ -511,7 +584,8 @@ class InferenceEngine:
                 self._emit_token(seq, tok)
                 if self._finished(seq):
                     self._retire(i, seq)
-                    self._slots[i] = None
+                    if self._slots[i] is seq:
+                        self._slots[i] = None
                     break
         self._update_slot_gauges()
 

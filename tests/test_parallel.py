@@ -77,3 +77,13 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert out.ndim == 3
     g.dryrun_multichip(8)
+
+
+def test_dcn_init_noop_without_config():
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.parallel import initialize_multihost, process_topology
+
+    assert initialize_multihost(MockConfig({})) is False
+    topo = process_topology()
+    assert topo["process_count"] == 1
+    assert topo["global_devices"] == 8  # the virtual CPU mesh

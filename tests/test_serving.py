@@ -296,3 +296,32 @@ def test_scheduler_death_fails_futures_fast():
             eng.submit_generate("again")
     finally:
         eng.stop_sync()
+
+
+def test_cancelled_request_frees_slot():
+    """A caller cancelling its future mid-generation must not leak the slot
+    (pipelined windows skip done futures — the slot still has to free)."""
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, tokenizer=ByteTokenizer()
+    )
+    eng.start_sync()
+    try:
+        req = eng.submit_generate("x" * 20, max_new_tokens=64, stop_on_eos=False)
+        deadline = time.time() + 10
+        while not any(s is not None for s in eng._slots) and time.time() < deadline:
+            time.sleep(0.01)
+        req.future.cancel()
+        deadline = time.time() + 10
+        while any(s is not None for s in eng._slots) and time.time() < deadline:
+            time.sleep(0.05)
+        assert all(s is None for s in eng._slots), "cancelled slot leaked"
+    finally:
+        eng.stop_sync()
+
+
+def test_max_len_too_small_for_pipeline_rejected():
+    with pytest.raises(ValueError, match="max_len"):
+        InferenceEngine(
+            "llama-tiny", n_slots=2, max_len=16, tokenizer=ByteTokenizer(),
+            window_k=8, pipeline_depth=2,
+        )
