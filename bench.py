@@ -14,9 +14,11 @@ comparable across rounds. Details (TTFT p50/p99, per-request rates) go to
 stderr.
 
 Env knobs: BENCH_MODEL (default llama-1b on TPU, llama-tiny on CPU),
-BENCH_REQUESTS (default 32), BENCH_NEW_TOKENS (default 128),
-BENCH_SLOTS (default 16), BENCH_MAX_LEN (default 1024),
-BENCH_WINDOW (default 8), BENCH_DEPTH (default 2).
+BENCH_REQUESTS (default 64), BENCH_NEW_TOKENS (default 128),
+BENCH_SLOTS (default 32), BENCH_MAX_LEN (default 1024),
+BENCH_WINDOW (default 8), BENCH_DEPTH (default 2),
+BENCH_QUANT (default int8 on TPU — weight-only int8, the production
+serving configuration; set BENCH_QUANT=none for bf16 weights).
 """
 
 from __future__ import annotations
@@ -38,13 +40,16 @@ def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
-    n_requests = int(os.environ.get("BENCH_REQUESTS", "32"))
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
-    n_slots = int(os.environ.get("BENCH_SLOTS", "16"))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "32"))
     max_len = int(os.environ.get("BENCH_MAX_LEN", "1024"))
+    quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "")
+    if quant.lower() in ("none", "0"):
+        quant = ""
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
-        f"new_tokens={new_tokens} slots={n_slots}")
+        f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -54,6 +59,7 @@ def main() -> None:
         model, n_slots=n_slots, max_len=max_len, tokenizer=ByteTokenizer(),
         window_k=int(os.environ.get("BENCH_WINDOW", "8")),
         pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
+        quant=quant,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

@@ -170,10 +170,23 @@ def kv_cache_specs() -> KVCache:
 # ---------------------------------------------------------------------------
 
 
+def _wein(subscripts, x, w):
+    """einsum whose weight operand may be int8-quantized (ops/quant.Q8).
+
+    Dequant is ``q.astype(f32) * scale`` feeding straight into the einsum,
+    so XLA fuses it into the matmul's operand read — HBM streams int8.
+    """
+    from gofr_tpu.ops.quant import Q8
+
+    if isinstance(w, Q8):
+        w = (w.q.astype(jnp.float32) * w.s).astype(x.dtype)
+    return jnp.einsum(subscripts, x, w)
+
+
 def _ffn_dense(x, lp, cfg):
-    gate = jnp.einsum("bsd,df->bsf", x, lp["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"])
-    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+    gate = _wein("bsd,df->bsf", x, lp["w_gate"])
+    up = _wein("bsd,df->bsf", x, lp["w_up"])
+    return _wein("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
 
 
 def _ffn_moe(x, lp, cfg):
@@ -182,7 +195,7 @@ def _ffn_moe(x, lp, cfg):
     small expert counts (no ragged dispatch); capacity-based a2a dispatch is
     the scale-out variant (see parallel/moe_dispatch)."""
     b, s, D = x.shape
-    router_logits = jnp.einsum("bsd,de->bse", x, lp["router"]).astype(jnp.float32)
+    router_logits = _wein("bsd,de->bse", x, lp["router"]).astype(jnp.float32)
     probs = jax.nn.softmax(router_logits, axis=-1)
     topk_probs, topk_idx = jax.lax.top_k(probs, cfg.n_experts_active)
     topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
@@ -192,10 +205,10 @@ def _ffn_moe(x, lp, cfg):
         jnp.arange(s)[None, :, None],
         topk_idx,
     ].set(topk_probs)
-    gate = jnp.einsum("bsd,edf->bsef", x, lp["w_gate"])
-    up = jnp.einsum("bsd,edf->bsef", x, lp["w_up"])
+    gate = _wein("bsd,edf->bsef", x, lp["w_gate"])
+    up = _wein("bsd,edf->bsef", x, lp["w_up"])
     hidden = jax.nn.silu(gate) * up
-    out = jnp.einsum("bsef,efd->bsed", hidden, lp["w_down"])
+    out = _wein("bsef,efd->bsed", hidden, lp["w_down"])
     return jnp.einsum("bsed,bse->bsd", out, weights.astype(x.dtype))
 
 
@@ -210,16 +223,16 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None):
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bsd,dh->bsh", h, lp["wq"]).reshape(b, s, H, hd)
-    k = jnp.einsum("bsd,dh->bsh", h, lp["wk"]).reshape(b, s, KV, hd)
-    v = jnp.einsum("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
+    q = _wein("bsd,dh->bsh", h, lp["wq"]).reshape(b, s, H, hd)
+    k = _wein("bsd,dh->bsh", h, lp["wk"]).reshape(b, s, KV, hd)
+    v = _wein("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     if attn_fn is None:
         attn = attention(q, k, v, causal=True, mask=mask)
     else:
         attn = attn_fn(q, k, v, mask)
-    x = x + jnp.einsum("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
+    x = x + _wein("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
@@ -252,7 +265,7 @@ def transformer_forward(
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    return _wein("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
 def transformer_prefill(
@@ -296,7 +309,7 @@ def transformer_prefill(
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
-    logits = jnp.einsum("bd,dv->bv", x_last, params["lm_head"]).astype(jnp.float32)
+    logits = _wein("bd,dv->bv", x_last, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 
@@ -330,9 +343,9 @@ def transformer_decode_step(
     def body(x, scanned):
         lp, ck, cv = scanned  # ck/cv: [S, KV, max_len, hd] for this layer
         h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
-        q = jnp.einsum("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
-        k = jnp.einsum("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
-        v = jnp.einsum("bd,dh->bh", h, lp["wv"]).reshape(S, KV, hd)
+        q = _wein("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
+        k = _wein("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
+        v = _wein("bd,dh->bh", h, lp["wv"]).reshape(S, KV, hd)
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
@@ -340,7 +353,7 @@ def transformer_decode_step(
         ck = ck.at[slot_idx[:, None], jnp.arange(KV)[None, :], positions[:, None]].set(k)
         cv = cv.at[slot_idx[:, None], jnp.arange(KV)[None, :], positions[:, None]].set(v)
         attn = decode_attention(q, ck, cv, positions + 1)
-        x = x + jnp.einsum("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
+        x = x + _wein("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
         h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
         x = x + ffn[:, 0]
@@ -353,7 +366,7 @@ def transformer_decode_step(
         lengths=cache.lengths + active.astype(jnp.int32),
     )
     x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]
-    logits = jnp.einsum("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
+    logits = _wein("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 

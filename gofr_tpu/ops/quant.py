@@ -1,0 +1,86 @@
+"""Int8 weight-only quantization for serving.
+
+Decode is HBM-bandwidth-bound on weight reads (every step streams the
+full parameter set); storing matmul weights as int8 with per-output-channel
+f32 scales halves that traffic. Dequantization happens inside the jitted
+step — ``dequant = q.astype(bf16) * scale`` immediately feeding an einsum —
+so XLA fuses it into the matmul loop and HBM sees only int8 bytes plus a
+tiny scale vector.
+
+Representation: a :class:`Q8` pytree node ``(q: int8, s: f32)`` replacing
+the weight leaf. The model's einsum helper (``models/transformer.py
+_wein``) dequantizes transparently, so the same forward serves bf16 and
+int8 params. Embeddings stay bf16 (gathers only touch the rows they need);
+norms/scales are tiny and stay bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Q8(NamedTuple):
+    """Int8 weight + per-output-channel scale (broadcastable to q.shape)."""
+
+    q: jnp.ndarray  # int8, same shape as the original weight
+    s: jnp.ndarray  # f32, shape = 1s except the channel (last) axis
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # for code asking "what compute dtype is this"
+        return jnp.bfloat16
+
+
+def quantize_array(w: jnp.ndarray) -> Q8:
+    """Absmax int8 quantization reducing ONLY the contraction axis.
+
+    Every matmul weight in the model — stacked or not, dense or MoE —
+    contracts its second-to-last axis (wq [L, D, H*hd], w_down [L, E, F, D],
+    lm_head [D, V], …), so scales keep per-layer / per-expert / per-channel
+    resolution with one rule: absmax over ``axis=-2``.
+    """
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return Q8(q=q, s=scale.astype(jnp.float32))
+
+
+def dequantize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
+    if isinstance(w, Q8):
+        return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+    return w
+
+
+# Weight leaves worth quantizing: the big matmul weights. Embeddings (gather)
+# and norms (tiny) stay in bf16.
+_QUANT_KEYS = {
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router", "lm_head"
+}
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize a transformer param tree's matmul weights to Q8 in place
+    (returns a new tree; non-matmul leaves pass through untouched)."""
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize_array(v) if k in _QUANT_KEYS else v)
+        for k, v in params["layers"].items()
+    }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_array(params["lm_head"])
+    return out
+
+
+def quantized_bytes(params: Any) -> int:
+    """Total parameter bytes as stored (int8 leaves count 1 byte/elem)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return int(total)

@@ -93,6 +93,7 @@ class InferenceEngine:
         pipeline_depth: int = 2,
         top_k: int = 0,
         mesh=None,
+        quant: str = "",
         logger=None,
         metrics=None,
         tokenizer=None,
@@ -133,6 +134,11 @@ class InferenceEngine:
             )(jax.random.PRNGKey(seed))
         else:
             self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
+
+        self.quant = ""
+        if quant:
+            self.apply_quantization(quant)
+
         if logger is not None:
             n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
             logger.infof(
@@ -239,6 +245,7 @@ class InferenceEngine:
         from gofr_tpu.serving.checkpoint import maybe_restore_params
 
         engine.params = maybe_restore_params(config, engine.params, logger)
+        engine.apply_quantization(config.get_or_default("TPU_QUANT", ""))
         return engine
 
     def _build_llm_steps(self) -> None:
@@ -316,6 +323,30 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
+
+    def apply_quantization(self, mode: str) -> None:
+        """Quantize weights in place (call BEFORE start / after restore).
+
+        Weight-only int8: halves the HBM weight stream that bounds decode
+        throughput; dequant fuses into the matmuls (``transformer._wein``).
+        """
+        mode = (mode or "").lower()
+        if not mode:
+            return
+        if mode != "int8":
+            raise ValueError(f"unsupported quant mode {mode!r} (int8 only)")
+        if self.family != "llm":
+            raise ValueError("quantization currently supports llm models only")
+        if self.mesh is not None:
+            raise NotImplementedError(
+                "int8 quantization + mesh sharding not supported yet"
+            )
+        if getattr(self, "_running", False):  # __init__ calls this pre-flags
+            raise RuntimeError("quantize before starting the engine")
+        from gofr_tpu.ops.quant import quantize_params
+
+        self.params = self._jax.jit(quantize_params)(self.params)
+        self.quant = mode
 
     async def start(self) -> None:
         self.start_sync()
