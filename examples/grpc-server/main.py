@@ -12,7 +12,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from gofr_tpu import App
-from gofr_tpu.grpc import add_inference_service
+from gofr_tpu.grpc import (
+    TypedInferenceServicer,
+    add_inference_service,
+    add_typed_inference_service,
+)
 from gofr_tpu.grpc.inference import InferenceServicer
 
 
@@ -21,6 +25,11 @@ def main() -> App:
     engine = app.container.tpu
     if engine is None:
         raise SystemExit("set TPU_MODEL in configs/.env")
+    # Typed protobuf contract (gofr.tpu.v1.Inference) + JSON exploration
+    # surface (gofr.tpu.Inference) on the same :9000 server.
+    app.register_service(
+        add_typed_inference_service, TypedInferenceServicer(engine)
+    )
     app.register_service(add_inference_service, InferenceServicer(engine))
 
     @app.get("/models")

@@ -196,7 +196,13 @@ class MQTTClient:
             except (ConnectionError, OSError):
                 pkt = None
             if pkt is None:
-                return
+                # Transient socket death must not silently end the
+                # subscription world (the reference's paho client
+                # auto-reconnects and re-subscribes): reconnect with backoff
+                # and replay SUBSCRIBEs for every registered filter.
+                if self._closed or not self._reconnect():
+                    return
+                continue
             if pkt.ptype == PUBLISH:
                 self._on_publish(pkt)
             elif pkt.ptype in (PUBACK, SUBACK, UNSUBACK):
@@ -206,6 +212,43 @@ class MQTTClient:
                     ev.set()
             elif pkt.ptype == PINGRESP:
                 self._pong.set()
+
+    def _reconnect(self) -> bool:
+        """Re-dial + CONNECT + replay SUBSCRIBEs. Runs on the reader thread,
+        so re-subscribes are fire-and-forget (the reader can't wait on its
+        own SUBACK processing). Retries with backoff until closed."""
+        import time as _time
+
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        delay = 0.2
+        while not self._closed:
+            try:
+                sock = socket.create_connection((self.host, self.port), 5.0)
+                sock.settimeout(None)
+                self._sock = sock
+                self._connect()
+                with self._sub_lock:
+                    topics = set(self._queues) | set(self._callbacks)
+                for t in topics:
+                    pid = self._next_pid()
+                    payload = (
+                        struct.pack(">H", pid) + encode_str(t) + bytes([self.qos])
+                    )
+                    with self._write_lock:
+                        write_packet(self._sock, SUBSCRIBE, payload, flags=0x02)
+                if self._logger is not None:
+                    self._logger.infof(
+                        "mqtt reconnected to %s:%d (%d subscriptions replayed)",
+                        self.host, self.port, len(topics),
+                    )
+                return True
+            except OSError:
+                _time.sleep(delay)
+                delay = min(delay * 2, 5.0)
+        return False
 
     def _on_publish(self, pkt: _Packet) -> None:
         qos = (pkt.flags >> 1) & 0x03
@@ -327,7 +370,15 @@ class MQTTClient:
             if new:
                 q = self._queues[topic] = queue.Queue()
         if new:
-            self._send_subscribe(topic)
+            try:
+                self._send_subscribe(topic)
+            except Exception:
+                # Roll back the registration: leaving it would make every
+                # retry see new=False and poll a queue the broker never
+                # heard about — silent permanent message loss (ADVICE r1).
+                with self._sub_lock:
+                    self._queues.pop(topic, None)
+                raise
         try:
             return q.get(timeout=timeout if timeout is not None else 0.5)
         except queue.Empty:
@@ -338,8 +389,18 @@ class MQTTClient:
     ) -> None:
         """Callback-per-message subscription (reference ``mqtt.go:233-258``)."""
         with self._sub_lock:
+            had = topic in self._callbacks
+            prev = self._callbacks.get(topic)
             self._callbacks[topic] = fn
-        self._send_subscribe(topic)
+        try:
+            self._send_subscribe(topic)
+        except Exception:
+            with self._sub_lock:  # roll back so a retry re-sends SUBSCRIBE
+                if had:
+                    self._callbacks[topic] = prev
+                else:
+                    self._callbacks.pop(topic, None)
+            raise
 
     def unsubscribe(self, topic: str) -> None:
         pid = self._next_pid()

@@ -88,3 +88,19 @@ class ErrorServiceUnavailable(GofrError):
         if dependency:
             msg += f": {dependency}"
         super().__init__(msg)
+
+
+class ErrorPromptTooLong(GofrError):
+    """413 — prompt exceeds the engine's serveable context window. A
+    serving framework must surface this, not silently truncate (truncation
+    is opt-in via TPU_TRUNCATE_PROMPTS)."""
+
+    status_code = 413
+
+    def __init__(self, prompt_tokens: int, max_tokens: int) -> None:
+        self.prompt_tokens = prompt_tokens
+        self.max_tokens = max_tokens
+        super().__init__(
+            f"prompt of {prompt_tokens} tokens exceeds the maximum "
+            f"serveable prompt length {max_tokens}"
+        )

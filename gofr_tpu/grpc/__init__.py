@@ -2,17 +2,29 @@
 
 An asyncio gRPC server with recovery + logging interceptors (the reference's
 interceptor chain, ``grpc.go:23-26``), started only when services are
-registered (``gofr.go:150-157``). Ships a built-in inference service
-(unary + server-streaming generate, embed, classify) using JSON-over-bytes
-messages — no codegen toolchain required in this environment.
+registered (``gofr.go:150-157``). Ships the built-in inference service in
+two flavors sharing :9000:
+
+* **typed protobuf** ``gofr.tpu.v1.Inference`` — the production contract
+  (``proto/inference.proto`` → protoc-generated ``inference_pb2`` +
+  stubs), interoperable with any stock gRPC client (the reference's
+  generated-stub pattern, ``grpc.go:15-46``);
+* **JSON-over-bytes** ``gofr.tpu.Inference`` — codegen-free exploration
+  surface.
 """
 
 from gofr_tpu.grpc.server import GRPCServer, json_method_handlers
 from gofr_tpu.grpc.inference import add_inference_service, InferenceClient
+from gofr_tpu.grpc.inference_typed import (
+    TypedInferenceServicer,
+    add_typed_inference_service,
+)
 
 __all__ = [
     "GRPCServer",
     "json_method_handlers",
     "add_inference_service",
     "InferenceClient",
+    "TypedInferenceServicer",
+    "add_typed_inference_service",
 ]

@@ -1,0 +1,109 @@
+"""gRPC stubs for ``gofr.tpu.v1.Inference`` (``proto/inference.proto``).
+
+Hand-written in the exact layout ``grpc_tools.protoc`` emits (Stub /
+Servicer / add_*_to_server / static service descriptor) because this image
+ships ``protoc`` without the grpcio-tools plugin; the message classes in
+``inference_pb2.py`` ARE protoc-generated. A stock ``grpc`` client uses
+this file exactly like generated code:
+
+    channel = grpc.insecure_channel(addr)
+    stub = inference_pb2_grpc.InferenceStub(channel)
+    reply = stub.Generate(inference_pb2.GenerateRequest(prompt="hi"))
+
+Reference parity: the generated-stub service pattern of
+``/root/reference/pkg/gofr/grpc.go:15-46`` and
+``examples/grpc-server/customer/grpc.pb.go``.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gofr_tpu.grpc import inference_pb2
+
+_SERVICE = "gofr.tpu.v1.Inference"
+
+
+class InferenceStub:
+    """Client stub; same surface as grpc_tools-generated code."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.Generate = channel.unary_unary(
+            f"/{_SERVICE}/Generate",
+            request_serializer=inference_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=inference_pb2.GenerateReply.FromString,
+        )
+        self.GenerateStream = channel.unary_stream(
+            f"/{_SERVICE}/GenerateStream",
+            request_serializer=inference_pb2.GenerateRequest.SerializeToString,
+            response_deserializer=inference_pb2.TokenChunk.FromString,
+        )
+        self.Embed = channel.unary_unary(
+            f"/{_SERVICE}/Embed",
+            request_serializer=inference_pb2.EmbedRequest.SerializeToString,
+            response_deserializer=inference_pb2.EmbedReply.FromString,
+        )
+        self.Classify = channel.unary_unary(
+            f"/{_SERVICE}/Classify",
+            request_serializer=inference_pb2.ClassifyRequest.SerializeToString,
+            response_deserializer=inference_pb2.ClassifyReply.FromString,
+        )
+        self.Health = channel.unary_unary(
+            f"/{_SERVICE}/Health",
+            request_serializer=inference_pb2.HealthRequest.SerializeToString,
+            response_deserializer=inference_pb2.HealthReply.FromString,
+        )
+
+
+class InferenceServicer:
+    """Service base class; override the methods you implement."""
+
+    async def Generate(self, request, context):
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Generate")
+
+    async def GenerateStream(self, request, context):
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "GenerateStream")
+        yield  # pragma: no cover — makes this an async generator
+
+    async def Embed(self, request, context):
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Embed")
+
+    async def Classify(self, request, context):
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Classify")
+
+    async def Health(self, request, context):
+        await context.abort(grpc.StatusCode.UNIMPLEMENTED, "Health")
+
+
+def add_InferenceServicer_to_server(servicer, server) -> None:
+    rpc_method_handlers = {
+        "Generate": grpc.unary_unary_rpc_method_handler(
+            servicer.Generate,
+            request_deserializer=inference_pb2.GenerateRequest.FromString,
+            response_serializer=inference_pb2.GenerateReply.SerializeToString,
+        ),
+        "GenerateStream": grpc.unary_stream_rpc_method_handler(
+            servicer.GenerateStream,
+            request_deserializer=inference_pb2.GenerateRequest.FromString,
+            response_serializer=inference_pb2.TokenChunk.SerializeToString,
+        ),
+        "Embed": grpc.unary_unary_rpc_method_handler(
+            servicer.Embed,
+            request_deserializer=inference_pb2.EmbedRequest.FromString,
+            response_serializer=inference_pb2.EmbedReply.SerializeToString,
+        ),
+        "Classify": grpc.unary_unary_rpc_method_handler(
+            servicer.Classify,
+            request_deserializer=inference_pb2.ClassifyRequest.FromString,
+            response_serializer=inference_pb2.ClassifyReply.SerializeToString,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            servicer.Health,
+            request_deserializer=inference_pb2.HealthRequest.FromString,
+            response_serializer=inference_pb2.HealthReply.SerializeToString,
+        ),
+    }
+    generic_handler = grpc.method_handlers_generic_handler(
+        _SERVICE, rpc_method_handlers
+    )
+    server.add_generic_rpc_handlers((generic_handler,))

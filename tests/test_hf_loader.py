@@ -1,0 +1,159 @@
+"""Real-weights ingestion: HF Llama safetensors → our param pytree.
+
+The oracle is the `transformers` LlamaForCausalLM itself (torch CPU): a
+tiny random HF model is saved with safe_serialization and loaded by
+``serving/hf_loader``; logits must match — which validates the name map,
+the [out,in]→[in,out] transposes, the RoPE convention, and RMSNorm eps in
+one shot (VERDICT r1 #5)."""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from gofr_tpu.models.transformer import (  # noqa: E402
+    TransformerConfig,
+    transformer_forward,
+)
+from gofr_tpu.serving.hf_loader import (  # noqa: E402
+    config_from_hf,
+    is_hf_checkpoint,
+    load_hf_llama,
+    params_have_q8,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf-llama")
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def _our_cfg(dtype=jnp.float32) -> TransformerConfig:
+    return TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_len=128, rope_theta=10000.0, norm_eps=1e-6,
+        dtype=dtype,
+    )
+
+
+def test_is_hf_checkpoint_and_config(hf_checkpoint):
+    path, _ = hf_checkpoint
+    assert is_hf_checkpoint(path)
+    cfg = config_from_hf(path)
+    assert cfg.d_model == 64
+    assert cfg.n_kv_heads == 2
+    assert not is_hf_checkpoint("/nonexistent")
+
+
+def test_hf_llama_logit_parity(hf_checkpoint):
+    path, model = hf_checkpoint
+    cfg = _our_cfg()
+    params = load_hf_llama(path, cfg)
+    tokens = np.array([[1, 5, 9, 2, 7, 3, 11, 90]], dtype=np.int32)
+    ours = np.asarray(transformer_forward(params, jnp.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_llama_int8_load_coherent(hf_checkpoint):
+    """int8-on-load: quantized params produce near-identical greedy
+    next-token picks."""
+    path, _ = hf_checkpoint
+    cfg = _our_cfg()
+    ref = load_hf_llama(path, cfg)
+    q = load_hf_llama(path, cfg, quant="int8")
+    assert params_have_q8(q)
+    assert not params_have_q8(ref)
+    tokens = np.array([[1, 5, 9, 2, 7, 3]], dtype=np.int32)
+    lr = np.asarray(transformer_forward(ref, jnp.asarray(tokens), cfg))
+    lq = np.asarray(transformer_forward(q, jnp.asarray(tokens), cfg))
+    # Weight-only int8 keeps top-1 agreement on most positions.
+    agree = (lr.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree >= 0.8
+
+
+def test_hf_checkpoint_serves_through_engine(hf_checkpoint):
+    """TPU_CHECKPOINT boot seam end to end: the engine boots from the HF
+    dir and generates deterministically with real weights."""
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.models.registry import ModelSpec, register_model
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    path, _ = hf_checkpoint
+    cfg = _our_cfg(dtype=jnp.float32)
+    register_model(ModelSpec(
+        name="hf-tiny-test", family="llm", config=cfg,
+        init=lambda key, c: (_ for _ in ()).throw(
+            AssertionError("engine must not random-init when params given")
+        ),
+    ))
+    eng = InferenceEngine.from_config(MockConfig({
+        "TPU_MODEL": "hf-tiny-test",
+        "TPU_CHECKPOINT": path,
+        "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "64",
+    }))
+    eng.start_sync()
+    try:
+        r1 = eng.generate_sync(
+            [1, 5, 9], max_new_tokens=6, temperature=0.0, stop_on_eos=False
+        )
+        r2 = eng.generate_sync(
+            [1, 5, 9], max_new_tokens=6, temperature=0.0, stop_on_eos=False
+        )
+        assert r1.token_ids == r2.token_ids
+        assert len(r1.token_ids) == 6
+    finally:
+        eng.stop_sync()
+
+
+def test_config_mismatch_rejected(hf_checkpoint):
+    path, _ = hf_checkpoint
+    bad = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_len=128,
+    )
+    with pytest.raises(ValueError, match="d_model"):
+        load_hf_llama(path, bad)
+
+
+def test_tied_embeddings(tmp_path):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        d_ff=64, max_len=64, rope_theta=10000.0, norm_eps=1e-6,
+        dtype=jnp.float32,
+    )
+    params = load_hf_llama(str(tmp_path), cfg)
+    tokens = np.array([[1, 5, 9, 2]], dtype=np.int32)
+    ours = np.asarray(transformer_forward(params, jnp.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
