@@ -877,8 +877,11 @@ class InferenceEngine:
             jax.block_until_ready(first)
             prefill_s = time.perf_counter() - t0
 
+        # Fresh [B]-shaped vectors — the prefill loop's temps/greedy above
+        # are [P]-shaped and P != B crashes the decode window.
         active = jnp.ones((B,), dtype=bool)
-        tdev, gdev = jnp.asarray(temps), jnp.asarray(greedy)
+        tdev = jnp.ones((B,), dtype=jnp.float32)
+        gdev = jnp.ones((B,), dtype=bool)
 
         def window():
             out = self._decode_window(
