@@ -78,6 +78,37 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
+def q8_spec(spec) -> Q8:
+    """The Q8 PartitionSpec pair for a weight whose bf16 spec is ``spec``.
+
+    ``q`` keeps the weight's sharding (same shape). ``s`` has extent 1 on
+    the contraction (-2) axis, so that entry must be unsharded; every other
+    axis (leading layer/pp axes, the output-channel axis) keeps the
+    weight's sharding — the scale vector shards WITH its output channels,
+    which is what lets int8 compose with a tp mesh (VERDICT r2 next #2).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    entries = list(spec)
+    if len(entries) >= 2:
+        entries[-2] = None
+    return Q8(q=spec, s=P(*entries))
+
+
+def quantized_param_specs(specs: dict) -> dict:
+    """Map a bf16 param-spec tree (``transformer_param_specs``) to the spec
+    tree of ``quantize_params(params)``: quantized leaves become Q8 spec
+    pairs, everything else passes through."""
+    out = dict(specs)
+    out["layers"] = {
+        k: (q8_spec(v) if k in _QUANT_KEYS else v)
+        for k, v in specs["layers"].items()
+    }
+    if "lm_head" in specs:
+        out["lm_head"] = q8_spec(specs["lm_head"])
+    return out
+
+
 def quantized_bytes(params: Any) -> int:
     """Total parameter bytes as stored (int8 leaves count 1 byte/elem)."""
     total = 0

@@ -28,6 +28,15 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     )
 
 
+def named_shardings(specs: Any, mesh: Mesh) -> Any:
+    """Map a PartitionSpec pytree to NamedShardings on ``mesh`` (the shared
+    idiom for jit in/out_shardings and device_put placement)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def prune_specs(specs: Any, mesh: Mesh) -> Any:
     """Drop axis names a mesh doesn't have from a PartitionSpec pytree.
 

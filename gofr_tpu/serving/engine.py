@@ -144,14 +144,11 @@ class InferenceEngine:
             # Sharded init: params materialize directly onto the mesh with
             # their Megatron-style partition specs — never gathered on one
             # chip (an 8B model doesn't fit one v5e).
-            from jax.sharding import NamedSharding, PartitionSpec
-
             from gofr_tpu.models.transformer import transformer_param_specs
+            from gofr_tpu.parallel.sharding import named_shardings
 
-            specs = transformer_param_specs(self.cfg)
-            shardings = jax.tree_util.tree_map(
-                lambda s: NamedSharding(mesh, s), specs,
-                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            shardings = named_shardings(
+                transformer_param_specs(self.cfg), mesh
             )
             self.params = jax.jit(
                 lambda k: self.spec.init(k, self.cfg), out_shardings=shardings
@@ -210,15 +207,13 @@ class InferenceEngine:
             )
             if mesh is not None:
                 # KV heads shard over tp — same layout prefill and decode.
-                from jax.sharding import NamedSharding, PartitionSpec
-
                 from gofr_tpu.models.transformer import kv_cache_specs
+                from gofr_tpu.parallel.sharding import named_shardings
 
-                cache_shardings = jax.tree_util.tree_map(
-                    lambda s: NamedSharding(mesh, s), kv_cache_specs(),
-                    is_leaf=lambda x: isinstance(x, PartitionSpec),
-                )
-                self.cache = jax.jit(make_cache, out_shardings=cache_shardings)()
+                self.cache = jax.jit(
+                    make_cache,
+                    out_shardings=named_shardings(kv_cache_specs(), mesh),
+                )()
             else:
                 self.cache = make_cache()
             self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
@@ -285,12 +280,13 @@ class InferenceEngine:
             if is_hf_checkpoint(ckpt):
                 # Real weights (HF safetensors layout), quantized leaf-wise
                 # on device as they land — the bf16 tree never fully
-                # materializes (VERDICT r1 #5 + #4).
+                # materializes (VERDICT r1 #5 + #4) — and placed straight
+                # onto the tp mesh when one is configured.
                 from gofr_tpu.models.registry import get_model
 
                 params = load_hf_llama(
                     ckpt, get_model(model_name).config, quant=quant_cfg,
-                    logger=logger,
+                    mesh=mesh, logger=logger,
                 )
         engine = cls(
             model_name,
@@ -477,15 +473,32 @@ class InferenceEngine:
             raise ValueError(f"unsupported quant mode {mode!r} (int8 only)")
         if self.family != "llm":
             raise ValueError("quantization currently supports llm models only")
-        if self.mesh is not None:
-            raise NotImplementedError(
-                "int8 quantization + mesh sharding not supported yet"
-            )
         if getattr(self, "_running", False):  # __init__ calls this pre-flags
             raise RuntimeError("quantize before starting the engine")
         from gofr_tpu.ops.quant import quantize_params
 
-        self.params = self._jax.jit(quantize_params)(self.params)
+        # donate: the bf16 tree frees leaf-by-leaf as the int8 tree
+        # materializes — without it peak HBM is ~1.5× the bf16 tree.
+        if self.mesh is not None:
+            # Sharded quantization: each Q8 leaf gets out-shardings derived
+            # from its weight's PartitionSpec (the scale shards with the
+            # output-channel axis), so quantized serving composes with a tp
+            # mesh instead of gathering anything onto one chip.
+            from gofr_tpu.models.transformer import transformer_param_specs
+            from gofr_tpu.ops.quant import quantized_param_specs
+            from gofr_tpu.parallel.sharding import named_shardings, prune_specs
+
+            specs = quantized_param_specs(
+                prune_specs(transformer_param_specs(self.cfg), self.mesh)
+            )
+            self.params = self._jax.jit(
+                quantize_params, donate_argnums=(0,),
+                out_shardings=named_shardings(specs, self.mesh),
+            )(self.params)
+        else:
+            self.params = self._jax.jit(
+                quantize_params, donate_argnums=(0,)
+            )(self.params)
         self.quant = mode
 
     async def start(self) -> None:

@@ -96,19 +96,25 @@ def load_hf_llama(
     cfg=None,
     *,
     quant: str = "",
+    mesh=None,
     logger=None,
 ) -> dict:
     """Load an HF Llama checkpoint into this framework's param pytree.
 
     cfg: expected TransformerConfig (validated against ``config.json``;
     defaults to :func:`config_from_hf`). quant: "" or "int8" — int8
-    quantizes each matmul leaf on device as it lands.
+    quantizes each matmul leaf on device as it lands. mesh: a
+    ``jax.sharding.Mesh``; each leaf is ``device_put`` with the
+    NamedSharding from its Megatron partition spec as it lands (never
+    gathered on one chip — an 8B bf16 leaf set must stream straight onto
+    the tp mesh, VERDICT r2 next #2), and int8 scale vectors shard with
+    their output-channel axis.
     Returns the params dict ready for the serving engine.
     """
     import jax
     import jax.numpy as jnp
 
-    from gofr_tpu.ops.quant import quantize_array
+    from gofr_tpu.ops.quant import q8_spec, quantize_array
 
     file_cfg = (
         config_from_hf(path)
@@ -134,50 +140,79 @@ def load_hf_llama(
     src = _TensorSource(path)
     dtype = cfg.dtype
 
-    def to_device(x, quantize: bool):
+    specs = None
+    if mesh is not None:
+        from gofr_tpu.models.transformer import transformer_param_specs
+        from gofr_tpu.parallel.sharding import named_shardings, prune_specs
+
+        specs = prune_specs(transformer_param_specs(cfg), mesh)
+
+    def to_device(x, quantize: bool, spec=None):
         x = jnp.asarray(x, dtype=dtype)
+        if mesh is not None:
+            placed = jax.device_put(x, named_shardings(spec, mesh))
+            if quantize and quant:
+                return jax.jit(
+                    quantize_array, donate_argnums=(0,),
+                    out_shardings=named_shardings(q8_spec(spec), mesh),
+                )(placed)
+            return placed
         if quantize and quant:
-            return jax.jit(quantize_array)(jax.device_put(x))
+            return jax.jit(quantize_array, donate_argnums=(0,))(
+                jax.device_put(x)
+            )
         return jax.device_put(x)
 
-    def stacked(fmt: str, transpose: bool, quantize: bool = True):
+    def stacked(key: str, fmt: str, transpose: bool, quantize: bool = True):
         cpu = jax.devices("cpu")[0]
         with jax.default_device(cpu):
             leaves = [src.get(fmt.format(i)) for i in range(cfg.n_layers)]
             a = jnp.stack(leaves)
             if transpose:
                 a = jnp.swapaxes(a, -1, -2)  # HF [out,in] → ours [in,out]
-        out = to_device(a, quantize)
+        out = to_device(
+            a, quantize, specs["layers"][key] if specs is not None else None
+        )
         if logger is not None:
             logger.debugf("loaded %s x%d", fmt, cfg.n_layers)
         return out
 
     pre = "model.layers.{}."
     layers = {
-        "wq": stacked(pre + "self_attn.q_proj.weight", True),
-        "wk": stacked(pre + "self_attn.k_proj.weight", True),
-        "wv": stacked(pre + "self_attn.v_proj.weight", True),
-        "wo": stacked(pre + "self_attn.o_proj.weight", True),
-        "w_gate": stacked(pre + "mlp.gate_proj.weight", True),
-        "w_up": stacked(pre + "mlp.up_proj.weight", True),
-        "w_down": stacked(pre + "mlp.down_proj.weight", True),
-        "attn_norm": stacked(pre + "input_layernorm.weight", False, False),
+        "wq": stacked("wq", pre + "self_attn.q_proj.weight", True),
+        "wk": stacked("wk", pre + "self_attn.k_proj.weight", True),
+        "wv": stacked("wv", pre + "self_attn.v_proj.weight", True),
+        "wo": stacked("wo", pre + "self_attn.o_proj.weight", True),
+        "w_gate": stacked("w_gate", pre + "mlp.gate_proj.weight", True),
+        "w_up": stacked("w_up", pre + "mlp.up_proj.weight", True),
+        "w_down": stacked("w_down", pre + "mlp.down_proj.weight", True),
+        "attn_norm": stacked(
+            "attn_norm", pre + "input_layernorm.weight", False, False
+        ),
         "mlp_norm": stacked(
-            pre + "post_attention_layernorm.weight", False, False
+            "mlp_norm", pre + "post_attention_layernorm.weight", False, False
         ),
     }
-    embed = to_device(src.get("model.embed_tokens.weight"), False)
+    e_spec = specs["embed"] if specs is not None else None
+    h_spec = specs["lm_head"] if specs is not None else None
+    embed = to_device(src.get("model.embed_tokens.weight"), False, e_spec)
     if "lm_head.weight" in src:
         cpu = jax.devices("cpu")[0]
         with jax.default_device(cpu):
             head = jnp.swapaxes(src.get("lm_head.weight"), -1, -2)
-        lm_head = to_device(head, True)
+        lm_head = to_device(head, True, h_spec)
     else:  # tie_word_embeddings
-        lm_head = to_device(jnp.swapaxes(src.get("model.embed_tokens.weight"), -1, -2), True)
+        lm_head = to_device(
+            jnp.swapaxes(src.get("model.embed_tokens.weight"), -1, -2),
+            True, h_spec,
+        )
     params = {
         "embed": embed,
         "layers": layers,
-        "final_norm": to_device(src.get("model.norm.weight"), False),
+        "final_norm": to_device(
+            src.get("model.norm.weight"), False,
+            specs["final_norm"] if specs is not None else None,
+        ),
         "lm_head": lm_head,
     }
     if logger is not None:

@@ -164,12 +164,19 @@ def test_engine_from_config_and_container():
         c.tpu.stop_sync()
 
 
-def test_sharded_serving_matches_single_device():
+@pytest.mark.parametrize("quant", ["", "int8"])
+def test_sharded_serving_matches_single_device(quant):
     """TPU_MESH_TP=2: Megatron-sharded params + KV heads over a 2-device
-    mesh must produce identical greedy generations."""
+    mesh must produce identical greedy generations — in bf16 AND with
+    weight-only int8 (the quant × mesh composition, VERDICT r2 next #2)."""
+    # Init bf16 then quantize — the same init path the mesh branch takes
+    # (the quant="int8" ctor arg would take the leaf-wise init, whose
+    # different key-split order gives different random weights).
     single = InferenceEngine(
-        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer()
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
     )
+    if quant:
+        single.apply_quantization(quant)
     single.start_sync()
     try:
         ref = single.generate_sync(
@@ -180,10 +187,18 @@ def test_sharded_serving_matches_single_device():
 
     cfg = MockConfig({
         "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
-        "TPU_MAX_LEN": "64", "TPU_MESH_TP": "2",
+        "TPU_MAX_LEN": "64", "TPU_MESH_TP": "2", "TPU_QUANT": quant,
     })
     sharded = InferenceEngine.from_config(cfg)
-    assert "tp" in str(sharded.params["layers"]["wq"].sharding.spec)
+    if quant:
+        assert sharded.quant == "int8"
+        q8 = sharded.params["layers"]["wq"]
+        assert "tp" in str(q8.q.sharding.spec)
+        # Scale shards with the output-channel axis, NOT the contraction
+        # axis (extent 1 there).
+        assert "tp" in str(q8.s.sharding.spec)
+    else:
+        assert "tp" in str(sharded.params["layers"]["wq"].sharding.spec)
     sharded.start_sync()
     try:
         got = sharded.generate_sync(
