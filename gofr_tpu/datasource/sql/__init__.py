@@ -1,6 +1,11 @@
 """SQL datasource (reference: ``pkg/gofr/datasource/sql``)."""
 
-from gofr_tpu.datasource.sql.db import DB, Tx, new_sql_from_config
+from gofr_tpu.datasource.sql.db import (
+    DB,
+    Tx,
+    new_sql_from_config,
+    register_sql_driver,
+)
 from gofr_tpu.datasource.sql.query_builder import (
     delete_by_query,
     insert_query,
@@ -13,6 +18,7 @@ __all__ = [
     "DB",
     "Tx",
     "new_sql_from_config",
+    "register_sql_driver",
     "insert_query",
     "select_query",
     "select_by_query",

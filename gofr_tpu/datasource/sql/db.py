@@ -204,11 +204,92 @@ def _to_snake(name: str) -> str:
     return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
 
 
+# Driver seam (reference idiom: sql.Open(driverName, dsn), sql.go:30-67).
+# Maps dialect → connect(host, port, user, password, database) returning a
+# DB-API connection. Real drivers self-register when importable; tests (and
+# driverless environments) register the in-proc fakes from ``fakedb.py``.
+_DRIVER_REGISTRY: dict[str, Any] = {}
+
+
+def register_sql_driver(dialect: str, connect) -> None:
+    """Register/override the connection factory for ``mysql``/``postgres``."""
+    _DRIVER_REGISTRY[dialect.lower()] = connect
+
+
+class _PyformatCursor:
+    """Translates this framework's dialect bindvars (mysql ``?``, postgres
+    ``$n`` — the reference drivers' styles, ``sql/query_builder.go:8-70``)
+    to the ``%s`` pyformat style both pymysql and psycopg2 actually speak.
+    Without this every parameterized query against a real driver dies in
+    the driver's formatter."""
+
+    _DOLLAR = re.compile(r"\$(\d+)")
+
+    def __init__(self, cursor, dialect: str) -> None:
+        self._cur = cursor
+        self._dialect = dialect
+
+    def execute(self, query: str, args=()):
+        args = tuple(args)
+        if self._dialect == "postgres":
+            order = [int(m) - 1 for m in self._DOLLAR.findall(query)]
+            query = self._DOLLAR.sub("%s", query)
+            args = tuple(args[i] for i in order)  # $n may repeat/reorder
+        else:  # mysql: positional ? one-to-one
+            query = query.replace("?", "%s")
+        return self._cur.execute(query, args)
+
+    def __getattr__(self, name):
+        return getattr(self._cur, name)
+
+
+class _PyformatConnection:
+    def __init__(self, conn, dialect: str) -> None:
+        self._conn = conn
+        self._dialect = dialect
+
+    def cursor(self) -> _PyformatCursor:
+        return _PyformatCursor(self._conn.cursor(), self._dialect)
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+def _real_driver(dialect: str):
+    """Best-effort import of a real DB-API driver for the dialect, wrapped
+    so it accepts the dialect's native bindvar style."""
+    if dialect == "mysql":
+        try:
+            import pymysql  # type: ignore[import-not-found]
+
+            return lambda **kw: _PyformatConnection(pymysql.connect(
+                host=kw["host"], port=kw["port"], user=kw["user"],
+                password=kw["password"], database=kw["database"],
+            ), "mysql")
+        except ImportError:
+            return None
+    if dialect == "postgres":
+        try:
+            import psycopg2  # type: ignore[import-not-found]
+
+            return lambda **kw: _PyformatConnection(psycopg2.connect(
+                host=kw["host"], port=kw["port"], user=kw["user"],
+                password=kw["password"], dbname=kw["database"],
+            ), "postgres")
+        except ImportError:
+            return None
+    return None
+
+
 def new_sql_from_config(config: Config, logger=None, metrics=None) -> Optional[DB]:
-    """Create the SQL datasource from env config (reference ``sql/sql.go:30-67``).
+    """Create the SQL datasource from env config (reference ``sql/sql.go:30-67``,
+    config keys ``sql.go:109-118``).
 
     Gated on ``DB_DIALECT``: ``sqlite`` (stdlib; ``DB_NAME`` is the file path,
-    default in-memory), ``mysql``/``postgres`` when their drivers exist.
+    default in-memory); ``mysql``/``postgres`` connect via a registered
+    driver factory (:func:`register_sql_driver`) or a real DB-API driver
+    when importable, reading ``DB_HOST``/``DB_PORT``/``DB_USER``/
+    ``DB_PASSWORD``/``DB_NAME``.
     Returns None when unconfigured — the container treats that as "no SQL".
     """
     dialect = (config.get_or_default("DB_DIALECT", "") or "").lower()
@@ -223,13 +304,38 @@ def new_sql_from_config(config: Config, logger=None, metrics=None) -> Optional[D
             logger.infof("connected to sqlite database %s", path)
         return db
     if dialect in ("mysql", "postgres"):
-        if logger is not None:
-            logger.errorf(
-                "SQL dialect %s requires a DB-API driver not present in this "
-                "environment; set DB_DIALECT=sqlite or install a driver",
-                dialect,
+        connect = _DRIVER_REGISTRY.get(dialect) or _real_driver(dialect)
+        if connect is None:
+            if logger is not None:
+                logger.errorf(
+                    "SQL dialect %s has no driver: install one (pymysql/"
+                    "psycopg2) or register a factory via register_sql_driver "
+                    "(in-proc fakes: datasource/sql/fakedb.py)",
+                    dialect,
+                )
+            return None
+        database = config.get_or_default("DB_NAME", "")
+        try:
+            conn = connect(
+                host=config.get_or_default("DB_HOST", "localhost"),
+                port=int(config.get_or_default(
+                    "DB_PORT", "3306" if dialect == "mysql" else "5432"
+                )),
+                user=config.get_or_default("DB_USER", "root"),
+                password=config.get_or_default("DB_PASSWORD", ""),
+                database=database,
             )
-        return None
+        except Exception as exc:  # noqa: BLE001 — boot must not crash
+            # Reference logs and continues when a datasource can't connect
+            # (sql.go:83-107 retries in background; our container health
+            # then reports the missing datasource).
+            if logger is not None:
+                logger.errorf("could not connect %s database: %s", dialect, exc)
+            return None
+        db = DB(conn, dialect, logger, metrics, database=database)
+        if logger is not None:
+            logger.infof("connected to %s database %s", dialect, database)
+        return db
     if logger is not None:
         logger.errorf("unsupported DB_DIALECT %s", dialect)
     return None

@@ -107,9 +107,14 @@ def run(migrations: dict[int, Migrate], container) -> None:
         duration_ms = (time.time() - start) * 1e3
         started_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(start))
         if tx is not None:
+            from gofr_tpu.datasource.sql.query_builder import insert_query
+
+            # Dialect-aware bindvars: postgres needs $n, mysql/sqlite ?.
             tx.exec(
-                "INSERT INTO gofr_migrations (version, method, start_time, duration_ms)"
-                " VALUES (?, ?, ?, ?)",
+                insert_query(
+                    container.sql.dialect(), "gofr_migrations",
+                    ["version", "method", "start_time", "duration_ms"],
+                ),
                 version,
                 "UP",
                 started_at,

@@ -18,6 +18,7 @@ from gofr_tpu.tracing.tracer import (
 from gofr_tpu.tracing.exporter import (
     ConsoleExporter,
     NoopExporter,
+    OTLPExporter,
     ZipkinExporter,
     exporter_from_config,
 )
@@ -32,6 +33,7 @@ __all__ = [
     "inject_traceparent",
     "ConsoleExporter",
     "NoopExporter",
+    "OTLPExporter",
     "ZipkinExporter",
     "exporter_from_config",
 ]

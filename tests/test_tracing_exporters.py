@@ -1,0 +1,128 @@
+"""Trace exporter wire formats against an in-proc HTTP collector:
+Zipkin JSON (reference ``exporter.go:58-96``) and OTLP/HTTP JSON — the
+jaeger sink is a DISTINCT protocol, not a zipkin alias (reference treats
+jaeger as its own OTLP exporter, ``gofr.go:277-286``; VERDICT r2 #2)."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.tracing import (
+    NoopExporter,
+    OTLPExporter,
+    ZipkinExporter,
+    exporter_from_config,
+)
+from gofr_tpu.tracing.tracer import Span
+
+
+@pytest.fixture
+def collector():
+    """In-proc HTTP sink capturing (path, body) of every POST."""
+    received: list[tuple[str, bytes]] = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            received.append((self.path, body))
+            self.send_response(202)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}", received
+    srv.shutdown()
+
+
+def _span(**kw) -> Span:
+    defaults = dict(
+        name="GET /hello",
+        trace_id="0af7651916cd43dd8448eb211c80319c",
+        span_id="b7ad6b7169203331",
+        parent_id="00f067aa0ba902b7",
+        start_ns=1_700_000_000_000_000_000,
+        end_ns=1_700_000_000_005_000_000,
+        attributes={"http.status": 200},
+    )
+    defaults.update(kw)
+    return Span(**defaults)
+
+
+def test_zipkin_wire_format(collector):
+    url, received = collector
+    exp = ZipkinExporter(url + "/api/v2/spans", flush_interval_s=0.05)
+    exp.export(_span(), "svc-a")
+    exp.shutdown()
+    assert received
+    batch = json.loads(received[0][1])
+    assert isinstance(batch, list)
+    span = batch[0]
+    assert span["traceId"] == "0af7651916cd43dd8448eb211c80319c"
+    assert span["parentId"] == "00f067aa0ba902b7"
+    assert span["duration"] == 5000
+    assert span["localEndpoint"] == {"serviceName": "svc-a"}
+    assert span["tags"] == {"http.status": "200"}
+
+
+def test_otlp_wire_format(collector):
+    url, received = collector
+    exp = OTLPExporter(url + "/v1/traces", flush_interval_s=0.05)
+    exp.export(_span(), "svc-b")
+    exp.export(_span(span_id="c000000000000001", status="ERROR"), "svc-b")
+    exp.shutdown()
+    assert received
+    body = json.loads(received[0][1])
+    rs = body["resourceSpans"]
+    assert len(rs) == 1
+    res_attrs = rs[0]["resource"]["attributes"]
+    assert {"key": "service.name", "value": {"stringValue": "svc-b"}} in res_attrs
+    spans = rs[0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 2
+    s0 = spans[0]
+    assert s0["traceId"] == "0af7651916cd43dd8448eb211c80319c"
+    assert s0["parentSpanId"] == "00f067aa0ba902b7"
+    assert s0["startTimeUnixNano"] == "1700000000000000000"
+    assert s0["endTimeUnixNano"] == "1700000000005000000"
+    assert {"key": "http.status", "value": {"stringValue": "200"}} in s0["attributes"]
+    assert s0["status"] == {"code": 1}
+    assert spans[1]["status"] == {"code": 2}
+    assert "_service" not in s0
+
+
+def test_exporter_selection():
+    assert isinstance(
+        exporter_from_config(MockConfig({
+            "TRACE_EXPORTER": "jaeger", "TRACER_URL": "http://j:4318/v1/traces",
+        })),
+        OTLPExporter,
+    )
+    assert isinstance(
+        exporter_from_config(MockConfig({
+            "TRACE_EXPORTER": "otlp", "TRACER_URL": "http://j:4318/v1/traces",
+        })),
+        OTLPExporter,
+    )
+    assert isinstance(
+        exporter_from_config(MockConfig({
+            "TRACE_EXPORTER": "zipkin", "TRACER_URL": "http://z:9411/api/v2/spans",
+        })),
+        ZipkinExporter,
+    )
+    assert isinstance(
+        exporter_from_config(MockConfig({"TRACE_EXPORTER": "jaeger"})),
+        NoopExporter,  # no URL
+    )
+
+
+def test_export_survives_dead_collector():
+    exp = OTLPExporter("http://127.0.0.1:1/v1/traces", flush_interval_s=0.05)
+    exp.export(_span(), "svc")
+    exp.shutdown()  # no raise
