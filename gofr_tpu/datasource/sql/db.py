@@ -79,6 +79,11 @@ class Tx(_Cursorish):
         self._logger = db._logger
         self._metrics = db._metrics
         self._conn = db._conn
+        if getattr(self._conn, "needs_explicit_begin", False):
+            # Autocommit connections (real mysql/postgres drivers, and the
+            # dialect fakes mirroring them) open transaction blocks with an
+            # explicit BEGIN; COMMIT/ROLLBACK below closes them.
+            self._conn.cursor().execute("BEGIN")
 
     def query(self, query: str, *args) -> list[dict]:
         cur = self._conn.cursor()
@@ -220,23 +225,44 @@ class _PyformatCursor:
     """Translates this framework's dialect bindvars (mysql ``?``, postgres
     ``$n`` — the reference drivers' styles, ``sql/query_builder.go:8-70``)
     to the ``%s`` pyformat style both pymysql and psycopg2 actually speak.
-    Without this every parameterized query against a real driver dies in
-    the driver's formatter."""
+    Literal-aware: quoted SQL strings pass through untouched (a ``?`` or
+    ``$1`` inside ``'...'`` is data, not a bindvar) and every raw ``%``
+    is escaped to ``%%`` so pyformat can't trip on ``LIKE '%a%'``."""
 
     _DOLLAR = re.compile(r"\$(\d+)")
+    _STRING = re.compile(r"'(?:[^']|'')*'")  # single-quoted SQL literal
 
     def __init__(self, cursor, dialect: str) -> None:
         self._cur = cursor
         self._dialect = dialect
 
+    def _translate(self, query: str) -> tuple[str, list[int]]:
+        order: list[int] = []
+
+        def outside(text: str) -> str:
+            text = text.replace("%", "%%")
+            if self._dialect == "postgres":
+                def repl(m):
+                    order.append(int(m.group(1)) - 1)
+                    return "%s"
+
+                return self._DOLLAR.sub(repl, text)
+            return text.replace("?", "%s")
+
+        chunks: list[str] = []
+        last = 0
+        for m in self._STRING.finditer(query):
+            chunks.append(outside(query[last:m.start()]))
+            chunks.append(m.group(0).replace("%", "%%"))
+            last = m.end()
+        chunks.append(outside(query[last:]))
+        return "".join(chunks), order
+
     def execute(self, query: str, args=()):
         args = tuple(args)
+        query, order = self._translate(query)
         if self._dialect == "postgres":
-            order = [int(m) - 1 for m in self._DOLLAR.findall(query)]
-            query = self._DOLLAR.sub("%s", query)
             args = tuple(args[i] for i in order)  # $n may repeat/reorder
-        else:  # mysql: positional ? one-to-one
-            query = query.replace("?", "%s")
         return self._cur.execute(query, args)
 
     def __getattr__(self, name):
@@ -244,6 +270,15 @@ class _PyformatCursor:
 
 
 class _PyformatConnection:
+    """Wraps a real driver connection in the dialect's bindvar style.
+
+    Drivers run in autocommit mode (set by ``_real_driver``) so read-only
+    traffic never leaves a transaction idling open; ``Tx`` issues an
+    explicit ``BEGIN`` (``needs_explicit_begin``) to open real transaction
+    blocks."""
+
+    needs_explicit_begin = True
+
     def __init__(self, conn, dialect: str) -> None:
         self._conn = conn
         self._dialect = dialect
@@ -265,6 +300,7 @@ def _real_driver(dialect: str):
             return lambda **kw: _PyformatConnection(pymysql.connect(
                 host=kw["host"], port=kw["port"], user=kw["user"],
                 password=kw["password"], database=kw["database"],
+                autocommit=True,
             ), "mysql")
         except ImportError:
             return None
@@ -272,10 +308,17 @@ def _real_driver(dialect: str):
         try:
             import psycopg2  # type: ignore[import-not-found]
 
-            return lambda **kw: _PyformatConnection(psycopg2.connect(
-                host=kw["host"], port=kw["port"], user=kw["user"],
-                password=kw["password"], dbname=kw["database"],
-            ), "postgres")
+            def _connect_pg(**kw):
+                conn = psycopg2.connect(
+                    host=kw["host"], port=kw["port"], user=kw["user"],
+                    password=kw["password"], dbname=kw["database"],
+                )
+                # Reads must not idle in an open transaction (blocks
+                # VACUUM, pins snapshots); Tx issues explicit BEGIN.
+                conn.autocommit = True
+                return _PyformatConnection(conn, "postgres")
+
+            return _connect_pg
         except ImportError:
             return None
     return None

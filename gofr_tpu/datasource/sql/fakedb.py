@@ -89,14 +89,26 @@ class _FakeCursor:
 
 
 class FakeDialectConnection:
-    """DB-API connection accepting mysql/postgres surface SQL over sqlite."""
+    """DB-API connection accepting mysql/postgres surface SQL over sqlite.
+
+    Mirrors the real drivers' transaction semantics: autocommit outside
+    explicit blocks (sqlite ``isolation_level=None``), transactions opened
+    by ``Tx``'s explicit ``BEGIN`` (``needs_explicit_begin``).
+
+    Fidelity caveat: ``lastrowid`` behaves like mysql's insert id; real
+    postgres returns no insert id without ``INSERT ... RETURNING``.
+    """
+
+    needs_explicit_begin = True
 
     def __init__(self, dialect: str) -> None:
         if dialect not in _TRANSLATORS:
             raise ValueError(f"unsupported fake dialect {dialect!r}")
         self.dialect = dialect
         self._translate = _TRANSLATORS[dialect]
-        self._conn = sqlite3.connect(":memory:", check_same_thread=False)
+        self._conn = sqlite3.connect(
+            ":memory:", check_same_thread=False, isolation_level=None
+        )
 
     def cursor(self) -> _FakeCursor:
         return _FakeCursor(self._conn.cursor(), self._translate)

@@ -164,7 +164,10 @@ class OTLPExporter(_BatchingHTTPExporter):
             "traceId": span.trace_id,
             "spanId": span.span_id,
             "name": span.name,
-            "kind": 2,  # SPAN_KIND_SERVER
+            # Root spans are server entry points; child spans (ctx.trace)
+            # are INTERNAL — span-kind-based processors (spanmetrics,
+            # service graphs) count SERVER spans as requests.
+            "kind": 1 if span.parent_id else 2,
             "startTimeUnixNano": str(span.start_ns),
             "endTimeUnixNano": str(end_ns),
             "attributes": [

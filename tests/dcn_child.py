@@ -1,0 +1,105 @@
+"""Child process for the DCN two-host test (``tests/test_dcn.py``).
+
+Runs as one of two cooperating processes: initializes the multi-host JAX
+runtime via ``parallel/dcn.initialize_multihost`` (the non-no-op path),
+proves a cross-process collective, then routes a request across "hosts"
+through the service tier — process 0 serves ``/topology`` over the real
+HTTP app surface, process 1 calls it through the inter-service client
+behind the circuit breaker (SURVEY §2.6: DCN tier = jax.distributed
+runtime + the service client/breaker reused verbatim).
+
+Usage: python dcn_child.py <pid 0|1> <coordinator_port> <http_port> <tmpdir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    coord_port = int(sys.argv[2])
+    http_port = int(sys.argv[3])
+    tmpdir = sys.argv[4]
+
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.parallel.dcn import initialize_multihost, process_topology
+
+    distributed = initialize_multihost(MockConfig({
+        "DCN_COORDINATOR": f"127.0.0.1:{coord_port}",
+        "DCN_NUM_PROCESSES": "2",
+        "DCN_PROCESS_ID": str(pid),
+    }))
+    assert distributed, "DCN config present → must take the distributed path"
+
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    topo = process_topology()
+    assert topo["process_count"] == 2, topo
+    assert topo["global_devices"] > topo["local_devices"], topo
+
+    # Cross-process collective: every host contributes pid+1; the gathered
+    # sum (3.0) can only come out right if the DCN runtime spans processes.
+    gathered = multihost_utils.process_allgather(jnp.array([float(pid + 1)]))
+    result = {"pid": pid, "topo": topo, "allgather_sum": float(gathered.sum())}
+
+    done_file = os.path.join(tmpdir, "peer_done")
+    if pid == 0:
+        import asyncio
+
+        from gofr_tpu import App
+
+        app = App(config=MockConfig({
+            "APP_NAME": "dcn-host-0",
+            "HTTP_PORT": str(http_port),
+            "METRICS_PORT": "0",
+        }))
+
+        @app.get("/topology")
+        async def topology(ctx):
+            return process_topology()
+
+        loop = asyncio.new_event_loop()
+        threading.Thread(target=loop.run_forever, daemon=True).start()
+        asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=30)
+        deadline = time.time() + 120
+        while not os.path.exists(done_file) and time.time() < deadline:
+            time.sleep(0.2)
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=10)
+        result["served_peer"] = os.path.exists(done_file)
+    else:
+        from gofr_tpu.service import CircuitBreakerConfig, new_http_service
+
+        svc = new_http_service(
+            f"http://127.0.0.1:{http_port}", None, None,
+            CircuitBreakerConfig(threshold=50, interval_s=60.0),
+        )
+        body = None
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                resp = svc.get("/topology")
+                if resp.status_code == 200:
+                    body = json.loads(resp.body)
+                    break
+            except Exception:  # noqa: BLE001 — peer still booting
+                pass
+            time.sleep(0.5)
+        assert body is not None, "never reached host 0 over the service tier"
+        assert body["data"]["process_count"] == 2, body
+        with open(done_file, "w") as f:
+            f.write("ok")
+        result["hop"] = body["data"]
+
+    print("DCN_RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

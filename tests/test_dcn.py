@@ -1,0 +1,76 @@
+"""DCN tier: the non-no-op multi-host path of ``parallel/dcn.py``
+exercised by two real processes on one machine (CPU backend, localhost
+coordinator) — VERDICT r2 next #8. Each child initializes via
+``initialize_multihost``, runs a cross-process allgather, and routes a
+request across hosts through the service client + circuit breaker."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.parallel.dcn import initialize_multihost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_no_config_is_single_host_noop():
+    assert initialize_multihost(MockConfig({})) is False
+
+
+def test_two_process_dcn_runtime_and_service_hop():
+    coord, http = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    tmpdir = os.path.join(REPO, ".pytest_cache", f"dcn-{coord}")
+    os.makedirs(tmpdir, exist_ok=True)
+    child = os.path.join(REPO, "tests", "dcn_child.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", child, str(pid), str(coord), str(http), tmpdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode("utf-8", "replace"))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("DCN children timed out:\n" + "\n".join(
+            p.stdout.read().decode("utf-8", "replace") for p in procs
+        ))
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("DCN_RESULT "):
+                r = json.loads(line[len("DCN_RESULT "):])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}, outs
+    for r in results.values():
+        assert r["topo"]["process_count"] == 2
+        assert r["allgather_sum"] == 3.0  # 1.0 + 2.0 across processes
+    assert results[0]["served_peer"] is True
+    assert results[1]["hop"]["process_count"] == 2

@@ -93,8 +93,19 @@ def test_otlp_wire_format(collector):
     assert s0["endTimeUnixNano"] == "1700000000005000000"
     assert {"key": "http.status", "value": {"stringValue": "200"}} in s0["attributes"]
     assert s0["status"] == {"code": 1}
+    assert s0["kind"] == 1  # has a parent → INTERNAL, not SERVER
     assert spans[1]["status"] == {"code": 2}
     assert "_service" not in s0
+
+
+def test_otlp_root_span_is_server_kind(collector):
+    url, received = collector
+    exp = OTLPExporter(url + "/v1/traces", flush_interval_s=0.05)
+    exp.export(_span(parent_id=None), "svc")
+    exp.shutdown()
+    span = json.loads(received[0][1])["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["kind"] == 2
+    assert "parentSpanId" not in span
 
 
 def test_exporter_selection():
