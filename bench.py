@@ -189,9 +189,13 @@ def main() -> None:
     quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "")
     if quant.lower() in ("none", "0"):
         quant = ""
+    kv_quant = os.environ.get("BENCH_KV_QUANT", "")
+    if kv_quant.lower() in ("none", "0"):
+        kv_quant = ""
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
-        f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'}")
+        f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "
+        f"kv_quant={kv_quant or 'bf16'}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -203,6 +207,7 @@ def main() -> None:
         window_k=int(os.environ.get("BENCH_WINDOW", "8")),
         pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
         quant=quant,
+        kv_quant=kv_quant,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

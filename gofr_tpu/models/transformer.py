@@ -34,7 +34,7 @@ from gofr_tpu.ops.attention import (
     cache_chunk_attention,
     decode_attention,
 )
-from gofr_tpu.ops.kv_cache import KVCache
+from gofr_tpu.ops.kv_cache import KVCache, quantize_kv
 from gofr_tpu.ops.norms import rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -160,12 +160,17 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
     }
 
 
-def kv_cache_specs() -> KVCache:
-    """Cache layout [L, slots, kv_heads, len, hd]: kv_heads over ``tp``."""
+def kv_cache_specs(quantized: bool = False) -> KVCache:
+    """Cache layout [L, slots, kv_heads, len, hd]: kv_heads over ``tp``.
+    Int8 mode adds per-position scales [L, slots, kv_heads, 8, len] whose
+    kv_heads axis shards the same way."""
+    kv = P(None, None, "tp", None, None)
     return KVCache(
-        k=P(None, None, "tp", None, None),
-        v=P(None, None, "tp", None, None),
+        k=kv,
+        v=kv,
         lengths=P(None),
+        k_s=kv if quantized else None,
+        v_s=kv if quantized else None,
     )
 
 
@@ -311,8 +316,19 @@ def transformer_prefill(
     vs = jnp.swapaxes(vs, 2, 3)
     ks = jnp.pad(ks, ((0, 0), (0, 0), (0, 0), (0, pad_len), (0, 0)))
     vs = jnp.pad(vs, ((0, 0), (0, 0), (0, 0), (0, pad_len), (0, 0)))
-    new_k = cache.k.at[:, slots].set(ks)
-    new_v = cache.v.at[:, slots].set(vs)
+    if cache.quantized:
+
+        ks, k_sc = quantize_kv(ks)  # scales [L, b, KV, max_len]
+        vs, v_sc = quantize_kv(vs)
+        rep8 = lambda sc: jnp.broadcast_to(  # noqa: E731
+            sc[:, :, :, None, :], sc.shape[:3] + (8,) + sc.shape[3:]
+        )
+        cache = cache._replace(
+            k_s=cache.k_s.at[:, slots].set(rep8(k_sc)),
+            v_s=cache.v_s.at[:, slots].set(rep8(v_sc)),
+        )
+    new_k = cache.k.at[:, slots].set(ks.astype(cache.k.dtype))
+    new_v = cache.v.at[:, slots].set(vs.astype(cache.v.dtype))
     cache = cache._replace(k=new_k, v=new_v)
     cache = cache._replace(lengths=cache.lengths.at[slots].set(lengths.astype(jnp.int32)))
 
@@ -356,9 +372,14 @@ def transformer_prefill_chunk(
     idx_slot = slots[:, None, None]
     idx_kv = jnp.arange(KV)[None, :, None]
     idx_pos = positions[:, None, :]  # [P, 1, c]
+    # Scale-write indices (int8 mode): [S, KV, 8, max_len] layer slice.
+    s_slot = slots[:, None, None, None]
+    s_kv = jnp.arange(KV)[None, :, None, None]
+    s_sub = jnp.arange(8)[None, None, :, None]
+    s_pos = positions[:, None, None, :]  # [P, 1, 1, c]
 
     def body(x, scanned):
-        lp, ck, cv = scanned  # ck/cv: [S, KV, max_len, hd] this layer
+        lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
         q = _wein("pcd,dh->pch", h, lp["wq"]).reshape(P, c, H, hd)
         k = _wein("pcd,dh->pch", h, lp["wk"]).reshape(P, c, KV, hd)
@@ -367,16 +388,30 @@ def transformer_prefill_chunk(
         k = apply_rope(k, cos, sin, positions)
         # Write the chunk's K/V into the cache, then attend against the
         # cache in place (kernel reads only blocks up to starts+lens).
+        if cks is not None:
+
+            k, k_sc = quantize_kv(k)  # scales [P, c, KV]
+            v, v_sc = quantize_kv(v)
+            cks = cks.at[s_slot, s_kv, s_sub, s_pos].set(
+                k_sc.transpose(0, 2, 1)[:, :, None, :]
+            )
+            cvs = cvs.at[s_slot, s_kv, s_sub, s_pos].set(
+                v_sc.transpose(0, 2, 1)[:, :, None, :]
+            )
         ck = ck.at[idx_slot, idx_kv, idx_pos].set(k.transpose(0, 2, 1, 3))
         cv = cv.at[idx_slot, idx_kv, idx_pos].set(v.transpose(0, 2, 1, 3))
-        attn = cache_chunk_attention(q, ck, cv, slots, starts, lens)
+        attn = cache_chunk_attention(
+            q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs
+        )
         x = x + _wein("pch,hd->pcd", attn.reshape(P, c, H * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
-        return x + ffn, (ck, cv)
+        return x + ffn, (ck, cv, cks, cvs)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
-    cache = cache._replace(k=new_k, v=new_v)
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
+    )
+    cache = cache._replace(k=new_k, v=new_v, k_s=new_ks, v_s=new_vs)
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     last_idx = jnp.maximum(lens - 1, 0)
@@ -416,7 +451,7 @@ def transformer_decode_step(
     slot_idx = jnp.arange(S)
 
     def body(x, scanned):
-        lp, ck, cv = scanned  # ck/cv: [S, KV, max_len, hd] for this layer
+        lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
         h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
         q = _wein("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
         k = _wein("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
@@ -424,20 +459,36 @@ def transformer_decode_step(
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
+        if cks is not None:
+
+            k, k_sc = quantize_kv(k)  # scales [S, KV]
+            v, v_sc = quantize_kv(v)
+            sidx = (
+                slot_idx[:, None, None], jnp.arange(KV)[None, :, None],
+                jnp.arange(8)[None, None, :], write_pos[:, None, None],
+            )
+            cks = cks.at[sidx].set(k_sc[:, :, None])
+            cvs = cvs.at[sidx].set(v_sc[:, :, None])
         # Heads-major write: [slot, kv_head, position] ← [S, KV, hd].
         ck = ck.at[slot_idx[:, None], jnp.arange(KV)[None, :], write_pos[:, None]].set(k)
         cv = cv.at[slot_idx[:, None], jnp.arange(KV)[None, :], write_pos[:, None]].set(v)
-        attn = decode_attention(q, ck, cv, positions + 1)
+        attn = decode_attention(
+            q, ck, cv, positions + 1, k_scale=cks, v_scale=cvs
+        )
         x = x + _wein("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
         h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
         x = x + ffn[:, 0]
-        return x, (ck, cv)
+        return x, (ck, cv, cks, cvs)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
+    )
     cache = cache._replace(
         k=new_k,
         v=new_v,
+        k_s=new_ks,
+        v_s=new_vs,
         lengths=cache.lengths + active.astype(jnp.int32),
     )
     x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]

@@ -117,6 +117,8 @@ def decode_attention(
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
 ) -> jnp.ndarray:
@@ -126,7 +128,9 @@ def decode_attention(
     k_cache, v_cache: [b, n_kv_heads, max_len, hd] (heads-major — the
     TPU-native cache layout, see ``ops/kv_cache.py``);
     lengths: [b] valid prefix length per slot (the new token's K/V must
-    already be written at position lengths-1).
+    already be written at position lengths-1);
+    k_scale/v_scale: int8-cache mode — per-position absmax scales
+    ``[b, n_kv, 8, max_len]`` (sublane-replicated, ``ops/kv_cache.py``).
     kernel: None → auto (pallas flash-decode kernel on TPU).
     """
     if kernel is None:
@@ -135,7 +139,8 @@ def decode_attention(
         from gofr_tpu.ops.pallas import flash_decode
 
         return flash_decode(
-            q, k_cache, v_cache, lengths, scale=scale, interpret=_interpret()
+            q, k_cache, v_cache, lengths, k_scale=k_scale, v_scale=v_scale,
+            scale=scale, interpret=_interpret(),
         )
     n_heads = q.shape[1]
     n_kv = k_cache.shape[1]
@@ -147,15 +152,25 @@ def decode_attention(
     b, max_len = k_cache.shape[0], k_cache.shape[2]
     qg = q.reshape(b, n_kv, n_rep, -1)
 
+    quant = k_scale is not None
+    if quant:  # int8 cache: dequant via score/prob scaling, not the cache
+        k_cache = k_cache.astype(q.dtype)
+        v_cache = v_cache.astype(q.dtype)
     scores = jnp.einsum(
         "bgrd,bgkd->bgrk", qg, k_cache, preferred_element_type=jnp.float32
     ) * scale  # [b, kv, rep, max_len]
+    if quant:
+        scores = scores * k_scale[:, :, 0, None, :]
 
     valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
 
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bgrk,bgkd->bgrd", probs, v_cache)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if quant:
+        probs = probs * v_scale[:, :, 0, :][:, :, None, :]
+    out = jnp.einsum(
+        "bgrk,bgkd->bgrd", probs.astype(q.dtype), v_cache
+    )
     return out.reshape(b, n_heads, -1)
 
 
@@ -167,6 +182,8 @@ def cache_chunk_attention(
     starts: jnp.ndarray,
     lens: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
 ) -> jnp.ndarray:
@@ -175,7 +192,8 @@ def cache_chunk_attention(
     positions). The chunk's K/V must already be written into the cache.
 
     q: [P, c, n_heads, hd]; caches: [S, n_kv, max_len, hd] (heads-major);
-    slots/starts/lens: [P] int32 (lens = valid tokens in this chunk).
+    slots/starts/lens: [P] int32 (lens = valid tokens in this chunk);
+    k_scale/v_scale: int8-cache scales [S, n_kv, 8, max_len].
     Rows with t >= lens[p] return 0. kernel: None → auto (pallas on TPU).
     """
     if kernel is None:
@@ -184,27 +202,35 @@ def cache_chunk_attention(
         from gofr_tpu.ops.pallas import flash_cache_attention
 
         return flash_cache_attention(
-            q, k_cache, v_cache, slots, starts, lens, scale=scale,
-            interpret=_interpret(),
+            q, k_cache, v_cache, slots, starts, lens, k_scale=k_scale,
+            v_scale=v_scale, scale=scale, interpret=_interpret(),
         )
     P, c, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     rep = n_heads // n_kv
     if scale is None:
         scale = hd**-0.5
+    quant = k_scale is not None
     ck = k_cache[slots]  # [P, KV, max_len, hd]
     cv = v_cache[slots]
+    if quant:  # int8 cache: dequant via score/prob scaling, not the cache
+        ck = ck.astype(q.dtype)
+        cv = cv.astype(q.dtype)
     qg = q.reshape(P, c, n_kv, rep, hd)
     scores = jnp.einsum(
         "pcgrd,pgkd->pgrck", qg, ck, preferred_element_type=jnp.float32
     ) * scale  # [P, KV, rep, c, max_len]
+    if quant:
+        scores = scores * k_scale[slots][:, :, 0, :][:, :, None, None, :]
     t = jnp.arange(c)
     pos = starts[:, None] + t[None, :]  # [P, c] global query positions
     valid = jnp.arange(max_len)[None, None, :] <= pos[:, :, None]
     valid = jnp.logical_and(valid, (t[None, :] < lens[:, None])[:, :, None])
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("pgrck,pgkd->pcgrd", probs, cv)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if quant:
+        probs = probs * v_scale[slots][:, :, 0, :][:, :, None, None, :]
+    out = jnp.einsum("pgrck,pgkd->pcgrd", probs.astype(q.dtype), cv)
     out = jnp.where(
         (t[None, :] < lens[:, None])[:, :, None, None, None], out, 0.0
     )

@@ -8,13 +8,16 @@ VMEM scratch across kv iterations):
   index maps so KV blocks are fetched once per kv-head (not per q-head);
 * :func:`flash_decode` — one query token per sequence against a paged slot
   KV cache with per-slot lengths prefetched to SMEM so fully-invalid KV
-  blocks are skipped before their DMA cost is paid.
+  blocks are skipped before their DMA cost is paid;
+* :func:`flash_cache_attention` — chunked-prefill queries against the slot
+  cache in place (one fixed-shape compile serves every prompt length).
 
-Both run under ``interpret=True`` on CPU, which is how the unit tests
+All run under ``interpret=True`` on CPU, which is how the unit tests
 exercise them without hardware.
 """
 
 from gofr_tpu.ops.pallas.flash_attention import flash_attention
 from gofr_tpu.ops.pallas.flash_decode import flash_decode
+from gofr_tpu.ops.pallas.flash_prefill import flash_cache_attention
 
-__all__ = ["flash_attention", "flash_decode"]
+__all__ = ["flash_attention", "flash_cache_attention", "flash_decode"]

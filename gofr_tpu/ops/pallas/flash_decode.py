@@ -37,9 +37,19 @@ def _clamp_blk(ik, length, block_k):
     return jnp.minimum(ik, jnp.maximum(0, (length - 1) // block_k))
 
 
-def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-            scale, block_k):
-    """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch."""
+def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant):
+    """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch.
+
+    quant (static): int8 cache mode — two extra scale refs follow v_ref
+    (``[8, block_k]`` sublane-replicated, one scale per key position);
+    scores multiply by the K scale after the q·k matmul, probs by the V
+    scale before p·v, so dequantized K/V tensors never materialize and
+    HBM streams int8.
+    """
+    if quant:
+        k_s_ref, v_s_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     ib = pl.program_id(0)
     ik = pl.program_id(2)
     length = len_ref[ib]
@@ -59,11 +69,16 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         k = k_ref[0, 0]      # [block_k, hd]
         v = v_ref[0, 0]
         rep = q.shape[0]
+        if quant:
+            k = k.astype(q.dtype)
+            v = v.astype(jnp.bfloat16)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [rep, block_k]
+        if quant:
+            s = s * k_s_ref[0, 0][0:1, :]  # per-key-position K scale
 
         cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (rep, block_k), 1)
         mask = cols < length
@@ -76,6 +91,8 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
+        if quant:
+            p = p * v_s_ref[0, 0][0:1, :]  # fold V scale into probs
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -98,6 +115,8 @@ def flash_decode(
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
     interpret: bool = False,
@@ -106,11 +125,14 @@ def flash_decode(
 
     q: [b, n_heads, hd]; caches: [b, n_kv, max_len, hd] (heads-major);
     lengths: [b] (valid prefix; the current token's K/V already written at
-    lengths-1). Returns [b, n_heads, hd].
+    lengths-1); k_scale/v_scale: int8-cache per-position scales
+    [b, n_kv, 8, max_len] (sublane-replicated, ``ops/kv_cache.py``).
+    Returns [b, n_heads, hd].
     """
     b, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     n_rep = n_heads // n_kv
+    quant = k_scale is not None
     if scale is None:
         scale = hd**-0.5
 
@@ -120,26 +142,41 @@ def flash_decode(
         cfg = [(0, 0), (0, 0), (0, pad), (0, 0)]
         k_cache = jnp.pad(k_cache, cfg)
         v_cache = jnp.pad(v_cache, cfg)
+        if quant:
+            scfg = [(0, 0), (0, 0), (0, 0), (0, pad)]
+            k_scale = jnp.pad(k_scale, scfg)
+            v_scale = jnp.pad(v_scale, scfg)
         max_len += pad
 
-    qg = q.reshape(b, n_kv, n_rep, hd)
+    # Clamp the kv block index to the slot's last valid block: grid
+    # steps beyond a short slot's length re-"fetch" the same block,
+    # which the pallas pipeline elides (same index → no new DMA) —
+    # this is where the SMEM-prefetched lengths actually save HBM
+    # bandwidth, not just compute.
+    def kv_spec():
+        return pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
+            ib, ig, _clamp_blk(ik, lens[ib], block_k), 0))
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
+        ),
+        kv_spec(),
+        kv_spec(),
+    ]
+    inputs = [lengths.astype(jnp.int32), q.reshape(b, n_kv, n_rep, hd),
+              k_cache, v_cache]
+    if quant:
+        scale_spec = pl.BlockSpec(
+            (1, 1, 8, block_k), lambda ib, ig, ik, lens: (
+                ib, ig, 0, _clamp_blk(ik, lens[ib], block_k)))
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, n_kv, max_len // block_k),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
-            ),
-            # Clamp the kv block index to the slot's last valid block: grid
-            # steps beyond a short slot's length re-"fetch" the same block,
-            # which the pallas pipeline elides (same index → no new DMA) —
-            # this is where the SMEM-prefetched lengths actually save HBM
-            # bandwidth, not just compute.
-            pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
-                ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
-                ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
         ),
@@ -150,10 +187,10 @@ def flash_decode(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block_k=block_k),
+        functools.partial(_kernel, scale=scale, block_k=block_k, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, hd), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), qg, k_cache, v_cache)
+    )(*inputs)
 
     return out.reshape(b, n_heads, hd)

@@ -38,9 +38,17 @@ def _clamp_blk(ik, ctx_len, block_k):
     return jnp.minimum(ik, jnp.maximum(0, (ctx_len - 1) // block_k))
 
 
-def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, scale, rep, block_k):
-    """Grid: (P, n_kv, kv_blocks); kv innermost (scratch carries state)."""
+def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+            scale, rep, block_k, quant):
+    """Grid: (P, n_kv, kv_blocks); kv innermost (scratch carries state).
+
+    quant (static): int8 cache mode — k/v scale refs follow v_ref
+    ([8, block_k] sublane-replicated); see ``flash_decode._kernel``.
+    """
+    if quant:
+        k_s_ref, v_s_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     ip = pl.program_id(0)
     ik = pl.program_id(2)
     n_k = pl.num_programs(2)
@@ -63,11 +71,16 @@ def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         k = k_ref[0, 0]  # [block_k, hd]
         v = v_ref[0, 0]
         rows = q.shape[0]
+        if quant:
+            k = k.astype(q.dtype)
+            v = v.astype(jnp.bfloat16)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [c*rep, block_k]
+        if quant:
+            s = s * k_s_ref[0, 0][0:1, :]
 
         row = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
         t = row // rep  # chunk-token index of each q row
@@ -86,6 +99,8 @@ def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         p = jnp.where(mask, jnp.exp(s - m_new[:, :1]), 0.0)
         l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
+        if quant:
+            p = p * v_s_ref[0, 0][0:1, :]
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -110,6 +125,8 @@ def flash_cache_attention(
     starts: jnp.ndarray,
     lens: jnp.ndarray,
     *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
     interpret: bool = False,
@@ -118,12 +135,14 @@ def flash_cache_attention(
 
     q: [P, c, n_heads, hd] — chunk queries (RoPE'd at positions
     starts[p]+t); k_cache, v_cache: [S, n_kv, max_len, hd] with the chunk's
-    K/V already written; slots/starts/lens: [P] int32. Rows with
-    ``t >= lens[p]`` return 0. Returns [P, c, n_heads, hd].
+    K/V already written; slots/starts/lens: [P] int32; k_scale/v_scale:
+    int8-cache scales [S, n_kv, 8, max_len]. Rows with ``t >= lens[p]``
+    return 0. Returns [P, c, n_heads, hd].
     """
     P, c, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     rep = n_heads // n_kv
+    quant = k_scale is not None
     if scale is None:
         scale = hd**-0.5
     block_k = min(block_k, max_len)
@@ -137,27 +156,41 @@ def flash_cache_attention(
     qg = q.reshape(P, c, n_kv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
         P, n_kv, c * rep, hd
     )
+
+    def kv_spec():
+        return pl.BlockSpec(
+            (1, 1, block_k, hd),
+            lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
+                slots[ip], ig,
+                _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
+        )
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, c * rep, hd),
+            lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
+        ),
+        kv_spec(),
+        kv_spec(),
+    ]
+    inputs = [
+        slots.astype(jnp.int32), starts.astype(jnp.int32),
+        lens.astype(jnp.int32), qg, k_cache, v_cache,
+    ]
+    if quant:
+        scale_spec = pl.BlockSpec(
+            (1, 1, 8, block_k),
+            lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
+                slots[ip], ig, 0,
+                _clamp_blk(ik, starts[ip] + lens[ip], bk)),
+        )
+        in_specs += [scale_spec, scale_spec]
+        inputs += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(P, n_kv, max_len // block_k),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, c * rep, hd),
-                lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, hd),
-                lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
-                    slots[ip], ig,
-                    _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k, hd),
-                lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
-                    slots[ip], ig,
-                    _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, c * rep, hd),
             lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
@@ -169,14 +202,13 @@ def flash_cache_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, rep=rep, block_k=block_k),
+        functools.partial(
+            _kernel, scale=scale, rep=rep, block_k=block_k, quant=quant
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((P, n_kv, c * rep, hd), q.dtype),
         interpret=interpret,
-    )(
-        slots.astype(jnp.int32), starts.astype(jnp.int32),
-        lens.astype(jnp.int32), qg, k_cache, v_cache,
-    )
+    )(*inputs)
     # [P, KV, c*rep, hd] → [P, c, H, hd]
     return out.reshape(P, n_kv, c, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
         P, c, n_heads, hd

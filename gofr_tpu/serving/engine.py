@@ -108,6 +108,7 @@ class InferenceEngine:
         top_k: int = 0,
         mesh=None,
         quant: str = "",
+        kv_quant: str = "",
         params=None,
         logger=None,
         metrics=None,
@@ -201,9 +202,11 @@ class InferenceEngine:
                     f"reserve pipelined-window overshoot room; lower "
                     f"window_k/pipeline_depth or raise max_len"
                 )
+            self.kv_quant = (kv_quant or "").lower()
             make_cache = lambda: KVCache.create(  # noqa: E731
                 self.cfg.n_layers, n_slots, self.max_len,
                 self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+                quant=self.kv_quant,
             )
             if mesh is not None:
                 # KV heads shard over tp — same layout prefill and decode.
@@ -212,7 +215,9 @@ class InferenceEngine:
 
                 self.cache = jax.jit(
                     make_cache,
-                    out_shardings=named_shardings(kv_cache_specs(), mesh),
+                    out_shardings=named_shardings(
+                        kv_cache_specs(quantized=bool(self.kv_quant)), mesh
+                    ),
                 )()
             else:
                 self.cache = make_cache()
@@ -299,6 +304,7 @@ class InferenceEngine:
             max_wait_s=float(config.get_or_default("TPU_BATCH_WAIT_MS", "5")) / 1e3,
             window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
             pipeline_depth=int(config.get_or_default("TPU_PIPELINE_DEPTH", "2")),
+            kv_quant=config.get_or_default("TPU_KV_QUANT", ""),
             prefill_chunk=int(config.get_or_default("TPU_PREFILL_CHUNK", "256")),
             prefill_batch=int(config.get_or_default("TPU_PREFILL_BATCH", "4")),
             truncate_prompts=config.get_or_default(
