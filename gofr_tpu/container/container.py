@@ -138,6 +138,9 @@ class Container:
             (1, 2, 4, 8, 16, 32, 64, 128, 256),
         )
         m.new_counter("app_tpu_tokens_generated", "tokens generated")
+        m.new_counter(
+            "app_tpu_prefix_hits", "prompts admitted via prefix-KV reuse"
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

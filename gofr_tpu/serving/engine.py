@@ -79,6 +79,9 @@ class _GenRequest:
     # ``truncated``; otherwise submit rejects with ErrorPromptTooLong).
     effective_prompt_len: int = 0
     truncated: bool = False
+    # True → prefill only, then park the KV rows in the prefix pool and
+    # resolve the future with the pool row (serving/prefix_cache.py).
+    prefix_store: bool = False
 
 
 @dataclass
@@ -109,6 +112,7 @@ class InferenceEngine:
         mesh=None,
         quant: str = "",
         kv_quant: str = "",
+        prefix_slots: int = 0,
         params=None,
         logger=None,
         metrics=None,
@@ -221,6 +225,15 @@ class InferenceEngine:
                 )()
             else:
                 self.cache = make_cache()
+            # Prefix-KV reuse: shared system prompts prefill once into a
+            # device pool; admission copies rows in (prefix_cache.py).
+            self._prefix_pool = None
+            if prefix_slots > 0:
+                from gofr_tpu.serving.prefix_cache import PrefixPool
+
+                self._prefix_pool = PrefixPool(
+                    prefix_slots, self.cache, mesh=mesh
+                )
             self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
             self._prefilling: dict[int, _PrefillState] = {}
             self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
@@ -305,6 +318,7 @@ class InferenceEngine:
             window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
             pipeline_depth=int(config.get_or_default("TPU_PIPELINE_DEPTH", "2")),
             kv_quant=config.get_or_default("TPU_KV_QUANT", ""),
+            prefix_slots=int(config.get_or_default("TPU_PREFIX_SLOTS", "0")),
             prefill_chunk=int(config.get_or_default("TPU_PREFILL_CHUNK", "256")),
             prefill_batch=int(config.get_or_default("TPU_PREFILL_BATCH", "4")),
             truncate_prompts=config.get_or_default(
@@ -654,7 +668,24 @@ class InferenceEngine:
                 - (self.pipeline_depth + 1) * self.window_k
             )
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
-            self._prefilling[free.pop(0)] = _PrefillState(request=req)
+            slot = free.pop(0)
+            state = _PrefillState(request=req)
+            if self._prefix_pool is not None and not req.prefix_store:
+                idx, plen = self._prefix_pool.lookup(req.prompt_ids)
+                if idx >= 0:
+                    # Copy pooled KV rows in; prefill only the remainder.
+                    # done < len(prompt) always, so the final chunk still
+                    # runs and samples the first token (re-writing the
+                    # boundary token's K/V is idempotent).
+                    self.cache = self._prefix_pool.load(
+                        self.cache, idx, slot, plen
+                    )
+                    state.done = min(plen, len(req.prompt_ids) - 1)
+                    if self._metrics is not None:
+                        self._metrics.increment_counter(
+                            "app_tpu_prefix_hits", "model", self.model_name
+                        )
+            self._prefilling[slot] = state
         if not self._prefilling:
             return False
 
@@ -711,8 +742,20 @@ class InferenceEngine:
             if finalize[i]:
                 st.request.effective_prompt_len = st.done
                 del self._prefilling[slot]
-                self._slots[slot] = _ActiveSeq(request=st.request, last_token=-1)
-                self._slot_state_dirty = True
+                if st.request.prefix_store:
+                    # Park the rows in the pool instead of decoding; the
+                    # slot goes straight back to the free list.
+                    idx = self._prefix_pool.store(
+                        st.request.prompt_ids, self.cache, slot
+                    )
+                    if not st.request.future.done():
+                        st.request.future.set_result(idx)
+                    st.request.stream.put(None)
+                else:
+                    self._slots[slot] = _ActiveSeq(
+                        request=st.request, last_token=-1
+                    )
+                    self._slot_state_dirty = True
         self._update_slot_gauges()
         return True
 
@@ -950,6 +993,24 @@ class InferenceEngine:
     # public LLM API
     # ------------------------------------------------------------------
 
+    @property
+    def max_prompt_tokens(self) -> int:
+        """Longest admissible prompt: one generated token plus pipelined-
+        window overshoot must still fit in max_len (the same invariant the
+        admission-room clamp in _dispatch_prefill_chunk enforces)."""
+        return self.max_len - 2 - (self.pipeline_depth + 1) * self.window_k
+
+    def _enqueue(self, req: _GenRequest) -> None:
+        # Check-and-enqueue under the drain lock: once the scheduler's final
+        # drain has run, nothing may land in the queue (it would hang).
+        with self._submit_lock:
+            if self._fatal is not None:
+                raise RuntimeError(f"engine scheduler died: {self._fatal}")
+            if not self._running or self._drained:
+                raise RuntimeError("engine not started")
+            self._pending.put_nowait(req)
+        self._work.set()
+
     def submit_generate(
         self,
         prompt: str | list[int],
@@ -966,9 +1027,7 @@ class InferenceEngine:
         # unless truncation was explicitly enabled, in which case the tail
         # is kept and the result is flagged (VERDICT r1 weak #8: never
         # silently drop prompt content).
-        max_prompt = (
-            self.max_len - 2 - (self.pipeline_depth + 1) * self.window_k
-        )
+        max_prompt = self.max_prompt_tokens
         truncated = False
         if len(ids) > max_prompt:
             if not self.truncate_prompts:
@@ -989,16 +1048,41 @@ class InferenceEngine:
             stop_on_eos=stop_on_eos,
             truncated=truncated,
         )
-        # Check-and-enqueue under the drain lock: once the scheduler's final
-        # drain has run, nothing may land in the queue (it would hang).
-        with self._submit_lock:
-            if self._fatal is not None:
-                raise RuntimeError(f"engine scheduler died: {self._fatal}")
-            if not self._running or self._drained:
-                raise RuntimeError("engine not started")
-            self._pending.put_nowait(req)
-        self._work.set()
+        self._enqueue(req)
         return req
+
+    def register_prefix(self, prompt: str | list[int]) -> _GenRequest:
+        """Prefill a shared prompt prefix ONCE and park its KV rows in the
+        device prefix pool; later prompts starting with it skip straight
+        to their remainder (admission-time row copy). The request's future
+        resolves with the pool row index. Requires ``prefix_slots > 0``
+        (``TPU_PREFIX_SLOTS``)."""
+        if self.family != "llm":
+            raise RuntimeError("prefix registration is for llm engines")
+        if self._prefix_pool is None:
+            raise RuntimeError(
+                "prefix pool disabled — construct the engine with "
+                "prefix_slots > 0 (TPU_PREFIX_SLOTS)"
+            )
+        ids = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str)
+            else list(prompt)
+        )
+        if not ids:
+            raise ValueError("prefix must be at least one token")
+        if len(ids) > self.max_prompt_tokens:
+            from gofr_tpu.errors import ErrorPromptTooLong
+
+            raise ErrorPromptTooLong(len(ids), self.max_prompt_tokens)
+        req = _GenRequest(
+            prompt_ids=ids, max_new_tokens=1, temperature=0.0,
+            stop_on_eos=False, prefix_store=True,
+        )
+        self._enqueue(req)
+        return req
+
+    def register_prefix_sync(self, prompt, timeout: float = 300.0) -> int:
+        return self.register_prefix(prompt).future.result(timeout=timeout)
 
     def generate_sync(self, prompt, timeout: float = 300.0, **kw) -> GenerationResult:
         return self.submit_generate(prompt, **kw).future.result(timeout=timeout)

@@ -1,0 +1,150 @@
+"""Prefix-KV reuse (serving/prefix_cache.py): shared system prompts
+prefill once; later requests admission-copy the pooled rows and must
+generate EXACTLY what full prefill would have (same cache values, same
+global positions — chunk boundaries don't change the math)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+SYSTEM = "You are a terse assistant. Answer in one word. "
+
+
+def _engine(**kw):
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, tokenizer=ByteTokenizer(),
+        **kw,
+    )
+    eng.start_sync()
+    return eng
+
+
+def test_prefix_reuse_matches_full_prefill():
+    ref = _engine()
+    try:
+        want = ref.generate_sync(
+            SYSTEM + "hi", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        ref.stop_sync()
+
+    eng = _engine(prefix_slots=2)
+    try:
+        idx = eng.register_prefix_sync(SYSTEM)
+        assert idx == 0
+        assert len(eng._prefix_pool) == 1
+        got = eng.generate_sync(
+            SYSTEM + "hi", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False,
+        )
+        # Second request re-hits the pool (fresh slot, same rows).
+        again = eng.generate_sync(
+            SYSTEM + "hi", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        eng.stop_sync()
+    assert got.token_ids == want.token_ids
+    assert again.token_ids == want.token_ids
+
+
+def test_prefix_reuse_with_int8_kv_cache():
+    ref = _engine(kv_quant="int8")
+    try:
+        want = ref.generate_sync(
+            SYSTEM + "go", max_new_tokens=6, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        ref.stop_sync()
+    eng = _engine(prefix_slots=1, kv_quant="int8")
+    try:
+        eng.register_prefix_sync(SYSTEM)
+        got = eng.generate_sync(
+            SYSTEM + "go", max_new_tokens=6, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        eng.stop_sync()
+    assert got.token_ids == want.token_ids
+
+
+def test_prefix_miss_and_exact_prompt():
+    eng = _engine(prefix_slots=1)
+    try:
+        eng.register_prefix_sync(SYSTEM)
+        # Prompt IS the prefix exactly — still generates (final token
+        # chunk re-runs to sample).
+        r = eng.generate_sync(
+            SYSTEM, max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        assert len(r.token_ids) == 4
+        # Unrelated prompt: plain miss, still correct.
+        miss = eng.generate_sync(
+            "completely different", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False,
+        )
+        assert len(miss.token_ids) == 4
+    finally:
+        eng.stop_sync()
+
+
+def test_prefix_lru_eviction():
+    eng = _engine(prefix_slots=1)
+    try:
+        eng.register_prefix_sync("prefix one ")
+        idx2 = eng.register_prefix_sync("prefix two ")
+        assert idx2 == 0  # evicted row reused
+        assert len(eng._prefix_pool) == 1
+        assert eng._prefix_pool.lookup(
+            eng.tokenizer.encode("prefix one and more")
+        ) == (-1, 0)
+    finally:
+        eng.stop_sync()
+
+
+def test_prefix_pool_disabled_raises():
+    eng = _engine()
+    try:
+        with pytest.raises(RuntimeError, match="prefix pool disabled"):
+            eng.register_prefix("nope")
+    finally:
+        eng.stop_sync()
+
+
+def test_prefix_via_config_and_longest_match():
+    eng = InferenceEngine.from_config(MockConfig({
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "128", "TPU_PREFIX_SLOTS": "2",
+    }))
+    eng.tokenizer = ByteTokenizer()
+    eng.start_sync()
+    try:
+        short = eng.register_prefix_sync("abcd")
+        long = eng.register_prefix_sync("abcdefgh")
+        ids = eng.tokenizer.encode("abcdefghij")
+        idx, plen = eng._prefix_pool.lookup(ids)
+        # longest match wins
+        assert idx == long and plen == len(eng.tokenizer.encode("abcdefgh"))
+        idx, plen = eng._prefix_pool.lookup(eng.tokenizer.encode("abcdx"))
+        assert idx == short and plen == len(eng.tokenizer.encode("abcd"))
+    finally:
+        eng.stop_sync()
+
+
+def test_prefix_pool_rows_are_real_kv():
+    """The pool row holds the slot's actual K rows (not zeros)."""
+    eng = _engine(prefix_slots=1)
+    try:
+        eng.register_prefix_sync(SYSTEM)
+        pk = eng._prefix_pool._pool[0]
+        plen = len(eng.tokenizer.encode(SYSTEM))
+        assert float(jnp.abs(pk[0, 0, :, :plen]).max()) > 0.0
+    finally:
+        eng.stop_sync()
