@@ -20,6 +20,24 @@ NEG_INF = -1e30
 # GOFR_TPU_FLASH: "1" force kernels (interpret-mode off-TPU), "0" force
 # dense, unset/"auto" → kernels on TPU backends only.
 _FLASH_ENV = os.environ.get("GOFR_TPU_FLASH", "auto")
+# GOFR_TPU_FLASH_DECODE: overrides GOFR_TPU_FLASH for DECODE attention
+# only. The decode kernel launches grid (slots × kv_heads × kv_blocks)
+# tiny programs per layer (length-skipping, O(true context) HBM reads);
+# the dense path is one fused XLA op reading the full max_len cache.
+# Which wins is a measured trade (per-program overhead vs full-length
+# reads) — this knob lets the bench A/B it on hardware.
+_FLASH_DECODE_ENV = os.environ.get("GOFR_TPU_FLASH_DECODE", "")
+# GOFR_TPU_DECODE_BLOCK_K: kv block size for the decode kernel (default
+# 256); bigger blocks → fewer grid programs, less length-skip precision.
+try:
+    _DECODE_BLOCK_K = int(os.environ.get("GOFR_TPU_DECODE_BLOCK_K", "256"))
+    if _DECODE_BLOCK_K <= 0:
+        raise ValueError
+except ValueError:
+    raise ValueError(
+        "GOFR_TPU_DECODE_BLOCK_K must be a positive integer, got "
+        f"{os.environ.get('GOFR_TPU_DECODE_BLOCK_K')!r}"
+    ) from None
 
 
 def _flash_enabled() -> bool:
@@ -28,6 +46,14 @@ def _flash_enabled() -> bool:
     if _FLASH_ENV == "0":
         return False
     return jax.default_backend() == "tpu"
+
+
+def _flash_decode_enabled() -> bool:
+    if _FLASH_DECODE_ENV == "1":
+        return True
+    if _FLASH_DECODE_ENV == "0":
+        return False
+    return _flash_enabled()
 
 
 def _interpret() -> bool:
@@ -131,16 +157,17 @@ def decode_attention(
     already be written at position lengths-1);
     k_scale/v_scale: int8-cache mode — per-position absmax scales
     ``[b, n_kv, 8, max_len]`` (sublane-replicated, ``ops/kv_cache.py``).
-    kernel: None → auto (pallas flash-decode kernel on TPU).
+    kernel: None → auto (pallas flash-decode kernel on TPU; override with
+    GOFR_TPU_FLASH_DECODE / GOFR_TPU_DECODE_BLOCK_K).
     """
     if kernel is None:
-        kernel = _flash_enabled()
+        kernel = _flash_decode_enabled()
     if kernel:
         from gofr_tpu.ops.pallas import flash_decode
 
         return flash_decode(
             q, k_cache, v_cache, lengths, k_scale=k_scale, v_scale=v_scale,
-            scale=scale, interpret=_interpret(),
+            scale=scale, block_k=_DECODE_BLOCK_K, interpret=_interpret(),
         )
     n_heads = q.shape[1]
     n_kv = k_cache.shape[1]

@@ -161,3 +161,31 @@ def test_flash_decode_zero_length_slot_is_finite():
     got = flash_decode(q, k_cache, v_cache, lens, block_k=64, interpret=True)
     assert bool(jnp.isfinite(got).all())
     np.testing.assert_allclose(np.asarray(got[0]), 0.0)
+
+
+def test_flash_decode_env_override(monkeypatch):
+    """GOFR_TPU_FLASH_DECODE overrides GOFR_TPU_FLASH for decode only —
+    the bench's A/B knob for the kernel-vs-fused-dense decode trade."""
+    import importlib
+
+    att = importlib.import_module("gofr_tpu.ops.attention")
+    monkeypatch.setattr(att, "_FLASH_ENV", "1")
+    monkeypatch.setattr(att, "_FLASH_DECODE_ENV", "0")
+    assert att._flash_enabled() is True
+    assert att._flash_decode_enabled() is False
+    monkeypatch.setattr(att, "_FLASH_DECODE_ENV", "1")
+    assert att._flash_decode_enabled() is True
+    monkeypatch.setattr(att, "_FLASH_DECODE_ENV", "")
+    monkeypatch.setattr(att, "_FLASH_ENV", "0")
+    assert att._flash_decode_enabled() is False
+
+    # Both paths agree regardless of the knob.
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 32), jnp.float32)
+    k_cache = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 64, 32), jnp.float32)
+    v_cache = jax.random.normal(jax.random.PRNGKey(2), (2, 2, 64, 32), jnp.float32)
+    lens = jnp.asarray([5, 64], jnp.int32)
+    dense = att.decode_attention(q, k_cache, v_cache, lens, kernel=False)
+    kern = att.decode_attention(q, k_cache, v_cache, lens, kernel=True)
+    np.testing.assert_allclose(
+        np.asarray(kern), np.asarray(dense), atol=2e-5, rtol=2e-5
+    )
