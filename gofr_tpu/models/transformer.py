@@ -182,13 +182,24 @@ def kv_cache_specs(quantized: bool = False) -> KVCache:
 def _wein(subscripts, x, w):
     """einsum whose weight operand may be int8-quantized (ops/quant.Q8).
 
-    Dequant is ``q.astype(f32) * scale`` feeding straight into the einsum,
-    so XLA fuses it into the matmul's operand read — HBM streams int8.
+    Per-output-channel scales commute with the contraction (every Q8
+    scale reduces the -2 axis, the one every ``_wein`` call contracts),
+    so dequant is applied to the OUTPUT: ``(x · q) * s``. The weight
+    operand then carries only an int8→bf16 convert — which XLA can fuse
+    into the matmul's operand read — instead of a convert+multiply that
+    risks materializing a full bf16 weight copy in HBM each decode step.
+    The cast is exact (|q| ≤ 127 is representable in bf16).
+
+    Every call site contracts w's -2 axis and keeps w's remaining dims
+    as the output's trailing dims, so ``squeeze(s, -2)`` broadcasts onto
+    the output directly (checked for dense, stacked, MoE, and lm_head
+    shapes).
     """
     from gofr_tpu.ops.quant import Q8
 
     if isinstance(w, Q8):
-        w = (w.q.astype(jnp.float32) * w.s).astype(x.dtype)
+        out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
+        return (out * jnp.squeeze(w.s, -2).astype(jnp.float32)).astype(x.dtype)
     return jnp.einsum(subscripts, x, w)
 
 

@@ -27,6 +27,11 @@ _FLASH_ENV = os.environ.get("GOFR_TPU_FLASH", "auto")
 # Which wins is a measured trade (per-program overhead vs full-length
 # reads) — this knob lets the bench A/B it on hardware.
 _FLASH_DECODE_ENV = os.environ.get("GOFR_TPU_FLASH_DECODE", "")
+if _FLASH_DECODE_ENV not in ("", "0", "1"):
+    raise ValueError(
+        'GOFR_TPU_FLASH_DECODE must be "1", "0", or unset, got '
+        f"{_FLASH_DECODE_ENV!r}"
+    )
 # GOFR_TPU_DECODE_BLOCK_K: kv block size for the decode kernel (default
 # 256); bigger blocks → fewer grid programs, less length-skip precision.
 try:
