@@ -131,6 +131,46 @@ def run_with_retry() -> int:
     return 1
 
 
+def _decode_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.ops.attention import decode_attention
+    from gofr_tpu.ops.kv_cache import quantize_kv
+
+    cfg = engine.cfg
+    S, T = n_slots, engine.max_len
+    key = jax.random.PRNGKey(0)
+    qa = jax.random.normal(key, (S, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
+    kc = jax.random.normal(key, (S, cfg.n_kv_heads, T, cfg.head_dim), jnp.bfloat16)
+    vc = kc + 1
+    ks = vs = None
+    if kv_quant:  # mirror the served cache dtype (int8 + scale planes)
+        kc, ksc = quantize_kv(kc)
+        vc, vsc = quantize_kv(vc)
+        rep8 = lambda s: jnp.broadcast_to(  # noqa: E731
+            s[:, :, None, :], (S, cfg.n_kv_heads, 8, T)
+        ).astype(jnp.float32)
+        ks, vs = rep8(ksc), rep8(vsc)
+    lens = jnp.full((S,), T // 2, jnp.int32)  # typical half-full slots
+    for name, kern in (("kernel", True), ("dense", False)):
+        try:
+            fn = jax.jit(lambda q, k, v, le, sk, sv, kn=kern: decode_attention(
+                q, k, v, le, k_scale=sk, v_scale=sv, kernel=kn))
+            jax.block_until_ready(fn(qa, kc, vc, lens, ks, vs))
+            t_ab = time.perf_counter()
+            out = None
+            for _ in range(30):
+                out = fn(qa, kc, vc, lens, ks, vs)
+            jax.block_until_ready(out)
+            per = (time.perf_counter() - t_ab) / 30 * 1e3
+            log(f"profile: decode-attn[{name}] ({kv_quant or 'bf16'} kv) "
+                f"{per:.3f} ms/layer → ~{per * cfg.n_layers:.2f} ms/step "
+                f"attn total")
+        except Exception as exc:  # noqa: BLE001 — A/B is advisory
+            log(f"profile: decode-attn[{name}] probe failed: {exc}")
+
+
 _STAGE = ["start", time.time()]
 
 
@@ -238,6 +278,14 @@ def main() -> None:
         f"{peak_gbps:.0f} GB/s peak (weight-stream bound: "
         f"{peak_gbps * 1e9 / pbytes * n_slots:.0f} tok/s; device-bound: "
         f"{device_bound_tps:.0f} tok/s)")
+
+    # Decode-attention path A/B (kernel grid vs fused dense) at the real
+    # serving shapes and kv dtype — answers GOFR_TPU_FLASH_DECODE's
+    # question from one run. Kernel probe only where it compiles natively
+    # (interpret mode off-TPU is meaninglessly slow). Helper scope so the
+    # probe tensors (GB-scale at 8B/8k shapes) free before the measured run.
+    if on_tpu:
+        _decode_attn_ab(engine, n_slots, kv_quant)
     log(f"profile in {time.time() - t0:.1f}s")
 
     # Warmup: compile the real prefill bucket + steady-state decode path.
