@@ -1,0 +1,27 @@
+#!/bin/bash
+# TPU measurement campaign for round 3 (run when the axon relay is up).
+# Each run's stderr (profile lines, TTFT, A/B) + JSON goes to campaign/.
+# Order: most valuable first, in case the relay window is short.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p campaign
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  env "$@" BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 BENCH_TOTAL_BUDGET=900 \
+    timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
+  echo "--- rc=$? json:"; cat "campaign/$name.json"
+  tail -5 "campaign/$name.log"
+}
+# 1. Headline: llama-1b int8 32-slot (round-1 comparable).
+run r3-1b-int8 BENCH_MODEL=llama-1b
+# 2. + int8 KV cache (new lever).
+run r3-1b-int8-kv8 BENCH_MODEL=llama-1b BENCH_KV_QUANT=int8
+# 3. Flagship: llama-3-8b int8 (first ever 8B run).
+run r3-8b-int8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=16 BENCH_REQUESTS=32
+# 4. 8B + int8 KV (cache halved → more slots viable).
+run r3-8b-int8-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_KV_QUANT=int8
+# 5. Decode-path A/B forced dense (compare with default kernel runs above).
+run r3-1b-dense-decode BENCH_MODEL=llama-1b GOFR_TPU_FLASH_DECODE=0
+# 6. Window/depth sweep around the default.
+run r3-1b-w16d3 BENCH_MODEL=llama-1b BENCH_WINDOW=16 BENCH_DEPTH=3
