@@ -195,11 +195,17 @@ def _wein(subscripts, x, w):
     the output directly (checked for dense, stacked, MoE, and lm_head
     shapes).
     """
-    from gofr_tpu.ops.quant import Q8
+    from gofr_tpu.ops.quant import Q4, Q8, dequantize
 
     if isinstance(w, Q8):
         out = jnp.einsum(subscripts, x, w.q.astype(x.dtype))
         return (out * jnp.squeeze(w.s, -2).astype(jnp.float32)).astype(x.dtype)
+    if isinstance(w, Q4):
+        # Group-wise scales don't commute with the full contraction, so
+        # Q4 dequantizes the operand (int4 → bf16 × group scale); XLA
+        # fuses or materializes per its cost model — the int4 HBM
+        # footprint win holds either way.
+        return jnp.einsum(subscripts, x, dequantize(w, x.dtype))
     return jnp.einsum(subscripts, x, w)
 
 

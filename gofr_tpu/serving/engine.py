@@ -139,12 +139,11 @@ class InferenceEngine:
         self.quant = ""
         if params is not None:
             # Pre-built params (e.g. a real-weights checkpoint loaded via
-            # serving/hf_loader, possibly already int8).
-            from gofr_tpu.serving.hf_loader import params_have_q8
+            # serving/hf_loader, possibly already int8/int4).
+            from gofr_tpu.serving.hf_loader import params_quant_mode
 
             self.params = params
-            if params_have_q8(params):
-                self.quant = "int8"
+            self.quant = params_quant_mode(params)
         elif mesh is not None and self.family == "llm":
             # Sharded init: params materialize directly onto the mesh with
             # their Megatron-style partition specs — never gathered on one
@@ -158,12 +157,12 @@ class InferenceEngine:
             self.params = jax.jit(
                 lambda k: self.spec.init(k, self.cfg), out_shardings=shardings
             )(jax.random.PRNGKey(seed))
-        elif (quant or "").lower() == "int8" and self.family == "llm":
-            # Init DIRECTLY quantized, leaf by leaf: peak HBM is the int8
-            # tree plus one bf16 leaf — llama-3-8b's full bf16 tree (~16GB)
-            # would not fit a single v5e (VERDICT r1 #4).
+        elif (quant or "").lower() in ("int8", "int4") and self.family == "llm":
+            # Init DIRECTLY quantized, leaf by leaf: peak HBM is the
+            # quantized tree plus one bf16 leaf — llama-3-8b's full bf16
+            # tree (~16GB) would not fit a single v5e (VERDICT r1 #4).
+            self.quant = (quant or "").lower()
             self.params = self._init_llm_quantized(seed)
-            self.quant = "int8"
         else:
             self.params = self.spec.init(jax.random.PRNGKey(seed), self.cfg)
 
@@ -338,13 +337,22 @@ class InferenceEngine:
         return engine
 
     def _init_llm_quantized(self, seed: int) -> dict:
-        """Random-init the transformer leaf-by-leaf with immediate int8
-        quantization of the matmul weights (same fan-in-scaled normal as
-        ``init_transformer``, different key-split order — irrelevant for
-        random weights). Each leaf's bf16 tensor is transient inside its
-        own jit, so an 8B tree peaks near its int8 footprint."""
+        """Random-init the transformer leaf-by-leaf with immediate int8 or
+        int4 quantization (``self.quant``) of the matmul weights (same
+        fan-in-scaled normal as ``init_transformer``, different key-split
+        order — irrelevant for random weights). Each leaf's bf16 tensor is
+        transient inside its own jit, so an 8B tree peaks near its
+        quantized footprint."""
         jax, jnp = self._jax, self._jnp
-        from gofr_tpu.ops.quant import _QUANT_KEYS, quantize_array
+        from gofr_tpu.ops.quant import (
+            _QUANT_KEYS,
+            quantize_array,
+            quantize_array4,
+        )
+
+        quantize_leaf = (
+            quantize_array4 if self.quant == "int4" else quantize_array
+        )
 
         cfg = self.cfg
         shapes = jax.eval_shape(
@@ -364,7 +372,7 @@ class InferenceEngine:
                 w = (
                     jax.random.normal(k, sds.shape, jnp.float32) * fan_in**-0.5
                 ).astype(cfg.dtype)
-                return quantize_array(w) if name in _QUANT_KEYS else w
+                return quantize_leaf(w) if name in _QUANT_KEYS else w
 
             return jax.jit(init_leaf)(key)
 
@@ -489,8 +497,10 @@ class InferenceEngine:
                 f"params already quantized as {self.quant!r}; cannot "
                 f"re-quantize as {mode!r}"
             )
-        if mode != "int8":
-            raise ValueError(f"unsupported quant mode {mode!r} (int8 only)")
+        if mode not in ("int8", "int4"):
+            raise ValueError(
+                f"unsupported quant mode {mode!r} (int8 or int4)"
+            )
         if self.family != "llm":
             raise ValueError("quantization currently supports llm models only")
         if getattr(self, "_running", False):  # __init__ calls this pre-flags
@@ -509,15 +519,16 @@ class InferenceEngine:
             from gofr_tpu.parallel.sharding import named_shardings, prune_specs
 
             specs = quantized_param_specs(
-                prune_specs(transformer_param_specs(self.cfg), self.mesh)
+                prune_specs(transformer_param_specs(self.cfg), self.mesh),
+                mode,
             )
             self.params = self._jax.jit(
-                quantize_params, donate_argnums=(0,),
+                partial(quantize_params, mode=mode), donate_argnums=(0,),
                 out_shardings=named_shardings(specs, self.mesh),
             )(self.params)
         else:
             self.params = self._jax.jit(
-                quantize_params, donate_argnums=(0,)
+                partial(quantize_params, mode=mode), donate_argnums=(0,)
             )(self.params)
         self.quant = mode
 

@@ -114,7 +114,15 @@ def load_hf_llama(
     import jax
     import jax.numpy as jnp
 
-    from gofr_tpu.ops.quant import q8_spec, quantize_array
+    from gofr_tpu.ops.quant import (
+        q4_spec,
+        q8_spec,
+        quantize_array,
+        quantize_array4,
+    )
+
+    qfn = quantize_array4 if quant == "int4" else quantize_array
+    qspec = q4_spec if quant == "int4" else q8_spec
 
     file_cfg = (
         config_from_hf(path)
@@ -134,7 +142,7 @@ def load_hf_llama(
                     f"checkpoint/config mismatch: {field}={have} in "
                     f"{path}/config.json but engine expects {want}"
                 )
-    if quant and quant != "int8":
+    if quant and quant not in ("int8", "int4"):
         raise ValueError(f"unsupported quant {quant!r}")
 
     src = _TensorSource(path)
@@ -153,14 +161,12 @@ def load_hf_llama(
             placed = jax.device_put(x, named_shardings(spec, mesh))
             if quantize and quant:
                 return jax.jit(
-                    quantize_array, donate_argnums=(0,),
-                    out_shardings=named_shardings(q8_spec(spec), mesh),
+                    qfn, donate_argnums=(0,),
+                    out_shardings=named_shardings(qspec(spec), mesh),
                 )(placed)
             return placed
         if quantize and quant:
-            return jax.jit(quantize_array, donate_argnums=(0,))(
-                jax.device_put(x)
-            )
+            return jax.jit(qfn, donate_argnums=(0,))(jax.device_put(x))
         return jax.device_put(x)
 
     def stacked(key: str, fmt: str, transpose: bool, quantize: bool = True):
@@ -218,19 +224,26 @@ def load_hf_llama(
     if logger is not None:
         logger.infof(
             "loaded HF llama checkpoint from %s (%d layers%s)",
-            path, cfg.n_layers, ", int8" if quant else "",
+            path, cfg.n_layers, f", {quant}" if quant else "",
         )
     return params
 
 
 def params_have_q8(params: Any) -> bool:
+    return params_quant_mode(params) == "int8"
+
+
+def params_quant_mode(params: Any) -> str:
+    """"int8" / "int4" / "" — detect pre-quantized param trees."""
     import jax
 
-    from gofr_tpu.ops.quant import Q8
+    from gofr_tpu.ops.quant import Q4, Q8
 
-    return any(
-        isinstance(leaf, Q8)
-        for leaf in jax.tree_util.tree_leaves(
-            params, is_leaf=lambda x: isinstance(x, Q8)
-        )
-    )
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, (Q4, Q8))
+    ):
+        if isinstance(leaf, Q8):
+            return "int8"
+        if isinstance(leaf, Q4):
+            return "int4"
+    return ""

@@ -25,3 +25,6 @@ run r3-8b-int8-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH
 run r3-1b-dense-decode BENCH_MODEL=llama-1b GOFR_TPU_FLASH_DECODE=0
 # 6. Window/depth sweep around the default.
 run r3-1b-w16d3 BENCH_MODEL=llama-1b BENCH_WINDOW=16 BENCH_DEPTH=3
+# 7. int4 weights (group-wise W4A16): weight stream quartered.
+run r3-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
+run r3-8b-int4-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_QUANT=int4 BENCH_KV_QUANT=int8

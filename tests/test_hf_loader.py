@@ -125,6 +125,23 @@ def test_hf_checkpoint_serves_through_engine(hf_checkpoint):
         eng.stop_sync()
 
 
+def test_hf_llama_int4_load(hf_checkpoint):
+    """W4A16 group-wise load: Q4 leaves, logits track the bf16 load."""
+    from gofr_tpu.serving.hf_loader import params_quant_mode
+
+    path, _ = hf_checkpoint
+    cfg = _our_cfg()
+    ref = load_hf_llama(path, cfg)
+    q = load_hf_llama(path, cfg, quant="int4")
+    assert params_quant_mode(q) == "int4"
+    assert q["layers"]["wq"].q.dtype.name == "int4"
+    tokens = np.array([[1, 5, 9, 2, 7, 3]], dtype=np.int32)
+    lr = np.asarray(transformer_forward(ref, jnp.asarray(tokens), cfg))
+    lq = np.asarray(transformer_forward(q, jnp.asarray(tokens), cfg))
+    corr = np.corrcoef(lr.ravel(), lq.ravel())[0, 1]
+    assert corr >= 0.95  # group-wise 4-bit tracks closely
+
+
 def test_hf_llama_loads_onto_mesh(hf_checkpoint):
     """mesh= places every leaf with its Megatron NamedSharding as it
     lands; logits must match the unsharded load exactly."""

@@ -89,3 +89,86 @@ def test_quant_rejections():
         )
     with pytest.raises(ValueError, match="llm"):
         InferenceEngine("resnet-tiny", quant="int8")
+
+
+def test_int4_groupwise_roundtrip():
+    from gofr_tpu.ops.quant import dequantize, quantize_array4
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 64), jnp.float32)
+    q4 = quantize_array4(w, group=128)
+    assert q4.q.dtype.name == "int4"
+    assert q4.s.shape == (2, 1, 64)  # 256/128 groups
+    recon = np.asarray(dequantize(q4, jnp.float32))
+    # 4-bit group-wise: ~7% of group absmax worst case.
+    err = np.abs(recon - np.asarray(w))
+    assert err.max() <= np.abs(np.asarray(w)).max() / 7 + 1e-6
+
+
+def test_int4_engine_serves_and_bytes_halve():
+    from gofr_tpu.ops.quant import quantized_bytes
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    e8 = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
+        quant="int8",
+    )
+    e4 = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
+        quant="int4",
+    )
+    assert e4.quant == "int4"
+    # int4 matmul weights store at half the int8 bytes (embeddings and
+    # norms stay bf16 in both, so the full tree shrinks by less than 2x).
+    assert quantized_bytes(e4.params) < quantized_bytes(e8.params)
+    e4.start_sync()
+    try:
+        r1 = e4.generate_sync(
+            "int4", max_new_tokens=8, temperature=0.0, stop_on_eos=False
+        )
+        r2 = e4.generate_sync(
+            "int4", max_new_tokens=8, temperature=0.0, stop_on_eos=False
+        )
+    finally:
+        e4.stop_sync()
+    assert r1.token_ids == r2.token_ids and len(r1.token_ids) == 8
+
+
+def test_int4_logits_close_to_bf16():
+    from gofr_tpu.models.registry import get_model
+    from gofr_tpu.models.transformer import transformer_forward
+    from gofr_tpu.ops.quant import quantize_params
+
+    spec = get_model("llama-tiny")
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    q4 = quantize_params(params, mode="int4")
+    tokens = jnp.asarray([[1, 5, 9, 2, 7, 3]], jnp.int32)
+    lb = np.asarray(transformer_forward(params, tokens, spec.config))
+    l4 = np.asarray(transformer_forward(q4, tokens, spec.config))
+    # Random-init tiny models have near-uniform logits (argmax gaps ~0),
+    # so greedy agreement is meaningless here; logit correlation is the
+    # right fidelity measure (trained models keep argmax via large gaps).
+    corr = np.corrcoef(lb.ravel(), l4.ravel())[0, 1]
+    assert corr >= 0.9
+
+
+def test_int4_sharded_from_config():
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    eng = InferenceEngine.from_config(MockConfig({
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+        "TPU_MESH_TP": "2", "TPU_QUANT": "int4",
+    }))
+    assert eng.quant == "int4"
+    q4 = eng.params["layers"]["wq"]
+    assert "tp" in str(q4.q.sharding.spec)
+    eng.start_sync()
+    try:
+        r = eng.generate_sync(
+            "int4 mesh", max_new_tokens=6, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        eng.stop_sync()
+    assert len(r.token_ids) == 6
