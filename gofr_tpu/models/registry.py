@@ -56,6 +56,23 @@ def _register_llms() -> None:
             vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
             n_kv_heads=8, d_ff=14336, max_len=8192, rope_theta=500000.0,
         ),
+        # Multi-host scale target: Llama-3-70B dims — serves tp=8 per
+        # v5e-8 slice (tp is capped by the 8 kv heads the cache shards
+        # over); scale FURTHER with dp replicas / pp stages across hosts
+        # via the DCN runtime (parallel/dcn.py). Capacity math in
+        # tests/test_models.py.
+        "llama-3-70b": TransformerConfig(
+            vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
+            n_kv_heads=8, d_ff=28672, max_len=8192, rope_theta=500000.0,
+        ),
+        # Mistral-7B dims (HF loader accepts model_type=mistral).
+        # max_len capped at the model's 4096 sliding window: attention
+        # here is dense causal, which matches the reference only within
+        # the window.
+        "mistral-7b": TransformerConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_len=4096, rope_theta=10000.0,
+        ),
         # ~1.1B config that fits one v5e chip comfortably for benching.
         "llama-1b": TransformerConfig(
             vocab_size=32768, d_model=2048, n_layers=22, n_heads=16,
