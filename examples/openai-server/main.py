@@ -1,0 +1,24 @@
+"""OpenAI-compatible LLM server: point any OpenAI SDK's base_url here.
+
+POST /v1/completions        {"prompt": "...", "max_tokens": 32, "stream": true}
+POST /v1/chat/completions   {"messages": [{"role": "user", "content": "hi"}]}
+GET  /v1/models
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from gofr_tpu import App
+from gofr_tpu.serving.openai_compat import add_openai_routes
+
+
+def main() -> App:
+    app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
+    add_openai_routes(app)
+    return app
+
+
+if __name__ == "__main__":
+    main().run()

@@ -10,7 +10,7 @@ reference ``http/router.go:23-28``).
 from gofr_tpu.http.proto import RawRequest, Response
 from gofr_tpu.http.request import Request
 from gofr_tpu.http.responder import Responder
-from gofr_tpu.http.response import File, Raw, Redirect
+from gofr_tpu.http.response import File, Raw, Redirect, Stream
 from gofr_tpu.http.router import Router
 from gofr_tpu.http.server import HTTPServer
 
@@ -22,6 +22,7 @@ __all__ = [
     "Raw",
     "File",
     "Redirect",
+    "Stream",
     "Router",
     "HTTPServer",
 ]

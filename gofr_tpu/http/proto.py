@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Optional
 
 MAX_REQUEST_LINE = 8192
 MAX_HEADER_COUNT = 100
@@ -63,6 +63,10 @@ class Response:
     status: int = 200
     headers: dict[str, str] = field(default_factory=dict)
     body: bytes = b""
+    # Streaming body (SSE, chunked downloads): an async iterator of bytes.
+    # When set, the server sends Transfer-Encoding: chunked and writes
+    # chunks as they arrive; ``body`` is ignored.
+    body_stream: Optional[Any] = None
 
     def set_header(self, key: str, value: str) -> None:
         self.headers[key] = value
@@ -152,7 +156,11 @@ def serialize_response(resp: Response, *, head_only: bool = False, keep_alive: b
     headers = dict(resp.headers)
     headers.setdefault("Date", time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime()))
     headers.setdefault("Server", "gofr-tpu")
-    if resp.status not in (204, 304):
+    streaming = resp.body_stream is not None and not head_only
+    if streaming:
+        headers["Transfer-Encoding"] = "chunked"
+        headers.pop("Content-Length", None)
+    elif resp.status not in (204, 304):
         headers["Content-Length"] = str(len(resp.body))
     if not keep_alive:
         headers["Connection"] = "close"
@@ -160,6 +168,14 @@ def serialize_response(resp: Response, *, head_only: bool = False, keep_alive: b
         f"{k}: {v}\r\n" for k, v in headers.items()
     ) + "\r\n"
     out = head.encode("latin-1")
-    if not head_only and resp.status not in (204, 304):
+    if not head_only and not streaming and resp.status not in (204, 304):
         out += resp.body
     return out
+
+
+def chunk_encode(data: bytes) -> bytes:
+    """One HTTP/1.1 chunked-transfer chunk."""
+    return f"{len(data):x}\r\n".encode("latin-1") + data + b"\r\n"
+
+
+CHUNKED_TERMINATOR = b"0\r\n\r\n"

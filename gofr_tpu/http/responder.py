@@ -20,7 +20,7 @@ from typing import Any, Optional
 import numpy as _np
 
 from gofr_tpu.http.proto import Response
-from gofr_tpu.http.response import File, Raw, Redirect, TypedResponse
+from gofr_tpu.http.response import File, Raw, Redirect, Stream, TypedResponse
 
 
 def _default(obj: Any) -> Any:
@@ -70,9 +70,23 @@ class Responder:
             )
         if isinstance(result, Raw):
             return Response(
-                status=self._success_status(),
+                status=result.status or self._success_status(),
                 headers={"Content-Type": "application/json"},
                 body=to_json_bytes(result.data),
+            )
+        if isinstance(result, Stream):
+            async def _encoded(chunks=result.chunks):
+                async for chunk in chunks:
+                    yield chunk.encode() if isinstance(chunk, str) else chunk
+
+            return Response(
+                status=200,
+                headers={
+                    "Content-Type": result.content_type,
+                    "Cache-Control": "no-cache",
+                    **result.headers,
+                },
+                body_stream=_encoded(),
             )
         if isinstance(result, TypedResponse):
             headers = {"Content-Type": "application/json", **result.headers}

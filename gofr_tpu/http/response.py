@@ -18,6 +18,9 @@ from typing import Any
 @dataclass
 class Raw:
     data: Any
+    # Override the method-derived success status (e.g. OpenAI-compat
+    # POSTs answer 200, not the framework's POST→201 default).
+    status: Any = None
 
 
 @dataclass
@@ -30,6 +33,20 @@ class File:
 class Redirect:
     url: str
     status: int = 302
+
+
+@dataclass
+class Stream:
+    """Chunked streaming response (SSE by default).
+
+    ``chunks``: an async iterator of ``bytes`` (or ``str``, encoded
+    utf-8). The server sends ``Transfer-Encoding: chunked`` and writes
+    each chunk as it arrives — token streaming over plain HTTP.
+    """
+
+    chunks: Any
+    content_type: str = "text/event-stream"
+    headers: dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

@@ -18,9 +18,11 @@ import asyncio
 from typing import Awaitable, Callable, Optional
 
 from gofr_tpu.http.proto import (
+    CHUNKED_TERMINATOR,
     ProtocolError,
     RawRequest,
     Response,
+    chunk_encode,
     read_request,
     serialize_response,
 )
@@ -170,6 +172,44 @@ class HTTPServer:
                         )
                     )
                     drained = await _safe_drain(writer)
+                    if (
+                        drained
+                        and resp.body_stream is not None
+                        and raw.method != "HEAD"
+                    ):
+                        # Chunked streaming body (SSE): write chunks as
+                        # the handler's async iterator yields them. A
+                        # failed write mid-stream closes the connection
+                        # (the client can't distinguish a truncated
+                        # chunked body from completion otherwise).
+                        try:
+                            async for chunk in resp.body_stream:
+                                if not chunk:
+                                    continue
+                                writer.write(chunk_encode(chunk))
+                                if not await _safe_drain(writer):
+                                    keep = False
+                                    break
+                            else:
+                                writer.write(CHUNKED_TERMINATOR)
+                                drained = await _safe_drain(writer)
+                        except Exception as exc:  # noqa: BLE001
+                            if self._logger is not None:
+                                self._logger.errorf(
+                                    "stream body failed: %s", exc
+                                )
+                            keep = False
+                        finally:
+                            # Disconnect mid-stream: close the generator
+                            # NOW so GeneratorExit reaches the handler
+                            # (which can cancel the generation feeding
+                            # it) instead of at GC time.
+                            aclose = getattr(resp.body_stream, "aclose", None)
+                            if aclose is not None:
+                                try:
+                                    await aclose()
+                                except Exception:  # noqa: BLE001
+                                    pass
                 finally:
                     self._inflight.discard(writer)
                 if not drained or not keep:

@@ -1,0 +1,273 @@
+"""OpenAI-compatible serving surface (net-new; no reference analog).
+
+``add_openai_routes(app)`` registers the three endpoints LLM clients
+expect, backed by the container's TPU engine:
+
+* ``POST /v1/completions`` — prompt in, text out; ``"stream": true``
+  switches to SSE chunks (``data: {...}\\n\\n`` … ``data: [DONE]``).
+* ``POST /v1/chat/completions`` — messages in, assistant message out;
+  same streaming contract.
+* ``GET /v1/models`` — the model registry.
+
+Responses use the OpenAI wire shapes directly (``Raw`` / ``Stream``
+bypass the framework's ``{"data": ...}`` envelope), so off-the-shelf
+OpenAI SDKs can point their ``base_url`` at this server. Chat messages
+are flattened with a minimal generic template; models loaded from HF
+checkpoints with their own chat template should pre-format prompts
+client-side or override ``chat_template``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+from gofr_tpu.errors import GofrError
+from gofr_tpu.http.response import Raw, Stream
+
+
+class OpenAIRequestError(GofrError):
+    """400 with a plain message (OpenAI clients show error.message)."""
+
+    status_code = 400
+
+
+def default_chat_template(messages: list[dict]) -> str:
+    """Minimal generic chat flattening (role-tagged lines + cue)."""
+    lines = []
+    for m in messages:
+        role = m.get("role", "user")
+        lines.append(f"{role}: {m.get('content', '')}")
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+def _completion_body(req_json: bytes) -> dict:
+    try:
+        body = json.loads(req_json or b"{}")
+    except json.JSONDecodeError as exc:
+        raise OpenAIRequestError(f"invalid JSON body: {exc}") from None
+    if not isinstance(body, dict):
+        raise OpenAIRequestError("request body must be a JSON object")
+    return body
+
+
+def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+def add_openai_routes(
+    app,
+    chat_template: Optional[Callable[[list[dict]], str]] = None,
+) -> None:
+    """Register /v1/* OpenAI-compatible routes on a gofr_tpu App."""
+    template = chat_template or default_chat_template
+
+    def _engine(ctx):
+        engine = getattr(ctx.container, "tpu", None)
+        if engine is None:
+            raise OpenAIRequestError(
+                "no TPU engine configured (set TPU_ENABLED/TPU_MODEL)"
+            )
+        return engine
+
+    def _params(body: dict) -> dict:
+        # Explicit nulls are legal per the OpenAI spec → fall back to
+        # defaults instead of int(None)/float(None) crashes.
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = body.get("max_completion_tokens")
+        temperature = body.get("temperature")
+        return dict(
+            max_new_tokens=128 if max_tokens is None else int(max_tokens),
+            temperature=1.0 if temperature is None else float(temperature),
+            stop_on_eos=True,
+        )
+
+    def _stream_response(
+        engine, prompt, params: dict, *, rid: str, model: str, chat: bool,
+    ) -> Stream:
+        # Submit BEFORE returning the Stream: prompt validation
+        # (ErrorPromptTooLong → 413 etc.) must fail the request proper,
+        # not die silently after the 200/SSE headers are on the wire.
+        req = engine.submit_generate(prompt, **params)
+        object_name = (
+            "chat.completion.chunk" if chat else "text_completion"
+        )
+
+        async def events():
+            created = int(time.time())
+            loop = asyncio.get_running_loop()
+            emitted_ids: list[int] = []
+            printed = ""
+            try:
+                if chat:
+                    first = {"role": "assistant", "content": ""}
+                    yield _sse(rid, object_name, model, created,
+                               {"delta": first, "index": 0})
+                while True:
+                    tok = await loop.run_in_executor(None, req.stream.get)
+                    if tok is None:
+                        break
+                    emitted_ids.append(tok)
+                    if engine.tokenizer is None:
+                        text = ""
+                    else:
+                        # Cumulative decode: per-token decode would split
+                        # multi-byte UTF-8 / BPE merges. Hold back while
+                        # the tail is an incomplete sequence (U+FFFD).
+                        full = engine.tokenizer.decode(emitted_ids)
+                        if full.endswith("�"):
+                            continue
+                        text, printed = full[len(printed):], full
+                    payload = (
+                        {"delta": {"content": text}, "index": 0}
+                        if chat else {"text": text, "index": 0}
+                    )
+                    yield _sse(rid, object_name, model, created, payload)
+                # Flush any held-back tail (genuinely invalid bytes stay
+                # U+FFFD; emit them now that the stream is over).
+                if engine.tokenizer is not None and emitted_ids:
+                    full = engine.tokenizer.decode(emitted_ids)
+                    if full != printed:
+                        tail = full[len(printed):]
+                        payload = (
+                            {"delta": {"content": tail}, "index": 0}
+                            if chat else {"text": tail, "index": 0}
+                        )
+                        yield _sse(rid, object_name, model, created, payload)
+                done = (
+                    {"delta": {}, "index": 0, "finish_reason": "stop"}
+                    if chat else
+                    {"text": "", "index": 0, "finish_reason": "stop"}
+                )
+                yield _sse(rid, object_name, model, created, done)
+                yield "data: [DONE]\n\n"
+            finally:
+                # Client disconnected (GeneratorExit via the server's
+                # aclose) or completed: cancel so the engine frees the
+                # KV slot instead of decoding to max_tokens for nobody.
+                req.future.cancel()
+
+        return Stream(chunks=events())
+
+    def _sse(rid, object_name, model, created, choice) -> str:
+        return "data: " + json.dumps({
+            "id": rid,
+            "object": object_name,
+            "created": created,
+            "model": model,
+            "choices": [choice],
+        }) + "\n\n"
+
+    def _normalize_prompts(prompt) -> list:
+        """OpenAI ``prompt`` forms: str, [int] (token ids), [str] /
+        [[int]] (a batch — one completion per element)."""
+        if isinstance(prompt, str):
+            return [prompt]
+        if isinstance(prompt, list):
+            if not prompt:
+                raise OpenAIRequestError("prompt must not be empty")
+            if all(isinstance(p, int) for p in prompt):
+                return [prompt]  # one prompt as token ids
+            if all(isinstance(p, str) for p in prompt) or all(
+                isinstance(p, list) and all(isinstance(t, int) for t in p)
+                for p in prompt
+            ):
+                return list(prompt)
+        raise OpenAIRequestError(
+            "prompt must be a string, token-id array, or batch thereof"
+        )
+
+    @app.post("/v1/completions")
+    async def completions(ctx):  # noqa: ANN001
+        engine = _engine(ctx)
+        body = _completion_body(ctx.request.raw.body)
+        prompts = _normalize_prompts(body.get("prompt", ""))
+        params = _params(body)
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model", engine.model_name)
+        if body.get("stream"):
+            if len(prompts) > 1:
+                raise OpenAIRequestError(
+                    "streaming supports a single prompt per request"
+                )
+            return _stream_response(
+                engine, prompts[0], params, rid=rid, model=model, chat=False,
+            )
+        results = await asyncio.gather(
+            *(engine.generate(p, **params) for p in prompts)
+        )
+        return Raw({
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [
+                {
+                    "text": r.text,
+                    "index": i,
+                    "logprobs": None,
+                    "finish_reason": "stop",
+                }
+                for i, r in enumerate(results)
+            ],
+            "usage": _usage(
+                sum(r.prompt_tokens for r in results),
+                sum(len(r.token_ids) for r in results),
+            ),
+        }, status=200)
+
+    @app.post("/v1/chat/completions")
+    async def chat_completions(ctx):  # noqa: ANN001
+        engine = _engine(ctx)
+        body = _completion_body(ctx.request.raw.body)
+        messages = body.get("messages") or []
+        if not isinstance(messages, list) or not messages:
+            raise OpenAIRequestError("messages must be a non-empty list")
+        prompt = template(messages)
+        params = _params(body)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model", engine.model_name)
+        if body.get("stream"):
+            return _stream_response(
+                engine, prompt, params, rid=rid, model=model, chat=True,
+            )
+        result = await engine.generate(prompt, **params)
+        return Raw({
+            "id": rid,
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": result.text},
+                "finish_reason": "stop",
+            }],
+            "usage": _usage(result.prompt_tokens, len(result.token_ids)),
+        }, status=200)
+
+    @app.get("/v1/models")
+    async def models(ctx):  # noqa: ANN001
+        from gofr_tpu.models.registry import list_models
+
+        engine: Any = getattr(ctx.container, "tpu", None)
+        return Raw({
+            "object": "list",
+            "data": [
+                {
+                    "id": name,
+                    "object": "model",
+                    "owned_by": "gofr-tpu",
+                    "loaded": engine is not None and engine.model_name == name,
+                }
+                for name in list_models()
+            ],
+        })

@@ -193,3 +193,18 @@ def test_using_cmd_example(capsys):
     rc = app.run(["hello", "-name=TPU"])
     assert rc == 0
     assert "Hello TPU!" in capsys.readouterr().out
+
+
+def test_openai_server_example():
+    mod = load_example("openai-server")
+    with Harness(mod.main()) as h:
+        status, body = h.request("GET", "/v1/models")
+        assert status == 200
+        assert json.loads(body)["object"] == "list"
+        status, body = h.request("POST", "/v1/completions", body={
+            "prompt": "hi", "max_tokens": 4, "temperature": 0,
+        })
+        assert status in (200, 201)
+        out = json.loads(body)
+        assert out["object"] == "text_completion"
+        assert out["usage"]["completion_tokens"] >= 1

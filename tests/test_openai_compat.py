@@ -1,0 +1,202 @@
+"""OpenAI-compatible surface: /v1/completions, /v1/chat/completions
+(non-stream + SSE streaming over chunked transfer), /v1/models — wire
+shapes an off-the-shelf OpenAI SDK expects."""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import threading
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MockConfig
+from gofr_tpu.serving.openai_compat import (
+    add_openai_routes,
+    default_chat_template,
+)
+
+
+@pytest.fixture(scope="module")
+def oai_app():
+    app = App(config=MockConfig({
+        "APP_NAME": "oai-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "128",
+    }))
+    add_openai_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=60)
+    yield app
+    asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _conn(app) -> http.client.HTTPConnection:
+    return http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=120)
+
+
+def test_completions_non_stream(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "model": "llama-tiny", "prompt": "hello", "max_tokens": 8,
+        "temperature": 0,
+    }))
+    r = c.getresponse()
+    assert r.status == 200  # OpenAI wire-compat: POST answers 200, not 201
+    body = json.loads(r.read())
+    assert body["object"] == "text_completion"
+    assert body["id"].startswith("cmpl-")
+    assert body["choices"][0]["finish_reason"] == "stop"
+    assert isinstance(body["choices"][0]["text"], str)
+    usage = body["usage"]
+    assert usage["total_tokens"] == (
+        usage["prompt_tokens"] + usage["completion_tokens"]
+    )
+    assert 1 <= usage["completion_tokens"] <= 8
+
+
+def test_chat_completions_non_stream(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ],
+        "max_tokens": 6, "temperature": 0,
+    }))
+    body = json.loads(c.getresponse().read())
+    assert body["object"] == "chat.completion"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+
+
+def test_completions_streaming_sse(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "stream me", "max_tokens": 6, "temperature": 0,
+        "stream": True,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    assert r.headers["Content-Type"].startswith("text/event-stream")
+    raw = r.read().decode()  # http.client de-chunks transparently
+    events = [
+        line[len("data: "):]
+        for line in raw.split("\n") if line.startswith("data: ")
+    ]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(ch["object"] == "text_completion" for ch in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    text = "".join(ch["choices"][0]["text"] for ch in chunks)
+    assert len(text) > 0
+
+
+def test_chat_streaming_deltas(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [{"role": "user", "content": "go"}],
+        "max_tokens": 4, "temperature": 0, "stream": True,
+    }))
+    raw = c.getresponse().read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.split("\n")
+        if line.startswith("data: ") and not line.endswith("[DONE]")
+    ]
+    assert events[0]["choices"][0]["delta"]["role"] == "assistant"
+    assert events[-1]["choices"][0]["finish_reason"] == "stop"
+    assert all(e["object"] == "chat.completion.chunk" for e in events)
+
+
+def test_models_endpoint(oai_app):
+    c = _conn(oai_app)
+    c.request("GET", "/v1/models")
+    body = json.loads(c.getresponse().read())
+    assert body["object"] == "list"
+    ids = {m["id"] for m in body["data"]}
+    assert {"llama-tiny", "llama-3-8b", "llama-3-70b"} <= ids
+    loaded = [m for m in body["data"] if m["loaded"]]
+    assert [m["id"] for m in loaded] == ["llama-tiny"]
+
+
+def test_bad_requests_are_400(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/chat/completions", body=b"{not json")
+    r = c.getresponse()
+    assert r.status == 400
+    r.read()  # drain before reusing the keep-alive connection
+    c.request("POST", "/v1/chat/completions", body=json.dumps({"messages": []}))
+    r = c.getresponse()
+    assert r.status == 400
+    r.read()
+
+
+def test_stream_text_matches_non_stream(oai_app):
+    """Cumulative UTF-8-safe decode: the streamed deltas concatenate to
+    exactly the non-streamed text (ByteTokenizer splits multi-byte
+    chars across tokens, so per-token decode would corrupt this)."""
+    payload = {"prompt": "match", "max_tokens": 10, "temperature": 0}
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps(payload))
+    want = json.loads(c.getresponse().read())["choices"][0]["text"]
+    c.request("POST", "/v1/completions",
+              body=json.dumps({**payload, "stream": True}))
+    raw = c.getresponse().read().decode()
+    got = "".join(
+        json.loads(line[len("data: "):])["choices"][0]["text"]
+        for line in raw.split("\n")
+        if line.startswith("data: ") and not line.endswith("[DONE]")
+    )
+    assert got == want
+
+
+def test_null_params_and_token_id_prompt(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": [1, 5, 9],  # token-id array form
+        "max_tokens": 4, "temperature": None,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    body = json.loads(r.read())
+    assert body["usage"]["prompt_tokens"] == 3
+
+
+def test_batch_prompts_yield_indexed_choices(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": ["one", "two"], "max_tokens": 3, "temperature": 0,
+    }))
+    body = json.loads(c.getresponse().read())
+    assert [ch["index"] for ch in body["choices"]] == [0, 1]
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": ["one", "two"], "max_tokens": 3, "stream": True,
+    }))
+    r = c.getresponse()
+    assert r.status == 400  # streaming is single-prompt
+    r.read()
+
+
+def test_stream_overlong_prompt_fails_before_headers(oai_app):
+    """Prompt validation happens BEFORE the SSE response starts — the
+    client gets a real 413, not a dead 200 stream."""
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "x" * 500, "max_tokens": 4, "stream": True,
+    }))
+    r = c.getresponse()
+    assert r.status == 413
+    r.read()
+
+
+def test_default_chat_template():
+    out = default_chat_template([
+        {"role": "system", "content": "S"},
+        {"role": "user", "content": "U"},
+    ])
+    assert out == "system: S\nuser: U\nassistant:"
