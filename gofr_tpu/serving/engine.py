@@ -47,6 +47,11 @@ class GenerationResult:
     ttft_s: float
     duration_s: float
     truncated: bool = False  # prompt head dropped (TPU_TRUNCATE_PROMPTS)
+    # Model log-softmax at each generated token (OpenAI logprobs field).
+    token_logprobs: list[float] = field(default_factory=list)
+    # "stop" (eos or a stop sequence matched) | "length" (token budget or
+    # context window exhausted).
+    finish_reason: str = "stop"
 
     @property
     def tokens_per_sec(self) -> float:
@@ -73,6 +78,7 @@ class _GenRequest:
     future: Future = field(default_factory=Future)
     enqueued_at: float = field(default_factory=time.time)
     token_ids: list[int] = field(default_factory=list)
+    token_logprobs: list[float] = field(default_factory=list)
     ttft_s: float = 0.0
     # Prompt length actually in the cache (set at admission; with
     # TPU_TRUNCATE_PROMPTS an overlong prompt keeps its tail and sets
@@ -82,6 +88,12 @@ class _GenRequest:
     # True → prefill only, then park the KV rows in the prefix pool and
     # resolve the future with the pool row (serving/prefix_cache.py).
     prefix_store: bool = False
+    # Stop sequences: generation retires early when the decoded text
+    # contains one; the result is trimmed at the match.
+    stop_texts: list[str] = field(default_factory=list)
+    # Set by _finished when a stop sequence matched: char offset of the
+    # earliest match in the decoded text.
+    stop_cut: int = -1
 
 
 @dataclass
@@ -239,6 +251,7 @@ class InferenceEngine:
             self._work = threading.Event()
             self._sched: Optional[threading.Thread] = None
             self._tokens_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
+            self._logps_dev = jnp.zeros((n_slots,), dtype=jnp.float32)
             # Slot state lives ON DEVICE between windows; re-uploaded only
             # when admissions/retirements change it (dirty flag). Steady-
             # state decode then dispatches with zero host→device traffic.
@@ -394,6 +407,9 @@ class InferenceEngine:
         cfg, top_k = self.cfg, self._top_k
 
         def sample(logits, key, temps, greedy):
+            """Returns (token, logprob) — the logprob is the model's
+            (unscaled) log-softmax at the chosen token, the number the
+            OpenAI logprobs field reports."""
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-4)[:, None]
             if top_k > 0:
@@ -401,12 +417,15 @@ class InferenceEngine:
                 kth = sorted_l[:, top_k - 1][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-            return jnp.where(greedy, greedy_tok, sampled)
+            chosen = jnp.where(greedy, greedy_tok, sampled)
+            logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp = jnp.take_along_axis(logp_all, chosen[:, None], axis=-1)[:, 0]
+            return chosen, logp
 
-        @partial(jax.jit, donate_argnums=(1, 10, 11))
+        @partial(jax.jit, donate_argnums=(1, 10, 11, 12))
         def prefill_chunk_step(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, key, all_tokens,
+            temps, greedy, key, all_tokens, all_logps,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -417,7 +436,7 @@ class InferenceEngine:
             logits, cache = transformer_prefill_chunk(
                 params, tokens, cache, slots, starts, lens, cfg
             )
-            first = sample(logits, sub, temps, greedy)
+            first, first_lp = sample(logits, sub, temps, greedy)
             S = all_tokens.shape[0]
             match = (
                 (jnp.arange(S)[:, None] == slots[None, :])
@@ -426,35 +445,39 @@ class InferenceEngine:
             has = jnp.any(match, axis=1)
             idx = jnp.argmax(match, axis=1)
             all_tokens = jnp.where(has, first[idx], all_tokens)
+            all_logps = jnp.where(has, first_lp[idx], all_logps)
             cache = cache._replace(
                 lengths=jnp.where(has, (starts + lens)[idx], cache.lengths)
             )
-            return cache, all_tokens, first, key
+            return cache, all_tokens, all_logps, first, key
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(2, 4))
-        def decode_window(params, tokens, cache, active, key, temps, greedy, k):
-            """Run k decode steps entirely on device; emit the k tokens that
-            ENTER each step (so a freshly prefilled slot's first token is
-            emitted by its first window) and carry the (k+1)-th as next
-            input. One host fetch per k tokens — the host↔device roundtrip
-            (≈66ms through a network-attached relay, SURVEY §7 hard part
-            #1: batch at the boundary) amortizes k-fold. The PRNG key is
-            threaded through ON DEVICE (returned for the next window), so
-            steady-state dispatch uploads nothing host→device at all."""
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5))
+        def decode_window(params, tokens, logps, cache, active, key, temps,
+                          greedy, k):
+            """Run k decode steps entirely on device; emit the k
+            (token, logprob) pairs that ENTER each step (so a freshly
+            prefilled slot's first token is emitted by its first window)
+            and carry the (k+1)-th as next input. One host fetch per k
+            tokens — emitted tokens and logprobs pack into ONE [2, k, S]
+            f32 block (token ids are exact in f32 below 2^24) so the
+            host↔device roundtrip count stays one per window. The PRNG
+            key is threaded through ON DEVICE, so steady-state dispatch
+            uploads nothing host→device at all."""
 
             def body(carry, _):
-                tokens, cache, key = carry
+                tokens, logps, cache, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = transformer_decode_step(
                     params, tokens, cache, active, cfg
                 )
-                nxt = sample(logits, sub, temps, greedy)
-                return (nxt, cache, key), tokens
+                nxt, nlp = sample(logits, sub, temps, greedy)
+                return (nxt, nlp, cache, key), (tokens, logps)
 
-            (final, cache, key), emitted = jax.lax.scan(
-                body, (tokens, cache, key), length=k
+            (final, final_lp, cache, key), (etoks, elps) = jax.lax.scan(
+                body, (tokens, logps, cache, key), length=k
             )
-            return emitted, final, cache, key
+            emitted = jnp.stack([etoks.astype(jnp.float32), elps])
+            return emitted, final, final_lp, cache, key
 
         self._prefill_chunk_step = prefill_chunk_step
         self._decode_window = decode_window
@@ -731,13 +754,13 @@ class InferenceEngine:
 
         jnp = self._jnp
         t0 = time.time()
-        self.cache, self._tokens_dev, _first, self._key_dev = (
+        self.cache, self._tokens_dev, self._logps_dev, _first, self._key_dev = (
             self._prefill_chunk_step(
                 self.params, self.cache, jnp.asarray(tokens),
                 jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
                 jnp.asarray(finalize), jnp.asarray(row_valid),
                 jnp.asarray(temps), jnp.asarray(greedy),
-                self._key_dev, self._tokens_dev,
+                self._key_dev, self._tokens_dev, self._logps_dev,
             )
         )
         if self._metrics is not None:
@@ -795,11 +818,11 @@ class InferenceEngine:
             self._slot_state_dirty = False
 
         t0 = time.time()
-        emitted, self._tokens_dev, self.cache, self._key_dev = (
+        emitted, self._tokens_dev, self._logps_dev, self.cache, self._key_dev = (
             self._decode_window(
-                self.params, self._tokens_dev, self.cache, self._active_dev,
-                self._key_dev, self._temps_dev, self._greedy_dev,
-                k=self.window_k,
+                self.params, self._tokens_dev, self._logps_dev, self.cache,
+                self._active_dev, self._key_dev, self._temps_dev,
+                self._greedy_dev, k=self.window_k,
             )
         )
         try:
@@ -841,10 +864,10 @@ class InferenceEngine:
                 seq.request.ttft_s = now - seq.request.enqueued_at
                 seq.first_token_at = now
             for step in range(self.window_k):
-                tok = int(emitted_host[step, i])
+                tok = int(emitted_host[0, step, i])
                 seq.last_token = tok
                 seq.n_generated += 1
-                self._emit_token(seq, tok)
+                self._emit_token(seq, tok, float(emitted_host[1, step, i]))
                 if self._finished(seq):
                     self._retire(i, seq)
                     if self._slots[i] is seq:
@@ -853,8 +876,9 @@ class InferenceEngine:
                     break
         self._update_slot_gauges()
 
-    def _emit_token(self, seq: _ActiveSeq, tok: int) -> None:
+    def _emit_token(self, seq: _ActiveSeq, tok: int, logprob: float) -> None:
         seq.request.token_ids.append(tok)
+        seq.request.token_logprobs.append(logprob)
         seq.request.stream.put(tok)
         if self._metrics is not None:
             self._metrics.increment_counter(
@@ -866,6 +890,15 @@ class InferenceEngine:
         eos = self.tokenizer.eos_id if self.tokenizer is not None else -1
         if req.stop_on_eos and req.token_ids and req.token_ids[-1] == eos:
             return True
+        if req.stop_texts and self.tokenizer is not None:
+            text = self.tokenizer.decode(req.token_ids)
+            at = min(
+                (p for p in (text.find(s) for s in req.stop_texts) if p != -1),
+                default=-1,
+            )
+            if at != -1:
+                req.stop_cut = at
+                return True
         if len(req.token_ids) >= req.max_new_tokens:
             return True
         prompt_len = req.effective_prompt_len or len(req.prompt_ids)
@@ -873,17 +906,39 @@ class InferenceEngine:
 
     def _retire(self, slot: int, seq: _ActiveSeq) -> None:
         req = seq.request
-        req.stream.put(None)  # stream sentinel
+        text = self.tokenizer.decode(req.token_ids) if self.tokenizer else ""
+        ids, lps = list(req.token_ids), list(req.token_logprobs)
+        eos = self.tokenizer.eos_id if self.tokenizer is not None else -1
+        if req.stop_cut >= 0:
+            # Stop sequence: trim the text at the match and the token/
+            # logprob lists to the longest prefix whose decode fits the
+            # kept text, so text and logprobs stay aligned.
+            text = text[: req.stop_cut]
+            keep = 0
+            for i in range(1, len(ids) + 1):
+                if len(self.tokenizer.decode(ids[:i])) <= req.stop_cut:
+                    keep = i
+                else:
+                    break
+            ids, lps = ids[:keep], lps[:keep]
+            reason = "stop"
+        elif req.stop_on_eos and ids and ids[-1] == eos:
+            reason = "stop"
+        else:
+            reason = "length"  # token budget or context window exhausted
         result = GenerationResult(
-            text=self.tokenizer.decode(req.token_ids) if self.tokenizer else "",
-            token_ids=list(req.token_ids),
+            text=text,
+            token_ids=ids,
             prompt_tokens=len(req.prompt_ids),
             ttft_s=req.ttft_s,
             duration_s=time.time() - req.enqueued_at,
             truncated=req.truncated,
+            token_logprobs=lps,
+            finish_reason=reason,
         )
         if not req.future.done():
             req.future.set_result(result)
+        req.stream.put(None)  # stream sentinel (after the result resolves)
 
     def _update_slot_gauges(self) -> None:
         if self._metrics is None:
@@ -938,13 +993,13 @@ class InferenceEngine:
             temps = np.ones((P,), dtype=np.float32)
             greedy = np.ones((P,), dtype=bool)
             t0 = time.perf_counter()
-            self.cache, self._tokens_dev, first, self._key_dev = (
+            self.cache, self._tokens_dev, self._logps_dev, first, self._key_dev = (
                 self._prefill_chunk_step(
                     self.params, self.cache, jnp.asarray(tokens),
                     jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
                     jnp.asarray(finalize), jnp.asarray(row_valid),
                     jnp.asarray(temps), jnp.asarray(greedy),
-                    self._key_dev, self._tokens_dev,
+                    self._key_dev, self._tokens_dev, self._logps_dev,
                 )
             )
             jax.block_until_ready(first)
@@ -958,10 +1013,11 @@ class InferenceEngine:
 
         def window():
             out = self._decode_window(
-                self.params, self._tokens_dev, self.cache, active,
-                self._key_dev, tdev, gdev, k=self.window_k,
+                self.params, self._tokens_dev, self._logps_dev, self.cache,
+                active, self._key_dev, tdev, gdev, k=self.window_k,
             )
-            emitted, self._tokens_dev, self.cache, self._key_dev = out
+            (emitted, self._tokens_dev, self._logps_dev, self.cache,
+             self._key_dev) = out
             return emitted
 
         # Warmup (compile) + RTT probe: a blocking fetch of a just-computed
@@ -1028,6 +1084,7 @@ class InferenceEngine:
         max_new_tokens: int = 128,
         temperature: float = 0.0,
         stop_on_eos: bool = True,
+        stop: "Optional[list[str]]" = None,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -1058,6 +1115,7 @@ class InferenceEngine:
             temperature=temperature,
             stop_on_eos=stop_on_eos,
             truncated=truncated,
+            stop_texts=list(stop or []),
         )
         self._enqueue(req)
         return req

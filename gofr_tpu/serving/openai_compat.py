@@ -63,6 +63,48 @@ def _usage(prompt_tokens: int, completion_tokens: int) -> dict:
     }
 
 
+_MAX_N = 16  # choices per request; unbounded n is a one-request DoS
+
+
+def _stop_list(body: dict) -> list[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if not (isinstance(stop, list) and all(isinstance(s, str) for s in stop)):
+        raise OpenAIRequestError("stop must be a string or list of strings")
+    if len(stop) > 4:
+        raise OpenAIRequestError("stop supports at most 4 sequences")
+    if any(not s for s in stop):
+        raise OpenAIRequestError("stop sequences must be non-empty")
+    return stop
+
+
+def _n_choices(body: dict, streaming: bool) -> int:
+    n = body.get("n")
+    n = 1 if n is None else int(n)  # NOT `or`: n=0 must reach validation
+    if n < 1 or n > _MAX_N:
+        raise OpenAIRequestError(f"n must be between 1 and {_MAX_N}")
+    if streaming and n > 1:
+        raise OpenAIRequestError("streaming supports n=1")
+    return n
+
+
+def _completion_logprobs(engine, result) -> dict:
+    """OpenAI completions logprobs block (no top_logprobs alternatives)."""
+    tokens = [
+        engine.tokenizer.decode([t]) if engine.tokenizer else ""
+        for t in result.token_ids
+    ]
+    return {
+        "tokens": tokens,
+        "token_logprobs": [round(lp, 6) for lp in result.token_logprobs],
+        "top_logprobs": None,
+        "text_offset": None,
+    }
+
+
 def add_openai_routes(
     app,
     chat_template: Optional[Callable[[list[dict]], str]] = None,
@@ -93,67 +135,98 @@ def add_openai_routes(
 
     def _stream_response(
         engine, prompt, params: dict, *, rid: str, model: str, chat: bool,
+        stop_seqs: Optional[list[str]] = None,
     ) -> Stream:
         # Submit BEFORE returning the Stream: prompt validation
         # (ErrorPromptTooLong → 413 etc.) must fail the request proper,
         # not die silently after the 200/SSE headers are on the wire.
-        req = engine.submit_generate(prompt, **params)
+        # Stop sequences go to the ENGINE too, so decoding halts and the
+        # KV slot frees at the match instead of running out the budget.
+        req = engine.submit_generate(
+            prompt, stop=list(stop_seqs or []), **params
+        )
         object_name = (
             "chat.completion.chunk" if chat else "text_completion"
         )
+        stops = stop_seqs or []
 
         async def events():
             created = int(time.time())
             loop = asyncio.get_running_loop()
             emitted_ids: list[int] = []
             printed = ""
+            reason = "stop"
+
+            def payload_of(text):
+                return (
+                    {"delta": {"content": text}, "index": 0}
+                    if chat else {"text": text, "index": 0}
+                )
+
+            def stop_hit(full):
+                return min(
+                    (at for at in (full.find(s) for s in stops) if at != -1),
+                    default=-1,
+                )
+
             try:
                 if chat:
                     first = {"role": "assistant", "content": ""}
                     yield _sse(rid, object_name, model, created,
                                {"delta": first, "index": 0})
-                while True:
+                # Hold back enough text that a stop sequence can never be
+                # emitted before it is detected (a stop spanning two
+                # deltas must still cut cleanly).
+                hold = max((len(s) for s in stops), default=0)
+                stopped = False
+                while not stopped:
                     tok = await loop.run_in_executor(None, req.stream.get)
                     if tok is None:
                         break
                     emitted_ids.append(tok)
                     if engine.tokenizer is None:
-                        text = ""
-                    else:
-                        # Cumulative decode: per-token decode would split
-                        # multi-byte UTF-8 / BPE merges. Hold back while
-                        # the tail is an incomplete sequence (U+FFFD).
-                        full = engine.tokenizer.decode(emitted_ids)
-                        if full.endswith("�"):
-                            continue
-                        text, printed = full[len(printed):], full
-                    payload = (
-                        {"delta": {"content": text}, "index": 0}
-                        if chat else {"text": text, "index": 0}
-                    )
-                    yield _sse(rid, object_name, model, created, payload)
-                # Flush any held-back tail (genuinely invalid bytes stay
-                # U+FFFD; emit them now that the stream is over).
-                if engine.tokenizer is not None and emitted_ids:
+                        continue
+                    # Cumulative decode: per-token decode would split
+                    # multi-byte UTF-8 / BPE merges.
                     full = engine.tokenizer.decode(emitted_ids)
-                    if full != printed:
-                        tail = full[len(printed):]
-                        payload = (
-                            {"delta": {"content": tail}, "index": 0}
-                            if chat else {"text": tail, "index": 0}
-                        )
-                        yield _sse(rid, object_name, model, created, payload)
+                    at = stop_hit(full)
+                    if at != -1:
+                        full = full[:at]
+                        stopped = True
+                    elif full.endswith("�"):
+                        # Possibly incomplete UTF-8 tail — hold back.
+                        continue
+                    else:
+                        full = full[: max(len(printed), len(full) - hold)]
+                    if len(full) > len(printed):
+                        text, printed = full[len(printed):], full
+                        yield _sse(rid, object_name, model, created,
+                                   payload_of(text))
+                if stopped:
+                    reason = "stop"
+                else:
+                    # The engine's retired result is authoritative: its
+                    # text is already stop-trimmed, its finish_reason
+                    # covers eos/budget/context-window.
+                    result = req.future.result(timeout=30)
+                    reason = result.finish_reason
+                    if (
+                        engine.tokenizer is not None
+                        and len(result.text) > len(printed)
+                    ):
+                        yield _sse(rid, object_name, model, created,
+                                   payload_of(result.text[len(printed):]))
                 done = (
-                    {"delta": {}, "index": 0, "finish_reason": "stop"}
+                    {"delta": {}, "index": 0, "finish_reason": reason}
                     if chat else
-                    {"text": "", "index": 0, "finish_reason": "stop"}
+                    {"text": "", "index": 0, "finish_reason": reason}
                 )
                 yield _sse(rid, object_name, model, created, done)
                 yield "data: [DONE]\n\n"
             finally:
                 # Client disconnected (GeneratorExit via the server's
-                # aclose) or completed: cancel so the engine frees the
-                # KV slot instead of decoding to max_tokens for nobody.
+                # aclose), stop sequence hit, or completed: cancel so the
+                # engine frees the KV slot instead of decoding for nobody.
                 req.future.cancel()
 
         return Stream(chunks=events())
@@ -192,33 +265,42 @@ def add_openai_routes(
         body = _completion_body(ctx.request.raw.body)
         prompts = _normalize_prompts(body.get("prompt", ""))
         params = _params(body)
+        stop_seqs = _stop_list(body)
+        streaming = bool(body.get("stream"))
+        n = _n_choices(body, streaming)
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         model = body.get("model", engine.model_name)
-        if body.get("stream"):
+        if streaming:
             if len(prompts) > 1:
                 raise OpenAIRequestError(
                     "streaming supports a single prompt per request"
                 )
             return _stream_response(
                 engine, prompts[0], params, rid=rid, model=model, chat=False,
+                stop_seqs=stop_seqs,
             )
+        want_logprobs = body.get("logprobs") not in (None, False, 0)
         results = await asyncio.gather(
-            *(engine.generate(p, **params) for p in prompts)
+            *(engine.generate(p, stop=stop_seqs, **params)
+              for p in prompts for _ in range(n))
         )
+        choices = []
+        for i, r in enumerate(results):
+            # The engine trims text/tokens at the stop match and reports
+            # finish_reason itself, so logprobs stay text-aligned.
+            choices.append({
+                "text": r.text,
+                "index": i,
+                "logprobs": _completion_logprobs(engine, r)
+                if want_logprobs else None,
+                "finish_reason": r.finish_reason,
+            })
         return Raw({
             "id": rid,
             "object": "text_completion",
             "created": int(time.time()),
             "model": model,
-            "choices": [
-                {
-                    "text": r.text,
-                    "index": i,
-                    "logprobs": None,
-                    "finish_reason": "stop",
-                }
-                for i, r in enumerate(results)
-            ],
+            "choices": choices,
             "usage": _usage(
                 sum(r.prompt_tokens for r in results),
                 sum(len(r.token_ids) for r in results),
@@ -234,24 +316,48 @@ def add_openai_routes(
             raise OpenAIRequestError("messages must be a non-empty list")
         prompt = template(messages)
         params = _params(body)
+        stop_seqs = _stop_list(body)
+        streaming = bool(body.get("stream"))
+        n = _n_choices(body, streaming)
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         model = body.get("model", engine.model_name)
-        if body.get("stream"):
+        if streaming:
             return _stream_response(
                 engine, prompt, params, rid=rid, model=model, chat=True,
+                stop_seqs=stop_seqs,
             )
-        result = await engine.generate(prompt, **params)
+        want_logprobs = bool(body.get("logprobs"))
+        results = await asyncio.gather(
+            *(engine.generate(prompt, stop=stop_seqs, **params)
+              for _ in range(n))
+        )
+        choices = []
+        for i, r in enumerate(results):
+            choice: dict = {
+                "index": i,
+                "message": {"role": "assistant", "content": r.text},
+                "finish_reason": r.finish_reason,
+            }
+            if want_logprobs:
+                choice["logprobs"] = {"content": [
+                    {
+                        "token": engine.tokenizer.decode([t])
+                        if engine.tokenizer else "",
+                        "logprob": round(lp, 6),
+                    }
+                    for t, lp in zip(r.token_ids, r.token_logprobs)
+                ]}
+            choices.append(choice)
         return Raw({
             "id": rid,
             "object": "chat.completion",
             "created": int(time.time()),
             "model": model,
-            "choices": [{
-                "index": 0,
-                "message": {"role": "assistant", "content": result.text},
-                "finish_reason": "stop",
-            }],
-            "usage": _usage(result.prompt_tokens, len(result.token_ids)),
+            "choices": choices,
+            "usage": _usage(
+                sum(r.prompt_tokens for r in results),
+                sum(len(r.token_ids) for r in results),
+            ),
         }, status=200)
 
     @app.get("/v1/models")

@@ -49,7 +49,9 @@ def test_completions_non_stream(oai_app):
     body = json.loads(r.read())
     assert body["object"] == "text_completion"
     assert body["id"].startswith("cmpl-")
-    assert body["choices"][0]["finish_reason"] == "stop"
+    # Budget exhausted without eos → "length" (this model never emits eos
+    # for this greedy prompt).
+    assert body["choices"][0]["finish_reason"] == "length"
     assert isinstance(body["choices"][0]["text"], str)
     usage = body["usage"]
     assert usage["total_tokens"] == (
@@ -91,7 +93,7 @@ def test_completions_streaming_sse(oai_app):
     assert events[-1] == "[DONE]"
     chunks = [json.loads(e) for e in events[:-1]]
     assert all(ch["object"] == "text_completion" for ch in chunks)
-    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "length"
     text = "".join(ch["choices"][0]["text"] for ch in chunks)
     assert len(text) > 0
 
@@ -109,7 +111,7 @@ def test_chat_streaming_deltas(oai_app):
         if line.startswith("data: ") and not line.endswith("[DONE]")
     ]
     assert events[0]["choices"][0]["delta"]["role"] == "assistant"
-    assert events[-1]["choices"][0]["finish_reason"] == "stop"
+    assert events[-1]["choices"][0]["finish_reason"] == "length"
     assert all(e["object"] == "chat.completion.chunk" for e in events)
 
 
@@ -192,6 +194,109 @@ def test_stream_overlong_prompt_fails_before_headers(oai_app):
     r = c.getresponse()
     assert r.status == 413
     r.read()
+
+
+def test_stop_sequences_and_finish_reason(oai_app):
+    base = {"prompt": "det", "max_tokens": 10, "temperature": 0}
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps(base))
+    first = json.loads(c.getresponse().read())["choices"][0]
+    assert first["finish_reason"] == "length"  # budget exhausted, no eos
+    full = first["text"]
+    assert len(full) >= 2
+    marker = full[1:3]  # greedy determinism → same text next time
+    c.request("POST", "/v1/completions",
+              body=json.dumps({**base, "stop": marker}))
+    cut = json.loads(c.getresponse().read())["choices"][0]
+    assert cut["finish_reason"] == "stop"
+    assert cut["text"] == full[: full.find(marker)]
+    assert marker not in cut["text"]
+    # Streaming with the same stop cuts identically.
+    c.request("POST", "/v1/completions",
+              body=json.dumps({**base, "stop": marker, "stream": True}))
+    raw = c.getresponse().read().decode()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.split("\n")
+        if line.startswith("data: ") and not line.endswith("[DONE]")
+    ]
+    text = "".join(e["choices"][0]["text"] for e in events)
+    assert text == cut["text"]
+    assert events[-1]["choices"][0]["finish_reason"] == "stop"
+
+
+def test_n_choices_and_logprobs(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "lp", "max_tokens": 4, "temperature": 0,
+        "n": 2, "logprobs": 1,
+    }))
+    body = json.loads(c.getresponse().read())
+    assert [ch["index"] for ch in body["choices"]] == [0, 1]
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 4
+    assert all(isinstance(v, float) and v <= 0.0 for v in lp["token_logprobs"])
+    assert len(lp["tokens"]) == 4
+    assert body["usage"]["completion_tokens"] == 8  # 2 choices x 4
+
+    c.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3, "temperature": 0, "logprobs": True,
+    }))
+    chat = json.loads(c.getresponse().read())
+    content_lp = chat["choices"][0]["logprobs"]["content"]
+    assert len(content_lp) == 3
+    assert all(e["logprob"] <= 0.0 for e in content_lp)
+
+
+def test_engine_result_carries_logprobs(oai_app):
+    eng = oai_app.container.tpu
+    r = eng.generate_sync(
+        "lp check", max_new_tokens=5, temperature=0.0, stop_on_eos=False,
+        timeout=120,
+    )
+    assert len(r.token_logprobs) == len(r.token_ids) == 5
+    assert all(lp <= 0.0 for lp in r.token_logprobs)
+
+
+def test_param_validation_limits(oai_app):
+    c = _conn(oai_app)
+
+    def post(payload):
+        c.request("POST", "/v1/completions", body=json.dumps(payload))
+        r = c.getresponse()
+        r.read()
+        return r.status
+
+    base = {"prompt": "x", "max_tokens": 2}
+    assert post({**base, "n": 0}) == 400
+    assert post({**base, "n": 1000}) == 400  # unbounded n is a DoS vector
+    assert post({**base, "n": 2, "stream": True}) == 400
+    assert post({**base, "stop": ""}) == 400  # empty stop matches everything
+    assert post({**base, "stop": ["a", "b", "c", "d", "e"]}) == 400
+
+
+def test_stop_trims_logprobs_aligned(oai_app):
+    """Engine-level stop: token/logprob lists are trimmed WITH the text."""
+    eng = oai_app.container.tpu
+    full = eng.generate_sync(
+        "align", max_new_tokens=10, temperature=0.0, stop_on_eos=False,
+        timeout=120,
+    )
+    marker = full.text[2:4]
+    cut = eng.generate_sync(
+        "align", max_new_tokens=10, temperature=0.0, stop_on_eos=False,
+        stop=[marker], timeout=120,
+    )
+    assert cut.finish_reason == "stop"
+    assert cut.text == full.text[: full.text.find(marker)]
+    assert len(cut.token_logprobs) == len(cut.token_ids)
+    assert len(cut.token_ids) < len(full.token_ids)
+    # Trimmed ids decode to a prefix of the kept text.
+    assert eng.tokenizer.decode(cut.token_ids) == cut.text[
+        : len(eng.tokenizer.decode(cut.token_ids))
+    ]
+    assert full.finish_reason == "length"
 
 
 def test_default_chat_template():
