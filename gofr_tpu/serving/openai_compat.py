@@ -314,7 +314,18 @@ def add_openai_routes(
         messages = body.get("messages") or []
         if not isinstance(messages, list) or not messages:
             raise OpenAIRequestError("messages must be a non-empty list")
-        prompt = template(messages)
+        # Prefer the model's own chat template (HF tokenizers carry one);
+        # fall back to the generic role-tagged flattening. An explicit
+        # chat_template arg to add_openai_routes overrides both.
+        if chat_template is None and hasattr(
+            engine.tokenizer, "apply_chat_template"
+        ):
+            try:
+                prompt = engine.tokenizer.apply_chat_template(messages)
+            except Exception:  # noqa: BLE001 — template may reject roles
+                prompt = template(messages)
+        else:
+            prompt = template(messages)
         params = _params(body)
         stop_seqs = _stop_list(body)
         streaming = bool(body.get("stream"))

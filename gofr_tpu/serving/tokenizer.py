@@ -61,6 +61,13 @@ def tokenizer_from_config(config, logger=None) -> Tokenizer:
                 def decode(self, ids) -> str:
                     return tok.decode(list(ids), skip_special_tokens=True)
 
+                def apply_chat_template(self, messages) -> str:
+                    """The model's OWN chat format (HF chat_template) —
+                    used by the OpenAI-compat surface when present."""
+                    return tok.apply_chat_template(
+                        messages, tokenize=False, add_generation_prompt=True
+                    )
+
             return _HF()
         except Exception as exc:
             if logger is not None:

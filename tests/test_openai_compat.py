@@ -305,3 +305,48 @@ def test_default_chat_template():
         {"role": "user", "content": "U"},
     ])
     assert out == "system: S\nuser: U\nassistant:"
+
+
+def test_chat_uses_tokenizer_template_when_available():
+    """An HF-style tokenizer's own chat template wins over the generic
+    flattening; an explicit chat_template arg overrides both."""
+    import asyncio as aio
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    class TemplatedTokenizer(ByteTokenizer):
+        def apply_chat_template(self, messages):
+            return "<tmpl>" + messages[-1]["content"]
+
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, tokenizer=TemplatedTokenizer()
+    )
+    eng.start_sync()
+    seen = {}
+    orig = eng.submit_generate
+
+    def spy(prompt, **kw):
+        seen["prompt"] = prompt
+        return orig(prompt, **kw)
+
+    eng.submit_generate = spy
+    app = App(config=MockConfig({
+        "APP_NAME": "tmpl", "HTTP_PORT": "0", "METRICS_PORT": "0",
+    }))
+    app.container.tpu = eng
+    add_openai_routes(app)
+    loop = aio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    aio.run_coroutine_threadsafe(app.start(), loop).result(timeout=30)
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=120)
+        c.request("POST", "/v1/chat/completions", body=json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 2, "temperature": 0,
+        }))
+        assert c.getresponse().status == 200
+        assert seen["prompt"] == "<tmpl>hi"
+    finally:
+        aio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        eng.stop_sync()
