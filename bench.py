@@ -304,15 +304,18 @@ def main() -> None:
         for _ in range(n_requests)
     ]
     results = [r.future.result(timeout=1800) for r in reqs]
-    wall = time.time() - t0
+    # NB: must not be named `wall` — that would rebind the watchdog
+    # closure's deadline and kill the run at the unloaded-ttft stage.
+    measure_wall = time.time() - t0
 
     total_tokens = sum(len(r.token_ids) for r in results)
-    tps = total_tokens / wall
+    tps = total_tokens / measure_wall
     ttfts = sorted(r.ttft_s * 1e3 for r in results)
     p50 = statistics.median(ttfts)
     p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
 
-    log(f"generated {total_tokens} tokens in {wall:.2f}s → {tps:.1f} tok/s/chip")
+    log(f"generated {total_tokens} tokens in {measure_wall:.2f}s "
+        f"→ {tps:.1f} tok/s/chip")
     log(f"TTFT p50={p50:.1f}ms p99={p99:.1f}ms (includes queueing behind "
         f"{n_requests} concurrent requests on {n_slots} slots)")
 

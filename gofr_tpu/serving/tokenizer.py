@@ -61,12 +61,18 @@ def tokenizer_from_config(config, logger=None) -> Tokenizer:
                 def decode(self, ids) -> str:
                     return tok.decode(list(ids), skip_special_tokens=True)
 
-                def apply_chat_template(self, messages) -> str:
+                def apply_chat_template(self, messages) -> list[int]:
                     """The model's OWN chat format (HF chat_template) —
-                    used by the OpenAI-compat surface when present."""
-                    return tok.apply_chat_template(
-                        messages, tokenize=False, add_generation_prompt=True
-                    )
+                    used by the OpenAI-compat surface when present.
+
+                    Returns token IDS, not a string: a rendered template
+                    already contains BOS/special tokens, and re-encoding
+                    it through ``encode`` (add_special_tokens=True) would
+                    prepend a second BOS — the classic tokenize=False
+                    pitfall."""
+                    return list(tok.apply_chat_template(
+                        messages, tokenize=True, add_generation_prompt=True
+                    ))
 
             return _HF()
         except Exception as exc:

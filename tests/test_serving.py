@@ -117,6 +117,31 @@ def test_streaming(llm_engine):
     assert len(toks) == 5
 
 
+def test_greedy_matches_cache_free_rollout(llm_engine):
+    """Engine output == argmax rollout of the plain forward (no KV cache).
+
+    Catches emission bugs no engine-vs-engine comparison can: a duplicated
+    first token (early prefill emission + window re-emission), dropped or
+    reordered window tokens, off-by-one cache lengths.
+    """
+    import jax.numpy as jnp
+
+    from gofr_tpu.models.transformer import transformer_forward
+
+    prompt = "oracle"
+    n_new = 7
+    r = llm_engine.generate_sync(
+        prompt, max_new_tokens=n_new, temperature=0.0, stop_on_eos=False
+    )
+    seq = list(llm_engine.tokenizer.encode(prompt))
+    for _ in range(n_new):
+        logits = transformer_forward(
+            llm_engine.params, jnp.asarray([seq]), llm_engine.cfg
+        )
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    assert r.token_ids == seq[-n_new:]
+
+
 def test_llm_health(llm_engine):
     h = llm_engine.health_check()
     assert h["status"] == "UP"
