@@ -188,11 +188,14 @@ def main() -> None:
     import threading
 
     t_start = time.time()
-    wall = float(os.environ.get("BENCH_CHILD_WALL", "0"))
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
     stage_deadlines = {"jax-init": init_timeout}
 
-    def _watchdog() -> None:
+    # Deadline bound AT CREATION (default arg), not late-bound from a
+    # function-local — a later `wall = ...` elapsed-time assignment in
+    # main() must not be able to rebind the watchdog's budget (that exact
+    # bug killed every campaign-1 run at the unloaded-ttft stage).
+    def _watchdog(wall=float(os.environ.get("BENCH_CHILD_WALL", "0"))) -> None:
         last_beat = 0.0
         while True:
             time.sleep(5)

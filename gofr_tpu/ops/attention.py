@@ -148,6 +148,8 @@ def decode_attention(
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_new: jnp.ndarray | None = None,
+    v_new: jnp.ndarray | None = None,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
@@ -158,20 +160,35 @@ def decode_attention(
     q: [b, n_heads, hd] (one query per sequence);
     k_cache, v_cache: [b, n_kv_heads, max_len, hd] (heads-major — the
     TPU-native cache layout, see ``ops/kv_cache.py``);
-    lengths: [b] valid prefix length per slot (the new token's K/V must
-    already be written at position lengths-1);
+    lengths: [b] valid prefix length per slot. Two calling conventions:
+
+    * ``k_new is None`` — the new token's K/V is already written in the
+      cache at position lengths-1 (lengths INCLUDES it);
+    * ``k_new``/``v_new`` given (``[b, n_kv, hd]``, same dtype as q) —
+      the current token's K/V is attended SPLIT from the cache (online-
+      softmax merge) and ``lengths`` counts only the cache prefix. This
+      is the serving decode path: keeping the cache read-only inside the
+      per-layer scan lets one scatter commit every layer's token per
+      step, instead of the full cache round-tripping through scan ys
+      (measured 11 ms/step of pure copy traffic on llama-1b at 32
+      slots — scripts/tpu_probe.py).
+
     k_scale/v_scale: int8-cache mode — per-position absmax scales
-    ``[b, n_kv, 8, max_len]`` (sublane-replicated, ``ops/kv_cache.py``).
+    ``[b, n_kv, 8, max_len]`` (sublane-replicated, ``ops/kv_cache.py``);
+    ``k_new``/``v_new`` stay bf16 (quantization happens at commit).
     kernel: None → auto (pallas flash-decode kernel on TPU; override with
     GOFR_TPU_FLASH_DECODE / GOFR_TPU_DECODE_BLOCK_K).
     """
+    if (k_new is None) != (v_new is None):
+        raise ValueError("pass k_new and v_new together")
     if kernel is None:
         kernel = _flash_decode_enabled()
     if kernel:
         from gofr_tpu.ops.pallas import flash_decode
 
         return flash_decode(
-            q, k_cache, v_cache, lengths, k_scale=k_scale, v_scale=v_scale,
+            q, k_cache, v_cache, lengths, k_new=k_new, v_new=v_new,
+            k_scale=k_scale, v_scale=v_scale,
             scale=scale, block_k=_DECODE_BLOCK_K, interpret=_interpret(),
         )
     n_heads = q.shape[1]
@@ -197,12 +214,29 @@ def decode_attention(
     valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
 
-    probs = jax.nn.softmax(scores, axis=-1)
+    if k_new is None:
+        probs = jax.nn.softmax(scores, axis=-1)
+        if quant:
+            probs = probs * v_scale[:, :, 0, :][:, :, None, :]
+        out = jnp.einsum(
+            "bgrk,bgkd->bgrd", probs.astype(q.dtype), v_cache
+        )
+        return out.reshape(b, n_heads, -1)
+
+    # Split path: merge the current token's (always-valid) score into the
+    # cache-prefix softmax without writing it to the cache first.
+    s_new = jnp.einsum(
+        "bgrd,bgd->bgr", qg, k_new, preferred_element_type=jnp.float32
+    ) * scale  # [b, kv, rep]
+    m = jnp.maximum(jnp.max(scores, axis=-1), s_new)  # [b, kv, rep]
+    e_c = jnp.exp(scores - m[..., None])  # [b, kv, rep, max_len]
+    e_n = jnp.exp(s_new - m)  # [b, kv, rep]
+    denom = jnp.sum(e_c, axis=-1) + e_n
     if quant:
-        probs = probs * v_scale[:, :, 0, :][:, :, None, :]
-    out = jnp.einsum(
-        "bgrk,bgkd->bgrd", probs.astype(q.dtype), v_cache
-    )
+        e_c = e_c * v_scale[:, :, 0, :][:, :, None, :]
+    out = jnp.einsum("bgrk,bgkd->bgrd", e_c.astype(q.dtype), v_cache)
+    out = out + e_n[..., None].astype(q.dtype) * v_new[:, :, None, :]
+    out = out / denom[..., None].astype(q.dtype)
     return out.reshape(b, n_heads, -1)
 
 

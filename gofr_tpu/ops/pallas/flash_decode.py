@@ -37,7 +37,8 @@ def _clamp_blk(ik, length, block_k):
     return jnp.minimum(ik, jnp.maximum(0, (length - 1) // block_k))
 
 
-def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant):
+def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant,
+            has_new):
     """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch.
 
     quant (static): int8 cache mode — two extra scale refs follow v_ref
@@ -45,11 +46,22 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant):
     scores multiply by the K scale after the q·k matmul, probs by the V
     scale before p·v, so dequantized K/V tensors never materialize and
     HBM streams int8.
+
+    has_new (static): the current token's K/V (``[8, hd]`` sublane-
+    replicated bf16 refs after the scale refs) is merged into the online
+    softmax at the finish step instead of being read from the cache —
+    ``lengths`` then counts only the cache prefix. Lets the serving
+    decode keep the cache read-only until one end-of-step commit.
     """
+    rest = list(rest)
+    k_s_ref = v_s_ref = kn_ref = vn_ref = None
     if quant:
-        k_s_ref, v_s_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+        k_s_ref, v_s_ref = rest[:2]
+        rest = rest[2:]
+    if has_new:
+        kn_ref, vn_ref = rest[:2]
+        rest = rest[2:]
+    o_ref, acc_ref, m_ref, l_ref = rest
     ib = pl.program_id(0)
     ik = pl.program_id(2)
     length = len_ref[ib]
@@ -101,9 +113,33 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant):
 
     @pl.when(ik == last_vis)
     def _finish():
-        l = l_ref[:, :1]
-        out = jnp.where(l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0)
-        o_ref[0, 0] = out.astype(o_ref.dtype)
+        if has_new:
+            # Merge the current token (always valid, bf16, unscaled) into
+            # the running softmax, then normalize. With an empty prefix
+            # (length 0: m=-inf, l=0) this reduces to attending the new
+            # token alone — corr underflows to 0 cleanly.
+            # f32 throughout: the refs are f32 (wrapper casts) — Mosaic
+            # rejects mixed-dtype broadcasts in this tail block.
+            q = q_ref[0, 0].astype(jnp.float32)  # [rep, hd]
+            kn = kn_ref[0, 0][0:1, :]  # [1, hd] f32 (row 0 of the 8-replica)
+            vn = vn_ref[0, 0][0:1, :]
+            s_n = jax.lax.dot_general(
+                q, kn, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [rep, 1]
+            m_prev = m_ref[:, :1]
+            m_new = jnp.maximum(m_prev, s_n)
+            corr = jnp.exp(m_prev - m_new)
+            e_n = jnp.exp(s_n - m_new)
+            l = l_ref[:, :1] * corr + e_n
+            acc = acc_ref[:] * corr + e_n * vn
+            o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+        else:
+            l = l_ref[:, :1]
+            out = jnp.where(
+                l > 0.0, acc_ref[:] / jnp.where(l > 0.0, l, 1.0), 0.0
+            )
+            o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -115,6 +151,8 @@ def flash_decode(
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
     *,
+    k_new: jnp.ndarray | None = None,
+    v_new: jnp.ndarray | None = None,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
@@ -124,8 +162,11 @@ def flash_decode(
     """Same contract as ``ops.attention.decode_attention``:
 
     q: [b, n_heads, hd]; caches: [b, n_kv, max_len, hd] (heads-major);
-    lengths: [b] (valid prefix; the current token's K/V already written at
-    lengths-1); k_scale/v_scale: int8-cache per-position scales
+    lengths: [b] valid prefix — INCLUDES the current token (already
+    written at lengths-1) when ``k_new`` is None, EXCLUDES it when
+    ``k_new``/``v_new`` ([b, n_kv, hd] bf16) are given (split path: the
+    new token merges in-kernel at the finish step).
+    k_scale/v_scale: int8-cache per-position scales
     [b, n_kv, 8, max_len] (sublane-replicated, ``ops/kv_cache.py``).
     Returns [b, n_heads, hd].
     """
@@ -133,6 +174,7 @@ def flash_decode(
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     n_rep = n_heads // n_kv
     quant = k_scale is not None
+    has_new = k_new is not None
     if scale is None:
         scale = hd**-0.5
 
@@ -172,6 +214,18 @@ def flash_decode(
                 ib, ig, 0, _clamp_blk(ik, lens[ib], block_k)))
         in_specs += [scale_spec, scale_spec]
         inputs += [k_scale, v_scale]
+    if has_new:
+        # [b, n_kv, hd] → sublane-replicated [b, n_kv, 8, hd] f32 (the
+        # finish-step merge runs in f32; mixed-dtype broadcasts fail
+        # Mosaic verification) so the block tiles VMEM (a [1, hd] can't).
+        rep8 = lambda t: jnp.broadcast_to(  # noqa: E731
+            t[:, :, None, :], (b, n_kv, 8, hd)
+        ).astype(jnp.float32)
+        new_spec = pl.BlockSpec(
+            (1, 1, 8, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
+        )
+        in_specs += [new_spec, new_spec]
+        inputs += [rep8(k_new), rep8(v_new)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -187,7 +241,10 @@ def flash_decode(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, block_k=block_k, quant=quant),
+        functools.partial(
+            _kernel, scale=scale, block_k=block_k, quant=quant,
+            has_new=has_new,
+        ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, hd), q.dtype),
         interpret=interpret,

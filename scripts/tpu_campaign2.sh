@@ -1,0 +1,29 @@
+#!/bin/bash
+# Follow-up TPU campaign: re-measure configs whose first runs were killed
+# by the bench watchdog shadowing bug, plus scheduler-fix validation.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p campaign
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  env "$@" BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 BENCH_TOTAL_BUDGET=900 \
+    timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
+  echo "--- rc=$? json:"; cat "campaign/$name.json"
+  tail -n 3 "campaign/$name.log"
+}
+# 1. Scheduler-fix validation: same config as r3-1b-int8 (1688 tok/s,
+#    unloaded TTFT 361 ms before the early-emit + wave-drain fixes).
+run r3b-1b-int8 BENCH_MODEL=llama-1b
+# 2. Flagship 8B rows (first runs died at the unloaded-ttft stage).
+run r3b-8b-int8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=16 BENCH_REQUESTS=32
+run r3b-8b-int8-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_KV_QUANT=int8
+# 3. Window 16 retest (prior 1192 tok/s was starved by 1:1 admission).
+run r3b-1b-w16d3 BENCH_MODEL=llama-1b BENCH_WINDOW=16 BENCH_DEPTH=3
+# 4. Slot scaling: does 64 slots amortize the fixed step cost?
+run r3b-1b-int8-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128
+run r3b-1b-int8-kv8-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128 BENCH_KV_QUANT=int8
+# 5. Decode attention dense vs kernel at the split-cache step (probe says
+#    dense 2.4 ms vs kernel 5.1 ms per full stack at half-full 1024).
+run r3b-1b-int8-dense BENCH_MODEL=llama-1b GOFR_TPU_FLASH_DECODE=0
+run r3b-8b-int8-kv8-dense BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_KV_QUANT=int8 GOFR_TPU_FLASH_DECODE=0

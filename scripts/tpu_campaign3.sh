@@ -1,0 +1,24 @@
+#!/bin/bash
+# Campaign 3: dense-decode sweep. Campaign 2 found GOFR_TPU_FLASH_DECODE=0
+# (one fused XLA op) beats the grid kernel at serving shapes: step
+# 6.44 -> 4.08 ms, 1931 -> 2421 tok/s. Remaining gap is the ~70 ms
+# dispatch cost per window cycle; sweep window/depth/slots to amortize it.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p campaign
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  env "$@" GOFR_TPU_FLASH_DECODE=0 BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 \
+    BENCH_TOTAL_BUDGET=900 \
+    timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
+  echo "--- rc=$? json:"; cat "campaign/$name.json"
+  tail -n 3 "campaign/$name.log"
+}
+run r3c-1b-kv8 BENCH_MODEL=llama-1b BENCH_KV_QUANT=int8
+run r3c-1b-w16 BENCH_MODEL=llama-1b BENCH_WINDOW=16
+run r3c-1b-w16-kv8 BENCH_MODEL=llama-1b BENCH_WINDOW=16 BENCH_KV_QUANT=int8
+run r3c-1b-w24d3-kv8 BENCH_MODEL=llama-1b BENCH_WINDOW=24 BENCH_DEPTH=3 BENCH_KV_QUANT=int8
+run r3c-1b-s64-kv8-w16 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128 BENCH_KV_QUANT=int8 BENCH_WINDOW=16
+run r3c-8b-kv8-s32 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
+run r3c-8b-kv8-s32-w16 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_WINDOW=16

@@ -125,6 +125,88 @@ def test_flash_decode_matches_dense(b, max_len, n_heads, n_kv, hd, lengths):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize(
+    "b,max_len,n_heads,n_kv,hd,lengths",
+    [
+        (4, 128, 4, 4, 32, [0, 7, 64, 127]),   # incl. empty prefix
+        (2, 256, 8, 2, 32, [100, 255]),        # GQA
+    ],
+)
+def test_split_decode_matches_write_then_attend(
+    b, max_len, n_heads, n_kv, hd, lengths
+):
+    """decode_attention(k_new=...) over the cache PREFIX must equal the
+    old convention (token written at lengths-1, lengths includes it) —
+    dense split vs dense written, and the kernel split path vs dense."""
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv, kn, vn_key = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (b, n_heads, hd))
+    k_cache = jax.random.normal(kk, (b, n_kv, max_len, hd))
+    v_cache = jax.random.normal(kv, (b, n_kv, max_len, hd))
+    k_new = jax.random.normal(kn, (b, n_kv, hd))
+    v_new = jax.random.normal(vn_key, (b, n_kv, hd))
+    prev = jnp.array(lengths, dtype=jnp.int32)
+
+    # Old convention: write the new token at position prev, lengths+1.
+    bi = jnp.arange(b)[:, None]
+    ki = jnp.arange(n_kv)[None, :]
+    kw = k_cache.at[bi, ki, prev[:, None]].set(k_new)
+    vw = v_cache.at[bi, ki, prev[:, None]].set(v_new)
+    want = decode_attention(kernel=False, q=q, k_cache=kw, v_cache=vw,
+                            lengths=prev + 1)
+
+    got = decode_attention(
+        q, k_cache, v_cache, prev, k_new=k_new, v_new=v_new, kernel=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+    got_kern = flash_decode(
+        q, k_cache, v_cache, prev, k_new=k_new, v_new=v_new, block_k=64,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_kern), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_split_decode_int8_cache_matches_dense(monkeypatch):
+    """The int8-cache + k_new split combination — exactly what int8-KV
+    serving runs on TPU — must match the dense split path (kernel in
+    interpret mode off-TPU)."""
+    from gofr_tpu.ops.kv_cache import quantize_kv
+
+    b, max_len, n_heads, n_kv, hd = 3, 128, 8, 2, 32
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv, kn, vn_key = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (b, n_heads, hd), jnp.bfloat16)
+    k_f = jax.random.normal(kk, (b, n_kv, max_len, hd))
+    v_f = jax.random.normal(kv, (b, n_kv, max_len, hd))
+    k_new = jax.random.normal(kn, (b, n_kv, hd), jnp.bfloat16)
+    v_new = jax.random.normal(vn_key, (b, n_kv, hd), jnp.bfloat16)
+    prev = jnp.array([0, 60, 128], dtype=jnp.int32)
+
+    kq8, ks = quantize_kv(k_f)  # scales [b, n_kv, max_len]
+    vq8, vs = quantize_kv(v_f)
+    rep8 = lambda s: jnp.broadcast_to(  # noqa: E731
+        s[:, :, None, :], (b, n_kv, 8, max_len)
+    ).astype(jnp.float32)
+    ks8, vs8 = rep8(ks), rep8(vs)
+
+    want = decode_attention(
+        q, kq8, vq8, prev, k_new=k_new, v_new=v_new, k_scale=ks8,
+        v_scale=vs8, kernel=False,
+    ).astype(jnp.float32)
+    got = flash_decode(
+        q, kq8, vq8, prev, k_new=k_new, v_new=v_new, k_scale=ks8,
+        v_scale=vs8, block_k=64, interpret=True,
+    ).astype(jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=3e-2, rtol=3e-2
+    )
+
+
 def test_dispatch_and_grad(monkeypatch):
     # Force the kernel path off-TPU (interpret mode) and check both the
     # dispatch and the dense-recompute backward pass.
