@@ -113,7 +113,7 @@ def run_with_retry() -> int:
     # Scrub every TPU-sized knob: a driver-exported 64×256-token config
     # would blow the fallback's wall clock on CPU and lose the artifact.
     for knob in ("BENCH_MODEL", "BENCH_NEW_TOKENS", "BENCH_SLOTS",
-                 "BENCH_MAX_LEN", "BENCH_QUANT"):
+                 "BENCH_MAX_LEN", "BENCH_QUANT", "BENCH_SPEC"):
         env.pop(knob, None)
     env["BENCH_REQUESTS"] = "8"
     env["BENCH_CHILD_WALL"] = "870"
@@ -235,10 +235,11 @@ def main() -> None:
     kv_quant = os.environ.get("BENCH_KV_QUANT", "")
     if kv_quant.lower() in ("none", "0"):
         kv_quant = ""
+    spec_tokens = int(os.environ.get("BENCH_SPEC", "0"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
         f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "
-        f"kv_quant={kv_quant or 'bf16'}")
+        f"kv_quant={kv_quant or 'bf16'} spec={spec_tokens}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -251,6 +252,7 @@ def main() -> None:
         pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
         quant=quant,
         kv_quant=kv_quant,
+        spec_tokens=spec_tokens,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

@@ -141,6 +141,11 @@ class Container:
         m.new_counter(
             "app_tpu_prefix_hits", "prompts admitted via prefix-KV reuse"
         )
+        m.new_histogram(
+            "app_tpu_spec_tokens_per_step",
+            "speculative decoding: tokens accepted per live step",
+            (1, 1.5, 2, 2.5, 3, 4, 5, 6, 8),
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -33,8 +33,9 @@ from gofr_tpu.ops.attention import (
     attention,
     cache_chunk_attention,
     decode_attention,
+    verify_chunk_attention,
 )
-from gofr_tpu.ops.kv_cache import KVCache, quantize_kv
+from gofr_tpu.ops.kv_cache import KVCache, fake_quantize_kv, quantize_kv
 from gofr_tpu.ops.norms import rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -484,6 +485,11 @@ def transformer_decode_step(
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
+        if cache.quantized:
+            # Attend what the cache will hold: fake-quantize the fresh
+            # K/V so the split path matches a write-then-attend int8
+            # cache bit for bit (commit re-quantizes to the same int8).
+            k, v = fake_quantize_kv(k), fake_quantize_kv(v)
         attn = decode_attention(
             q, ck, cv, positions, k_new=k, v_new=v, k_scale=cks, v_scale=cvs
         )
@@ -521,6 +527,136 @@ def transformer_decode_step(
     x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]
     logits = _wein("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
     return logits, cache
+
+
+def transformer_verify_step(
+    params: dict,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+    cfg: TransformerConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative-verify forward: ``c`` candidate tokens per slot in one
+    pass, cache READ-ONLY (rejected drafts need no rollback — the caller
+    commits only what it accepts via :func:`commit_chunk_kv`).
+
+    tokens: [S, c] — position j of slot s sits at global position
+    ``cache.lengths[s] + j``. Returns (logits [S, c, vocab] f32,
+    new_k [L, S, c, KV, hd], new_v [L, S, c, KV, hd]).
+    """
+    S, c = tokens.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    x = params["embed"][tokens]  # [S, c, D]
+    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    positions = cache.lengths[:, None] + jnp.arange(c)[None, :]  # [S, c]
+
+    def body(x, scanned):
+        lp, ck, cv, cks, cvs = scanned  # read-only cache slices
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = _wein("bcd,dh->bch", h, lp["wq"]).reshape(S, c, H, hd)
+        k = _wein("bcd,dh->bch", h, lp["wk"]).reshape(S, c, KV, hd)
+        v = _wein("bcd,dh->bch", h, lp["wv"]).reshape(S, c, KV, hd)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        if cache.quantized:
+            # Same fake-quant rule as the decode step: the in-chunk K/V
+            # must match what commit_chunk_kv will write, or spec-on
+            # output diverges from spec-off under an int8 cache.
+            k, v = fake_quantize_kv(k), fake_quantize_kv(v)
+        attn = verify_chunk_attention(
+            q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs
+        )
+        x = x + _wein("bch,hd->bcd", attn.reshape(S, c, H * hd), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+        return x + ffn, (k, v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = _wein("bcd,dv->bcv", x, params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v
+
+
+def commit_chunk_kv(
+    cache: KVCache,
+    new_k: jnp.ndarray,
+    new_v: jnp.ndarray,
+    active: jnp.ndarray,
+    cfg: TransformerConfig,
+) -> KVCache:
+    """Scatter a verify step's K/V ([L, S, c, KV, hd]) into the cache at
+    positions ``lengths + j``. ALL c positions are written — entries past
+    the accepted count sit beyond ``lengths`` (the caller advances it by
+    accepted+1 only), are never attended, and are overwritten by later
+    steps; inactive slots park at max_len-1 like the decode step.
+    ``cache.lengths`` is NOT updated here.
+    """
+    L, S, c, KV, hd = new_k.shape
+    pos = cache.lengths[:, None] + jnp.arange(c)[None, :]  # [S, c]
+    pos = jnp.where(active[:, None], pos, cache.max_len - 1)
+    pos = jnp.minimum(pos, cache.max_len - 1)
+    li = jnp.arange(L)[:, None, None, None]
+    si = jnp.arange(S)[None, :, None, None]
+    ki = jnp.arange(KV)[None, None, :, None]
+    pi = pos[None, :, None, :]  # [1, S, 1, c]
+    nk = new_k.transpose(0, 1, 3, 2, 4)  # [L, S, KV, c, hd]
+    nv = new_v.transpose(0, 1, 3, 2, 4)
+    if cache.quantized:
+        nk, k_sc = quantize_kv(nk)  # scales [L, S, KV, c]
+        nv, v_sc = quantize_kv(nv)
+        sidx = (
+            li[..., None], si[..., None], ki[..., None],
+            jnp.arange(8)[None, None, None, None, :], pi[..., None],
+        )
+        cache = cache._replace(
+            k_s=cache.k_s.at[sidx].set(k_sc[..., None]),
+            v_s=cache.v_s.at[sidx].set(v_sc[..., None]),
+        )
+    return cache._replace(
+        k=cache.k.at[li, si, ki, pi].set(nk.astype(cache.k.dtype)),
+        v=cache.v.at[li, si, ki, pi].set(nv.astype(cache.v.dtype)),
+    )
+
+
+def ngram_draft(
+    history: jnp.ndarray,
+    lengths: jnp.ndarray,
+    current: jnp.ndarray,
+    n_draft: int,
+) -> jnp.ndarray:
+    """Prompt-lookup drafting: continue the most recent prior occurrence
+    of the current context in the slot's own token history.
+
+    history: [S, max_len] int32 (prompt + generated tokens; entries past
+    lengths+1 are stale); lengths: [S] tokens in history BEFORE current;
+    current: [S] the token about to be fed to the model (already at
+    history[lengths]). Matches the bigram (history[p-1], history[p]) ==
+    (previous, current) — falling back to a unigram match when the
+    context has fewer than 2 tokens — and drafts
+    ``history[p+1 : p+1+n_draft]``. No match → repeats ``current``
+    (cheap, will simply be rejected). Returns [S, n_draft] int32.
+    """
+    S, T = history.shape
+    pos = jnp.arange(T)[None, :]  # [1, T]
+    prev_idx = jnp.maximum(lengths - 1, 0)
+    prev = jnp.take_along_axis(history, prev_idx[:, None], axis=1)[:, 0]
+    hist_prev = jnp.concatenate(
+        [jnp.zeros((S, 1), history.dtype), history[:, :-1]], axis=1
+    )
+    m1 = history == current[:, None]
+    m2 = m1 & (hist_prev == prev[:, None])
+    use_bigram = (lengths >= 2)[:, None]
+    match = jnp.where(use_bigram, m2, m1)
+    # Only positions strictly before the current token's slot qualify.
+    match = match & (pos < lengths[:, None])
+    p_star = jnp.max(jnp.where(match, pos, -1), axis=1)  # [S]
+    found = p_star >= 0
+    gidx = jnp.clip(
+        p_star[:, None] + 1 + jnp.arange(n_draft)[None, :], 0, T - 1
+    )
+    draft = jnp.take_along_axis(history, gidx, axis=1)
+    return jnp.where(found[:, None], draft, current[:, None])
 
 
 def count_params(params: dict) -> int:

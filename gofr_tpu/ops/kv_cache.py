@@ -102,3 +102,15 @@ def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
         jnp.round(xf / scale[..., None]), -127, 127
     ).astype(jnp.int8)
     return q, scale
+
+
+def fake_quantize_kv(x: jnp.ndarray) -> jnp.ndarray:
+    """Quantize → dequantize (same dtype out). The split decode/verify
+    paths attend a token's K/V BEFORE it is committed to an int8 cache;
+    running the fresh values through the quantizer first makes what is
+    attended bit-identical to what later steps will read back — and
+    re-quantizing the result at commit time reproduces the same int8
+    (the max element maps to exactly ±127, so the absmax scale is a
+    fixed point)."""
+    q, scale = quantize_kv(x)
+    return (q.astype(jnp.float32) * scale[..., None]).astype(x.dtype)

@@ -122,9 +122,10 @@ class InferenceEngine:
         window_k: int = 8,
         pipeline_depth: int = 2,
         prefill_chunk: int = 256,
-        prefill_batch: int = 4,
+        prefill_batch: int = 8,
         truncate_prompts: bool = False,
         top_k: int = 0,
+        spec_tokens: int = 0,
         mesh=None,
         quant: str = "",
         kv_quant: str = "",
@@ -213,13 +214,19 @@ class InferenceEngine:
             self.prefill_chunk = max(16, min(prefill_chunk, self.max_len))
             self.prefill_batch = max(1, min(prefill_batch, n_slots))
             self.truncate_prompts = truncate_prompts
-            reserve = 1 + (self.pipeline_depth + 1) * self.window_k
+            # Speculative decoding (n-gram prompt lookup): each device step
+            # verifies spec_tokens drafts + 1, so windows can emit up to
+            # window_k * (spec_tokens+1) tokens per slot.
+            self.spec_tokens = max(0, spec_tokens)
+            step_tokens = self.window_k * (self.spec_tokens + 1)
+            reserve = 1 + (self.pipeline_depth + 1) * step_tokens
             if self.max_len <= reserve:
                 raise ValueError(
                     f"max_len={self.max_len} too small: need > {reserve} "
-                    f"(1 + (pipeline_depth+1)*window_k) so admission can "
-                    f"reserve pipelined-window overshoot room; lower "
-                    f"window_k/pipeline_depth or raise max_len"
+                    f"(1 + (pipeline_depth+1)*window_k*(spec_tokens+1)) so "
+                    f"admission can reserve pipelined-window overshoot "
+                    f"room; lower window_k/pipeline_depth/spec_tokens or "
+                    f"raise max_len"
                 )
             self.kv_quant = (kv_quant or "").lower()
             make_cache = lambda: KVCache.create(  # noqa: E731
@@ -266,6 +273,12 @@ class InferenceEngine:
             self._temps_dev = jnp.ones((n_slots,), dtype=jnp.float32)
             self._greedy_dev = jnp.ones((n_slots,), dtype=bool)
             self._slot_state_dirty = True
+            # Token history per slot (prompt + generated) — the n-gram
+            # draft source; only maintained when speculation is on.
+            self._history_dev = (
+                jnp.zeros((n_slots, self.max_len), dtype=jnp.int32)
+                if self.spec_tokens else None
+            )
             self._build_llm_steps()
         elif self.family == "encoder":
             self.max_len = min(max_len, self.cfg.max_len)
@@ -338,11 +351,12 @@ class InferenceEngine:
             kv_quant=config.get_or_default("TPU_KV_QUANT", ""),
             prefix_slots=int(config.get_or_default("TPU_PREFIX_SLOTS", "0")),
             prefill_chunk=int(config.get_or_default("TPU_PREFILL_CHUNK", "256")),
-            prefill_batch=int(config.get_or_default("TPU_PREFILL_BATCH", "4")),
+            prefill_batch=int(config.get_or_default("TPU_PREFILL_BATCH", "8")),
             truncate_prompts=config.get_or_default(
                 "TPU_TRUNCATE_PROMPTS", "false"
             ).lower() in ("1", "true", "yes"),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
+            spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             logger=logger,
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
@@ -428,8 +442,7 @@ class InferenceEngine:
             logp = jnp.take_along_axis(logp_all, chosen[:, None], axis=-1)[:, 0]
             return chosen, logp
 
-        @partial(jax.jit, donate_argnums=(1, 10, 11, 12))
-        def prefill_chunk_step(
+        def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, key, all_tokens, all_logps,
         ):
@@ -456,6 +469,29 @@ class InferenceEngine:
                 lengths=jnp.where(has, (starts + lens)[idx], cache.lengths)
             )
             return cache, all_tokens, all_logps, first, first_lp, key
+
+        prefill_chunk_step = partial(
+            jax.jit, donate_argnums=(1, 10, 11, 12)
+        )(_prefill_core)
+
+        @partial(jax.jit, donate_argnums=(1, 10, 11, 12, 13))
+        def prefill_chunk_step_hist(
+            params, cache, tokens, slots, starts, lens, finalize, row_valid,
+            temps, greedy, key, all_tokens, all_logps, history,
+        ):
+            """Prefill + record the chunk's tokens into the draft history
+            (speculation on). Padding rows duplicate row 0 — idempotent."""
+            out = _prefill_core(
+                params, cache, tokens, slots, starts, lens, finalize,
+                row_valid, temps, greedy, key, all_tokens, all_logps,
+            )
+            c = tokens.shape[1]
+            hpos = jnp.clip(
+                starts[:, None] + jnp.arange(c)[None, :], 0,
+                history.shape[1] - 1,
+            )
+            history = history.at[slots[:, None], hpos].set(tokens)
+            return out + (history,)
 
         @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5))
         def decode_window(params, tokens, logps, cache, active, key, temps,
@@ -485,8 +521,103 @@ class InferenceEngine:
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
             return emitted, final, final_lp, cache, key
 
+        G = self.spec_tokens
+
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 8))
+        def spec_window(params, tokens, logps, cache, active, key, temps,
+                        greedy, history, k):
+            """k speculative steps on device. Each step drafts G tokens by
+            n-gram lookup in the slot's own history, verifies draft+current
+            in ONE [S, G+1] forward (cache read-only), accepts the longest
+            matching prefix (greedy slots — lossless by construction;
+            sampled slots take 0 drafts and resample position 0), commits
+            all layers' K/V in one scatter, and carries the bonus token.
+            Emits per step: tokens [S, G+1] (= the step's inputs), logps,
+            and counts [S] (=accepted+1 valid entries)."""
+            from gofr_tpu.models.transformer import (
+                commit_chunk_kv,
+                ngram_draft,
+                transformer_verify_step,
+            )
+
+            def body(carry, _):
+                tokens, logps, cache, key, history = carry
+                key, sub = jax.random.split(key)
+                draft = ngram_draft(history, cache.lengths, tokens, G)
+                inputs = jnp.concatenate([tokens[:, None], draft], axis=1)
+                logits, nk, nv = transformer_verify_step(
+                    params, inputs, cache, cfg
+                )
+                greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                samp0, samp0_lp = sample(logits[:, 0], sub, temps, greedy)
+                match = draft == greedy_next[:, :G]
+                acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+                acc = jnp.where(greedy, acc, 0)  # sampled slots: no drafts
+                bonus_g = jnp.take_along_axis(
+                    greedy_next, acc[:, None], axis=1
+                )[:, 0]
+                bonus = jnp.where(greedy, bonus_g, samp0)
+                logp_all = jax.nn.log_softmax(logits, axis=-1)
+                draft_lp = jnp.take_along_axis(
+                    logp_all[:, :G], draft[..., None], axis=2
+                )[..., 0]  # [S, G]
+                pos_lp = jnp.take_along_axis(
+                    logp_all, acc[:, None, None], axis=1
+                )[:, 0]  # [S, V] — distribution at the bonus position
+                bonus_lp = jnp.where(
+                    greedy,
+                    jnp.take_along_axis(pos_lp, bonus_g[:, None], axis=1)[:, 0],
+                    samp0_lp,
+                )
+                counts = jnp.where(active, acc + 1, 0)
+                step_tokens = inputs  # [S, G+1]; first `counts` are emitted
+                step_logps = jnp.concatenate(
+                    [logps[:, None], draft_lp], axis=1
+                )
+                cache = commit_chunk_kv(cache, nk, nv, active, cfg)
+                # History: current+accepted drafts at len..len+acc, bonus at
+                # len+counts — the invariant "current token sits at
+                # history[lengths]" holds into the next step. Rejected
+                # drafts and inactive slots park at max_len-1 (XLA scatter
+                # is nondeterministic on duplicate indices, so the rejected
+                # entries must not share a position with the bonus write;
+                # history[max_len-1] garbage only ever wastes a draft).
+                S2, T = history.shape
+                hvals = jnp.concatenate([inputs, bonus[:, None]], axis=1)
+                hpos = cache.lengths[:, None] + jnp.arange(G + 2)[None, :]
+                hpos = hpos.at[:, G + 1].set(cache.lengths + counts)
+                keep = jnp.concatenate(
+                    [
+                        jnp.arange(G + 1)[None, :] <= acc[:, None],
+                        jnp.ones((S2, 1), dtype=bool),
+                    ],
+                    axis=1,
+                )
+                keep = keep & active[:, None]
+                hpos = jnp.where(keep, jnp.minimum(hpos, T - 1), T - 1)
+                history = history.at[
+                    jnp.arange(S2)[:, None], hpos
+                ].set(hvals)
+                cache = cache._replace(lengths=cache.lengths + counts)
+                return (
+                    (bonus, bonus_lp, cache, key, history),
+                    (step_tokens, step_logps, counts),
+                )
+
+            (final, final_lp, cache, key, history), (etoks, elps, ecnt) = (
+                jax.lax.scan(
+                    body, (tokens, logps, cache, key, history), length=k
+                )
+            )
+            emitted = jnp.stack(
+                [etoks.astype(jnp.float32), elps]
+            )  # [2, k, S, G+1]
+            return emitted, ecnt, final, final_lp, cache, key, history
+
         self._prefill_chunk_step = prefill_chunk_step
+        self._prefill_chunk_step_hist = prefill_chunk_step_hist
         self._decode_window = decode_window
+        self._spec_window = spec_window
 
     def _build_encoder_step(self) -> None:
         from gofr_tpu.models.bert import bert_embed
@@ -613,21 +744,25 @@ class InferenceEngine:
         # (D=1) tok/s/chip and beyond; the floor becomes device step time.
         from collections import deque
 
-        inflight: deque = deque()  # (emitted_dev, slots_snapshot, t_dispatch)
+        inflight: deque = deque()  # (emitted_dev, counts_dev|None, snapshot, t)
         try:
             while self._running:
                 # One chunk step per iteration, interleaved 1:1 with decode
                 # windows: a long prompt's prefill proceeds in bounded slices
                 # and never freezes active token streams (VERDICT r1 #9).
                 progressed = self._dispatch_prefill_chunk()
-                # Wave admission: on a cold start or a retirement wave (zero
-                # live streams) the 1:1 interleave would refill capacity one
-                # chunk per window — ~8 windows of a mostly-idle device.
-                # With nobody decoding there is no latency to protect, so
-                # drain the whole prefill backlog back-to-back instead.
+                # Wave admission: on a cold start or a retirement wave the
+                # 1:1 interleave would refill capacity one chunk per window
+                # — at 64 slots that is ~15 windows of a mostly-idle device
+                # (measured: the 64-slot bench lost ~2 s per wave to it).
+                # While live streams fill under a quarter of the slots, the
+                # marginal inter-token latency of another ~1-4 ms chunk step
+                # is noise next to the idle capacity, so keep draining; past
+                # that, protect the live streams' latency (1:1 again).
                 if progressed:
                     while (
-                        not any(s is not None for s in self._slots)
+                        sum(1 for s in self._slots if s is not None) * 4
+                        < self.n_slots
                         and self._dispatch_prefill_chunk()
                     ):
                         pass
@@ -671,7 +806,7 @@ class InferenceEngine:
         # interpreter teardown (observed as a runtime-client thread panic
         # at exit).
         while inflight:
-            emitted, _, _ = inflight.popleft()
+            emitted, _, _, _ = inflight.popleft()
             try:
                 np.asarray(emitted)
             except Exception:  # noqa: BLE001 — device may already be down
@@ -719,6 +854,7 @@ class InferenceEngine:
             room = (
                 self.max_len - 1 - len(req.prompt_ids)
                 - (self.pipeline_depth + 1) * self.window_k
+                * (self.spec_tokens + 1)
             )
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
             slot = free.pop(0)
@@ -773,16 +909,21 @@ class InferenceEngine:
 
         jnp = self._jnp
         t0 = time.time()
-        (self.cache, self._tokens_dev, self._logps_dev, first_dev, first_lp_dev,
-         self._key_dev) = (
-            self._prefill_chunk_step(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
-                jnp.asarray(finalize), jnp.asarray(row_valid),
-                jnp.asarray(temps), jnp.asarray(greedy),
-                self._key_dev, self._tokens_dev, self._logps_dev,
-            )
+        args = (
+            self.params, self.cache, jnp.asarray(tokens),
+            jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
+            jnp.asarray(finalize), jnp.asarray(row_valid),
+            jnp.asarray(temps), jnp.asarray(greedy),
+            self._key_dev, self._tokens_dev, self._logps_dev,
         )
+        if self.spec_tokens:
+            (self.cache, self._tokens_dev, self._logps_dev, first_dev,
+             first_lp_dev, self._key_dev, self._history_dev) = (
+                self._prefill_chunk_step_hist(*args, self._history_dev)
+            )
+        else:
+            (self.cache, self._tokens_dev, self._logps_dev, first_dev,
+             first_lp_dev, self._key_dev) = self._prefill_chunk_step(*args)
         if self._metrics is not None:
             self._metrics.record_histogram(
                 "app_tpu_infer_latency", time.time() - t0, "kind", "prefill"
@@ -869,10 +1010,12 @@ class InferenceEngine:
 
     def _dispatch_window(self):
         """Dispatch one k-step device window (non-blocking) and start the
-        async device→host copy of its [k, S] token block. Returns
-        ``(emitted_dev, slots_snapshot, t_dispatch)`` for _process_window —
-        the snapshot matters because by processing time a retired slot may
-        already hold a NEW request admitted in between."""
+        async device→host copy of its emitted block — [2, k, S] for plain
+        decode, [2, k, S, G+1] plus a [k, S] counts array for speculative
+        windows. Returns ``(emitted_dev, counts_dev_or_None,
+        slots_snapshot, t_dispatch)`` for _process_window — the snapshot
+        matters because by processing time a retired slot may already hold
+        a NEW request admitted in between."""
         jnp = self._jnp
         if self._slot_state_dirty:
             # Slot composition changed since the last window: re-upload the
@@ -892,20 +1035,34 @@ class InferenceEngine:
             self._slot_state_dirty = False
 
         t0 = time.time()
-        emitted, self._tokens_dev, self._logps_dev, self.cache, self._key_dev = (
-            self._decode_window(
-                self.params, self._tokens_dev, self._logps_dev, self.cache,
-                self._active_dev, self._key_dev, self._temps_dev,
-                self._greedy_dev, k=self.window_k,
+        counts = None
+        if self.spec_tokens:
+            (emitted, counts, self._tokens_dev, self._logps_dev, self.cache,
+             self._key_dev, self._history_dev) = (
+                self._spec_window(
+                    self.params, self._tokens_dev, self._logps_dev,
+                    self.cache, self._active_dev, self._key_dev,
+                    self._temps_dev, self._greedy_dev, self._history_dev,
+                    k=self.window_k,
+                )
             )
-        )
-        try:
-            emitted.copy_to_host_async()
-        except AttributeError:  # older jax / fake backends
-            pass
-        return emitted, list(self._slots), t0
+        else:
+            (emitted, self._tokens_dev, self._logps_dev, self.cache,
+             self._key_dev) = (
+                self._decode_window(
+                    self.params, self._tokens_dev, self._logps_dev,
+                    self.cache, self._active_dev, self._key_dev,
+                    self._temps_dev, self._greedy_dev, k=self.window_k,
+                )
+            )
+        for arr in (emitted, counts) if counts is not None else (emitted,):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:  # older jax / fake backends
+                pass
+        return emitted, counts, list(self._slots), t0
 
-    def _process_window(self, emitted, snapshot, t0) -> None:
+    def _process_window(self, emitted, counts, snapshot, t0) -> None:
         t_fetch = time.time()
         # Interruptible wait: while this window's block is in flight, flush
         # any prefill first-token fetches that land first (unloaded TTFT
@@ -917,7 +1074,9 @@ class InferenceEngine:
                     time.sleep(0.001)
             except AttributeError:
                 pass
-        emitted_host = np.asarray(emitted)  # [k, S] — the one roundtrip
+        # Decode: [2, k, S]. Spec: [2, k, S, G+1] + counts [k, S].
+        emitted_host = np.asarray(emitted)
+        counts_host = np.asarray(counts) if counts is not None else None
         if self._metrics is not None:
             # decode_fetch = host-blocking time (what pipelining hides);
             # decode_window_pipeline = dispatch→processed incl. D windows
@@ -947,22 +1106,50 @@ class InferenceEngine:
             if seq.request.ttft_s == 0.0:
                 seq.request.ttft_s = now - seq.request.enqueued_at
                 seq.first_token_at = now
-            for step in range(self.window_k):
-                if seq.first_emitted and not seq.first_skip_done:
-                    # This position repeats the prefill-sampled token that
-                    # _flush_prefill_emits already emitted.
-                    seq.first_skip_done = True
-                    continue
-                tok = int(emitted_host[0, step, i])
-                seq.last_token = tok
-                seq.n_generated += 1
-                self._emit_token(seq, tok, float(emitted_host[1, step, i]))
-                if self._finished(seq):
-                    self._retire(i, seq)
-                    if self._slots[i] is seq:
-                        self._slots[i] = None
-                        self._slot_state_dirty = True
+            if counts_host is None:
+                step_toks = (
+                    ((emitted_host[0, step, i], emitted_host[1, step, i]),)
+                    for step in range(self.window_k)
+                )
+            else:
+                step_toks = (
+                    tuple(
+                        (emitted_host[0, step, i, j], emitted_host[1, step, i, j])
+                        for j in range(int(counts_host[step, i]))
+                    )
+                    for step in range(self.window_k)
+                )
+            done = False
+            for toks in step_toks:
+                for tok_f, lp in toks:
+                    if seq.first_emitted and not seq.first_skip_done:
+                        # This position repeats the prefill-sampled token
+                        # that _flush_prefill_emits already emitted.
+                        seq.first_skip_done = True
+                        continue
+                    tok = int(tok_f)
+                    seq.last_token = tok
+                    seq.n_generated += 1
+                    self._emit_token(seq, tok, float(lp))
+                    if self._finished(seq):
+                        self._retire(i, seq)
+                        if self._slots[i] is seq:
+                            self._slots[i] = None
+                            self._slot_state_dirty = True
+                        done = True
+                        break
+                if done:
                     break
+        if counts_host is not None and self._metrics is not None:
+            # Acceptance observability: tokens-per-live-step across the
+            # window (1.0 = no draft accepted, spec_tokens+1 = all).
+            live = counts_host > 0
+            if live.any():
+                self._metrics.record_histogram(
+                    "app_tpu_spec_tokens_per_step",
+                    float(counts_host[live].mean()),
+                    "model", self.model_name,
+                )
         self._update_slot_gauges()
 
     def _emit_token(self, seq: _ActiveSeq, tok: int, logprob: float) -> None:

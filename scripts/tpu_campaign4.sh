@@ -1,0 +1,25 @@
+#!/bin/bash
+# Campaign 4: speculative decoding (n-gram, greedy-lossless) + retest of
+# 64-slot scaling with the quarter-capacity admission drain.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p campaign
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  env "$@" GOFR_TPU_FLASH_DECODE=0 BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 \
+    BENCH_TOTAL_BUDGET=900 \
+    timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
+  echo "--- rc=$? json:"; cat "campaign/$name.json"
+  tail -n 3 "campaign/$name.log"
+}
+# 1. Speculation on the headline config. NOTE: random-weight greedy output
+#    loops, which flatters n-gram acceptance — report as a labeled row,
+#    never as the headline number.
+run r3d-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3
+run r3d-8b-spec3 BENCH_MODEL=llama-3-8b BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_SPEC=3
+# 2. 64-slot retest (quarter-capacity drain + prefill_batch 8).
+run r3d-1b-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128
+# 3. Headline re-run for the drain/prefill-batch deltas.
+run r3d-1b BENCH_MODEL=llama-1b
+run r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8

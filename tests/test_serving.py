@@ -142,6 +142,106 @@ def test_greedy_matches_cache_free_rollout(llm_engine):
     assert r.token_ids == seq[-n_new:]
 
 
+@pytest.fixture(scope="module")
+def f32_plain_engine():
+    # f32: exact greedy equality between the spec engine's [S, G+1] verify
+    # forward and the plain [S] decode forward — bf16 argmax tie-breaks
+    # differ between those execution shapes (expected; greedy sampling is
+    # not bitwise stable across batch shapes in half precision).
+    eng = InferenceEngine(
+        "llama-tiny-f32", n_slots=4, max_len=256, tokenizer=ByteTokenizer()
+    )
+    eng.start_sync()
+    yield eng
+    eng.stop_sync()
+
+
+def test_speculative_decoding_lossless_greedy(f32_plain_engine):
+    """Greedy generation with n-gram speculation must produce EXACTLY the
+    tokens of plain greedy decode (acceptance is by exact match), for
+    several concurrent requests; sampled-temperature requests still
+    complete in the same batch (they take no drafts)."""
+    spec = InferenceEngine(
+        "llama-tiny-f32", n_slots=4, max_len=256, tokenizer=ByteTokenizer(),
+        spec_tokens=3,
+    )
+    spec.start_sync()
+    try:
+        prompts = ["hello world", "abab abab abab", "the cat sat on"]
+        want = [
+            f32_plain_engine.generate_sync(
+                p, max_new_tokens=12, temperature=0.0, stop_on_eos=False
+            ).token_ids
+            for p in prompts
+        ]
+        reqs = [
+            spec.submit_generate(
+                p, max_new_tokens=12, temperature=0.0, stop_on_eos=False
+            )
+            for p in prompts
+        ]
+        noise = spec.submit_generate(
+            "noise", max_new_tokens=8, temperature=0.9, stop_on_eos=False
+        )
+        got = [r.future.result(timeout=120).token_ids for r in reqs]
+        assert got == want
+        assert len(noise.future.result(timeout=120).token_ids) == 8
+    finally:
+        spec.stop_sync()
+
+
+def test_speculative_decoding_lossless_int8_kv():
+    """Spec-on == spec-off under an int8 KV cache too: the verify path
+    fake-quantizes in-chunk K/V so it attends exactly what commit writes
+    (f32 weights so argmax ties can't flip between execution shapes)."""
+    results = []
+    for spec_tokens in (0, 3):
+        eng = InferenceEngine(
+            "llama-tiny-f32", n_slots=2, max_len=256,
+            tokenizer=ByteTokenizer(), kv_quant="int8",
+            spec_tokens=spec_tokens,
+        )
+        eng.start_sync()
+        try:
+            results.append(
+                eng.generate_sync(
+                    "quantized spec", max_new_tokens=14, temperature=0.0,
+                    stop_on_eos=False,
+                ).token_ids
+            )
+        finally:
+            eng.stop_sync()
+    assert results[0] == results[1]
+
+
+def test_spec_streaming_order(f32_plain_engine):
+    """Streaming through the spec engine yields the same token order as
+    the non-spec engine's result."""
+    spec = InferenceEngine(
+        "llama-tiny-f32", n_slots=2, max_len=256, tokenizer=ByteTokenizer(),
+        spec_tokens=2,
+    )
+    spec.start_sync()
+    try:
+        want = f32_plain_engine.generate_sync(
+            "stream spec", max_new_tokens=9, temperature=0.0,
+            stop_on_eos=False,
+        ).token_ids
+
+        async def run():
+            toks = []
+            async for tok in spec.generate_stream(
+                "stream spec", max_new_tokens=9, temperature=0.0,
+                stop_on_eos=False,
+            ):
+                toks.append(tok)
+            return toks
+
+        assert asyncio.run(run()) == want
+    finally:
+        spec.stop_sync()
+
+
 def test_llm_health(llm_engine):
     h = llm_engine.health_check()
     assert h["status"] == "UP"
