@@ -236,15 +236,17 @@ class App:
             await self._grpc_server.start()
             self.grpc_port = self._grpc_server.port
 
-        if self.container.tpu is not None and hasattr(self.container.tpu, "start"):
-            await self.container.tpu.start()
+        for engine in (self.container.tpu, self.container.tpu_embed):
+            if engine is not None and hasattr(engine, "start"):
+                await engine.start()
 
         self._subscriptions.start()
 
     async def stop(self) -> None:
         await self._subscriptions.stop()
-        if self.container.tpu is not None and hasattr(self.container.tpu, "stop"):
-            await self.container.tpu.stop()
+        for engine in (self.container.tpu, self.container.tpu_embed):
+            if engine is not None and hasattr(engine, "stop"):
+                await engine.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop()
         for server in (self._http_server, self._metrics_server):

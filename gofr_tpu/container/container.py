@@ -33,6 +33,7 @@ class Container:
         self.pubsub = None
         self.mongo = None  # injected seam (reference datasource/mongo.go:8)
         self.tpu = None  # net-new: TPU inference backend (SURVEY §2.6)
+        self.tpu_embed = None  # secondary encoder engine (TPU_EMBED_MODEL)
         self.services: dict[str, Any] = {}  # name → service.HTTP clients
 
         self._remote_logger: Optional[RemoteLevelLogger] = None
@@ -71,6 +72,10 @@ class Container:
         from gofr_tpu.serving.backend import new_tpu_from_config
 
         c.tpu = new_tpu_from_config(config, c.logger, c.metrics)
+
+        from gofr_tpu.serving.backend import new_tpu_embed_from_config
+
+        c.tpu_embed = new_tpu_embed_from_config(config, c.logger, c.metrics)
         return c
 
     def use_mongo(self, client) -> None:
@@ -171,7 +176,7 @@ class Container:
             "startedAt": getattr(self, "_started_at", ""),
         }
         details: dict[str, Any] = {}
-        for name in ("sql", "redis", "pubsub", "tpu", "mongo"):
+        for name in ("sql", "redis", "pubsub", "tpu", "tpu_embed", "mongo"):
             ds = getattr(self, name)
             if ds is None or not hasattr(ds, "health_check"):
                 # health_check is opt-in for injected clients (use_mongo /
@@ -200,7 +205,7 @@ class Container:
         self._started_at = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
     async def close(self) -> None:
-        for name in ("sql", "redis", "pubsub", "tpu", "mongo"):
+        for name in ("sql", "redis", "pubsub", "tpu", "tpu_embed", "mongo"):
             ds = getattr(self, name)
             if ds is not None and hasattr(ds, "close"):
                 try:

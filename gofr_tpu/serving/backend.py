@@ -26,3 +26,76 @@ def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
         if logger is not None:
             logger.errorf("could not initialise TPU backend: %s", exc)
         return None
+
+
+def new_tpu_embed_from_config(
+    config, logger=None, metrics=None
+) -> Optional[object]:
+    """Secondary encoder engine (``TPU_EMBED_MODEL``) so one app can serve
+    chat from the primary engine AND /v1/embeddings from an encoder —
+    the same config-gated datasource idiom as the primary."""
+    model = config.get_or_default("TPU_EMBED_MODEL", "")
+    if not model:
+        return None
+    from gofr_tpu.models.registry import get_model
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer, tokenizer_from_config
+
+    try:
+        spec = get_model(model)
+        if spec.family != "encoder":
+            raise ValueError(
+                f"TPU_EMBED_MODEL={model!r} is family {spec.family!r}, "
+                f"need an encoder (e.g. bert-base)"
+            )
+        # The encoder needs its OWN vocabulary — the chat model's
+        # TPU_TOKENIZER would feed llama-range ids into the BERT
+        # embedding table (XLA clamps the gather silently → garbage).
+        tok_path = config.get_or_default("TPU_EMBED_TOKENIZER", "")
+        if tok_path:
+            tok_config = _Overlay(config, {"TPU_TOKENIZER": tok_path})
+            tokenizer = tokenizer_from_config(tok_config, logger)
+        else:
+            tokenizer = ByteTokenizer()
+        engine = InferenceEngine(
+            model,
+            max_batch=int(config.get_or_default("TPU_MAX_BATCH", "8")),
+            max_wait_s=float(
+                config.get_or_default("TPU_BATCH_WAIT_MS", "5")
+            ) / 1e3,
+            max_len=int(config.get_or_default("TPU_MAX_LEN", "1024")),
+            logger=logger,
+            metrics=metrics,
+            tokenizer=tokenizer,
+        )
+        ckpt = config.get_or_default("TPU_EMBED_CHECKPOINT", "")
+        if ckpt:
+            from gofr_tpu.serving.checkpoint import restore_checkpoint
+
+            engine.params = restore_checkpoint(ckpt, like=engine.params)
+            if logger is not None:
+                logger.infof("restored embed params from %s", ckpt)
+        if logger is not None:
+            logger.infof("TPU embed backend initialised with model %s", model)
+        return engine
+    except Exception as exc:
+        if logger is not None:
+            logger.errorf("could not initialise TPU embed backend: %s", exc)
+        return None
+
+
+class _Overlay:
+    """Config view with a few keys overridden (keeps the Config protocol)."""
+
+    def __init__(self, base, overrides: dict) -> None:
+        self._base, self._overrides = base, overrides
+
+    def get(self, key: str):
+        if key in self._overrides:
+            return self._overrides[key]
+        return self._base.get(key)
+
+    def get_or_default(self, key: str, default: str):
+        if key in self._overrides:
+            return self._overrides[key]
+        return self._base.get_or_default(key, default)

@@ -371,11 +371,61 @@ def add_openai_routes(
             ),
         }, status=200)
 
+    @app.post("/v1/embeddings")
+    async def embeddings(ctx):  # noqa: ANN001
+        """OpenAI embeddings: served by the secondary encoder engine
+        (``TPU_EMBED_MODEL``), or by the primary when it IS an encoder."""
+        engine = getattr(ctx.container, "tpu_embed", None)
+        if engine is None:
+            primary = getattr(ctx.container, "tpu", None)
+            if primary is not None and primary.family == "encoder":
+                engine = primary
+        if engine is None:
+            raise OpenAIRequestError(
+                "no encoder engine configured (set TPU_EMBED_MODEL, or "
+                "TPU_MODEL to an encoder like bert-base)"
+            )
+        body = _completion_body(ctx.request.raw.body)
+        inputs = body.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if (
+            not isinstance(inputs, list) or not inputs
+            or not all(isinstance(t, str) for t in inputs)
+        ):
+            raise OpenAIRequestError(
+                "input must be a string or a non-empty list of strings"
+            )
+        vecs = await asyncio.gather(*(engine.embed(t) for t in inputs))
+        data = [
+            {
+                "object": "embedding",
+                "embedding": [float(x) for x in v],
+                "index": i,
+            }
+            for i, v in enumerate(vecs)
+        ]
+        n_tokens = sum(
+            min(len(engine.tokenizer.encode(t)), engine.max_len)
+            if engine.tokenizer else 0
+            for t in inputs
+        )
+        return Raw({
+            "object": "list",
+            "data": data,
+            "model": body.get("model", engine.model_name),
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+        }, status=200)  # OpenAI wire-compat: POST answers 200
+
     @app.get("/v1/models")
     async def models(ctx):  # noqa: ANN001
         from gofr_tpu.models.registry import list_models
 
         engine: Any = getattr(ctx.container, "tpu", None)
+        embedder: Any = getattr(ctx.container, "tpu_embed", None)
+        loaded = {
+            e.model_name for e in (engine, embedder) if e is not None
+        }
         return Raw({
             "object": "list",
             "data": [
@@ -383,7 +433,7 @@ def add_openai_routes(
                     "id": name,
                     "object": "model",
                     "owned_by": "gofr-tpu",
-                    "loaded": engine is not None and engine.model_name == name,
+                    "loaded": name in loaded,
                 }
                 for name in list_models()
             ],

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Wait for the axon relay to come back, then run the pending TPU work:
+# campaign 4 (spec + s64 retest + headline re-runs) and the dispatch-cost
+# probe. Probe cadence 5 min; each probe is timeout-guarded because a
+# wedged relay HANGS jax.devices() rather than failing it.
+set -u
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "relay up at $(date)"
+    bash scripts/tpu_campaign4.sh
+    PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
+      python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
+    exit 0
+  fi
+  echo "relay down at $(date)"
+  sleep 300
+done

@@ -168,6 +168,24 @@ def main() -> None:
     params_bf16 = jax.jit(lambda k: spec.init(k, cfg))(jax.random.PRNGKey(0))
     print(f"probe: bf16 params in {time.time() - t0:.1f}s", flush=True)
     probe("full bf16", full, params_bf16, tokens, cache)
+    # Dispatch-cost probe (BEFORE the int4 quantize donates params_bf16):
+    # how long does ONE jit call hold the host thread (async dispatch
+    # return — NOT device completion)? The serving scheduler issues one
+    # window call per cycle; if the relay charges a full RTT per
+    # dispatch, the cycle floor is that RTT regardless of pipeline depth,
+    # and overlapping dispatch with processing in separate threads is
+    # the fix.
+    for burst in (1, 4):
+        t0 = time.perf_counter()
+        outs = [full(params_bf16, tokens, cache) for _ in range(burst)]
+        t_disp = (time.perf_counter() - t0) / burst * 1e3
+        jax.block_until_ready(outs[-1])
+        t_total = (time.perf_counter() - t0) * 1e3
+        print(
+            f"probe: dispatch burst={burst}: {t_disp:.1f} ms/call host-"
+            f"blocked, {t_total:.1f} ms to completion",
+            flush=True,
+        )
     params4 = jax.jit(
         partial(quantize_params, mode="int4"), donate_argnums=(0,)
     )(params_bf16)

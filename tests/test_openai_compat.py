@@ -350,3 +350,46 @@ def test_chat_uses_tokenizer_template_when_available():
     finally:
         aio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
         eng.stop_sync()
+
+
+def test_embeddings_endpoint_with_secondary_encoder():
+    """TPU_EMBED_MODEL wires a second (encoder) engine into the container;
+    /v1/embeddings serves from it while the primary llm serves chat, and
+    /v1/models marks both loaded."""
+    app = App(config=MockConfig({
+        "APP_NAME": "embed-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "128",
+        "TPU_EMBED_MODEL": "bert-tiny",
+    }))
+    add_openai_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=60)
+    try:
+        assert app.container.tpu_embed is not None
+        c = _conn(app)
+        c.request("POST", "/v1/embeddings", body=json.dumps({
+            "input": ["the cat sat", "on the mat"],
+        }))
+        r = c.getresponse()
+        assert r.status == 200
+        body = json.loads(r.read())
+        assert body["object"] == "list"
+        assert [d["index"] for d in body["data"]] == [0, 1]
+        dims = {len(d["embedding"]) for d in body["data"]}
+        assert len(dims) == 1 and dims.pop() > 0
+        assert body["usage"]["prompt_tokens"] > 0
+
+        c = _conn(app)
+        c.request("GET", "/v1/models")
+        models = json.loads(c.getresponse().read())["data"]
+        loaded = {m["id"] for m in models if m["loaded"]}
+        assert loaded == {"llama-tiny", "bert-tiny"}
+
+        # Bad input shape → OpenAI-style 400.
+        c = _conn(app)
+        c.request("POST", "/v1/embeddings", body=json.dumps({"input": []}))
+        assert c.getresponse().status == 400
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
