@@ -113,7 +113,8 @@ def run_with_retry() -> int:
     # Scrub every TPU-sized knob: a driver-exported 64×256-token config
     # would blow the fallback's wall clock on CPU and lose the artifact.
     for knob in ("BENCH_MODEL", "BENCH_NEW_TOKENS", "BENCH_SLOTS",
-                 "BENCH_MAX_LEN", "BENCH_QUANT", "BENCH_SPEC"):
+                 "BENCH_MAX_LEN", "BENCH_QUANT", "BENCH_SPEC",
+                 "BENCH_KV_BLOCK", "GOFR_TPU_FLASH_DECODE"):
         env.pop(knob, None)
     env["BENCH_REQUESTS"] = "8"
     env["BENCH_CHILD_WALL"] = "870"
@@ -236,10 +237,12 @@ def main() -> None:
     if kv_quant.lower() in ("none", "0"):
         kv_quant = ""
     spec_tokens = int(os.environ.get("BENCH_SPEC", "0"))
+    kv_block = int(os.environ.get("BENCH_KV_BLOCK", "0"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
         f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "
-        f"kv_quant={kv_quant or 'bf16'} spec={spec_tokens}")
+        f"kv_quant={kv_quant or 'bf16'} spec={spec_tokens} "
+        f"kv_block={kv_block}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -253,6 +256,7 @@ def main() -> None:
         quant=quant,
         kv_quant=kv_quant,
         spec_tokens=spec_tokens,
+        kv_block=kv_block,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

@@ -151,6 +151,9 @@ class Container:
             "speculative decoding: tokens accepted per live step",
             (1, 1.5, 2, 2.5, 3, 4, 5, 6, 8),
         )
+        m.new_gauge(
+            "app_tpu_kv_blocks_free", "paged KV cache: free pool blocks"
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

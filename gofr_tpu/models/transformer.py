@@ -35,7 +35,13 @@ from gofr_tpu.ops.attention import (
     decode_attention,
     verify_chunk_attention,
 )
-from gofr_tpu.ops.kv_cache import KVCache, fake_quantize_kv, quantize_kv
+from gofr_tpu.ops.kv_cache import (
+    KVCache,
+    PagedKVCache,
+    fake_quantize_kv,
+    paged_view,
+    quantize_kv,
+)
 from gofr_tpu.ops.norms import rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
 
@@ -161,11 +167,21 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
     }
 
 
-def kv_cache_specs(quantized: bool = False) -> KVCache:
-    """Cache layout [L, slots, kv_heads, len, hd]: kv_heads over ``tp``.
-    Int8 mode adds per-position scales [L, slots, kv_heads, 8, len] whose
-    kv_heads axis shards the same way."""
+def kv_cache_specs(quantized: bool = False, paged: bool = False):
+    """Cache layout [L, slots|blocks, kv_heads, len|block, hd]: kv_heads
+    over ``tp``. Int8 mode adds per-position scales whose kv_heads axis
+    shards the same way; the paged pool shards identically (axis 2) with
+    a replicated block table."""
     kv = P(None, None, "tp", None, None)
+    if paged:
+        return PagedKVCache(
+            k=kv,
+            v=kv,
+            block_table=P(None, None),
+            lengths=P(None),
+            k_s=kv if quantized else None,
+            v_s=kv if quantized else None,
+        )
     return KVCache(
         k=kv,
         v=kv,
@@ -386,15 +402,40 @@ def transformer_prefill_chunk(
     x = params["embed"][tokens]  # [P, c, D]
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
     positions = starts[:, None] + jnp.arange(c)[None, :]  # [P, c] global
+    paged = isinstance(cache, PagedKVCache)
 
-    idx_slot = slots[:, None, None]
     idx_kv = jnp.arange(KV)[None, :, None]
-    idx_pos = positions[:, None, :]  # [P, 1, c]
-    # Scale-write indices (int8 mode): [S, KV, 8, max_len] layer slice.
-    s_slot = slots[:, None, None, None]
     s_kv = jnp.arange(KV)[None, :, None, None]
     s_sub = jnp.arange(8)[None, None, :, None]
-    s_pos = positions[:, None, None, :]  # [P, 1, 1, c]
+    if paged:
+        # Map global positions onto (pool block, offset) via the rows'
+        # table entries; positions past a row's allocation resolve to the
+        # parking block 0 (padding columns only — live prompt positions
+        # are allocated ahead by the engine).
+        B = cache.block
+        bt_rows = cache.block_table[slots]  # [P, max_blocks]
+        blk = jnp.take_along_axis(
+            bt_rows,
+            jnp.minimum(positions // B, bt_rows.shape[1] - 1),
+            axis=1,
+        )  # [P, c]
+        # Padding columns past max_len MUST park in block 0: the slot
+        # cache dropped them as out-of-bounds scatter updates, but the
+        # min-clamp above would remap them INTO the last real block on
+        # top of live prompt K/V.
+        in_range = positions < cache.max_len
+        blk = jnp.where(in_range, blk, 0)
+        off = jnp.where(in_range, positions % B, B - 1)
+        idx_row = blk[:, None, :]  # [P, 1, c] pool block per position
+        idx_pos = off[:, None, :]
+        s_row = blk[:, None, None, :]
+        s_pos = off[:, None, None, :]
+    else:
+        idx_row = slots[:, None, None]
+        idx_pos = positions[:, None, :]  # [P, 1, c]
+        # Scale-write indices (int8 mode): [S, KV, 8, max_len] layer slice.
+        s_row = slots[:, None, None, None]
+        s_pos = positions[:, None, None, :]  # [P, 1, 1, c]
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
@@ -410,17 +451,24 @@ def transformer_prefill_chunk(
 
             k, k_sc = quantize_kv(k)  # scales [P, c, KV]
             v, v_sc = quantize_kv(v)
-            cks = cks.at[s_slot, s_kv, s_sub, s_pos].set(
+            cks = cks.at[s_row, s_kv, s_sub, s_pos].set(
                 k_sc.transpose(0, 2, 1)[:, :, None, :]
             )
-            cvs = cvs.at[s_slot, s_kv, s_sub, s_pos].set(
+            cvs = cvs.at[s_row, s_kv, s_sub, s_pos].set(
                 v_sc.transpose(0, 2, 1)[:, :, None, :]
             )
-        ck = ck.at[idx_slot, idx_kv, idx_pos].set(k.transpose(0, 2, 1, 3))
-        cv = cv.at[idx_slot, idx_kv, idx_pos].set(v.transpose(0, 2, 1, 3))
-        attn = cache_chunk_attention(
-            q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs
-        )
+        ck = ck.at[idx_row, idx_kv, idx_pos].set(k.transpose(0, 2, 1, 3))
+        cv = cv.at[idx_row, idx_kv, idx_pos].set(v.transpose(0, 2, 1, 3))
+        if paged:
+            vk, vv, vks, vvs = paged_view(cache.block_table, ck, cv, slots, cks, cvs)
+            attn = cache_chunk_attention(
+                q, vk, vv, jnp.arange(P), starts, lens, k_scale=vks,
+                v_scale=vvs,
+            )
+        else:
+            attn = cache_chunk_attention(
+                q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs
+            )
         x = x + _wein("pch,hd->pcd", attn.reshape(P, c, H * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
@@ -476,6 +524,8 @@ def transformer_decode_step(
     # Round-tripping the full cache through scan ys instead costs ~11 ms
     # of pure HBM copy per step at llama-1b/32 slots (the nested window
     # scan defeats XLA's ys/xs aliasing — scripts/tpu_probe.py).
+    paged = isinstance(cache, PagedKVCache)
+
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
         h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
@@ -491,7 +541,9 @@ def transformer_decode_step(
             # cache bit for bit (commit re-quantizes to the same int8).
             k, v = fake_quantize_kv(k), fake_quantize_kv(v)
         attn = decode_attention(
-            q, ck, cv, positions, k_new=k, v_new=v, k_scale=cks, v_scale=cvs
+            q, ck, cv, positions, k_new=k, v_new=v, k_scale=cks,
+            v_scale=cvs,
+            block_table=cache.block_table if paged else None,
         )
         x = x + _wein("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
         h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)
@@ -503,16 +555,27 @@ def transformer_decode_step(
         body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
     )
     # Commit every layer's token in one scatter: [L, S, KV, hd] values at
-    # [l, s, kv, write_pos[s]] — donation makes this in-place.
+    # [l, s, kv, write_pos[s]] (slot cache) or [l, table[s, p//B], kv,
+    # p%B] (paged pool; inactive slots park in block 0) — donation makes
+    # this in-place.
     li = jnp.arange(L)[:, None, None]
-    si = slot_idx[None, :, None]
     ki = jnp.arange(KV)[None, None, :]
-    wp = write_pos[None, :, None]
+    if paged:
+        B = cache.block
+        blk_log = positions // B
+        blk = jnp.take_along_axis(
+            cache.block_table, jnp.minimum(blk_log, cache.block_table.shape[1] - 1)[:, None], axis=1
+        )[:, 0]
+        row = jnp.where(active, blk, 0)[None, :, None]
+        wp = jnp.where(active, positions % B, B - 1)[None, :, None]
+    else:
+        row = slot_idx[None, :, None]
+        wp = write_pos[None, :, None]
     if cache.quantized:
         new_k, k_sc = quantize_kv(new_k)  # scales [L, S, KV]
         new_v, v_sc = quantize_kv(new_v)
         sidx = (
-            li[..., None], si[..., None], ki[..., None],
+            li[..., None], row[..., None], ki[..., None],
             jnp.arange(8)[None, None, None, :], wp[..., None],
         )
         cache = cache._replace(
@@ -520,8 +583,8 @@ def transformer_decode_step(
             v_s=cache.v_s.at[sidx].set(v_sc[..., None]),
         )
     cache = cache._replace(
-        k=cache.k.at[li, si, ki, wp].set(new_k.astype(cache.k.dtype)),
-        v=cache.v.at[li, si, ki, wp].set(new_v.astype(cache.v.dtype)),
+        k=cache.k.at[li, row, ki, wp].set(new_k.astype(cache.k.dtype)),
+        v=cache.v.at[li, row, ki, wp].set(new_v.astype(cache.v.dtype)),
         lengths=cache.lengths + active.astype(jnp.int32),
     )
     x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]
@@ -548,6 +611,8 @@ def transformer_verify_step(
     x = params["embed"][tokens]  # [S, c, D]
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
     positions = cache.lengths[:, None] + jnp.arange(c)[None, :]  # [S, c]
+    paged = isinstance(cache, PagedKVCache)
+    rows = jnp.arange(S)
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # read-only cache slices
@@ -562,6 +627,8 @@ def transformer_verify_step(
             # must match what commit_chunk_kv will write, or spec-on
             # output diverges from spec-off under an int8 cache.
             k, v = fake_quantize_kv(k), fake_quantize_kv(v)
+        if paged:
+            ck, cv, cks, cvs = paged_view(cache.block_table, ck, cv, rows, cks, cvs)
         attn = verify_chunk_attention(
             q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs
         )
@@ -597,16 +664,23 @@ def commit_chunk_kv(
     pos = jnp.where(active[:, None], pos, cache.max_len - 1)
     pos = jnp.minimum(pos, cache.max_len - 1)
     li = jnp.arange(L)[:, None, None, None]
-    si = jnp.arange(S)[None, :, None, None]
     ki = jnp.arange(KV)[None, None, :, None]
-    pi = pos[None, :, None, :]  # [1, S, 1, c]
+    if isinstance(cache, PagedKVCache):
+        B = cache.block
+        blk = jnp.take_along_axis(cache.block_table, pos // B, axis=1)
+        blk = jnp.where(active[:, None], blk, 0)  # park in block 0
+        row = blk[None, :, None, :]  # [1, S, 1, c] pool block ids
+        pi = jnp.where(active[:, None], pos % B, B - 1)[None, :, None, :]
+    else:
+        row = jnp.arange(S)[None, :, None, None]
+        pi = pos[None, :, None, :]  # [1, S, 1, c]
     nk = new_k.transpose(0, 1, 3, 2, 4)  # [L, S, KV, c, hd]
     nv = new_v.transpose(0, 1, 3, 2, 4)
     if cache.quantized:
         nk, k_sc = quantize_kv(nk)  # scales [L, S, KV, c]
         nv, v_sc = quantize_kv(nv)
         sidx = (
-            li[..., None], si[..., None], ki[..., None],
+            li[..., None], row[..., None], ki[..., None],
             jnp.arange(8)[None, None, None, None, :], pi[..., None],
         )
         cache = cache._replace(
@@ -614,8 +688,8 @@ def commit_chunk_kv(
             v_s=cache.v_s.at[sidx].set(v_sc[..., None]),
         )
     return cache._replace(
-        k=cache.k.at[li, si, ki, pi].set(nk.astype(cache.k.dtype)),
-        v=cache.v.at[li, si, ki, pi].set(nv.astype(cache.v.dtype)),
+        k=cache.k.at[li, row, ki, pi].set(nk.astype(cache.k.dtype)),
+        v=cache.v.at[li, row, ki, pi].set(nv.astype(cache.v.dtype)),
     )
 
 

@@ -152,6 +152,7 @@ def decode_attention(
     v_new: jnp.ndarray | None = None,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
 ) -> jnp.ndarray:
@@ -188,9 +189,24 @@ def decode_attention(
 
         return flash_decode(
             q, k_cache, v_cache, lengths, k_new=k_new, v_new=v_new,
-            k_scale=k_scale, v_scale=v_scale,
+            k_scale=k_scale, v_scale=v_scale, block_table=block_table,
             scale=scale, block_k=_DECODE_BLOCK_K, interpret=_interpret(),
         )
+    if block_table is not None:
+        # Paged pool + dense fallback: gather each row's blocks into a
+        # contiguous view, then fall through to the regular dense math
+        # (the kernel path above indexes the pool in place instead).
+        from gofr_tpu.ops.kv_cache import paged_view
+
+        rows = jnp.arange(q.shape[0])
+        if k_scale is not None:
+            k_cache, v_cache, k_scale, v_scale = paged_view(
+                block_table, k_cache, v_cache, rows, k_scale, v_scale
+            )
+        else:
+            k_cache, v_cache, _, _ = paged_view(
+                block_table, k_cache, v_cache, rows
+            )
     n_heads = q.shape[1]
     n_kv = k_cache.shape[1]
     n_rep = n_heads // n_kv

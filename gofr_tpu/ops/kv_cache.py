@@ -89,6 +89,132 @@ class KVCache(NamedTuple):
         return int(total)
 
 
+class PagedKVCache(NamedTuple):
+    """Block-pool KV cache (the vLLM idea, TPU-shaped).
+
+    The slot cache reserves ``n_slots × max_len`` HBM whether or not the
+    sequences are long; the paged cache reserves a POOL of fixed-size
+    blocks and maps each slot's logical positions onto pool blocks via a
+    block table, so HBM scales with the tokens actually resident:
+
+    * ``k``/``v``: ``[L, n_blocks, KV, block, hd]`` — block as the
+      second-to-last axis keeps per-(block, head) tiles ``[block, hd]``,
+      the same VMEM-tileable layout the slot cache uses, so the pallas
+      decode kernel only changes its index_map (pool block id from the
+      prefetched table instead of ``ik``);
+    * ``block_table``: ``[S, max_blocks] int32`` — pool block id for
+      each slot's j-th logical block (entries past the allocated count
+      are 0; the allocator guarantees allocation stays ahead of the
+      pipelined windows' overshoot, see engine admission);
+    * ``lengths``: ``[S]`` valid logical prefix per slot;
+    * ``k_s``/``v_s``: int8 mode — ``[L, n_blocks, KV, 8, block]``
+      sublane-replicated scale planes, mirroring the slot cache's.
+
+    Block 0 is a reserved PARKING block: inactive-slot writes and
+    rejected-draft history land there, so it is never handed out by the
+    allocator and garbage in it is never attended (table entries of
+    unallocated logical blocks also point at it).
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    block_table: jnp.ndarray
+    lengths: jnp.ndarray
+    k_s: Optional[jnp.ndarray] = None
+    v_s: Optional[jnp.ndarray] = None
+
+    @classmethod
+    def create(
+        cls,
+        n_layers: int,
+        n_slots: int,
+        max_len: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+        quant: str = "",
+        block: int = 128,
+        n_blocks: int = 0,
+    ) -> "PagedKVCache":
+        """``max_len`` is the per-slot LOGICAL cap (table width);
+        ``n_blocks`` the pool size (default: slots×max_len/block — same
+        capacity as the slot cache; size it smaller to oversubscribe)."""
+        if max_len % block:
+            raise ValueError(f"max_len {max_len} not a multiple of block {block}")
+        max_blocks = max_len // block
+        if n_blocks <= 0:
+            n_blocks = n_slots * max_blocks + 1  # +1: parking block 0
+        shape = (n_layers, n_blocks, n_kv_heads, block, head_dim)
+        table = jnp.zeros((n_slots, max_blocks), dtype=jnp.int32)
+        if (quant or "").lower() == "int8":
+            sshape = (n_layers, n_blocks, n_kv_heads, 8, block)
+            return cls(
+                k=jnp.zeros(shape, dtype=jnp.int8),
+                v=jnp.zeros(shape, dtype=jnp.int8),
+                block_table=table,
+                lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+                k_s=jnp.ones(sshape, dtype=jnp.float32),
+                v_s=jnp.ones(sshape, dtype=jnp.float32),
+            )
+        if quant:
+            raise ValueError(f"unsupported KV quant mode {quant!r} (int8 only)")
+        return cls(
+            k=jnp.zeros(shape, dtype=dtype),
+            v=jnp.zeros(shape, dtype=dtype),
+            block_table=table,
+            lengths=jnp.zeros((n_slots,), dtype=jnp.int32),
+        )
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_s is not None
+
+    @property
+    def n_slots(self) -> int:
+        return self.block_table.shape[0]
+
+    @property
+    def block(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.block_table.shape[1] * self.k.shape[3]
+
+    def hbm_bytes(self) -> int:
+        total = self.k.size * self.k.dtype.itemsize * 2
+        if self.k_s is not None:
+            total += self.k_s.size * self.k_s.dtype.itemsize * 2
+        return int(total)
+
+
+def paged_view(block_table, layer_k, layer_v, rows, layer_ks=None,
+               layer_vs=None):
+    """Dense-fallback view: gather ``rows``' blocks into contiguous
+    per-row caches ``[R, KV, max_len, hd]`` (+ scale planes). Materializes
+    a copy — the paged flash-decode kernel indexes the pool in place
+    instead; this exists for the CPU/dense path and tests.
+
+    layer_k/layer_v: one layer's pool ``[n_blocks, KV, block, hd]``.
+    """
+    bt = block_table[rows]  # [R, max_blocks]
+    R, MB = bt.shape
+    KV, B, hd = layer_k.shape[1], layer_k.shape[2], layer_k.shape[3]
+    k = layer_k[bt]  # [R, MB, KV, block, hd]
+    v = layer_v[bt]
+    k = k.transpose(0, 2, 1, 3, 4).reshape(R, KV, MB * B, hd)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(R, KV, MB * B, hd)
+    if layer_ks is None:
+        return k, v, None, None
+    ks = layer_ks[bt].transpose(0, 2, 3, 1, 4).reshape(R, KV, 8, MB * B)
+    vs = layer_vs[bt].transpose(0, 2, 3, 1, 4).reshape(R, KV, 8, MB * B)
+    return k, v, ks, vs
+
+
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Absmax-int8 quantize K/V rows over the trailing head_dim axis.
 

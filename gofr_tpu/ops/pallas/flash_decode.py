@@ -37,8 +37,7 @@ def _clamp_blk(ik, length, block_k):
     return jnp.minimum(ik, jnp.maximum(0, (length - 1) // block_k))
 
 
-def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant,
-            has_new):
+def _kernel(*refs, scale, block_k, quant, has_new, paged):
     """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch.
 
     quant (static): int8 cache mode — two extra scale refs follow v_ref
@@ -48,12 +47,22 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale, block_k, quant,
     HBM streams int8.
 
     has_new (static): the current token's K/V (``[8, hd]`` sublane-
-    replicated bf16 refs after the scale refs) is merged into the online
+    replicated f32 refs after the scale refs) is merged into the online
     softmax at the finish step instead of being read from the cache —
     ``lengths`` then counts only the cache prefix. Lets the serving
     decode keep the cache read-only until one end-of-step commit.
+
+    paged (static): a second prefetched scalar (the block table) follows
+    ``lengths``; the kernel BODY is unchanged — the table acts entirely
+    through the BlockSpec index_maps, which turn logical kv-block ``ik``
+    into a pool block id, so the pool is read in place with no gather.
     """
-    rest = list(rest)
+    refs = list(refs)
+    len_ref = refs.pop(0)
+    if paged:
+        refs.pop(0)  # block table: consumed by the index_maps only
+    q_ref, k_ref, v_ref = refs[:3]
+    rest = refs[3:]
     k_s_ref = v_s_ref = kn_ref = vn_ref = None
     if quant:
         k_s_ref, v_s_ref = rest[:2]
@@ -155,6 +164,7 @@ def flash_decode(
     v_new: jnp.ndarray | None = None,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
     interpret: bool = False,
@@ -168,51 +178,80 @@ def flash_decode(
     new token merges in-kernel at the finish step).
     k_scale/v_scale: int8-cache per-position scales
     [b, n_kv, 8, max_len] (sublane-replicated, ``ops/kv_cache.py``).
-    Returns [b, n_heads, hd].
+
+    block_table ([b, max_blocks] int32, paged mode): caches are then a
+    POOL [n_blocks, n_kv, block, hd] (scales [n_blocks, n_kv, 8, block])
+    and the table maps each row's logical kv block onto a pool block —
+    indexing happens in the BlockSpec index_maps, so the pool streams
+    straight from HBM with no per-step gather. ``block_k`` is the pool's
+    block size in that mode. Returns [b, n_heads, hd].
     """
     b, n_heads, hd = q.shape
-    n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    paged = block_table is not None
+    n_kv = k_cache.shape[1]
     n_rep = n_heads // n_kv
     quant = k_scale is not None
     has_new = k_new is not None
     if scale is None:
         scale = hd**-0.5
 
-    block_k = min(block_k, max_len)
-    if max_len % block_k:
-        pad = block_k - max_len % block_k
-        cfg = [(0, 0), (0, 0), (0, pad), (0, 0)]
-        k_cache = jnp.pad(k_cache, cfg)
-        v_cache = jnp.pad(v_cache, cfg)
-        if quant:
-            scfg = [(0, 0), (0, 0), (0, 0), (0, pad)]
-            k_scale = jnp.pad(k_scale, scfg)
-            v_scale = jnp.pad(v_scale, scfg)
-        max_len += pad
+    if paged:
+        block_k = k_cache.shape[2]
+        n_grid_blocks = block_table.shape[1]
+        max_len = n_grid_blocks * block_k
+    else:
+        max_len = k_cache.shape[2]
+        block_k = min(block_k, max_len)
+        if max_len % block_k:
+            pad = block_k - max_len % block_k
+            cfg = [(0, 0), (0, 0), (0, pad), (0, 0)]
+            k_cache = jnp.pad(k_cache, cfg)
+            v_cache = jnp.pad(v_cache, cfg)
+            if quant:
+                scfg = [(0, 0), (0, 0), (0, 0), (0, pad)]
+                k_scale = jnp.pad(k_scale, scfg)
+                v_scale = jnp.pad(v_scale, scfg)
+            max_len += pad
+        n_grid_blocks = max_len // block_k
 
     # Clamp the kv block index to the slot's last valid block: grid
     # steps beyond a short slot's length re-"fetch" the same block,
     # which the pallas pipeline elides (same index → no new DMA) —
     # this is where the SMEM-prefetched lengths actually save HBM
-    # bandwidth, not just compute.
-    def kv_spec():
-        return pl.BlockSpec((1, 1, block_k, hd), lambda ib, ig, ik, lens: (
-            ib, ig, _clamp_blk(ik, lens[ib], block_k), 0))
+    # bandwidth, not just compute. Paged mode adds the table lookup on
+    # top: the clamped LOGICAL block resolves to a pool block id.
+    if paged:
+        def kv_idx(ib, ig, ik, lens, bt):
+            return (bt[ib, _clamp_blk(ik, lens[ib], block_k)], ig, 0, 0)
 
+        def scale_idx(ib, ig, ik, lens, bt):
+            return (bt[ib, _clamp_blk(ik, lens[ib], block_k)], ig, 0, 0)
+
+        def row_idx(ib, ig, ik, lens, bt):
+            return (ib, ig, 0, 0)
+    else:
+        def kv_idx(ib, ig, ik, lens):
+            return (ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)
+
+        def scale_idx(ib, ig, ik, lens):
+            return (ib, ig, 0, _clamp_blk(ik, lens[ib], block_k))
+
+        def row_idx(ib, ig, ik, lens):
+            return (ib, ig, 0, 0)
+
+    kv_block_shape = (1, 1, block_k, hd)
     in_specs = [
-        pl.BlockSpec(
-            (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
-        ),
-        kv_spec(),
-        kv_spec(),
+        pl.BlockSpec((1, 1, n_rep, hd), row_idx),
+        pl.BlockSpec(kv_block_shape, kv_idx),
+        pl.BlockSpec(kv_block_shape, kv_idx),
     ]
-    inputs = [lengths.astype(jnp.int32), q.reshape(b, n_kv, n_rep, hd),
-              k_cache, v_cache]
+    inputs = [lengths.astype(jnp.int32)]
+    if paged:
+        inputs.append(block_table.astype(jnp.int32))
+    inputs += [q.reshape(b, n_kv, n_rep, hd), k_cache, v_cache]
     if quant:
-        scale_spec = pl.BlockSpec(
-            (1, 1, 8, block_k), lambda ib, ig, ik, lens: (
-                ib, ig, 0, _clamp_blk(ik, lens[ib], block_k)))
-        in_specs += [scale_spec, scale_spec]
+        sspec = pl.BlockSpec((1, 1, 8, block_k), scale_idx)
+        in_specs += [sspec, sspec]
         inputs += [k_scale, v_scale]
     if has_new:
         # [b, n_kv, hd] → sublane-replicated [b, n_kv, 8, hd] f32 (the
@@ -221,19 +260,15 @@ def flash_decode(
         rep8 = lambda t: jnp.broadcast_to(  # noqa: E731
             t[:, :, None, :], (b, n_kv, 8, hd)
         ).astype(jnp.float32)
-        new_spec = pl.BlockSpec(
-            (1, 1, 8, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
-        )
+        new_spec = pl.BlockSpec((1, 1, 8, hd), row_idx)
         in_specs += [new_spec, new_spec]
         inputs += [rep8(k_new), rep8(v_new)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(b, n_kv, max_len // block_k),
+        num_scalar_prefetch=2 if paged else 1,
+        grid=(b, n_kv, n_grid_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, n_rep, hd), lambda ib, ig, ik, lens: (ib, ig, 0, 0)
-        ),
+        out_specs=pl.BlockSpec((1, 1, n_rep, hd), row_idx),
         scratch_shapes=[
             pltpu.VMEM((n_rep, hd), jnp.float32),
             pltpu.VMEM((n_rep, 128), jnp.float32),
@@ -243,7 +278,7 @@ def flash_decode(
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, block_k=block_k, quant=quant,
-            has_new=has_new,
+            has_new=has_new, paged=paged,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, hd), q.dtype),

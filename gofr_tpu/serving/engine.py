@@ -126,6 +126,8 @@ class InferenceEngine:
         truncate_prompts: bool = False,
         top_k: int = 0,
         spec_tokens: int = 0,
+        kv_block: int = 0,
+        kv_pool_blocks: int = 0,
         mesh=None,
         quant: str = "",
         kv_quant: str = "",
@@ -229,11 +231,34 @@ class InferenceEngine:
                     f"raise max_len"
                 )
             self.kv_quant = (kv_quant or "").lower()
-            make_cache = lambda: KVCache.create(  # noqa: E731
-                self.cfg.n_layers, n_slots, self.max_len,
-                self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
-                quant=self.kv_quant,
-            )
+            # Paged KV (TPU_KV_BLOCK>0): block-pool cache + host allocator
+            # — HBM scales with resident tokens, not slots × max_len.
+            self.kv_block = max(0, kv_block)
+            if self.kv_block:
+                from gofr_tpu.ops.kv_cache import PagedKVCache
+
+                if self.max_len % self.kv_block:
+                    raise ValueError(
+                        f"max_len={self.max_len} must be a multiple of "
+                        f"kv_block={self.kv_block}"
+                    )
+                if prefix_slots > 0:
+                    raise ValueError(
+                        "prefix-KV reuse and the paged cache are mutually "
+                        "exclusive (the pool copies slot rows)"
+                    )
+                make_cache = lambda: PagedKVCache.create(  # noqa: E731
+                    self.cfg.n_layers, n_slots, self.max_len,
+                    self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+                    quant=self.kv_quant, block=self.kv_block,
+                    n_blocks=kv_pool_blocks,
+                )
+            else:
+                make_cache = lambda: KVCache.create(  # noqa: E731
+                    self.cfg.n_layers, n_slots, self.max_len,
+                    self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+                    quant=self.kv_quant,
+                )
             if mesh is not None:
                 # KV heads shard over tp — same layout prefill and decode.
                 from gofr_tpu.models.transformer import kv_cache_specs
@@ -242,11 +267,26 @@ class InferenceEngine:
                 self.cache = jax.jit(
                     make_cache,
                     out_shardings=named_shardings(
-                        kv_cache_specs(quantized=bool(self.kv_quant)), mesh
+                        kv_cache_specs(
+                            quantized=bool(self.kv_quant),
+                            paged=bool(self.kv_block),
+                        ),
+                        mesh,
                     ),
                 )()
             else:
                 self.cache = make_cache()
+            if self.kv_block:
+                # Host-side block allocator: block 0 is the parking block
+                # and never handed out; the table mirror uploads (8 KB)
+                # only when an admission/top-up/release dirtied it.
+                self._free_blocks = list(range(1, self.cache.n_blocks))
+                self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
+                self._table_host = np.zeros(
+                    (n_slots, self.max_len // self.kv_block), dtype=np.int32
+                )
+                self._table_dirty = False
+                self._dispatched_tokens = [0] * n_slots
             # Prefix-KV reuse: shared system prompts prefill once into a
             # device pool; admission copies rows in (prefix_cache.py).
             self._prefix_pool = None
@@ -260,6 +300,10 @@ class InferenceEngine:
             self._prefilling: dict[int, _PrefillState] = {}
             # (first_dev, first_lp_dev, row, slot, seq) awaiting async fetch.
             self._prefill_emits: list = []
+            # Paged mode: requests held back waiting for free pool blocks.
+            from collections import deque as _deque
+
+            self._wait_kv: "_deque[_GenRequest]" = _deque()
             self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
             self._work = threading.Event()
             self._sched: Optional[threading.Thread] = None
@@ -357,6 +401,10 @@ class InferenceEngine:
             ).lower() in ("1", "true", "yes"),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
+            kv_block=int(config.get_or_default("TPU_KV_BLOCK", "0")),
+            kv_pool_blocks=int(
+                config.get_or_default("TPU_KV_POOL_BLOCKS", "0")
+            ),
             logger=logger,
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
@@ -823,11 +871,76 @@ class InferenceEngine:
             if seq is None:
                 continue
             _fail(seq.request)
-            self._slots[i] = None
+            self._release_slot(i)
         for slot, st in list(self._prefilling.items()):
             _fail(st.request)
             del self._prefilling[slot]
+        while self._wait_kv:
+            _fail(self._wait_kv.popleft())
         self._prefill_emits.clear()
+
+    # ------------------------------------------------------------------
+    # paged-KV block allocator (host side; kv_block > 0 only)
+    # ------------------------------------------------------------------
+
+    def _ensure_blocks(self, slot: int, tokens: int) -> bool:
+        """Grow ``slot``'s allocation to cover ``tokens`` logical tokens.
+        Returns False when the pool is exhausted (caller defers or fails)
+        — rolling back any partial grab, so a waiting request can never
+        strand blocks on an idle slot while live streams starve."""
+        B = self.kv_block
+        target = min(
+            (min(tokens, self.max_len) + B - 1) // B,
+            self._table_host.shape[1],
+        )
+        row = self._slot_blocks[slot]
+        start_len = len(row)
+        while len(row) < target:
+            if not self._free_blocks:
+                while len(row) > start_len:  # rollback the partial grab
+                    blk = row.pop()
+                    self._table_host[slot, len(row)] = 0
+                    self._free_blocks.append(blk)
+                return False
+            blk = self._free_blocks.pop()
+            self._table_host[slot, len(row)] = blk
+            row.append(blk)
+            self._table_dirty = True
+        if self._metrics is not None and len(row) != start_len:
+            self._metrics.set_gauge(
+                "app_tpu_kv_blocks_free", len(self._free_blocks),
+                "model", self.model_name,
+            )
+        return True
+
+    def _release_slot(self, slot: int) -> None:
+        """Free a slot and (paged mode) return its blocks to the pool."""
+        self._slots[slot] = None
+        self._slot_state_dirty = True
+        if self.kv_block:
+            row = self._slot_blocks[slot]
+            if row:
+                self._free_blocks.extend(row)
+                self._slot_blocks[slot] = []
+                self._table_host[slot, :] = 0
+                self._table_dirty = True
+            self._dispatched_tokens[slot] = 0
+        if self._metrics is not None and self.kv_block:
+            self._metrics.set_gauge(
+                "app_tpu_kv_blocks_free", len(self._free_blocks),
+                "model", self.model_name,
+            )
+
+    def _push_table(self) -> None:
+        """Upload the block-table mirror if admission/top-up dirtied it."""
+        if self.kv_block and self._table_dirty:
+            self.cache = self.cache._replace(
+                block_table=self._jnp.asarray(self._table_host)
+            )
+            self._table_dirty = False
+
+    def _window_tokens(self) -> int:
+        return self.window_k * (self.spec_tokens + 1)
 
     def _dispatch_prefill_chunk(self) -> bool:
         """Admit pending requests into free slots and dispatch ONE
@@ -844,11 +957,38 @@ class InferenceEngine:
             i for i, s in enumerate(self._slots)
             if s is None and i not in self._prefilling
         ]
-        while free and not self._pending.empty():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                break
+        while free and (self._wait_kv or not self._pending.empty()):
+            if self._wait_kv:
+                req = self._wait_kv.popleft()
+            else:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+            if self.kv_block:
+                # A request bigger than the ENTIRE pool can never be
+                # admitted — fail it now instead of deadlocking the
+                # admission queue behind it forever.
+                B = self.kv_block
+                need = (min(len(req.prompt_ids) + 1, self.max_len) + B - 1) // B
+                if need > self.cache.n_blocks - 1:
+                    if not req.future.done():
+                        req.future.set_exception(RuntimeError(
+                            f"prompt needs {need} KV blocks but the pool "
+                            f"has {self.cache.n_blocks - 1}; raise "
+                            f"TPU_KV_POOL_BLOCKS"
+                        ))
+                    req.stream.put(None)
+                    continue
+                # Cover the prompt + the first decode token now; windows
+                # top up ahead of dispatch. Pool dry → hold the request
+                # back (retirements will refill the free list).
+                if not self._ensure_blocks(
+                    free[0], len(req.prompt_ids) + 1
+                ):
+                    self._wait_kv.appendleft(req)
+                    break
+                self._dispatched_tokens[free[0]] = 0
             # Clamp generation budget so pipelined-window overshoot can't
             # overrun the cache (admission-time guard; see _dispatch_window).
             room = (
@@ -909,6 +1049,7 @@ class InferenceEngine:
 
         jnp = self._jnp
         t0 = time.time()
+        self._push_table()
         args = (
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
@@ -1004,8 +1145,7 @@ class InferenceEngine:
             if self._finished(seq):
                 self._retire(slot, seq)
                 if self._slots[slot] is seq:
-                    self._slots[slot] = None
-                    self._slot_state_dirty = True
+                    self._release_slot(slot)
         self._prefill_emits = keep
 
     def _dispatch_window(self):
@@ -1033,6 +1173,30 @@ class InferenceEngine:
             self._temps_dev = jnp.asarray(temps)
             self._greedy_dev = jnp.asarray(greedy)
             self._slot_state_dirty = False
+
+        if self.kv_block:
+            # Allocation must stay AHEAD of the window about to be
+            # dispatched (its writes land before the host sees the
+            # tokens). A dry pool mid-stream fails the request — the
+            # honest outcome of an oversubscribed pool.
+            wt = self._window_tokens()
+            for i, seq in enumerate(self._slots):
+                if seq is None:
+                    continue
+                req = seq.request
+                base = req.effective_prompt_len or len(req.prompt_ids)
+                need = base + self._dispatched_tokens[i] + wt + 1
+                if self._ensure_blocks(i, need):
+                    self._dispatched_tokens[i] += wt
+                    continue
+                if not req.future.done():
+                    req.future.set_exception(RuntimeError(
+                        "KV block pool exhausted mid-generation "
+                        "(raise TPU_KV_POOL_BLOCKS or lower concurrency)"
+                    ))
+                req.stream.put(None)
+                self._release_slot(i)
+            self._push_table()
 
         t0 = time.time()
         counts = None
@@ -1100,8 +1264,7 @@ class InferenceEngine:
                 # free the slot or it would stay active forever.
                 if self._slots[i] is seq:
                     seq.request.stream.put(None)
-                    self._slots[i] = None
-                    self._slot_state_dirty = True
+                    self._release_slot(i)
                 continue
             if seq.request.ttft_s == 0.0:
                 seq.request.ttft_s = now - seq.request.enqueued_at
@@ -1134,8 +1297,7 @@ class InferenceEngine:
                     if self._finished(seq):
                         self._retire(i, seq)
                         if self._slots[i] is seq:
-                            self._slots[i] = None
-                            self._slot_state_dirty = True
+                            self._release_slot(i)
                         done = True
                         break
                 if done:
@@ -1544,4 +1706,10 @@ class InferenceEngine:
                 "in_use": sum(1 for s in self._slots if s is not None),
             }
             details["max_len"] = self.max_len
+            if self.kv_block:
+                details["kv_blocks"] = {
+                    "block": self.kv_block,
+                    "total": self.cache.n_blocks - 1,  # block 0 parks
+                    "free": len(self._free_blocks),
+                }
         return {"status": "UP" if self._running else "DOWN", "details": details}

@@ -23,3 +23,6 @@ run r3d-1b-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128
 # 3. Headline re-run for the drain/prefill-batch deltas.
 run r3d-1b BENCH_MODEL=llama-1b
 run r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
+# 4. Paged KV cache: dense fallback + the table-indexed kernel.
+run r3d-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128
+run r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1

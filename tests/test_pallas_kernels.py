@@ -207,6 +207,50 @@ def test_split_decode_int8_cache_matches_dense(monkeypatch):
     )
 
 
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_flash_decode_matches_dense(quant):
+    """Table-indexed pool kernel == dense over the gathered view, with a
+    scrambled block table, ragged lengths, and the k_new split merge."""
+    from gofr_tpu.ops.kv_cache import paged_view, quantize_kv
+
+    b, n_heads, n_kv, hd, bs, mb = 3, 8, 2, 32, 64, 4
+    n_blocks = 1 + b * mb
+    key = jax.random.PRNGKey(13)
+    kp, kv_, kq, kn, vn_k = jax.random.split(key, 5)
+    pool_k = jax.random.normal(kp, (n_blocks, n_kv, bs, hd))
+    pool_v = jax.random.normal(kv_, (n_blocks, n_kv, bs, hd))
+    q = jax.random.normal(kq, (b, n_heads, hd))
+    k_new = jax.random.normal(kn, (b, n_kv, hd))
+    v_new = jax.random.normal(vn_k, (b, n_kv, hd))
+    # Scrambled, non-contiguous table (pool ids 1..12 permuted).
+    perm = jax.random.permutation(jax.random.PRNGKey(3), n_blocks - 1) + 1
+    table = perm.reshape(b, mb).astype(jnp.int32)
+    prev = jnp.array([0, 100, 256], dtype=jnp.int32)
+
+    ks = vs = pks = pvs = None
+    if quant:
+        pool_k, ksc = quantize_kv(pool_k)  # scales [n_blocks, n_kv, bs]
+        pool_v, vsc = quantize_kv(pool_v)
+        rep8 = lambda s: jnp.broadcast_to(  # noqa: E731
+            s[:, :, None, :], (n_blocks, n_kv, 8, bs)
+        ).astype(jnp.float32)
+        pks, pvs = rep8(ksc), rep8(vsc)
+
+    vk, vv, vks, vvs = paged_view(table, pool_k, pool_v, jnp.arange(b),
+                                  pks, pvs)
+    want = decode_attention(
+        q, vk, vv, prev, k_new=k_new, v_new=v_new, k_scale=vks,
+        v_scale=vvs, kernel=False,
+    ).astype(jnp.float32)
+    got = flash_decode(
+        q, pool_k, pool_v, prev, k_new=k_new, v_new=v_new, k_scale=pks,
+        v_scale=pvs, block_table=table, interpret=True,
+    ).astype(jnp.float32)
+    tol = 3e-2 if quant else 2e-5
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=tol, rtol=tol)
+
+
 def test_dispatch_and_grad(monkeypatch):
     # Force the kernel path off-TPU (interpret mode) and check both the
     # dispatch and the dense-recompute backward pass.

@@ -242,6 +242,136 @@ def test_spec_streaming_order(f32_plain_engine):
         spec.stop_sync()
 
 
+def test_paged_cache_matches_slot_cache(llm_engine):
+    """TPU_KV_BLOCK engine produces the same greedy tokens as the slot
+    cache, across concurrent requests and block boundaries (max_len 128,
+    block 32 → prompts + generations span multiple blocks)."""
+    paged = InferenceEngine(
+        "llama-tiny", n_slots=4, max_len=128, tokenizer=ByteTokenizer(),
+        kv_block=32,
+    )
+    paged.start_sync()
+    try:
+        prompts = ["hello world", "paged attention", "x" * 40]
+        want = [
+            llm_engine.generate_sync(
+                p, max_new_tokens=10, temperature=0.0, stop_on_eos=False
+            ).token_ids
+            for p in prompts
+        ]
+        reqs = [
+            paged.submit_generate(
+                p, max_new_tokens=10, temperature=0.0, stop_on_eos=False
+            )
+            for p in prompts
+        ]
+        got = [r.future.result(timeout=120).token_ids for r in reqs]
+        assert got == want
+        h = paged.health_check()
+        assert h["details"]["kv_blocks"]["block"] == 32
+    finally:
+        paged.stop_sync()
+
+
+def test_paged_pool_exhaustion_holds_requests_back():
+    """A pool smaller than slots×max_len admits what fits and holds the
+    rest back until retirements free blocks — all requests complete."""
+    paged = InferenceEngine(
+        "llama-tiny", n_slots=4, max_len=128, tokenizer=ByteTokenizer(),
+        kv_block=32, kv_pool_blocks=9,  # parking + 8 = two slots' worth
+    )
+    paged.start_sync()
+    try:
+        reqs = [
+            paged.submit_generate(
+                f"request {i}", max_new_tokens=6, temperature=0.0,
+                stop_on_eos=False,
+            )
+            for i in range(6)
+        ]
+        results = [r.future.result(timeout=180) for r in reqs]
+        assert all(len(r.token_ids) == 6 for r in results)
+        assert len(paged._free_blocks) == 8  # everything returned
+    finally:
+        paged.stop_sync()
+
+
+def test_paged_prefill_padding_does_not_corrupt_prompt():
+    """A prefill chunk whose padding columns extend past max_len must park
+    them in block 0 — remapping them into the last real block would
+    scatter garbage over the prompt's tail K/V (regression)."""
+    mk = lambda **kw: InferenceEngine(  # noqa: E731
+        "llama-tiny", n_slots=2, max_len=96, prefill_chunk=64,
+        tokenizer=ByteTokenizer(), **kw,
+    )
+    plain, paged = mk(), mk(kv_block=32)
+    plain.start_sync()
+    paged.start_sync()
+    try:
+        prompt = "abcdefgh" * 8  # 64 chars → chunk 2 pads past max_len
+        want = plain.generate_sync(
+            prompt, max_new_tokens=6, temperature=0.0, stop_on_eos=False
+        ).token_ids
+        got = paged.generate_sync(
+            prompt, max_new_tokens=6, temperature=0.0, stop_on_eos=False
+        ).token_ids
+        assert got == want
+    finally:
+        plain.stop_sync()
+        paged.stop_sync()
+
+
+def test_paged_oversized_prompt_fails_without_deadlock():
+    """A prompt needing more blocks than the whole pool fails its own
+    future immediately — and does NOT wedge admission for requests
+    behind it."""
+    paged = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, tokenizer=ByteTokenizer(),
+        kv_block=32, kv_pool_blocks=4,  # 3 usable blocks = 96 tokens
+    )
+    paged.start_sync()
+    try:
+        big = paged.submit_generate(
+            "x" * 100, max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        small = paged.submit_generate(
+            "ok", max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        with pytest.raises(RuntimeError, match="KV blocks"):
+            big.future.result(timeout=60)
+        assert len(small.future.result(timeout=60).token_ids) == 4
+    finally:
+        paged.stop_sync()
+
+
+def test_paged_with_int8_kv_and_spec():
+    """Paged × int8 KV × speculation compose: same tokens as the plain
+    slot-cache engine (f32 oracle model)."""
+    plain = InferenceEngine(
+        "llama-tiny-f32", n_slots=2, max_len=128, tokenizer=ByteTokenizer(),
+        kv_quant="int8",
+    )
+    paged = InferenceEngine(
+        "llama-tiny-f32", n_slots=2, max_len=128, tokenizer=ByteTokenizer(),
+        kv_quant="int8", kv_block=32, spec_tokens=2,
+    )
+    for eng in (plain, paged):
+        eng.start_sync()
+    try:
+        want = plain.generate_sync(
+            "compose everything", max_new_tokens=9, temperature=0.0,
+            stop_on_eos=False,
+        ).token_ids
+        got = paged.generate_sync(
+            "compose everything", max_new_tokens=9, temperature=0.0,
+            stop_on_eos=False,
+        ).token_ids
+        assert got == want
+    finally:
+        plain.stop_sync()
+        paged.stop_sync()
+
+
 def test_llm_health(llm_engine):
     h = llm_engine.health_check()
     assert h["status"] == "UP"
