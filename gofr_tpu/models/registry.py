@@ -65,6 +65,17 @@ def _register_llms() -> None:
             vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
             n_kv_heads=8, d_ff=28672, max_len=8192, rope_theta=500000.0,
         ),
+        # Mixtral-8x7B (MoE: 8 experts, top-2; 47B params total, ~13B
+        # active). Serves tp-sharded — experts shard over the tp axis
+        # (expert parallelism rides the model axis,
+        # models/transformer.py transformer_param_specs); int4+tp2 or
+        # int8+tp4 fit v5e slices. HF loader maps
+        # block_sparse_moe.{gate,experts.*.w1/w2/w3}.
+        "mixtral-8x7b": TransformerConfig(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14336, max_len=8192, rope_theta=1e6,
+            n_experts=8, n_experts_active=2,
+        ),
         # Mistral-7B dims (HF loader accepts model_type=mistral).
         # max_len capped at the model's 4096 sliding window: attention
         # here is dense causal, which matches the reference only within

@@ -103,10 +103,12 @@ def dequantize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
     return w
 
 
-# Weight leaves worth quantizing: the big matmul weights. Embeddings (gather)
-# and norms (tiny) stay in bf16.
+# Weight leaves worth quantizing: the big matmul weights. Embeddings
+# (gather), norms (tiny), and the MoE router (tiny AND routing-sensitive:
+# a flipped top-k from quantization error changes which experts run)
+# stay in bf16.
 _QUANT_KEYS = {
-    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "router", "lm_head"
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"
 }
 
 

@@ -47,7 +47,7 @@ def config_from_hf(path: str):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral"):
+    if mt not in ("llama", "mistral", "mixtral"):
         raise ValueError(f"unsupported HF model_type {mt!r} (llama-family only)")
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
@@ -60,6 +60,9 @@ def config_from_hf(path: str):
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         dtype=jnp.bfloat16,
+        # Mixtral MoE: top-k routing over stacked experts.
+        n_experts=int(hf.get("num_local_experts", 0)) if mt == "mixtral" else 0,
+        n_experts_active=int(hf.get("num_experts_per_tok", 2)),
     )
 
 
@@ -135,7 +138,8 @@ def load_hf_llama(
         raise ValueError(f"{path} has no config.json and no cfg was given")
     if file_cfg is not None:
         for field in ("vocab_size", "d_model", "n_layers", "n_heads",
-                      "n_kv_heads", "d_ff"):
+                      "n_kv_heads", "d_ff", "n_experts",
+                      "n_experts_active"):
             want, have = getattr(cfg, field), getattr(file_cfg, field)
             if want != have:
                 raise ValueError(
@@ -183,15 +187,31 @@ def load_hf_llama(
             logger.debugf("loaded %s x%d", fmt, cfg.n_layers)
         return out
 
+    def stacked_experts(key: str, fmt: str):
+        """Mixtral expert weights: fmt has {i}=layer, {e}=expert; HF
+        stores [out, in] per expert → ours [L, E, in, out]."""
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            a = jnp.stack([
+                jnp.stack([
+                    jnp.swapaxes(src.get(fmt.format(i=i, e=e)), -1, -2)
+                    for e in range(cfg.n_experts)
+                ])
+                for i in range(cfg.n_layers)
+            ])  # [L, E, in, out]
+        out = to_device(
+            a, True, specs["layers"][key] if specs is not None else None
+        )
+        if logger is not None:
+            logger.debugf("loaded %s x%dx%d", fmt, cfg.n_layers, cfg.n_experts)
+        return out
+
     pre = "model.layers.{}."
     layers = {
         "wq": stacked("wq", pre + "self_attn.q_proj.weight", True),
         "wk": stacked("wk", pre + "self_attn.k_proj.weight", True),
         "wv": stacked("wv", pre + "self_attn.v_proj.weight", True),
         "wo": stacked("wo", pre + "self_attn.o_proj.weight", True),
-        "w_gate": stacked("w_gate", pre + "mlp.gate_proj.weight", True),
-        "w_up": stacked("w_up", pre + "mlp.up_proj.weight", True),
-        "w_down": stacked("w_down", pre + "mlp.down_proj.weight", True),
         "attn_norm": stacked(
             "attn_norm", pre + "input_layernorm.weight", False, False
         ),
@@ -199,6 +219,24 @@ def load_hf_llama(
             "mlp_norm", pre + "post_attention_layernorm.weight", False, False
         ),
     }
+    if cfg.is_moe:
+        moe = "model.layers.{i}.block_sparse_moe."
+        layers.update(
+            router=stacked(
+                "router", "model.layers.{}.block_sparse_moe.gate.weight",
+                True, quantize=False,  # tiny and routing-sensitive
+            ),
+            # Mixtral naming: w1=gate, w3=up, w2=down.
+            w_gate=stacked_experts("w_gate", moe + "experts.{e}.w1.weight"),
+            w_up=stacked_experts("w_up", moe + "experts.{e}.w3.weight"),
+            w_down=stacked_experts("w_down", moe + "experts.{e}.w2.weight"),
+        )
+    else:
+        layers.update(
+            w_gate=stacked("w_gate", pre + "mlp.gate_proj.weight", True),
+            w_up=stacked("w_up", pre + "mlp.up_proj.weight", True),
+            w_down=stacked("w_down", pre + "mlp.down_proj.weight", True),
+        )
     e_spec = specs["embed"] if specs is not None else None
     h_spec = specs["lm_head"] if specs is not None else None
     embed = to_device(src.get("model.embed_tokens.weight"), False, e_spec)

@@ -35,6 +35,14 @@ class OpenAIRequestError(GofrError):
     status_code = 400
 
 
+class OpenAIModelNotFound(GofrError):
+    """404 — the OpenAI wire code for requesting a model that isn't
+    loaded (clients silently getting a DIFFERENT model's output would
+    be worse than the error)."""
+
+    status_code = 404
+
+
 def default_chat_template(messages: list[dict]) -> str:
     """Minimal generic chat flattening (role-tagged lines + cue)."""
     lines = []
@@ -119,6 +127,17 @@ def add_openai_routes(
                 "no TPU engine configured (set TPU_ENABLED/TPU_MODEL)"
             )
         return engine
+
+    def _check_model(body: dict, engine) -> None:
+        """A request naming a model that is NOT the loaded one gets the
+        OpenAI 404, not the loaded model's output."""
+        want = body.get("model")
+        if want and want != engine.model_name:
+            raise OpenAIModelNotFound(
+                f"model {want!r} is not loaded (serving "
+                f"{engine.model_name!r}); GET /v1/models lists "
+                f"availability"
+            )
 
     def _params(body: dict) -> dict:
         # Explicit nulls are legal per the OpenAI spec → fall back to
@@ -263,6 +282,7 @@ def add_openai_routes(
     async def completions(ctx):  # noqa: ANN001
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
+        _check_model(body, engine)
         prompts = _normalize_prompts(body.get("prompt", ""))
         params = _params(body)
         stop_seqs = _stop_list(body)
@@ -311,6 +331,7 @@ def add_openai_routes(
     async def chat_completions(ctx):  # noqa: ANN001
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
+        _check_model(body, engine)
         messages = body.get("messages") or []
         if not isinstance(messages, list) or not messages:
             raise OpenAIRequestError("messages must be a non-empty list")
@@ -386,6 +407,7 @@ def add_openai_routes(
                 "TPU_MODEL to an encoder like bert-base)"
             )
         body = _completion_body(ctx.request.raw.body)
+        _check_model(body, engine)
         inputs = body.get("input")
         if isinstance(inputs, str):
             inputs = [inputs]

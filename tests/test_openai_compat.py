@@ -393,3 +393,23 @@ def test_embeddings_endpoint_with_secondary_encoder():
     finally:
         asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_unknown_model_gets_404(oai_app):
+    """Naming a model that isn't the loaded one must 404 (OpenAI wire
+    code), never silently serve the loaded model's output."""
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "model": "llama-3-8b", "prompt": "hello", "max_tokens": 4,
+    }))
+    r = c.getresponse()
+    assert r.status == 404
+    assert "not loaded" in json.loads(r.read())["error"]["message"]
+
+    # The loaded name (and omitting model entirely) still works.
+    c = _conn(oai_app)
+    c.request("POST", "/v1/chat/completions", body=json.dumps({
+        "model": "llama-tiny", "max_tokens": 2,
+        "messages": [{"role": "user", "content": "hi"}],
+    }))
+    assert c.getresponse().status == 200

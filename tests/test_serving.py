@@ -419,16 +419,19 @@ def test_engine_from_config_and_container():
         c.tpu.stop_sync()
 
 
-@pytest.mark.parametrize("quant", ["", "int8"])
-def test_sharded_serving_matches_single_device(quant):
+@pytest.mark.parametrize("quant,kv_block", [("", 0), ("int8", 0), ("", 32)])
+def test_sharded_serving_matches_single_device(quant, kv_block):
     """TPU_MESH_TP=2: Megatron-sharded params + KV heads over a 2-device
-    mesh must produce identical greedy generations — in bf16 AND with
-    weight-only int8 (the quant × mesh composition, VERDICT r2 next #2)."""
+    mesh must produce identical greedy generations — in bf16, with
+    weight-only int8 (the quant × mesh composition, VERDICT r2 next #2),
+    and with the paged block pool (its KV axis shards like the slot
+    cache; the table replicates)."""
     # Init bf16 then quantize — the same init path the mesh branch takes
     # (the quant="int8" ctor arg would take the leaf-wise init, whose
     # different key-split order gives different random weights).
     single = InferenceEngine(
         "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
+        kv_block=kv_block,
     )
     if quant:
         single.apply_quantization(quant)
@@ -443,6 +446,7 @@ def test_sharded_serving_matches_single_device(quant):
     cfg = MockConfig({
         "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
         "TPU_MAX_LEN": "64", "TPU_MESH_TP": "2", "TPU_QUANT": quant,
+        "TPU_KV_BLOCK": str(kv_block),
     })
     sharded = InferenceEngine.from_config(cfg)
     if quant:
