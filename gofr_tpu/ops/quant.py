@@ -40,7 +40,13 @@ class Q8(NamedTuple):
 class Q4(NamedTuple):
     """Int4 weight + group-wise scales (W4A16).
 
-    ``q``: int4 (XLA native s4, packed 2/byte in HBM), original shape.
+    ``q``: uint8 with TWO 4-bit values (two's-complement nibbles) packed
+    along the contraction axis — ``[..., D/2, out]`` for an original
+    ``[..., D, out]`` weight. Explicit nibble packing instead of XLA's
+    native s4: same ½-byte/elem HBM footprint, but the arrays are plain
+    uint8 everywhere outside the fused unpack — s4 layouts trip backend
+    bugs (the axon relay's ``device_put`` re-layout of S4 recursed
+    fatally) and s4 support is emulated on most backends anyway.
     ``s``: f32 ``[..., G, 1, out]`` — one scale per ``group`` contraction
     rows per output channel (group-wise absmax keeps 4-bit quality;
     per-column int4 is too coarse for real weights). Weight HBM is ~¼ of
@@ -51,8 +57,9 @@ class Q4(NamedTuple):
     s: jnp.ndarray
 
     @property
-    def shape(self):
-        return self.q.shape
+    def shape(self):  # logical (unpacked) shape
+        lead, (d2, o) = self.q.shape[:-2], self.q.shape[-2:]
+        return (*lead, d2 * 2, o)
 
     @property
     def dtype(self):
@@ -75,12 +82,17 @@ def quantize_array(w: jnp.ndarray) -> Q8:
 
 
 def quantize_array4(w: jnp.ndarray, group: int = 128) -> Q4:
-    """Group-wise absmax int4 over the contraction (-2) axis.
+    """Group-wise absmax int4 over the contraction (-2) axis, nibble-
+    packed into uint8 (two values per byte along that axis).
 
     ``group`` shrinks to the axis size when it doesn't divide it (tiny
-    test models); real model dims are multiples of 128.
+    test models); real model dims are multiples of 128. The contraction
+    axis must be even (every real transformer dim is).
     """
     D = w.shape[-2]
+    if D % 2:
+        raise ValueError(f"int4 nibble packing needs an even contraction "
+                         f"axis, got {D}")
     if D % group:
         group = D
     G = D // group
@@ -88,18 +100,28 @@ def quantize_array4(w: jnp.ndarray, group: int = 128) -> Q4:
     wf = w.astype(jnp.float32).reshape(*lead, G, group, w.shape[-1])
     absmax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)  # [.., G, 1, O]
     scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
-    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int4)
-    return Q4(q=q.reshape(w.shape), s=scale.astype(jnp.float32))
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int32)
+    q = q.reshape(*lead, D, w.shape[-1])
+    nib = jnp.where(q < 0, q + 16, q).astype(jnp.uint8)  # two's complement
+    packed = (nib[..., 0::2, :] << 4) | nib[..., 1::2, :]
+    return Q4(q=packed, s=scale.astype(jnp.float32))
 
 
 def dequantize(w: Any, dtype=jnp.bfloat16) -> jnp.ndarray:
     if isinstance(w, Q8):
         return (w.q.astype(jnp.float32) * w.s).astype(dtype)
     if isinstance(w, Q4):
-        lead, (D, O) = w.q.shape[:-2], w.q.shape[-2:]
+        lead, (D2, O) = w.q.shape[:-2], w.q.shape[-2:]
+        D = D2 * 2
+        # Unpack nibbles (hi = even rows, lo = odd) and sign-extend —
+        # elementwise ops XLA fuses into the consuming matmul's read.
+        hi = (w.q >> 4).astype(jnp.int32)
+        lo = (w.q & 0xF).astype(jnp.int32)
+        n = jnp.stack([hi, lo], axis=-2)  # [..., D/2, 2, O]
+        n = jnp.where(n > 7, n - 16, n).reshape(*lead, D, O)
         G = w.s.shape[-3]
-        wf = w.q.astype(jnp.float32).reshape(*lead, G, D // G, O) * w.s
-        return wf.reshape(w.q.shape).astype(dtype)
+        wf = n.astype(jnp.float32).reshape(*lead, G, D // G, O) * w.s
+        return wf.reshape(*lead, D, O).astype(dtype)
     return w
 
 
@@ -179,12 +201,10 @@ def quantized_param_specs(specs: dict, mode: str = "int8") -> dict:
 
 
 def quantized_bytes(params: Any) -> int:
-    """Total parameter bytes as stored (int8 → 1 B/elem, int4 → ½ B/elem
-    — XLA packs s4 two per byte in HBM)."""
+    """Total parameter bytes as stored (int8 → 1 B/elem; int4 leaves are
+    nibble-packed uint8, so the generic itemsize path already counts
+    them at ½ B per logical element)."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(params):
-        if leaf.dtype.name in ("int4", "uint4"):
-            total += (leaf.size + 1) // 2
-        else:
-            total += leaf.size * leaf.dtype.itemsize
+        total += leaf.size * leaf.dtype.itemsize
     return int(total)
