@@ -27,6 +27,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from gofr_tpu.ops.attention import (
@@ -734,4 +735,16 @@ def ngram_draft(
 
 
 def count_params(params: dict) -> int:
-    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    """LOGICAL parameter count — a nibble-packed Q4 leaf stores two
+    weights per uint8 element, so physical ``.size`` would halve it."""
+    from gofr_tpu.ops.quant import Q4, Q8
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, (Q4, Q8))
+    ):
+        if isinstance(leaf, (Q4, Q8)):
+            total += int(np.prod(leaf.shape))  # Q4.shape is logical
+        else:
+            total += int(leaf.size)
+    return total

@@ -184,6 +184,20 @@ def decode_attention(
         raise ValueError("pass k_new and v_new together")
     if kernel is None:
         kernel = _flash_decode_enabled()
+        if (
+            kernel
+            and _FLASH_DECODE_ENV == ""
+            and _FLASH_ENV in ("", "auto")
+            and block_table is None
+        ):
+            # Measured auto heuristic (BASELINE.md round 3): at short
+            # max_len ONE fused dense op beats the kernel's grid of tiny
+            # programs (llama-1b/1024: 2.4 vs 5.1 ms per stack; engine
+            # 2421 vs 1931 tok/s); length-skipping only pays once the
+            # full-length reads the dense path can't skip get big. The
+            # paged pool always takes the kernel — its dense fallback
+            # must materialize a gather first.
+            kernel = k_cache.shape[2] > 2048
     if kernel:
         from gofr_tpu.ops.pallas import flash_decode
 

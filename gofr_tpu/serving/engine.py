@@ -189,7 +189,9 @@ class InferenceEngine:
             self.apply_quantization(quant)
 
         if logger is not None:
-            n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(self.params))
+            from gofr_tpu.models.transformer import count_params
+
+            n_params = count_params(self.params)
             logger.infof(
                 "model %s initialised: %.2fB params in %.1fs",
                 model_name, n_params / 1e9, time.time() - t0,

@@ -26,3 +26,6 @@ run r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_
 # 4. Paged KV cache: dense fallback + the table-indexed kernel.
 run r3d-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128
 run r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
+# 5. int4 weights, now nibble-packed uint8 (the s4 relay bug is dodged).
+run r3d-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
+run r3d-8b-int4-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_QUANT=int4 BENCH_KV_QUANT=int8
