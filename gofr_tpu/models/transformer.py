@@ -168,13 +168,25 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
     }
 
 
-def kv_cache_specs(quantized: bool = False, paged: bool = False):
+def kv_cache_specs(
+    quantized: bool = False, paged: bool = False, cp: bool = False
+):
     """Cache layout [L, slots|blocks, kv_heads, len|block, hd]: kv_heads
     over ``tp``. Int8 mode adds per-position scales whose kv_heads axis
     shards the same way; the paged pool shards identically (axis 2) with
-    a replicated block table."""
-    kv = P(None, None, "tp", None, None)
+    a replicated block table.
+
+    ``cp`` (serving context parallelism): the LENGTH axis additionally
+    shards over the ``cp`` mesh axis — each chip holds a slice of every
+    sequence and GSPMD partitions the dense decode/prefill attention
+    (sharded softmax reductions become collectives). This is what lets
+    max_len exceed one chip's cache HBM. Not combinable with paging.
+    """
+    seq = "cp" if cp else None
+    kv = P(None, None, "tp", seq, None)
     if paged:
+        if cp:
+            raise ValueError("paged cache and cp sharding are exclusive")
         return PagedKVCache(
             k=kv,
             v=kv,
@@ -183,12 +195,13 @@ def kv_cache_specs(quantized: bool = False, paged: bool = False):
             k_s=kv if quantized else None,
             v_s=kv if quantized else None,
         )
+    scale = P(None, None, "tp", None, seq)
     return KVCache(
         k=kv,
         v=kv,
         lengths=P(None),
-        k_s=kv if quantized else None,
-        v_s=kv if quantized else None,
+        k_s=scale if quantized else None,
+        v_s=scale if quantized else None,
     )
 
 
@@ -382,6 +395,7 @@ def transformer_prefill_chunk(
     starts: jnp.ndarray,
     lens: jnp.ndarray,
     cfg: TransformerConfig,
+    dense_attn: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Chunked serving prefill: one fixed-shape [P, c] chunk step.
 
@@ -460,15 +474,17 @@ def transformer_prefill_chunk(
             )
         ck = ck.at[idx_row, idx_kv, idx_pos].set(k.transpose(0, 2, 1, 3))
         cv = cv.at[idx_row, idx_kv, idx_pos].set(v.transpose(0, 2, 1, 3))
+        kern = False if dense_attn else None
         if paged:
             vk, vv, vks, vvs = paged_view(cache.block_table, ck, cv, slots, cks, cvs)
             attn = cache_chunk_attention(
                 q, vk, vv, jnp.arange(P), starts, lens, k_scale=vks,
-                v_scale=vvs,
+                v_scale=vvs, kernel=kern,
             )
         else:
             attn = cache_chunk_attention(
-                q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs
+                q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs,
+                kernel=kern,
             )
         x = x + _wein("pch,hd->pcd", attn.reshape(P, c, H * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
@@ -493,6 +509,7 @@ def transformer_decode_step(
     cache: KVCache,
     active: jnp.ndarray,
     cfg: TransformerConfig,
+    dense_attn: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step over ALL cache slots (static batch = n_slots).
 
@@ -545,6 +562,7 @@ def transformer_decode_step(
             q, ck, cv, positions, k_new=k, v_new=v, k_scale=cks,
             v_scale=cvs,
             block_table=cache.block_table if paged else None,
+            kernel=False if dense_attn else None,
         )
         x = x + _wein("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
         h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)

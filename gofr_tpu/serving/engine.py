@@ -168,10 +168,10 @@ class InferenceEngine:
             # their Megatron-style partition specs — never gathered on one
             # chip (an 8B model doesn't fit one v5e).
             from gofr_tpu.models.transformer import transformer_param_specs
-            from gofr_tpu.parallel.sharding import named_shardings
+            from gofr_tpu.parallel.sharding import named_shardings, prune_specs
 
             shardings = named_shardings(
-                transformer_param_specs(self.cfg), mesh
+                prune_specs(transformer_param_specs(self.cfg), mesh), mesh
             )
             self.params = jax.jit(
                 lambda k: self.spec.init(k, self.cfg), out_shardings=shardings
@@ -262,16 +262,24 @@ class InferenceEngine:
                     quant=self.kv_quant,
                 )
             if mesh is not None:
-                # KV heads shard over tp — same layout prefill and decode.
+                # KV heads shard over tp, the length axis over cp —
+                # same layout prefill and decode.
                 from gofr_tpu.models.transformer import kv_cache_specs
-                from gofr_tpu.parallel.sharding import named_shardings
+                from gofr_tpu.parallel.sharding import (
+                    named_shardings,
+                    prune_specs,
+                )
 
                 self.cache = jax.jit(
                     make_cache,
                     out_shardings=named_shardings(
-                        kv_cache_specs(
-                            quantized=bool(self.kv_quant),
-                            paged=bool(self.kv_block),
+                        prune_specs(
+                            kv_cache_specs(
+                                quantized=bool(self.kv_quant),
+                                paged=bool(self.kv_block),
+                                cp="cp" in mesh.axis_names,
+                            ),
+                            mesh,
                         ),
                         mesh,
                     ),
@@ -358,10 +366,19 @@ class InferenceEngine:
         """
         mesh = None
         tp = int(config.get_or_default("TPU_MESH_TP", "1"))
-        if tp > 1:
+        # Serving context parallelism: the KV cache's length axis shards
+        # over cp chips, so max_len can exceed one chip's cache HBM
+        # (GSPMD turns the sharded softmax reductions into collectives).
+        cp = int(config.get_or_default("TPU_MESH_CP", "1"))
+        if tp > 1 or cp > 1:
             from gofr_tpu.parallel import make_mesh
 
-            mesh = make_mesh({"tp": tp})
+            axes = {}
+            if tp > 1:
+                axes["tp"] = tp
+            if cp > 1:
+                axes["cp"] = cp
+            mesh = make_mesh(axes)
         model_name = config.get_or_default("TPU_MODEL", "llama-tiny")
         ckpt = config.get_or_default("TPU_CHECKPOINT", "")
         quant_cfg = config.get_or_default("TPU_QUANT", "")
@@ -475,6 +492,11 @@ class InferenceEngine:
             transformer_prefill_chunk,
         )
         cfg, top_k = self.cfg, self._top_k
+        # pallas kernels don't auto-partition under GSPMD: mesh-sharded
+        # serving takes the dense attention formulations, which XLA
+        # partitions (per-head locality under tp; sharded-softmax
+        # collectives under cp).
+        dense_attn = self.mesh is not None
 
         def sample(logits, key, temps, greedy):
             """Returns (token, logprob) — the logprob is the model's
@@ -503,7 +525,8 @@ class InferenceEngine:
             per-slot select, not scatter, so duplicates can't race)."""
             key, sub = jax.random.split(key)
             logits, cache = transformer_prefill_chunk(
-                params, tokens, cache, slots, starts, lens, cfg
+                params, tokens, cache, slots, starts, lens, cfg,
+                dense_attn=dense_attn,
             )
             first, first_lp = sample(logits, sub, temps, greedy)
             S = all_tokens.shape[0]
@@ -560,7 +583,7 @@ class InferenceEngine:
                 tokens, logps, cache, key = carry
                 key, sub = jax.random.split(key)
                 logits, cache = transformer_decode_step(
-                    params, tokens, cache, active, cfg
+                    params, tokens, cache, active, cfg, dense_attn=dense_attn
                 )
                 nxt, nlp = sample(logits, sub, temps, greedy)
                 return (nxt, nlp, cache, key), (tokens, logps)

@@ -52,9 +52,18 @@ class PrefixPool:
 
         if mesh is not None:
             from gofr_tpu.models.transformer import kv_cache_specs
-            from gofr_tpu.parallel.sharding import named_shardings
+            from gofr_tpu.parallel.sharding import named_shardings, prune_specs
 
-            specs = kv_cache_specs(quantized=cache.quantized)
+            # Same pruned, cp-aware specs as the engine's cache build —
+            # the pool must shard exactly like the cache it copies rows
+            # with (and a cp-only mesh has no "tp" axis to name).
+            specs = prune_specs(
+                kv_cache_specs(
+                    quantized=cache.quantized,
+                    cp="cp" in mesh.axis_names,
+                ),
+                mesh,
+            )
             shardings = tuple(
                 named_shardings(s, mesh) for s in specs[:2]
             ) + ((named_shardings(specs.k_s, mesh),) * 2 if cache.quantized

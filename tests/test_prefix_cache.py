@@ -148,3 +148,26 @@ def test_prefix_pool_rows_are_real_kv():
         assert float(jnp.abs(pk[0, 0, :, :plen]).max()) > 0.0
     finally:
         eng.stop_sync()
+
+
+def test_prefix_pool_on_cp_mesh():
+    """Prefix reuse on a cp-only mesh (no 'tp' axis): the pool must build
+    with the same pruned, cp-aware shardings as the cache (regression —
+    unpruned specs raised on the missing tp axis) and still serve."""
+    cfg = MockConfig({
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "64", "TPU_MESH_CP": "2", "TPU_PREFIX_SLOTS": "2",
+    })
+    eng = InferenceEngine.from_config(cfg)
+    assert "cp" in str(eng._prefix_pool._pool[0].sharding.spec)
+    eng.start_sync()
+    try:
+        idx = eng.register_prefix_sync("System: be nice. ")
+        assert idx >= 0
+        r = eng.generate_sync(
+            "System: be nice. hi", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False,
+        )
+        assert len(r.token_ids) == 4
+    finally:
+        eng.stop_sync()

@@ -468,6 +468,42 @@ def test_sharded_serving_matches_single_device(quant, kv_block):
     assert got.token_ids == ref.token_ids
 
 
+def test_context_parallel_serving_matches_single_device():
+    """TPU_MESH_CP=2 (± tp): the KV cache's LENGTH axis shards over cp
+    chips — the long-context serving axis (max_len past one chip's cache
+    HBM) — and greedy generations must match single-device exactly
+    (GSPMD turns the sharded softmax reductions into collectives)."""
+    single = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
+    )
+    single.start_sync()
+    try:
+        ref = single.generate_sync(
+            "long context", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        single.stop_sync()
+
+    for axes in ({"TPU_MESH_CP": "2"},
+                 {"TPU_MESH_TP": "2", "TPU_MESH_CP": "2"}):
+        cfg = MockConfig({
+            "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
+            "TPU_MAX_LEN": "64", **axes,
+        })
+        sharded = InferenceEngine.from_config(cfg)
+        assert "cp" in str(sharded.cache.k.sharding.spec)
+        sharded.start_sync()
+        try:
+            got = sharded.generate_sync(
+                "long context", max_new_tokens=8, temperature=0.0,
+                stop_on_eos=False,
+            )
+        finally:
+            sharded.stop_sync()
+        assert got.token_ids == ref.token_ids, axes
+
+
 def test_ctx_infer_through_http_app(free_port):
     """ctx.infer end to end through the HTTP surface."""
     import http.client
