@@ -78,6 +78,7 @@ class _GenRequest:
     max_new_tokens: int
     temperature: float
     stop_on_eos: bool
+    top_p: float = 1.0
     stream: "queue.Queue[Optional[int]]" = field(default_factory=queue.Queue)
     future: Future = field(default_factory=Future)
     enqueued_at: float = field(default_factory=time.time)
@@ -125,6 +126,7 @@ class InferenceEngine:
         prefill_batch: int = 8,
         truncate_prompts: bool = False,
         top_k: int = 0,
+        enable_top_p: bool = False,
         spec_tokens: int = 0,
         kv_block: int = 0,
         kv_pool_blocks: int = 0,
@@ -151,6 +153,9 @@ class InferenceEngine:
         self._logger = logger
         self._metrics = metrics
         self._top_k = top_k
+        # Nucleus sampling support is a COMPILE choice: the per-step
+        # [slots, vocab] sort only exists in the program when enabled.
+        self.enable_top_p = bool(enable_top_p)
         self.tokenizer = tokenizer
         self.mesh = mesh  # multi-chip: NamedSharding placement over ICI
 
@@ -325,6 +330,7 @@ class InferenceEngine:
             self._key_dev = jax.random.PRNGKey(seed + 2)
             self._active_dev = jnp.zeros((n_slots,), dtype=bool)
             self._temps_dev = jnp.ones((n_slots,), dtype=jnp.float32)
+            self._topp_dev = jnp.ones((n_slots,), dtype=jnp.float32)
             self._greedy_dev = jnp.ones((n_slots,), dtype=bool)
             self._slot_state_dirty = True
             # Token history per slot (prompt + generated) — the n-gram
@@ -419,6 +425,8 @@ class InferenceEngine:
                 "TPU_TRUNCATE_PROMPTS", "false"
             ).lower() in ("1", "true", "yes"),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
+            enable_top_p=config.get_or_default("TPU_TOP_P", "false").lower()
+            in ("1", "true", "yes"),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             kv_block=int(config.get_or_default("TPU_KV_BLOCK", "0")),
             kv_pool_blocks=int(
@@ -498,16 +506,47 @@ class InferenceEngine:
         # collectives under cp).
         dense_attn = self.mesh is not None
 
-        def sample(logits, key, temps, greedy):
+        enable_top_p = self.enable_top_p
+
+        def sample(logits, key, temps, greedy, topps):
             """Returns (token, logprob) — the logprob is the model's
             (unscaled) log-softmax at the chosen token, the number the
             OpenAI logprobs field reports."""
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-4)[:, None]
+            sorted_l = None
             if top_k > 0:
                 sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
                 kth = sorted_l[:, top_k - 1][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            if enable_top_p:
+                # Per-slot nucleus: keep the smallest prefix of the
+                # sorted distribution with cumulative prob >= top_p
+                # (slots at top_p=1.0 are untouched).
+                if sorted_l is not None:
+                    # Post-top_k sorted logits are the already-sorted
+                    # list with positions >= top_k masked — no second
+                    # vocab-wide sort on the decode hot path.
+                    V = sorted_l.shape[-1]
+                    sorted_p = jnp.where(
+                        jnp.arange(V)[None, :] < top_k, sorted_l, -jnp.inf
+                    )
+                else:
+                    sorted_p = jnp.sort(scaled, axis=-1)[:, ::-1]
+                cum = jnp.cumsum(jax.nn.softmax(sorted_p, axis=-1), axis=-1)
+                # Guarantee the predicate holds somewhere: fp32 cumsum
+                # over a big vocab can top out just below a top_p≈1,
+                # and argmax over all-False would return 0 — silently
+                # collapsing the request to greedy.
+                cum = cum.at[:, -1].set(2.0)
+                cut_idx = jnp.argmax(cum >= topps[:, None], axis=-1)
+                cutoff = jnp.take_along_axis(
+                    sorted_p, cut_idx[:, None], axis=-1
+                )
+                scaled = jnp.where(
+                    (topps < 1.0)[:, None] & (scaled < cutoff),
+                    -jnp.inf, scaled,
+                )
             sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
             chosen = jnp.where(greedy, greedy_tok, sampled)
             logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -516,7 +555,7 @@ class InferenceEngine:
 
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, key, all_tokens, all_logps,
+            temps, greedy, topps, key, all_tokens, all_logps,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -528,7 +567,7 @@ class InferenceEngine:
                 params, tokens, cache, slots, starts, lens, cfg,
                 dense_attn=dense_attn,
             )
-            first, first_lp = sample(logits, sub, temps, greedy)
+            first, first_lp = sample(logits, sub, temps, greedy, topps)
             S = all_tokens.shape[0]
             match = (
                 (jnp.arange(S)[:, None] == slots[None, :])
@@ -544,19 +583,19 @@ class InferenceEngine:
             return cache, all_tokens, all_logps, first, first_lp, key
 
         prefill_chunk_step = partial(
-            jax.jit, donate_argnums=(1, 10, 11, 12)
+            jax.jit, donate_argnums=(1, 11, 12, 13)
         )(_prefill_core)
 
-        @partial(jax.jit, donate_argnums=(1, 10, 11, 12, 13))
+        @partial(jax.jit, donate_argnums=(1, 11, 12, 13, 14))
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, key, all_tokens, all_logps, history,
+            temps, greedy, topps, key, all_tokens, all_logps, history,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
-                row_valid, temps, greedy, key, all_tokens, all_logps,
+                row_valid, temps, greedy, topps, key, all_tokens, all_logps,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -568,7 +607,7 @@ class InferenceEngine:
 
         @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5))
         def decode_window(params, tokens, logps, cache, active, key, temps,
-                          greedy, k):
+                          greedy, topps, k):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -585,7 +624,7 @@ class InferenceEngine:
                 logits, cache = transformer_decode_step(
                     params, tokens, cache, active, cfg, dense_attn=dense_attn
                 )
-                nxt, nlp = sample(logits, sub, temps, greedy)
+                nxt, nlp = sample(logits, sub, temps, greedy, topps)
                 return (nxt, nlp, cache, key), (tokens, logps)
 
             (final, final_lp, cache, key), (etoks, elps) = jax.lax.scan(
@@ -596,9 +635,9 @@ class InferenceEngine:
 
         G = self.spec_tokens
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 8))
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9))
         def spec_window(params, tokens, logps, cache, active, key, temps,
-                        greedy, history, k):
+                        greedy, topps, history, k):
             """k speculative steps on device. Each step drafts G tokens by
             n-gram lookup in the slot's own history, verifies draft+current
             in ONE [S, G+1] forward (cache read-only), accepts the longest
@@ -622,7 +661,7 @@ class InferenceEngine:
                     params, inputs, cache, cfg
                 )
                 greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                samp0, samp0_lp = sample(logits[:, 0], sub, temps, greedy)
+                samp0, samp0_lp = sample(logits[:, 0], sub, temps, greedy, topps)
                 match = draft == greedy_next[:, :G]
                 acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
                 acc = jnp.where(greedy, acc, 0)  # sampled slots: no drafts
@@ -1052,6 +1091,7 @@ class InferenceEngine:
         finalize = np.zeros((P,), dtype=bool)
         row_valid = np.zeros((P,), dtype=bool)
         temps = np.ones((P,), dtype=np.float32)
+        topps = np.ones((P,), dtype=np.float32)
         greedy = np.ones((P,), dtype=bool)
         for i, (slot, st) in enumerate(rows):
             ids = st.request.prompt_ids
@@ -1063,6 +1103,7 @@ class InferenceEngine:
             finalize[i] = st.done + len(chunk) >= len(ids)
             row_valid[i] = True
             temps[i] = max(st.request.temperature, 0.0)
+            topps[i] = st.request.top_p
             greedy[i] = st.request.temperature <= 0
         for i in range(len(rows), P):
             # Padding rows duplicate row 0: identical K/V writes to the
@@ -1070,7 +1111,7 @@ class InferenceEngine:
             # keeps them out of the finalize merge.
             tokens[i] = tokens[0]
             slots[i], starts[i], lens[i] = slots[0], starts[0], lens[0]
-            temps[i], greedy[i] = temps[0], greedy[0]
+            temps[i], greedy[i], topps[i] = temps[0], greedy[0], topps[0]
 
         jnp = self._jnp
         t0 = time.time()
@@ -1079,7 +1120,7 @@ class InferenceEngine:
             self.params, self.cache, jnp.asarray(tokens),
             jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
             jnp.asarray(finalize), jnp.asarray(row_valid),
-            jnp.asarray(temps), jnp.asarray(greedy),
+            jnp.asarray(temps), jnp.asarray(greedy), jnp.asarray(topps),
             self._key_dev, self._tokens_dev, self._logps_dev,
         )
         if self.spec_tokens:
@@ -1188,14 +1229,17 @@ class InferenceEngine:
             # dispatch is then pure device work, no H2D copies at all.
             active = np.zeros((self.n_slots,), dtype=bool)
             temps = np.ones((self.n_slots,), dtype=np.float32)
+            topps = np.ones((self.n_slots,), dtype=np.float32)
             greedy = np.ones((self.n_slots,), dtype=bool)
             for i, seq in enumerate(self._slots):
                 if seq is not None:
                     active[i] = True
                     temps[i] = max(seq.request.temperature, 0.0)
+                    topps[i] = seq.request.top_p
                     greedy[i] = seq.request.temperature <= 0
             self._active_dev = jnp.asarray(active)
             self._temps_dev = jnp.asarray(temps)
+            self._topp_dev = jnp.asarray(topps)
             self._greedy_dev = jnp.asarray(greedy)
             self._slot_state_dirty = False
 
@@ -1231,8 +1275,8 @@ class InferenceEngine:
                 self._spec_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._key_dev,
-                    self._temps_dev, self._greedy_dev, self._history_dev,
-                    k=self.window_k,
+                    self._temps_dev, self._greedy_dev, self._topp_dev,
+                    self._history_dev, k=self.window_k,
                 )
             )
         else:
@@ -1241,7 +1285,8 @@ class InferenceEngine:
                 self._decode_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._key_dev,
-                    self._temps_dev, self._greedy_dev, k=self.window_k,
+                    self._temps_dev, self._greedy_dev, self._topp_dev,
+                    k=self.window_k,
                 )
             )
         for arr in (emitted, counts) if counts is not None else (emitted,):
@@ -1454,6 +1499,7 @@ class InferenceEngine:
             row_valid = np.zeros((P,), dtype=bool)
             row_valid[: len(rows)] = True
             temps = np.ones((P,), dtype=np.float32)
+            topps = np.ones((P,), dtype=np.float32)
             greedy = np.ones((P,), dtype=bool)
             t0 = time.perf_counter()
             (self.cache, self._tokens_dev, self._logps_dev, first, _flp,
@@ -1463,6 +1509,7 @@ class InferenceEngine:
                     jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
                     jnp.asarray(finalize), jnp.asarray(row_valid),
                     jnp.asarray(temps), jnp.asarray(greedy),
+                    jnp.asarray(topps),
                     self._key_dev, self._tokens_dev, self._logps_dev,
                 )
             )
@@ -1473,12 +1520,13 @@ class InferenceEngine:
         # are [P]-shaped and P != B crashes the decode window.
         active = jnp.ones((B,), dtype=bool)
         tdev = jnp.ones((B,), dtype=jnp.float32)
+        pdev = jnp.ones((B,), dtype=jnp.float32)
         gdev = jnp.ones((B,), dtype=bool)
 
         def window():
             out = self._decode_window(
                 self.params, self._tokens_dev, self._logps_dev, self.cache,
-                active, self._key_dev, tdev, gdev, k=self.window_k,
+                active, self._key_dev, tdev, gdev, pdev, k=self.window_k,
             )
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
              self._key_dev) = out
@@ -1549,9 +1597,21 @@ class InferenceEngine:
         temperature: float = 0.0,
         stop_on_eos: bool = True,
         stop: "Optional[list[str]]" = None,
+        top_p: float = 1.0,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
+        if not 0.0 < top_p <= 1.0:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            raise ErrorInvalidParam(["top_p must be in (0, 1]"])
+        if top_p < 1.0 and not self.enable_top_p:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            raise ErrorInvalidParam([
+                "top_p requires TPU_TOP_P=true (compiles the nucleus "
+                "sort into the sampler)"
+            ])
         ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -1580,6 +1640,7 @@ class InferenceEngine:
             stop_on_eos=stop_on_eos,
             truncated=truncated,
             stop_texts=list(stop or []),
+            top_p=top_p,
         )
         self._enqueue(req)
         return req

@@ -146,9 +146,11 @@ def add_openai_routes(
         if max_tokens is None:
             max_tokens = body.get("max_completion_tokens")
         temperature = body.get("temperature")
+        top_p = body.get("top_p")
         return dict(
             max_new_tokens=128 if max_tokens is None else int(max_tokens),
             temperature=1.0 if temperature is None else float(temperature),
+            top_p=1.0 if top_p is None else float(top_p),
             stop_on_eos=True,
         )
 

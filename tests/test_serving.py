@@ -372,6 +372,39 @@ def test_paged_with_int8_kv_and_spec():
         paged.stop_sync()
 
 
+def test_top_p_sampling():
+    """Nucleus sampling: top_p→0 collapses to greedy (the nucleus keeps
+    only the argmax token) even at temperature 1; a top_p request
+    against an engine compiled without it gets the 400-class error."""
+    from gofr_tpu.errors import ErrorInvalidParam
+
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, tokenizer=ByteTokenizer(),
+        enable_top_p=True,
+    )
+    eng.start_sync()
+    try:
+        greedy = eng.generate_sync(
+            "nucleus", max_new_tokens=8, temperature=0.0, stop_on_eos=False
+        ).token_ids
+        collapsed = eng.generate_sync(
+            "nucleus", max_new_tokens=8, temperature=1.0, top_p=1e-9,
+            stop_on_eos=False,
+        ).token_ids
+        assert collapsed == greedy
+        with pytest.raises(ErrorInvalidParam):
+            eng.submit_generate("x", top_p=1.5)
+    finally:
+        eng.stop_sync()
+
+
+def test_top_p_rejected_when_not_compiled(llm_engine):
+    from gofr_tpu.errors import ErrorInvalidParam
+
+    with pytest.raises(ErrorInvalidParam, match="TPU_TOP_P"):
+        llm_engine.submit_generate("x", top_p=0.9)
+
+
 def test_llm_health(llm_engine):
     h = llm_engine.health_check()
     assert h["status"] == "UP"
