@@ -7,8 +7,9 @@ mkdir -p campaign
 run() {
   name=$1; shift
   echo "=== $name: $* ==="
-  env "$@" GOFR_TPU_FLASH_DECODE=0 BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 \
-    BENCH_TOTAL_BUDGET=900 \
+  # NB: per-run env comes LAST so a run's GOFR_TPU_FLASH_DECODE etc.
+  # wins; the auto heuristic already picks dense at max_len<=2048.
+  env BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 BENCH_TOTAL_BUDGET=900 "$@" \
     timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
   echo "--- rc=$? json:"; cat "campaign/$name.json"
   tail -n 3 "campaign/$name.log"
@@ -23,9 +24,15 @@ run r3d-1b-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128
 # 3. Headline re-run for the drain/prefill-batch deltas.
 run r3d-1b BENCH_MODEL=llama-1b
 run r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
-# 4. Paged KV cache: dense fallback + the table-indexed kernel.
-run r3d-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128
+# 4. Paged KV cache: dense (gather) fallback vs the table-indexed kernel
+#    — the auto heuristic always kernels paged caches, so the dense row
+#    needs the explicit override.
+run r3d-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128 GOFR_TPU_FLASH_DECODE=0
 run r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
 # 5. int4 weights, now nibble-packed uint8 (the s4 relay bug is dodged).
 run r3d-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
 run r3d-8b-int4-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_QUANT=int4 BENCH_KV_QUANT=int8
+# 6. Long context (max_len 4096): the auto heuristic picks the kernel
+#    here (length-skipping pays); the dense run is the A/B.
+run r3d-1b-4k BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32
+run r3d-1b-4k-dense BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 GOFR_TPU_FLASH_DECODE=0

@@ -413,3 +413,15 @@ def test_unknown_model_gets_404(oai_app):
         "messages": [{"role": "user", "content": "hi"}],
     }))
     assert c.getresponse().status == 200
+
+
+def test_top_p_zero_maps_to_greedy(oai_app):
+    """OpenAI accepts top_p=0 (smallest nucleus = argmax) — it must work
+    even on an engine compiled without the nucleus sampler, as greedy."""
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "greedy via top_p", "max_tokens": 4, "top_p": 0,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read())["usage"]["completion_tokens"] >= 1
