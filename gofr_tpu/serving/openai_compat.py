@@ -149,10 +149,11 @@ def add_openai_routes(
         temperature = 1.0 if temperature is None else float(temperature)
         top_p = body.get("top_p")
         top_p = 1.0 if top_p is None else float(top_p)
-        if top_p <= 0.0:
+        if top_p == 0.0:
             # OpenAI accepts top_p=0 (smallest nucleus = the argmax
             # token); map it to plain greedy so it works on engines
-            # compiled without the nucleus sampler too.
+            # compiled without the nucleus sampler too. Negative values
+            # stay invalid and flow through to the engine's 400.
             top_p, temperature = 1.0, 0.0
         return dict(
             max_new_tokens=128 if max_tokens is None else int(max_tokens),
