@@ -23,6 +23,7 @@ run r3d-8b-spec3 BENCH_MODEL=llama-3-8b BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_S
 run r3d-1b-s64 BENCH_MODEL=llama-1b BENCH_SLOTS=64 BENCH_REQUESTS=128
 # 3. Headline re-run for the drain/prefill-batch deltas.
 run r3d-1b BENCH_MODEL=llama-1b
+run r3d-1b-w16 BENCH_MODEL=llama-1b BENCH_WINDOW=16
 run r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
 # 4. Paged KV cache: dense (gather) fallback vs the table-indexed kernel
 #    — the auto heuristic always kernels paged caches, so the dense row
