@@ -35,11 +35,15 @@ class TypedInferenceServicer(_Base):
         prompt = (
             list(request.prompt_ids) if request.prompt_ids else request.prompt
         )
-        return prompt, {
+        kw = {
             "max_new_tokens": request.max_new_tokens or 128,
             "temperature": request.temperature,
             "stop_on_eos": request.stop_on_eos,
+            "stop": list(request.stop),
         }
+        if request.top_p:  # proto default 0 = "not set"
+            kw["top_p"] = request.top_p
+        return prompt, kw
 
     async def Generate(self, request, context):
         import grpc
@@ -59,23 +63,74 @@ class TypedInferenceServicer(_Base):
             ttft_ms=round(result.ttft_s * 1e3, 3),
             tokens_per_sec=round(result.tokens_per_sec, 3),
             truncated=result.truncated,
+            finish_reason=result.finish_reason,
+            token_logprobs=[round(lp, 6) for lp in result.token_logprobs],
         )
 
     async def GenerateStream(self, request, context):
+        import asyncio
+
+        import grpc
+
         prompt, kw = self._gen_kwargs(request)
+        stops = kw.get("stop") or []
         start = time.time()
         first_at = None
         n = 0
-        async for tok in self.engine.generate_stream(prompt, **kw):
+        try:
+            req = self.engine.submit_generate(prompt, **kw)
+        except GofrError as exc:
+            code = (
+                grpc.StatusCode.INVALID_ARGUMENT
+                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
+            )
+            await context.abort(code, str(exc))
+        loop = asyncio.get_running_loop()
+        # With stop sequences, hold back enough text that a match can
+        # never be emitted before it is detected — unary and streaming
+        # must deliver the SAME trimmed output.
+        hold = max((len(s) for s in stops), default=0)
+        trimming = bool(stops) and self.tokenizer is not None
+        ids: list[int] = []
+        printed = ""
+        while True:
+            tok = await loop.run_in_executor(None, req.stream.get)
+            if tok is None:
+                break
             if first_at is None:
                 first_at = time.time()
             n += 1
-            piece = self.tokenizer.decode([tok]) if self.tokenizer else ""
-            yield pb.TokenChunk(token=tok, text=piece)
+            ids.append(tok)
+            if self.tokenizer is None:
+                yield pb.TokenChunk(token=tok, text="")
+                continue
+            full = self.tokenizer.decode(ids)
+            if trimming:
+                at = min(
+                    (p for p in (full.find(s) for s in stops) if p != -1),
+                    default=-1,
+                )
+                if at != -1:
+                    full = full[:at]
+                elif full.endswith("�"):
+                    continue  # incomplete UTF-8 tail — hold back
+                else:
+                    full = full[: max(len(printed), len(full) - hold)]
+            elif full.endswith("�"):
+                continue
+            if len(full) > len(printed):
+                piece, printed = full[len(printed):], full
+                yield pb.TokenChunk(token=tok, text=piece)
+        try:
+            result = req.future.result(timeout=30)  # authoritative reason
+            reason = result.finish_reason
+        except Exception as exc:  # noqa: BLE001 — engine died mid-stream
+            await context.abort(grpc.StatusCode.INTERNAL, str(exc))
         yield pb.TokenChunk(
             done=True,
             tokens=n,
             ttft_ms=round(((first_at or time.time()) - start) * 1e3, 3),
+            finish_reason=reason,
         )
 
     async def Embed(self, request, context):

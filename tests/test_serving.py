@@ -775,13 +775,46 @@ def test_typed_protobuf_grpc_service():
         assert reply.tokens == 4
         assert reply.ttft_ms > 0
         assert reply.truncated is False
+        assert reply.finish_reason == "length"  # budget, no eos
+        assert len(reply.token_logprobs) == 4
+        assert all(lp <= 0 for lp in reply.token_logprobs)
+
+        # top_p on an engine compiled without it → INVALID_ARGUMENT.
+        with pytest.raises(grpc_lib.RpcError) as exc_info:
+            stub.Generate(pb.GenerateRequest(
+                prompt="x", max_new_tokens=2, top_p=0.9
+            ), timeout=60)
+        assert exc_info.value.code() == grpc_lib.StatusCode.INVALID_ARGUMENT
 
         chunks = list(stub.GenerateStream(pb.GenerateRequest(
             prompt="stream", max_new_tokens=3
         ), timeout=60))
         assert chunks[-1].done is True
         assert chunks[-1].tokens == 3
+        assert chunks[-1].finish_reason == "length"
         assert all(not c.done for c in chunks[:-1])
+
+        # Stop sequences: unary and streaming must deliver the SAME
+        # trimmed text (the stream holds text back until a match is
+        # ruled out). Derive a stop string this model will actually
+        # emit: the 3rd+4th greedy characters.
+        probe = stub.Generate(pb.GenerateRequest(
+            prompt="trim me", max_new_tokens=8, stop_on_eos=False
+        ), timeout=60)
+        stop_s = probe.text[2:4]
+        if stop_s:
+            unary = stub.Generate(pb.GenerateRequest(
+                prompt="trim me", max_new_tokens=8, stop_on_eos=False,
+                stop=[stop_s],
+            ), timeout=60)
+            assert unary.finish_reason == "stop"
+            schunks = list(stub.GenerateStream(pb.GenerateRequest(
+                prompt="trim me", max_new_tokens=8, stop_on_eos=False,
+                stop=[stop_s],
+            ), timeout=60))
+            streamed = "".join(c.text for c in schunks if not c.done)
+            assert streamed == unary.text
+            assert schunks[-1].finish_reason == "stop"
 
         health = stub.Health(pb.HealthRequest(), timeout=30)
         assert health.status == "UP"
