@@ -70,6 +70,12 @@ class _ActiveSeq:
     # (the decode window that re-emits it skips one position).
     first_emitted: bool = False
     first_skip_done: bool = False
+    # Tokens already covered by dispatched windows (starts at 1: the
+    # prefill-sampled first token rides the first window). When every
+    # active slot's budget is in flight, dispatching more windows is
+    # pure overshoot — measured at depth × window_time of wasted device
+    # per retirement wave (w16d3: ~0.3 s/wave).
+    tokens_in_flight: int = 1
 
 
 @dataclass
@@ -885,9 +891,23 @@ class InferenceEngine:
                         self._work.wait(timeout=0.02)
                         self._work.clear()
                     continue
-                if any_active:
+                # Dispatch only while some active slot still has budget
+                # beyond what in-flight windows already cover — a wave of
+                # same-length requests otherwise ends with `depth` pure-
+                # overshoot windows whose tokens are all discarded.
+                # (tokens_in_flight counts the GUARANTEED k emissions per
+                # window + the prefill token; emitted = in_flight - 1, so
+                # dispatch while in_flight <= budget. eos/stop retirements
+                # end earlier via processing; speculation only ever emits
+                # MORE per window than the guarantee.)
+                wants_more = any_active and any(
+                    s is not None
+                    and s.tokens_in_flight <= s.request.max_new_tokens
+                    for s in self._slots
+                )
+                if wants_more:
                     inflight.append(self._dispatch_window())
-                while len(inflight) > (self.pipeline_depth if any_active else 0):
+                while len(inflight) > (self.pipeline_depth if wants_more else 0):
                     self._process_window(*inflight.popleft())
         except BaseException as exc:  # noqa: BLE001 — must not strand futures
             # A scheduler crash (e.g. a kernel that fails to compile on this
@@ -1267,6 +1287,9 @@ class InferenceEngine:
                 self._release_slot(i)
             self._push_table()
 
+        for seq in self._slots:
+            if seq is not None:
+                seq.tokens_in_flight += self.window_k
         t0 = time.time()
         counts = None
         if self.spec_tokens:
