@@ -93,34 +93,45 @@ class TypedInferenceServicer(_Base):
         trimming = bool(stops) and self.tokenizer is not None
         ids: list[int] = []
         printed = ""
-        while True:
-            tok = await loop.run_in_executor(None, req.stream.get)
-            if tok is None:
-                break
-            if first_at is None:
-                first_at = time.time()
-            n += 1
-            ids.append(tok)
-            if self.tokenizer is None:
-                yield pb.TokenChunk(token=tok, text="")
-                continue
-            full = self.tokenizer.decode(ids)
-            if trimming:
-                at = min(
-                    (p for p in (full.find(s) for s in stops) if p != -1),
-                    default=-1,
-                )
-                if at != -1:
-                    full = full[:at]
+        finished = False
+        try:
+            while True:
+                tok = await loop.run_in_executor(None, req.stream.get)
+                if tok is None:
+                    break
+                if first_at is None:
+                    first_at = time.time()
+                n += 1
+                ids.append(tok)
+                if self.tokenizer is None:
+                    yield pb.TokenChunk(token=tok, text="")
+                    continue
+                full = self.tokenizer.decode(ids)
+                if trimming:
+                    at = min(
+                        (p for p in (full.find(s) for s in stops) if p != -1),
+                        default=-1,
+                    )
+                    if at != -1:
+                        full = full[:at]
+                    elif full.endswith("�"):
+                        continue  # incomplete UTF-8 tail — hold back
+                    else:
+                        full = full[: max(len(printed), len(full) - hold)]
                 elif full.endswith("�"):
-                    continue  # incomplete UTF-8 tail — hold back
-                else:
-                    full = full[: max(len(printed), len(full) - hold)]
-            elif full.endswith("�"):
-                continue
-            if len(full) > len(printed):
-                piece, printed = full[len(printed):], full
-                yield pb.TokenChunk(token=tok, text=piece)
+                    continue
+                if len(full) > len(printed):
+                    piece, printed = full[len(printed):], full
+                    yield pb.TokenChunk(token=tok, text=piece)
+            finished = True
+        finally:
+            # Any abnormal exit — client cancel (CancelledError),
+            # generator finalization (GeneratorExit), or a decode error
+            # — must stop the generation so the KV slot frees instead
+            # of decoding for nobody (same contract as the SSE surface;
+            # cancel on a completed future is a no-op).
+            if not finished:
+                req.future.cancel()
         try:
             result = req.future.result(timeout=30)  # authoritative reason
             reason = result.finish_reason

@@ -920,3 +920,62 @@ def test_moe_model_serves_with_spec_and_paged():
     finally:
         plain.stop_sync()
         fancy.stop_sync()
+
+
+def test_grpc_stream_cancel_frees_slot():
+    """Cancelling a streaming RPC client-side must cancel the engine
+    request so its KV slot frees (same contract as the SSE surface)."""
+    import io
+
+    import grpc as grpc_lib
+
+    from gofr_tpu.grpc import (
+        GRPCServer,
+        TypedInferenceServicer,
+        add_typed_inference_service,
+    )
+    from gofr_tpu.grpc import inference_pb2 as pb
+    from gofr_tpu.grpc.inference_pb2_grpc import InferenceStub
+    from gofr_tpu.logging import Level, Logger
+
+    eng = InferenceEngine("llama-tiny", n_slots=1, max_len=128,
+                          tokenizer=ByteTokenizer())
+    eng.start_sync()
+    logger = Logger(level=Level.DEBUG, out=io.StringIO(), err=io.StringIO(),
+                    is_terminal=False)
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    server = GRPCServer(0, logger)
+    server.register(add_typed_inference_service, TypedInferenceServicer(eng))
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(timeout=30)
+    channel = grpc_lib.insecure_channel(f"127.0.0.1:{server.port}")
+    try:
+        stub = InferenceStub(channel)
+        call = stub.GenerateStream(pb.GenerateRequest(
+            prompt="cancel me", max_new_tokens=90, stop_on_eos=False
+        ))
+        next(iter(call))  # first chunk arrived → generation is live
+        seqs = [s for s in eng._slots if s is not None]
+        assert seqs, "stream started but no active slot"
+        victim = seqs[0].request
+        call.cancel()
+        # The engine request must be CANCELLED, not run out its budget —
+        # if the RPC cancel were a no-op, the future would complete with
+        # a result and cancelled() would be False.
+        deadline = time.time() + 30
+        while not victim.future.done() and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.future.cancelled()
+        assert len(victim.token_ids) < 90
+        # The slot frees promptly; a follow-up request completes.
+        r = stub.Generate(pb.GenerateRequest(
+            prompt="after cancel", max_new_tokens=4, stop_on_eos=False,
+        ), timeout=120)
+        assert r.tokens == 4
+    finally:
+        channel.close()
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=10)
+        eng.stop_sync()
