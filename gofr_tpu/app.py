@@ -244,9 +244,19 @@ class App:
 
     async def stop(self) -> None:
         await self._subscriptions.stop()
+        # TPU_DRAIN_S > 0: graceful engine drain — in-flight generations
+        # complete (up to the deadline) while new submissions get 503,
+        # so a rolling restart doesn't fail live requests.
+        drain_s = float(self.config.get_or_default("TPU_DRAIN_S", "0"))
         for engine in (self.container.tpu, self.container.tpu_embed):
             if engine is not None and hasattr(engine, "stop"):
-                await engine.stop()
+                import inspect
+
+                params = inspect.signature(engine.stop).parameters
+                if "drain_s" in params:
+                    await engine.stop(drain_s=drain_s)
+                else:  # injected engines without the kwarg
+                    await engine.stop()
         if self._grpc_server is not None:
             await self._grpc_server.stop()
         for server in (self._http_server, self._metrics_server):

@@ -210,6 +210,8 @@ class InferenceEngine:
 
         self._key = jax.random.PRNGKey(seed + 1)
         self._running = False
+        self._draining = False  # graceful stop: reject new, finish live
+        self._sched_idle = False  # published by the scheduler, read by drain
         self._fatal: Optional[BaseException] = None  # scheduler death reason
         # Serializes submission against the scheduler's final drain, so a
         # request can never be enqueued after the drain has already run.
@@ -824,6 +826,7 @@ class InferenceEngine:
             self._sched = None
         self._running = True
         self._drained = False
+        self._draining = False
         self._fatal = None
         if self.family == "llm":
             self._sched = threading.Thread(
@@ -833,10 +836,32 @@ class InferenceEngine:
         else:
             self._batcher.start()
 
-    async def stop(self) -> None:
-        self.stop_sync()
+    async def stop(self, drain_s: float = 0.0) -> None:
+        if drain_s > 0:
+            await asyncio.get_running_loop().run_in_executor(
+                None, partial(self.stop_sync, drain_s)
+            )
+        else:
+            self.stop_sync()
 
-    def stop_sync(self) -> None:
+    def stop_sync(self, drain_s: float = 0.0) -> None:
+        """Stop the engine. ``drain_s > 0`` = GRACEFUL: new submissions
+        get 503 while in-flight generations run to completion (up to the
+        deadline) — a rolling restart should not fail live requests the
+        way a hard stop's drain does."""
+        if drain_s > 0 and self.family == "llm" and self._running:
+            with self._submit_lock:
+                self._draining = True
+                self._sched_idle = False
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                # Only the scheduler may declare the engine idle (it does
+                # so under the submit lock after verifying every queue and
+                # slot is empty) — polling the structures from here would
+                # race requests in transit between them.
+                if self._sched_idle or not self._running:
+                    break
+                time.sleep(0.05)
         self._running = False
         if self.family == "llm":
             self._work.set()
@@ -888,9 +913,16 @@ class InferenceEngine:
                 any_active = any(s is not None for s in self._slots)
                 if not any_active and not inflight:
                     if not progressed and not self._prefill_emits:
+                        # Publish "verifiably idle" under the submit lock:
+                        # the graceful drain trusts this flag, and the
+                        # lock means no submission can race past it.
+                        with self._submit_lock:
+                            if self._pending.empty() and not self._wait_kv:
+                                self._sched_idle = True
                         self._work.wait(timeout=0.02)
                         self._work.clear()
                     continue
+                self._sched_idle = False
                 # Dispatch only while some active slot still has budget
                 # beyond what in-flight windows already cover — a wave of
                 # same-length requests otherwise ends with `depth` pure-
@@ -1604,13 +1636,24 @@ class InferenceEngine:
 
     def _enqueue(self, req: _GenRequest) -> None:
         # Check-and-enqueue under the drain lock: once the scheduler's final
-        # drain has run, nothing may land in the queue (it would hang).
+        # drain has run, nothing may land in the queue (it would hang) —
+        # and during a GRACEFUL drain nothing may land either (503; the
+        # same lock the scheduler's idle confirmation takes, so a request
+        # can never slip in after the drain observed the engine idle).
         with self._submit_lock:
+            if self._draining:
+                from gofr_tpu.errors import ErrorServiceUnavailable
+
+                raise ErrorServiceUnavailable(
+                    "engine draining for shutdown; retry against another "
+                    "replica"
+                )
             if self._fatal is not None:
                 raise RuntimeError(f"engine scheduler died: {self._fatal}")
             if not self._running or self._drained:
                 raise RuntimeError("engine not started")
             self._pending.put_nowait(req)
+            self._sched_idle = False
         self._work.set()
 
     def submit_generate(

@@ -979,3 +979,36 @@ def test_grpc_stream_cancel_frees_slot():
         loop.call_soon_threadsafe(loop.stop)
         thread.join(timeout=10)
         eng.stop_sync()
+
+
+def test_graceful_drain_completes_inflight_and_rejects_new():
+    """stop_sync(drain_s=...) lets live generations finish (no 'engine
+    stopped' failures on a rolling restart) while new submissions get
+    the 503-class error."""
+    from gofr_tpu.errors import ErrorServiceUnavailable
+
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=1, max_len=128, tokenizer=ByteTokenizer(),
+    )
+    eng.start_sync()
+    req = eng.submit_generate(
+        "drain me", max_new_tokens=40, temperature=0.0, stop_on_eos=False
+    )
+    stopper = threading.Thread(target=lambda: eng.stop_sync(drain_s=60))
+    stopper.start()
+    # Submissions during the drain are rejected with 503.
+    deadline = time.time() + 10
+    saw_reject = False
+    while time.time() < deadline and not saw_reject:
+        try:
+            eng.submit_generate("late", max_new_tokens=2)
+        except ErrorServiceUnavailable:
+            saw_reject = True
+        except Exception:
+            break
+        time.sleep(0.02)
+    stopper.join(timeout=120)
+    assert saw_reject
+    # The in-flight request COMPLETED (drain, not the hard-stop failure).
+    result = req.future.result(timeout=5)
+    assert len(result.token_ids) == 40
