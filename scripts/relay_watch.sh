@@ -11,6 +11,8 @@ while true; do
     bash scripts/tpu_campaign4.sh
     PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
       python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
+    PYTHONPATH=/root/.axon_site:/root/repo timeout 900 \
+      python scripts/tpu_configs234.py 2>&1 | grep "config"
     exit 0
   fi
   echo "relay down at $(date)"

@@ -1,0 +1,69 @@
+"""BASELINE configs 2-3 on the real chip: ResNet-50 classify and
+BERT-base embed latency/throughput through the serving engines (the
+CPU rows live in BASELINE.md; this fills the TPU column when the relay
+is up). Prints one line per measurement.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+
+import numpy as np
+
+
+def bench_engine(name, submit, n_serial=20, n_burst=32):
+    # Warm (compile) then serial p50/p99 and a concurrent burst.
+    submit().result(timeout=300)
+    lat = []
+    for _ in range(n_serial):
+        t0 = time.perf_counter()
+        submit().result(timeout=60)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    p50 = statistics.median(lat)
+    p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+    t0 = time.perf_counter()
+    futs = [submit() for _ in range(n_burst)]
+    for f in futs:
+        f.result(timeout=120)
+    wall = time.perf_counter() - t0
+    print(
+        f"{name}: serial p50={p50:.2f}ms p99={p99:.2f}ms; "
+        f"{n_burst} concurrent in {wall * 1e3:.1f}ms "
+        f"({n_burst / wall:.1f} req/s, dynamic batching)",
+        flush=True,
+    )
+
+
+def main() -> None:
+    import jax
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    print(f"platform={jax.devices()[0].platform}", flush=True)
+
+    eng = InferenceEngine("resnet-50", max_batch=8, tokenizer=None)
+    eng.start_sync()
+    img = np.random.rand(224, 224, 3).astype(np.float32)
+    bench_engine(
+        "config2 resnet-50 classify",
+        lambda: eng._batcher.submit(img),
+    )
+    eng.stop_sync()
+
+    eng = InferenceEngine(
+        "bert-base", max_batch=8, max_len=128, tokenizer=ByteTokenizer()
+    )
+    eng.start_sync()
+    text = "the quick brown fox jumps over the lazy dog " * 2
+    bench_engine(
+        "config3 bert-base embed",
+        lambda: eng._batcher.submit(text),
+    )
+    eng.stop_sync()
+
+
+if __name__ == "__main__":
+    main()
