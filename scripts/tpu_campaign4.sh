@@ -33,6 +33,9 @@ run r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DEC
 # 5. int4 weights, now nibble-packed uint8 (the s4 relay bug is dodged).
 run r3d-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
 run r3d-8b-int4-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_QUANT=int4 BENCH_KV_QUANT=int8
+# 6a. Steady-state (staggered arrivals, varied budgets) vs the default
+#     synchronized-burst workload.
+run r3d-1b-steady BENCH_MODEL=llama-1b BENCH_ARRIVAL_MS=25 BENCH_TOKEN_SPREAD=0.5
 # 6. Long context (max_len 4096): the auto heuristic picks the kernel
 #    here (length-skipping pays); the dense run is the A/B.
 run r3d-1b-4k BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32
