@@ -474,18 +474,11 @@ def transformer_prefill_chunk(
             )
         ck = ck.at[idx_row, idx_kv, idx_pos].set(k.transpose(0, 2, 1, 3))
         cv = cv.at[idx_row, idx_kv, idx_pos].set(v.transpose(0, 2, 1, 3))
-        kern = False if dense_attn else None
-        if paged:
-            vk, vv, vks, vvs = paged_view(cache.block_table, ck, cv, slots, cks, cvs)
-            attn = cache_chunk_attention(
-                q, vk, vv, jnp.arange(P), starts, lens, k_scale=vks,
-                v_scale=vvs, kernel=kern,
-            )
-        else:
-            attn = cache_chunk_attention(
-                q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs,
-                kernel=kern,
-            )
+        attn = cache_chunk_attention(
+            q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs,
+            block_table=cache.block_table if paged else None,
+            kernel=False if dense_attn else None,
+        )
         x = x + _wein("pch,hd->pcd", attn.reshape(P, c, H * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)

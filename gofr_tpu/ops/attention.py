@@ -345,6 +345,7 @@ def cache_chunk_attention(
     *,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
 ) -> jnp.ndarray:
@@ -355,7 +356,11 @@ def cache_chunk_attention(
     q: [P, c, n_heads, hd]; caches: [S, n_kv, max_len, hd] (heads-major);
     slots/starts/lens: [P] int32 (lens = valid tokens in this chunk);
     k_scale/v_scale: int8-cache scales [S, n_kv, 8, max_len].
-    Rows with t >= lens[p] return 0. kernel: None → auto (pallas on TPU).
+    block_table ([S, max_blocks] int32, paged): the caches are a pool
+    [n_blocks, n_kv, block, hd]; the kernel indexes it through the table
+    in place, while the dense path gathers each row's contiguous view
+    (the CPU/tests fallback). Rows with t >= lens[p] return 0.
+    kernel: None → auto (pallas on TPU).
     """
     if kernel is None:
         kernel = _flash_enabled()
@@ -364,16 +369,33 @@ def cache_chunk_attention(
 
         return flash_cache_attention(
             q, k_cache, v_cache, slots, starts, lens, k_scale=k_scale,
-            v_scale=v_scale, scale=scale, interpret=_interpret(),
+            v_scale=v_scale, block_table=block_table, scale=scale,
+            interpret=_interpret(),
         )
+    pre_gathered = False
+    if block_table is not None:
+        from gofr_tpu.ops.kv_cache import paged_view
+
+        if k_scale is not None:
+            k_cache, v_cache, k_scale, v_scale = paged_view(
+                block_table, k_cache, v_cache, slots, k_scale, v_scale
+            )
+        else:
+            k_cache, v_cache, _, _ = paged_view(
+                block_table, k_cache, v_cache, slots
+            )
+        pre_gathered = True  # views are already per-row: skip the gather
     P, c, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
     rep = n_heads // n_kv
     if scale is None:
         scale = hd**-0.5
     quant = k_scale is not None
-    ck = k_cache[slots]  # [P, KV, max_len, hd]
-    cv = v_cache[slots]
+    if pre_gathered:
+        ck, cv = k_cache, v_cache
+    else:
+        ck = k_cache[slots]  # [P, KV, max_len, hd]
+        cv = v_cache[slots]
     if quant:  # int8 cache: dequant via score/prob scaling, not the cache
         ck = ck.astype(q.dtype)
         cv = cv.astype(q.dtype)
@@ -382,7 +404,8 @@ def cache_chunk_attention(
         "pcgrd,pgkd->pgrck", qg, ck, preferred_element_type=jnp.float32
     ) * scale  # [P, KV, rep, c, max_len]
     if quant:
-        scores = scores * k_scale[slots][:, :, 0, :][:, :, None, None, :]
+        ksl = k_scale if pre_gathered else k_scale[slots]
+        scores = scores * ksl[:, :, 0, :][:, :, None, None, :]
     t = jnp.arange(c)
     pos = starts[:, None] + t[None, :]  # [P, c] global query positions
     valid = jnp.arange(max_len)[None, None, :] <= pos[:, :, None]
@@ -390,7 +413,8 @@ def cache_chunk_attention(
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     if quant:
-        probs = probs * v_scale[slots][:, :, 0, :][:, :, None, None, :]
+        vsl = v_scale if pre_gathered else v_scale[slots]
+        probs = probs * vsl[:, :, 0, :][:, :, None, None, :]
     out = jnp.einsum("pgrck,pgkd->pcgrd", probs.astype(q.dtype), cv)
     out = jnp.where(
         (t[None, :] < lens[:, None])[:, :, None, None, None], out, 0.0

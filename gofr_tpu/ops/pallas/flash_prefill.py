@@ -38,13 +38,21 @@ def _clamp_blk(ik, ctx_len, block_k):
     return jnp.minimum(ik, jnp.maximum(0, (ctx_len - 1) // block_k))
 
 
-def _kernel(slot_ref, start_ref, len_ref, q_ref, k_ref, v_ref, *rest,
-            scale, rep, block_k, quant):
+def _kernel(*refs, scale, rep, block_k, quant, paged):
     """Grid: (P, n_kv, kv_blocks); kv innermost (scratch carries state).
 
     quant (static): int8 cache mode — k/v scale refs follow v_ref
     ([8, block_k] sublane-replicated); see ``flash_decode._kernel``.
+    paged (static): a 4th prefetched scalar (the block table) follows
+    lens; it acts only through the index_maps — the body is unchanged.
     """
+    refs = list(refs)
+    slot_ref, start_ref, len_ref = refs[:3]
+    refs = refs[3:]
+    if paged:
+        refs.pop(0)  # block table: consumed by the index_maps only
+    q_ref, k_ref, v_ref = refs[:3]
+    rest = refs[3:]
     if quant:
         k_s_ref, v_s_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -127,6 +135,7 @@ def flash_cache_attention(
     *,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
     interpret: bool = False,
@@ -137,64 +146,89 @@ def flash_cache_attention(
     starts[p]+t); k_cache, v_cache: [S, n_kv, max_len, hd] with the chunk's
     K/V already written; slots/starts/lens: [P] int32; k_scale/v_scale:
     int8-cache scales [S, n_kv, 8, max_len]. Rows with ``t >= lens[p]``
-    return 0. Returns [P, c, n_heads, hd].
+    return 0. block_table ([S, max_blocks] int32, paged mode): the caches
+    are then a POOL [n_blocks, n_kv, block, hd] (scales
+    [n_blocks, n_kv, 8, block]); logical kv block ``ik`` of row ``p``
+    resolves to pool block ``block_table[slots[p], ik]`` inside the
+    BlockSpec index_maps — no per-chunk gather of the whole view.
+    Returns [P, c, n_heads, hd].
     """
     P, c, n_heads, hd = q.shape
-    n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    paged = block_table is not None
+    n_kv = k_cache.shape[1]
     rep = n_heads // n_kv
     quant = k_scale is not None
     if scale is None:
         scale = hd**-0.5
-    block_k = min(block_k, max_len)
-    if max_len % block_k:
-        # Persistent cache can't be padded per call; shrink to a divisor.
-        block_k = next(
-            b for b in (128, 64, 32, 16, 8, 1) if max_len % b == 0
-        )
+    if paged:
+        block_k = k_cache.shape[2]  # pool block size
+        n_grid_blocks = block_table.shape[1]
+    else:
+        max_len = k_cache.shape[2]
+        block_k = min(block_k, max_len)
+        if max_len % block_k:
+            # Persistent cache can't be padded per call; shrink to a
+            # divisor.
+            block_k = next(
+                b for b in (128, 64, 32, 16, 8, 1) if max_len % b == 0
+            )
+        n_grid_blocks = max_len // block_k
 
     # [P, c, KV, rep, hd] → [P, KV, c*rep, hd], row = t*rep + head.
     qg = q.reshape(P, c, n_kv, rep, hd).transpose(0, 2, 1, 3, 4).reshape(
         P, n_kv, c * rep, hd
     )
 
-    def kv_spec():
-        return pl.BlockSpec(
-            (1, 1, block_k, hd),
-            lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
+    if paged:
+        def kv_idx(ip, ig, ik, slots, starts, lens, bt, bk=block_k):
+            return (
+                bt[slots[ip], _clamp_blk(ik, starts[ip] + lens[ip], bk)],
+                ig, 0, 0,
+            )
+
+        # Paged scale planes index exactly like K/V (pool block, head).
+        scale_idx = kv_idx
+
+        def row_idx(ip, ig, ik, slots, starts, lens, bt):
+            return (ip, ig, 0, 0)
+    else:
+        def kv_idx(ip, ig, ik, slots, starts, lens, bk=block_k):
+            return (
                 slots[ip], ig,
-                _clamp_blk(ik, starts[ip] + lens[ip], bk), 0),
-        )
+                _clamp_blk(ik, starts[ip] + lens[ip], bk), 0,
+            )
+
+        def scale_idx(ip, ig, ik, slots, starts, lens, bk=block_k):
+            return (
+                slots[ip], ig, 0,
+                _clamp_blk(ik, starts[ip] + lens[ip], bk),
+            )
+
+        def row_idx(ip, ig, ik, slots, starts, lens):
+            return (ip, ig, 0, 0)
 
     in_specs = [
-        pl.BlockSpec(
-            (1, 1, c * rep, hd),
-            lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
-        ),
-        kv_spec(),
-        kv_spec(),
+        pl.BlockSpec((1, 1, c * rep, hd), row_idx),
+        pl.BlockSpec((1, 1, block_k, hd), kv_idx),
+        pl.BlockSpec((1, 1, block_k, hd), kv_idx),
     ]
     inputs = [
         slots.astype(jnp.int32), starts.astype(jnp.int32),
-        lens.astype(jnp.int32), qg, k_cache, v_cache,
+        lens.astype(jnp.int32),
     ]
+    if paged:
+        inputs.append(block_table.astype(jnp.int32))
+    inputs += [qg, k_cache, v_cache]
     if quant:
-        scale_spec = pl.BlockSpec(
-            (1, 1, 8, block_k),
-            lambda ip, ig, ik, slots, starts, lens, bk=block_k: (
-                slots[ip], ig, 0,
-                _clamp_blk(ik, starts[ip] + lens[ip], bk)),
-        )
+        scale_spec = pl.BlockSpec((1, 1, 8, block_k), scale_idx)
         in_specs += [scale_spec, scale_spec]
         inputs += [k_scale, v_scale]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(P, n_kv, max_len // block_k),
+        num_scalar_prefetch=4 if paged else 3,
+        grid=(P, n_kv, n_grid_blocks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, c * rep, hd),
-            lambda ip, ig, ik, slots, starts, lens: (ip, ig, 0, 0),
-        ),
+        out_specs=pl.BlockSpec((1, 1, c * rep, hd), row_idx),
         scratch_shapes=[
             pltpu.VMEM((c * rep, hd), jnp.float32),
             pltpu.VMEM((c * rep, 128), jnp.float32),
@@ -203,7 +237,8 @@ def flash_cache_attention(
     )
     out = pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, rep=rep, block_k=block_k, quant=quant
+            _kernel, scale=scale, rep=rep, block_k=block_k, quant=quant,
+            paged=paged,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((P, n_kv, c * rep, hd), q.dtype),

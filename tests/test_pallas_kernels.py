@@ -315,3 +315,48 @@ def test_flash_decode_env_override(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(kern), np.asarray(dense), atol=2e-5, rtol=2e-5
     )
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_flash_cache_attention_matches_dense(quant):
+    """Table-indexed chunked-prefill kernel == dense over the gathered
+    view: scrambled table, ragged starts/lens, GQA, ±int8 scales."""
+    from gofr_tpu.ops.attention import cache_chunk_attention
+    from gofr_tpu.ops.kv_cache import paged_view, quantize_kv
+    from gofr_tpu.ops.pallas import flash_cache_attention
+
+    P, c, n_heads, n_kv, hd, bs, mb = 3, 8, 4, 2, 32, 64, 4
+    S = 4
+    n_blocks = 1 + S * mb
+    key = jax.random.PRNGKey(17)
+    kp, kv_, kq = jax.random.split(key, 3)
+    pool_k = jax.random.normal(kp, (n_blocks, n_kv, bs, hd))
+    pool_v = jax.random.normal(kv_, (n_blocks, n_kv, bs, hd))
+    q = jax.random.normal(kq, (P, c, n_heads, hd))
+    perm = jax.random.permutation(jax.random.PRNGKey(4), n_blocks - 1) + 1
+    table = perm.reshape(S, mb).astype(jnp.int32)
+    slots = jnp.array([0, 3, 1], dtype=jnp.int32)
+    starts = jnp.array([0, 100, 37], dtype=jnp.int32)
+    lens = jnp.array([8, 8, 5], dtype=jnp.int32)
+
+    pks = pvs = None
+    if quant:
+        pool_k, ksc = quantize_kv(pool_k)
+        pool_v, vsc = quantize_kv(pool_v)
+        rep8 = lambda s: jnp.broadcast_to(  # noqa: E731
+            s[:, :, None, :], (n_blocks, n_kv, 8, bs)
+        ).astype(jnp.float32)
+        pks, pvs = rep8(ksc), rep8(vsc)
+
+    vk, vv, vks, vvs = paged_view(table, pool_k, pool_v, slots, pks, pvs)
+    want = cache_chunk_attention(
+        q, vk, vv, jnp.arange(P), starts, lens, k_scale=vks, v_scale=vvs,
+        kernel=False,
+    ).astype(jnp.float32)
+    got = flash_cache_attention(
+        q, pool_k, pool_v, slots, starts, lens, k_scale=pks, v_scale=pvs,
+        block_table=table, interpret=True,
+    ).astype(jnp.float32)
+    tol = 3e-2 if quant else 2e-5
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=tol, rtol=tol)
