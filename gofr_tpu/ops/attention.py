@@ -212,15 +212,10 @@ def decode_attention(
         # (the kernel path above indexes the pool in place instead).
         from gofr_tpu.ops.kv_cache import paged_view
 
-        rows = jnp.arange(q.shape[0])
-        if k_scale is not None:
-            k_cache, v_cache, k_scale, v_scale = paged_view(
-                block_table, k_cache, v_cache, rows, k_scale, v_scale
-            )
-        else:
-            k_cache, v_cache, _, _ = paged_view(
-                block_table, k_cache, v_cache, rows
-            )
+        k_cache, v_cache, k_scale, v_scale = paged_view(
+            block_table, k_cache, v_cache, jnp.arange(q.shape[0]),
+            k_scale, v_scale,
+        )
     n_heads = q.shape[1]
     n_kv = k_cache.shape[1]
     n_rep = n_heads // n_kv
@@ -376,14 +371,9 @@ def cache_chunk_attention(
     if block_table is not None:
         from gofr_tpu.ops.kv_cache import paged_view
 
-        if k_scale is not None:
-            k_cache, v_cache, k_scale, v_scale = paged_view(
-                block_table, k_cache, v_cache, slots, k_scale, v_scale
-            )
-        else:
-            k_cache, v_cache, _, _ = paged_view(
-                block_table, k_cache, v_cache, slots
-            )
+        k_cache, v_cache, k_scale, v_scale = paged_view(
+            block_table, k_cache, v_cache, slots, k_scale, v_scale
+        )
         pre_gathered = True  # views are already per-row: skip the gather
     P, c, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
