@@ -3,10 +3,12 @@ BERT embeddings over gRPC unary, Llama chat over gRPC stream).
 
 Service ``gofr.tpu.Inference`` with JSON messages:
 
-* ``Generate``  (unary)  {prompt, max_new_tokens?, temperature?} →
-  {text, tokens, ttft_ms}
+* ``Generate``  (unary)  {prompt, max_new_tokens?, temperature?,
+  stop? (string or list), top_p?} →
+  {text, tokens, ttft_ms, tokens_per_sec, finish_reason}
 * ``GenerateStream`` (server streaming) same request → stream of
-  {token, text} chunks then a final {done: true, ttft_ms, tokens}
+  {token, text} chunks (stop-trimmed, identical text to the unary
+  reply) then a final {done: true, ttft_ms, tokens, finish_reason}
 * ``Embed``    (unary)  {text} → {embedding}
 * ``Classify`` (unary)  {image: [[...]] nested lists or flat+shape} →
   {class, logits}
@@ -21,6 +23,7 @@ from typing import Optional
 import grpc
 import numpy as np
 
+from gofr_tpu.errors import GofrError
 from gofr_tpu.grpc.server import json_method_handlers
 
 SERVICE = "gofr.tpu.Inference"
@@ -31,42 +34,61 @@ class InferenceServicer:
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
 
-    async def Generate(self, request, context):
-        result = await self.engine.generate(
-            request.get("prompt", ""),
+    def _gen_kwargs(self, request, stream: bool) -> dict:
+        from gofr_tpu.serving.stream_text import normalize_stop
+
+        kw = dict(
             max_new_tokens=int(request.get("max_new_tokens", 128)),
             temperature=float(request.get("temperature", 0.0)),
-            stop_on_eos=bool(request.get("stop_on_eos", True)),
+            stop_on_eos=bool(request.get("stop_on_eos", not stream)),
+            stop=normalize_stop(request.get("stop")),
         )
+        if request.get("top_p") is not None:
+            kw["top_p"] = float(request["top_p"])
+        return kw
+
+    async def Generate(self, request, context):
+        try:
+            result = await self.engine.generate(
+                request.get("prompt", ""), **self._gen_kwargs(request, False)
+            )
+        except GofrError as exc:
+            code = (
+                grpc.StatusCode.INVALID_ARGUMENT
+                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
+            )
+            await context.abort(code, str(exc))
         return {
             "text": result.text,
             "tokens": len(result.token_ids),
             "ttft_ms": round(result.ttft_s * 1e3, 2),
             "tokens_per_sec": round(result.tokens_per_sec, 2),
+            "finish_reason": result.finish_reason,
         }
 
     async def GenerateStream(self, request, context):
-        import time
+        from gofr_tpu.serving.stream_text import stream_generation
 
-        start = time.time()
-        first_at = None
-        n = 0
-        async for tok in self.engine.generate_stream(
-            request.get("prompt", ""),
-            max_new_tokens=int(request.get("max_new_tokens", 128)),
-            temperature=float(request.get("temperature", 0.0)),
-            stop_on_eos=bool(request.get("stop_on_eos", False)),
-        ):
-            if first_at is None:
-                first_at = time.time()
-            n += 1
-            piece = self.tokenizer.decode([tok]) if self.tokenizer else ""
-            yield {"token": tok, "text": piece}
-        yield {
-            "done": True,
-            "tokens": n,
-            "ttft_ms": round(((first_at or time.time()) - start) * 1e3, 2),
-        }
+        try:
+            async for ev in stream_generation(
+                self.engine, request.get("prompt", ""),
+                self._gen_kwargs(request, True), self.tokenizer,
+            ):
+                if ev["type"] == "piece":
+                    yield {"token": ev["token"], "text": ev["text"]}
+                else:
+                    yield {
+                        "done": True,
+                        "tokens": ev["tokens"],
+                        "ttft_ms": ev["ttft_ms"],
+                        "finish_reason": ev["finish_reason"],
+                    }
+        except GofrError as exc:
+            code = (
+                grpc.StatusCode.INVALID_ARGUMENT
+                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
+            )
+            await context.abort(code, str(exc))
 
     async def Embed(self, request, context):
         emb = await self.engine.embed(request.get("text", ""))

@@ -68,81 +68,32 @@ class TypedInferenceServicer(_Base):
         )
 
     async def GenerateStream(self, request, context):
-        import asyncio
-
         import grpc
 
+        from gofr_tpu.serving.stream_text import stream_generation
+
         prompt, kw = self._gen_kwargs(request)
-        stops = kw.get("stop") or []
-        start = time.time()
-        first_at = None
-        n = 0
         try:
-            req = self.engine.submit_generate(prompt, **kw)
+            async for ev in stream_generation(
+                self.engine, prompt, kw, self.tokenizer
+            ):
+                if ev["type"] == "piece":
+                    yield pb.TokenChunk(token=ev["token"], text=ev["text"])
+                else:
+                    yield pb.TokenChunk(
+                        done=True,
+                        tokens=ev["tokens"],
+                        ttft_ms=ev["ttft_ms"],
+                        finish_reason=ev["finish_reason"],
+                    )
         except GofrError as exc:
             code = (
                 grpc.StatusCode.INVALID_ARGUMENT
                 if exc.status_code < 500 else grpc.StatusCode.INTERNAL
             )
             await context.abort(code, str(exc))
-        loop = asyncio.get_running_loop()
-        # With stop sequences, hold back enough text that a match can
-        # never be emitted before it is detected — unary and streaming
-        # must deliver the SAME trimmed output.
-        hold = max((len(s) for s in stops), default=0)
-        trimming = bool(stops) and self.tokenizer is not None
-        ids: list[int] = []
-        printed = ""
-        finished = False
-        try:
-            while True:
-                tok = await loop.run_in_executor(None, req.stream.get)
-                if tok is None:
-                    break
-                if first_at is None:
-                    first_at = time.time()
-                n += 1
-                ids.append(tok)
-                if self.tokenizer is None:
-                    yield pb.TokenChunk(token=tok, text="")
-                    continue
-                full = self.tokenizer.decode(ids)
-                if trimming:
-                    at = min(
-                        (p for p in (full.find(s) for s in stops) if p != -1),
-                        default=-1,
-                    )
-                    if at != -1:
-                        full = full[:at]
-                    elif full.endswith("�"):
-                        continue  # incomplete UTF-8 tail — hold back
-                    else:
-                        full = full[: max(len(printed), len(full) - hold)]
-                elif full.endswith("�"):
-                    continue
-                if len(full) > len(printed):
-                    piece, printed = full[len(printed):], full
-                    yield pb.TokenChunk(token=tok, text=piece)
-            finished = True
-        finally:
-            # Any abnormal exit — client cancel (CancelledError),
-            # generator finalization (GeneratorExit), or a decode error
-            # — must stop the generation so the KV slot frees instead
-            # of decoding for nobody (same contract as the SSE surface;
-            # cancel on a completed future is a no-op).
-            if not finished:
-                req.future.cancel()
-        try:
-            result = req.future.result(timeout=30)  # authoritative reason
-            reason = result.finish_reason
         except Exception as exc:  # noqa: BLE001 — engine died mid-stream
             await context.abort(grpc.StatusCode.INTERNAL, str(exc))
-        yield pb.TokenChunk(
-            done=True,
-            tokens=n,
-            ttft_ms=round(((first_at or time.time()) - start) * 1e3, 3),
-            finish_reason=reason,
-        )
 
     async def Embed(self, request, context):
         emb = await self.engine.embed(request.text)
