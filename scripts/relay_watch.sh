@@ -1,18 +1,42 @@
 #!/bin/bash
-# Wait for the axon relay to come back, then run the pending TPU work:
-# campaign 4 (spec + s64 retest + headline re-runs) and the dispatch-cost
-# probe. Probe cadence 5 min; each probe is timeout-guarded because a
-# wedged relay HANGS jax.devices() rather than failing it.
+# Wait for the axon relay, then run the highest-value pending TPU
+# measurements. Deadline-aware: after DEADLINE_EPOCH the watcher exits
+# without starting anything, and the mini set (~35 min) is used instead
+# of the full campaign when less than ~90 min remain — the driver's
+# end-of-round bench must not contend with a long campaign.
 set -u
 cd "$(dirname "$0")/.."
+DEADLINE_EPOCH=${DEADLINE_EPOCH:-$(date -d '15:05' +%s 2>/dev/null || echo 0)}
+mkdir -p campaign
+mini() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  env BENCH_ATTEMPTS=1 BENCH_TIMEOUT=600 BENCH_TOTAL_BUDGET=600 "$@" \
+    timeout 700 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
+  echo "--- rc=$? json:"; cat "campaign/$name.json"
+}
 while true; do
+  now=$(date +%s)
+  if [ "$DEADLINE_EPOCH" -gt 0 ] && [ "$now" -ge "$DEADLINE_EPOCH" ]; then
+    echo "deadline passed at $(date); exiting without measurements"
+    exit 0
+  fi
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "relay up at $(date)"
-    bash scripts/tpu_campaign4.sh
-    PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
-      python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
-    PYTHONPATH=/root/.axon_site:/root/repo timeout 900 \
-      python scripts/tpu_configs234.py 2>&1 | grep "config"
+    remaining=$(( DEADLINE_EPOCH - $(date +%s) ))
+    if [ "$DEADLINE_EPOCH" -le 0 ] || [ "$remaining" -gt 5400 ]; then
+      bash scripts/tpu_campaign4.sh
+      PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
+        python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
+      PYTHONPATH=/root/.axon_site:/root/repo timeout 900 \
+        python scripts/tpu_configs234.py 2>&1 | grep "config"
+    else
+      echo "short window (${remaining}s): mini harvest"
+      mini r3d-1b BENCH_MODEL=llama-1b
+      mini r3d-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3
+      mini r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
+      mini r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
+    fi
     exit 0
   fi
   echo "relay down at $(date)"
