@@ -115,7 +115,7 @@ def run_with_retry() -> int:
     for knob in ("BENCH_MODEL", "BENCH_NEW_TOKENS", "BENCH_SLOTS",
                  "BENCH_MAX_LEN", "BENCH_QUANT", "BENCH_SPEC",
                  "BENCH_KV_BLOCK", "BENCH_KV_QUANT", "GOFR_TPU_FLASH_DECODE",
-                 "BENCH_ARRIVAL_MS", "BENCH_TOKEN_SPREAD"):
+                 "BENCH_ARRIVAL_MS", "BENCH_TOKEN_SPREAD", "BENCH_MEGA"):
         env.pop(knob, None)
     env["BENCH_REQUESTS"] = "8"
     env["BENCH_CHILD_WALL"] = "870"
@@ -239,11 +239,12 @@ def main() -> None:
         kv_quant = ""
     spec_tokens = int(os.environ.get("BENCH_SPEC", "0"))
     kv_block = int(os.environ.get("BENCH_KV_BLOCK", "0"))
+    mega = int(os.environ.get("BENCH_MEGA", "0"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
         f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "
         f"kv_quant={kv_quant or 'bf16'} spec={spec_tokens} "
-        f"kv_block={kv_block}")
+        f"kv_block={kv_block} mega={mega}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -258,6 +259,7 @@ def main() -> None:
         kv_quant=kv_quant,
         spec_tokens=spec_tokens,
         kv_block=kv_block,
+        mega_windows=mega,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

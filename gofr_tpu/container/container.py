@@ -50,7 +50,9 @@ class Container:
         remote_url = config.get_or_default("REMOTE_LOG_URL", "")
         if remote_url:
             interval = float(config.get_or_default("REMOTE_LOG_FETCH_INTERVAL", "15"))
-            c._remote_logger = RemoteLevelLogger(c.logger, remote_url, interval)
+            c._remote_logger = RemoteLevelLogger(
+                c.logger, remote_url, interval, metrics=c.metrics
+            )
             c._remote_logger.start()
 
         c.register_framework_metrics()

@@ -5,13 +5,17 @@ A background daemon thread polls ``REMOTE_LOG_URL`` every
 logger's level. The endpoint is expected to return
 ``{"data": [{"serviceName": ..., "logLevel": {"LOG_LEVEL": "DEBUG"}}]}`` —
 the same shape the reference parses (``dynamicLevelLogger.go:84-106``).
+
+The fetch goes through the framework's own instrumented service client
+(``service.HTTPService`` — spans, response histogram, structured service
+logs), exactly as the reference builds its poller on ``service.NewHTTPService``
+(``dynamicLevelLogger.go:58``): the framework's background HTTP traffic is
+visible to the same observability stack as user traffic.
 """
 
 from __future__ import annotations
 
-import json
 import threading
-import urllib.request
 
 from gofr_tpu.logging.level import level_from_string
 from gofr_tpu.logging.logger import Logger
@@ -20,10 +24,14 @@ from gofr_tpu.logging.logger import Logger
 class RemoteLevelLogger:
     """Wraps a :class:`Logger` and keeps its level in sync with a remote URL."""
 
-    def __init__(self, logger: Logger, url: str, interval_s: float = 15.0) -> None:
+    def __init__(
+        self, logger: Logger, url: str, interval_s: float = 15.0, metrics=None
+    ) -> None:
         self.logger = logger
         self._url = url
         self._interval = interval_s
+        self._metrics = metrics
+        self._service = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -37,6 +45,9 @@ class RemoteLevelLogger:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._service is not None:
+            self._service.close()
+            self._service = None
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
@@ -45,8 +56,17 @@ class RemoteLevelLogger:
     def fetch_and_update(self) -> None:
         """One poll cycle (reference ``dynamicLevelLogger.go:73-106``)."""
         try:
-            with urllib.request.urlopen(self._url, timeout=5) as resp:
-                body = json.loads(resp.read().decode("utf-8"))
+            if self._service is None:
+                from gofr_tpu.service.client import HTTPService
+
+                # The level endpoint IS the address; each poll GETs "".
+                # A quiet logger on the client: the poll's own debug-line
+                # would otherwise echo every 15s at DEBUG level — the span
+                # and histogram still record it.
+                self._service = HTTPService(
+                    self._url, logger=None, metrics=self._metrics, timeout=5.0
+                )
+            body = self._service.get("").json()
             data = body.get("data") or []
             if not data:
                 return

@@ -270,19 +270,26 @@ def _ffn_moe(x, lp, cfg):
 
 
 def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
-                   lengths=None):
+                   lengths=None, norm_out=None):
     """One decoder layer over a full sequence. Returns (x, (k, v)).
 
     attn_fn: optional override for the attention call, e.g. a
     context-parallel (ring/Ulysses) implementation — signature
     ``attn_fn(q, k, v, mask)``. lengths: per-row valid prefix lengths
     (right-padded serving prefill) — keeps the flash-kernel path, unlike
-    a dense ``mask``.
+    a dense ``mask``. norm_out: optional sharding hook applied to each
+    block's normed input — the Megatron-SP block boundary: the sequence-
+    parallel residual all-gathers over tp HERE, so the head sharding of
+    q/k/v flows purely from the tp-sharded weights and RoPE's split/
+    concat never sees a seq→head reshard (which GSPMD can only do by
+    involuntary full rematerialization when n_kv_heads < tp).
     """
     b, s, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    if norm_out is not None:
+        h = norm_out(h)
     q = _wein("bsd,dh->bsh", h, lp["wq"]).reshape(b, s, H, hd)
     k = _wein("bsd,dh->bsh", h, lp["wk"]).reshape(b, s, KV, hd)
     v = _wein("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
@@ -295,6 +302,8 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
     x = x + _wein("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    if norm_out is not None:
+        h = norm_out(h)
     ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
     return x + ffn, (k, v)
 

@@ -168,13 +168,29 @@ def make_train_step(
                 return jax.lax.with_sharding_constraint(h, spec)
             return jax.lax.with_sharding_constraint(h, NamedSharding(mesh, spec))
 
+        def gather_seq(h):
+            # Megatron-SP block boundary: all-gather the tp part of the
+            # sequence sharding on each block's normed input, so the
+            # tp-sharded projection weights alone determine q/k/v head
+            # shardings — without this, RoPE's concat on k sits on a
+            # seq→kv-head reshard GSPMD can only do by involuntary full
+            # rematerialization when n_kv_heads < tp (the r3 dryrun
+            # spmd_partitioner warnings). cp's seq sharding stays put.
+            if not sp:
+                return h
+            spec = prune_specs(P("dp", "cp" if use_cp else None, None), mesh)
+            if use_pp:
+                return jax.lax.with_sharding_constraint(h, spec)
+            return jax.lax.with_sharding_constraint(h, NamedSharding(mesh, spec))
+
         def make_body(cos, sin, positions):
             # One definition serves both the plain scan and the pipeline
             # stage scan; RoPE tables come in as args because shard_map
             # bodies must not close over tracers.
             def body(x, lp):
                 out, _ = _layer_prefill(
-                    x, lp, cfg, cos, sin, positions, mask=None, attn_fn=attn_fn
+                    x, lp, cfg, cos, sin, positions, mask=None,
+                    attn_fn=attn_fn, norm_out=gather_seq,
                 )
                 return constrain(out), None
 

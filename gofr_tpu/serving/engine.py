@@ -128,6 +128,7 @@ class InferenceEngine:
         max_wait_s: float = 0.005,
         window_k: int = 8,
         pipeline_depth: int = 2,
+        mega_windows: int = 0,
         prefill_chunk: int = 256,
         prefill_batch: int = 8,
         truncate_prompts: bool = False,
@@ -225,6 +226,24 @@ class InferenceEngine:
             self.n_slots = n_slots
             self.window_k = max(1, window_k)
             self.pipeline_depth = max(1, pipeline_depth)
+            # Mega-windows (throughput mode): ONE dispatch runs up to
+            # `mega_windows` k-step windows inside a device-side
+            # lax.while_loop that early-exits when every slot's remaining
+            # budget is covered (or its EOS was emitted). Through a
+            # network-attached relay each dispatch costs a full host↔device
+            # RTT *in the calling thread*, so at window 8 the RTT is paid
+            # every 8 steps (~72 of each ~105 ms wall, measured — r3
+            # campaign); one mega dispatch amortizes it over m×k steps.
+            # Trade-off: tokens surface per mega-window, not per window —
+            # streaming granularity coarsens, so serving defaults keep it
+            # off and bursty/offline throughput turns it on.
+            self.mega_windows = max(0, mega_windows)
+            if self.mega_windows > 1 and spec_tokens > 0:
+                raise ValueError(
+                    "TPU_MEGA_WINDOWS and TPU_SPEC_TOKENS are mutually "
+                    "exclusive (speculation amortizes dispatch differently; "
+                    "compose-on-demand is future work)"
+                )
             # Chunked prefill: ONE fixed [prefill_batch, prefill_chunk]
             # compile serves every prompt length, and chunk steps interleave
             # with decode windows so admission never stalls active streams.
@@ -425,6 +444,7 @@ class InferenceEngine:
             max_wait_s=float(config.get_or_default("TPU_BATCH_WAIT_MS", "5")) / 1e3,
             window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
             pipeline_depth=int(config.get_or_default("TPU_PIPELINE_DEPTH", "2")),
+            mega_windows=int(config.get_or_default("TPU_MEGA_WINDOWS", "0")),
             kv_quant=config.get_or_default("TPU_KV_QUANT", ""),
             prefix_slots=int(config.get_or_default("TPU_PREFIX_SLOTS", "0")),
             prefill_chunk=int(config.get_or_default("TPU_PREFILL_CHUNK", "256")),
@@ -641,6 +661,58 @@ class InferenceEngine:
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
             return emitted, final, final_lp, cache, key
 
+        eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
+
+        @partial(jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5))
+        def mega_window(params, tokens, logps, cache, active, key, temps,
+                        greedy, topps, remaining, eos_stop, k, m):
+            """Up to m k-step windows in ONE dispatch. A device-side
+            while_loop runs windows until every slot's `remaining` budget
+            is covered (decremented k per window; zeroed when the slot
+            emits EOS and `eos_stop` holds) or m windows have run. Emits
+            into a fixed [2, m*k, S] buffer; entries past the returned
+            windows_run*k are untouched zeros the host must not read.
+            Slots whose budget ran out while others continue keep
+            computing junk tokens — their cache writes land past their
+            retired region (scatter drops OOB; paged lookups park at
+            block 0) and the host drops the tokens post-retirement, so
+            the junk is slot-local by construction."""
+
+            def body(carry, _):
+                tokens, logps, cache, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = transformer_decode_step(
+                    params, tokens, cache, active, cfg, dense_attn=dense_attn
+                )
+                nxt, nlp = sample(logits, sub, temps, greedy, topps)
+                return (nxt, nlp, cache, key), (tokens, logps)
+
+            S = tokens.shape[0]
+            emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
+
+            def win_body(state):
+                w, tokens, logps, cache, key, remaining, emitted = state
+                (tokens, logps, cache, key), (etoks, elps) = jax.lax.scan(
+                    body, (tokens, logps, cache, key), length=k
+                )
+                slab = jnp.stack([etoks.astype(jnp.float32), elps])
+                emitted = jax.lax.dynamic_update_slice(
+                    emitted, slab, (0, w * k, 0)
+                )
+                hit = jnp.any(etoks == eos_id, axis=0) & eos_stop
+                remaining = jnp.where(hit, 0, jnp.maximum(remaining - k, 0))
+                return (w + 1, tokens, logps, cache, key, remaining, emitted)
+
+            def win_cond(state):
+                return (state[0] < m) & jnp.any(state[5] > 0)
+
+            w, final, final_lp, cache, key, _, emitted = jax.lax.while_loop(
+                win_cond, win_body,
+                (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
+                 remaining, emitted0),
+            )
+            return emitted, w, final, final_lp, cache, key
+
         G = self.spec_tokens
 
         @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9))
@@ -737,6 +809,7 @@ class InferenceEngine:
         self._prefill_chunk_step = prefill_chunk_step
         self._prefill_chunk_step_hist = prefill_chunk_step_hist
         self._decode_window = decode_window
+        self._mega_window = mega_window
         self._spec_window = spec_window
 
     def _build_encoder_step(self) -> None:
@@ -887,7 +960,7 @@ class InferenceEngine:
         # (D=1) tok/s/chip and beyond; the floor becomes device step time.
         from collections import deque
 
-        inflight: deque = deque()  # (emitted_dev, counts_dev|None, snapshot, t)
+        inflight: deque = deque()  # _dispatch_window return tuples
         try:
             while self._running:
                 # One chunk step per iteration, interleaved 1:1 with decode
@@ -970,7 +1043,7 @@ class InferenceEngine:
         # interpreter teardown (observed as a runtime-client thread panic
         # at exit).
         while inflight:
-            emitted, _, _, _ = inflight.popleft()
+            emitted = inflight.popleft()[0]
             try:
                 np.asarray(emitted)
             except Exception:  # noqa: BLE001 — device may already be down
@@ -1270,8 +1343,9 @@ class InferenceEngine:
         """Dispatch one k-step device window (non-blocking) and start the
         async device→host copy of its emitted block — [2, k, S] for plain
         decode, [2, k, S, G+1] plus a [k, S] counts array for speculative
-        windows. Returns ``(emitted_dev, counts_dev_or_None,
-        slots_snapshot, t_dispatch)`` for _process_window — the snapshot
+        windows, [2, m*k, S] plus a windows-run scalar for mega windows.
+        Returns ``(emitted_dev, counts_dev_or_None, slots_snapshot,
+        t_dispatch, wrun_dev_or_None)`` for _process_window — the snapshot
         matters because by processing time a retired slot may already hold
         a NEW request admitted in between."""
         jnp = self._jnp
@@ -1295,6 +1369,27 @@ class InferenceEngine:
             self._greedy_dev = jnp.asarray(greedy)
             self._slot_state_dirty = False
 
+        # Mega-window mode: compute each slot's remaining budget on the
+        # host (it knows tokens_in_flight) and hand it to the device loop;
+        # coverage accounting uses the same number so `wants_more` gating
+        # stays exact (the device delivers ≥ min(m·k, remaining) steps per
+        # slot — early exit only fires once every remaining hits 0 or EOS,
+        # and an EOS slot is retired by processing, so accounting can
+        # never strand a live slot).
+        mega = self.mega_windows if not self.spec_tokens else 0
+        remaining_host = eos_stop_host = None
+        cover = self.window_k * mega
+        if mega > 1:
+            remaining_host = np.zeros((self.n_slots,), dtype=np.int32)
+            eos_stop_host = np.zeros((self.n_slots,), dtype=bool)
+            for i, seq in enumerate(self._slots):
+                if seq is not None:
+                    remaining_host[i] = max(
+                        0,
+                        seq.request.max_new_tokens + 1 - seq.tokens_in_flight,
+                    )
+                    eos_stop_host[i] = seq.request.stop_on_eos
+
         if self.kv_block:
             # Allocation must stay AHEAD of the window about to be
             # dispatched (its writes land before the host sees the
@@ -1304,6 +1399,8 @@ class InferenceEngine:
             for i, seq in enumerate(self._slots):
                 if seq is None:
                     continue
+                if mega > 1:
+                    wt = min(cover, int(remaining_host[i]))
                 req = seq.request
                 base = req.effective_prompt_len or len(req.prompt_ids)
                 need = base + self._dispatched_tokens[i] + wt + 1
@@ -1317,14 +1414,35 @@ class InferenceEngine:
                     ))
                 req.stream.put(None)
                 self._release_slot(i)
+                if mega > 1:
+                    # remaining_host was computed before this loop; the
+                    # device must not spin mega windows covering a slot
+                    # whose request just failed.
+                    remaining_host[i] = 0
+                    eos_stop_host[i] = False
             self._push_table()
 
-        for seq in self._slots:
+        for i, seq in enumerate(self._slots):
             if seq is not None:
-                seq.tokens_in_flight += self.window_k
+                seq.tokens_in_flight += (
+                    min(cover, int(remaining_host[i])) if mega > 1
+                    else self.window_k
+                )
         t0 = time.time()
         counts = None
-        if self.spec_tokens:
+        wrun = None
+        if mega > 1:
+            (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
+             self._key_dev) = (
+                self._mega_window(
+                    self.params, self._tokens_dev, self._logps_dev,
+                    self.cache, self._active_dev, self._key_dev,
+                    self._temps_dev, self._greedy_dev, self._topp_dev,
+                    jnp.asarray(remaining_host), jnp.asarray(eos_stop_host),
+                    k=self.window_k, m=mega,
+                )
+            )
+        elif self.spec_tokens:
             (emitted, counts, self._tokens_dev, self._logps_dev, self.cache,
              self._key_dev, self._history_dev) = (
                 self._spec_window(
@@ -1344,28 +1462,38 @@ class InferenceEngine:
                     k=self.window_k,
                 )
             )
-        for arr in (emitted, counts) if counts is not None else (emitted,):
+        extras = [a for a in (counts, wrun) if a is not None]
+        for arr in (emitted, *extras):
             try:
                 arr.copy_to_host_async()
             except AttributeError:  # older jax / fake backends
                 pass
-        return emitted, counts, list(self._slots), t0
+        return emitted, counts, list(self._slots), t0, wrun
 
-    def _process_window(self, emitted, counts, snapshot, t0) -> None:
+    def _process_window(self, emitted, counts, snapshot, t0, wrun=None) -> None:
         t_fetch = time.time()
         # Interruptible wait: while this window's block is in flight, flush
         # any prefill first-token fetches that land first (unloaded TTFT
-        # would otherwise be gated on the window fetch).
-        if self._prefill_emits:
-            try:
-                while not emitted.is_ready():
-                    self._flush_prefill_emits()
-                    time.sleep(0.001)
-            except AttributeError:
-                pass
-        # Decode: [2, k, S]. Spec: [2, k, S, G+1] + counts [k, S].
+        # would otherwise be gated on the window fetch). Mega mode also
+        # keeps ADMITTING during the wait — prefill chunks for queued
+        # requests ride the device queue behind the in-flight mega window,
+        # overlapping next-wave admission with current-wave decode.
+        if (self._prefill_emits or wrun is not None) and hasattr(
+            emitted, "is_ready"
+        ):
+            while not emitted.is_ready():
+                if wrun is not None:
+                    self._dispatch_prefill_chunk()
+                self._flush_prefill_emits()
+                time.sleep(0.001)
+        # Decode: [2, k, S] (mega: [2, m*k, S], first wrun*k valid).
+        # Spec: [2, k, S, G+1] + counts [k, S].
         emitted_host = np.asarray(emitted)
         counts_host = np.asarray(counts) if counts is not None else None
+        steps = (
+            self.window_k if wrun is None
+            else int(np.asarray(wrun)) * self.window_k
+        )
         if self._metrics is not None:
             # decode_fetch = host-blocking time (what pipelining hides);
             # decode_window_pipeline = dispatch→processed incl. D windows
@@ -1397,7 +1525,7 @@ class InferenceEngine:
             if counts_host is None:
                 step_toks = (
                     ((emitted_host[0, step, i], emitted_host[1, step, i]),)
-                    for step in range(self.window_k)
+                    for step in range(steps)
                 )
             else:
                 step_toks = (

@@ -96,3 +96,50 @@ def test_file_logger(tmp_path):
 def test_silent_file_logger_when_no_path():
     log = new_file_logger("")
     log.info("discarded")  # must not raise
+
+
+def test_remote_level_logger_uses_instrumented_client():
+    """The level poll rides service.HTTPService: the level hot-swaps AND
+    the client's response histogram records the framework's own fetch
+    (reference dynamicLevelLogger.go:58 builds on service.NewHTTPService)."""
+    import http.server
+    import threading
+
+    from gofr_tpu.logging import RemoteLevelLogger
+    from gofr_tpu.metrics import new_metrics_manager
+
+    class LevelHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps({
+                "data": [{"serviceName": "t",
+                          "logLevel": {"LOG_LEVEL": "DEBUG"}}]
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), LevelHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        log, _, _ = make_logger(Level.INFO)
+        metrics = new_metrics_manager(log)
+        metrics.new_histogram(
+            "app_http_service_response", "outbound client response time"
+        )
+        rl = RemoteLevelLogger(
+            log, f"http://127.0.0.1:{srv.server_address[1]}/level",
+            metrics=metrics,
+        )
+        rl.fetch_and_update()
+        assert log.level == Level.DEBUG
+        from gofr_tpu.metrics.exposition import render_prometheus
+
+        assert "app_http_service_response" in render_prometheus(metrics)
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,169 @@
+"""Mega-window decode: one dispatch runs many k-step windows on device
+with budget/EOS early-exit (engine.py `mega_window`). Through a
+network-attached relay every dispatch costs a host↔device RTT, so the
+mega loop is the throughput-mode dispatch amortizer; these tests pin its
+correctness contract on CPU: token-for-token parity with the pipelined
+per-window path, exact budget delivery, EOS retirement, and composition
+with paged KV and sampling."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+PROMPT = "the quick brown fox"
+
+
+def _greedy(engine, prompt=PROMPT, n=24, **kw):
+    return engine.generate_sync(
+        prompt, max_new_tokens=n, temperature=0.0, stop_on_eos=False, **kw
+    )
+
+
+@pytest.fixture(scope="module")
+def base_tokens():
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=4, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(),
+    )
+    eng.start_sync()
+    try:
+        yield _greedy(eng).token_ids
+    finally:
+        eng.stop_sync()
+
+
+def _mega_engine(**kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("window_k", 4)
+    kw.setdefault("mega_windows", 4)
+    kw.setdefault("tokenizer", ByteTokenizer())
+    return InferenceEngine("llama-tiny", **kw)
+
+
+def test_mega_matches_windowed_greedy(base_tokens):
+    eng = _mega_engine()
+    eng.start_sync()
+    try:
+        assert _greedy(eng).token_ids == base_tokens
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_budget_exact_and_multiple_dispatches(base_tokens):
+    # 24 tokens at window 4 × mega 2 = 8-step coverage → 3+ mega
+    # dispatches; the budget must come out exact, not window-rounded.
+    eng = _mega_engine(mega_windows=2)
+    eng.start_sync()
+    try:
+        r = _greedy(eng)
+        assert len(r.token_ids) == 24
+        assert r.token_ids == base_tokens
+        assert r.finish_reason == "length"
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_uneven_budgets_concurrent():
+    # Slots with different budgets: device early-exit covers the longest;
+    # each request still gets exactly its own budget.
+    eng = _mega_engine()
+    eng.start_sync()
+    try:
+        reqs = [
+            eng.submit_generate(
+                PROMPT, max_new_tokens=n, temperature=0.0, stop_on_eos=False
+            )
+            for n in (3, 9, 17, 24)
+        ]
+        got = [len(r.future.result(timeout=120).token_ids) for r in reqs]
+        assert got == [3, 9, 17, 24]
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_eos_stops_early():
+    # ByteTokenizer eos_id=0; random-init llama-tiny rarely emits byte 0
+    # greedily, so drive EOS via stop_on_eos=False vs True on the same
+    # stream only if it appears — instead pin the *mechanism*: a stop
+    # text retires at host mid-mega and the engine must not stall.
+    eng = _mega_engine()
+    eng.start_sync()
+    try:
+        base = _greedy(eng, n=24).text
+        stop = base[2:6]
+        r = eng.generate_sync(
+            PROMPT, max_new_tokens=24, temperature=0.0, stop_on_eos=False,
+            stop=[stop], timeout=120,
+        )
+        assert stop not in r.text
+        assert r.finish_reason == "stop"
+        # Engine still serves after the mid-mega retirement.
+        assert _greedy(eng, n=8).token_ids == _greedy(eng, n=8).token_ids
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_with_paged_kv(base_tokens):
+    eng = _mega_engine(kv_block=32, kv_pool_blocks=24)
+    eng.start_sync()
+    try:
+        assert _greedy(eng).token_ids == base_tokens
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_sampled_path_runs():
+    # Sampled slots (temperature>0) exercise the PRNG threading through
+    # the while_loop carry; determinism across engines isn't asserted
+    # (different dispatch partitioning consumes the key differently),
+    # only that generation completes with the full budget.
+    eng = _mega_engine()
+    eng.start_sync()
+    try:
+        r = eng.generate_sync(
+            PROMPT, max_new_tokens=12, temperature=0.8, stop_on_eos=False,
+            timeout=120,
+        )
+        assert len(r.token_ids) == 12
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_rejects_speculation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _mega_engine(spec_tokens=2)
+
+
+def test_mega_device_eos_early_exit(base_tokens):
+    """Pin the DEVICE-side EOS exit: a tokenizer whose eos_id is a token
+    the greedy stream actually emits must (a) stop that request at the
+    EOS with finish_reason 'stop', and (b) leave a concurrent
+    stop_on_eos=False request's full budget intact — the while_loop's
+    `hit & eos_stop` must zero only the opted-in slot's remaining."""
+    eos_tok = int(base_tokens[5])
+
+    class EosTokenizer(ByteTokenizer):
+        pass
+
+    EosTokenizer.eos_id = eos_tok
+    eng = _mega_engine(tokenizer=EosTokenizer())
+    eng.start_sync()
+    try:
+        stopping = eng.submit_generate(
+            PROMPT, max_new_tokens=24, temperature=0.0, stop_on_eos=True
+        )
+        free = eng.submit_generate(
+            PROMPT, max_new_tokens=24, temperature=0.0, stop_on_eos=False
+        )
+        r_stop = stopping.future.result(timeout=120)
+        r_free = free.future.result(timeout=120)
+        first_eos = base_tokens.index(eos_tok)
+        assert r_stop.token_ids == base_tokens[: first_eos + 1]
+        assert r_stop.finish_reason == "stop"
+        assert r_free.token_ids == base_tokens
+    finally:
+        eng.stop_sync()
