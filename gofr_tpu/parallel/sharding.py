@@ -107,19 +107,12 @@ def make_train_step(
     axes = mesh_axis_sizes(mesh)
     use_pp = axes.get("pp", 1) > 1
     use_cp = axes.get("cp", 1) > 1
-    if use_pp and use_cp:
-        raise NotImplementedError(
-            "pp + cp in one mesh is not supported: context-parallel "
-            "attention opens its own shard_map, which cannot nest inside "
-            "the pipeline's manual-pp region. Shard the sequence with "
-            "sp (over tp) alongside pp, or use cp without pp."
-        )
 
     optimizer = optax.adamw(learning_rate)
     param_specs = prune_specs(transformer_param_specs(cfg, pp=use_pp), mesh)
 
     attn_fn = None
-    if use_cp:
+    if use_cp and not use_pp:
         from gofr_tpu.ops.ring_attention import context_parallel_attention
 
         def attn_fn(q, k, v, mask):
@@ -127,6 +120,15 @@ def make_train_step(
             return context_parallel_attention(
                 q, k, v, mesh, axis_name="cp", impl=cp_impl
             )
+    # pp + cp: the ring/Ulysses implementations open their own shard_map,
+    # which cannot nest inside the pipeline's manual-pp region — but the
+    # pipeline's shard_map is PARTIAL-manual (only pp), so cp composes as
+    # a GSPMD auto axis instead: activations stay seq-sharded over cp and
+    # the dense causal attention's softmax reductions compile to cp
+    # collectives (the serving cp path's formulation). Costs an allgather
+    # of K/V over cp inside attention where the ring overlaps it — the
+    # composition is for capacity (layers over pp, sequence over cp), not
+    # peak attention overlap.
 
     # Mixed precision: master params live in f32 (stable AdamW moments, f32
     # grad all-reduces); compute runs in cfg.dtype so the MXU sees bf16.

@@ -349,21 +349,50 @@ class InferenceEngine:
             self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
             self._work = threading.Event()
             self._sched: Optional[threading.Thread] = None
-            self._tokens_dev = jnp.zeros((n_slots,), dtype=jnp.int32)
-            self._logps_dev = jnp.zeros((n_slots,), dtype=jnp.float32)
+            # Host→device uploads: on a mesh, place as a REPLICATED global
+            # array — on a multi-host (DCN) mesh a bare jnp.asarray would
+            # make a process-local array that cannot feed the global-SPMD
+            # jits (every process runs this same code with the same host
+            # values, so replicated placement is well-defined).
+            if mesh is not None:
+                from jax.sharding import (
+                    NamedSharding as _NS,
+                    PartitionSpec as _P,
+                )
+
+                _rep = _NS(mesh, _P())
+                self._up = lambda x: jax.device_put(x, _rep)
+            else:
+                self._up = jnp.asarray
+            # Multi-PROCESS mesh on a non-TPU backend: serialize device
+            # programs. A real TPU core executes one program at a time, so
+            # identical per-process launch order is enough for its
+            # collectives to pair up; the CPU backend's gloo collectives
+            # run on a thread pool, and two in-flight programs (pipelined
+            # windows, prefill overlapping decode) interleave their
+            # collectives nondeterministically across ranks — observed as
+            # gloo "Received data size doesn't match expected size".
+            self._lockstep = False
+            if mesh is not None:
+                procs = {d.process_index for d in mesh.devices.flat}
+                self._lockstep = (
+                    len(procs) > 1 and jax.default_backend() != "tpu"
+                )
+            self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
+            self._logps_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
             # Slot state lives ON DEVICE between windows; re-uploaded only
             # when admissions/retirements change it (dirty flag). Steady-
             # state decode then dispatches with zero host→device traffic.
-            self._key_dev = jax.random.PRNGKey(seed + 2)
-            self._active_dev = jnp.zeros((n_slots,), dtype=bool)
-            self._temps_dev = jnp.ones((n_slots,), dtype=jnp.float32)
-            self._topp_dev = jnp.ones((n_slots,), dtype=jnp.float32)
-            self._greedy_dev = jnp.ones((n_slots,), dtype=bool)
+            self._key_dev = self._up(np.asarray(jax.random.PRNGKey(seed + 2)))
+            self._active_dev = self._up(np.zeros((n_slots,), dtype=bool))
+            self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
+            self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
+            self._greedy_dev = self._up(np.ones((n_slots,), dtype=bool))
             self._slot_state_dirty = True
             # Token history per slot (prompt + generated) — the n-gram
             # draft source; only maintained when speculation is on.
             self._history_dev = (
-                jnp.zeros((n_slots, self.max_len), dtype=jnp.int32)
+                self._up(np.zeros((n_slots, self.max_len), dtype=np.int32))
                 if self.spec_tokens else None
             )
             self._build_llm_steps()
@@ -534,6 +563,20 @@ class InferenceEngine:
         # collectives under cp).
         dense_attn = self.mesh is not None
 
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            _rep_sh = NamedSharding(self.mesh, PartitionSpec())
+
+            def rep(x):
+                # Host-fetched outputs must be REPLICATED: on a multi-host
+                # (DCN) mesh every process np.asarray()s its local shard,
+                # which is only the full value if the sharding says so.
+                return jax.lax.with_sharding_constraint(x, _rep_sh)
+        else:
+            def rep(x):
+                return x
+
         enable_top_p = self.enable_top_p
 
         def sample(logits, key, temps, greedy, topps):
@@ -608,7 +651,7 @@ class InferenceEngine:
             cache = cache._replace(
                 lengths=jnp.where(has, (starts + lens)[idx], cache.lengths)
             )
-            return cache, all_tokens, all_logps, first, first_lp, key
+            return cache, all_tokens, all_logps, rep(first), rep(first_lp), key
 
         prefill_chunk_step = partial(
             jax.jit, donate_argnums=(1, 11, 12, 13)
@@ -659,7 +702,7 @@ class InferenceEngine:
                 body, (tokens, logps, cache, key), length=k
             )
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
-            return emitted, final, final_lp, cache, key
+            return rep(emitted), final, final_lp, cache, key
 
         eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
 
@@ -711,7 +754,7 @@ class InferenceEngine:
                 (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
                  remaining, emitted0),
             )
-            return emitted, w, final, final_lp, cache, key
+            return rep(emitted), rep(w), final, final_lp, cache, key
 
         G = self.spec_tokens
 
@@ -804,7 +847,7 @@ class InferenceEngine:
             emitted = jnp.stack(
                 [etoks.astype(jnp.float32), elps]
             )  # [2, k, S, G+1]
-            return emitted, ecnt, final, final_lp, cache, key, history
+            return rep(emitted), rep(ecnt), final, final_lp, cache, key, history
 
         self._prefill_chunk_step = prefill_chunk_step
         self._prefill_chunk_step_hist = prefill_chunk_step_hist
@@ -1124,7 +1167,7 @@ class InferenceEngine:
         """Upload the block-table mirror if admission/top-up dirtied it."""
         if self.kv_block and self._table_dirty:
             self.cache = self.cache._replace(
-                block_table=self._jnp.asarray(self._table_host)
+                block_table=self._up(self._table_host)
             )
             self._table_dirty = False
 
@@ -1242,10 +1285,10 @@ class InferenceEngine:
         t0 = time.time()
         self._push_table()
         args = (
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
-            jnp.asarray(finalize), jnp.asarray(row_valid),
-            jnp.asarray(temps), jnp.asarray(greedy), jnp.asarray(topps),
+            self.params, self.cache, self._up(tokens),
+            self._up(slots), self._up(starts), self._up(lens),
+            self._up(finalize), self._up(row_valid),
+            self._up(temps), self._up(greedy), self._up(topps),
             self._key_dev, self._tokens_dev, self._logps_dev,
         )
         if self.spec_tokens:
@@ -1256,6 +1299,8 @@ class InferenceEngine:
         else:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
              first_lp_dev, self._key_dev) = self._prefill_chunk_step(*args)
+        if self._lockstep:
+            self._jax.block_until_ready(first_dev)
         if self._metrics is not None:
             self._metrics.record_histogram(
                 "app_tpu_infer_latency", time.time() - t0, "kind", "prefill"
@@ -1363,10 +1408,10 @@ class InferenceEngine:
                     temps[i] = max(seq.request.temperature, 0.0)
                     topps[i] = seq.request.top_p
                     greedy[i] = seq.request.temperature <= 0
-            self._active_dev = jnp.asarray(active)
-            self._temps_dev = jnp.asarray(temps)
-            self._topp_dev = jnp.asarray(topps)
-            self._greedy_dev = jnp.asarray(greedy)
+            self._active_dev = self._up(active)
+            self._temps_dev = self._up(temps)
+            self._topp_dev = self._up(topps)
+            self._greedy_dev = self._up(greedy)
             self._slot_state_dirty = False
 
         # Mega-window mode: compute each slot's remaining budget on the
@@ -1438,7 +1483,7 @@ class InferenceEngine:
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._key_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
-                    jnp.asarray(remaining_host), jnp.asarray(eos_stop_host),
+                    self._up(remaining_host), self._up(eos_stop_host),
                     k=self.window_k, m=mega,
                 )
             )
@@ -1468,6 +1513,8 @@ class InferenceEngine:
                 arr.copy_to_host_async()
             except AttributeError:  # older jax / fake backends
                 pass
+        if self._lockstep:
+            self._jax.block_until_ready(emitted)
         return emitted, counts, list(self._slots), t0, wrun
 
     def _process_window(self, emitted, counts, snapshot, t0, wrun=None) -> None:
@@ -1688,11 +1735,11 @@ class InferenceEngine:
             (self.cache, self._tokens_dev, self._logps_dev, first, _flp,
              self._key_dev) = (
                 self._prefill_chunk_step(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(slots), jnp.asarray(starts), jnp.asarray(lens),
-                    jnp.asarray(finalize), jnp.asarray(row_valid),
-                    jnp.asarray(temps), jnp.asarray(greedy),
-                    jnp.asarray(topps),
+                    self.params, self.cache, self._up(tokens),
+                    self._up(slots), self._up(starts), self._up(lens),
+                    self._up(finalize), self._up(row_valid),
+                    self._up(temps), self._up(greedy),
+                    self._up(topps),
                     self._key_dev, self._tokens_dev, self._logps_dev,
                 )
             )

@@ -25,17 +25,15 @@ while true; do
     echo "relay up at $(date)"
     remaining=$(( DEADLINE_EPOCH - $(date +%s) ))
     if [ "$DEADLINE_EPOCH" -le 0 ] || [ "$remaining" -gt 5400 ]; then
-      bash scripts/tpu_campaign4.sh
+      bash scripts/tpu_campaign5.sh
       PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
         python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
-      PYTHONPATH=/root/.axon_site:/root/repo timeout 900 \
-        python scripts/tpu_configs234.py 2>&1 | grep "config"
     else
-      echo "short window (${remaining}s): mini harvest"
-      mini r3d-1b BENCH_MODEL=llama-1b
-      mini r3d-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3
-      mini r3d-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
-      mini r3d-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
+      echo "short window (${remaining}s): mini harvest — mega A/B first"
+      mini r4-1b BENCH_MODEL=llama-1b
+      mini r4-1b-mega16 BENCH_MODEL=llama-1b BENCH_MEGA=16
+      mini r4-8b-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=8
+      mini r4-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
     fi
     exit 0
   fi

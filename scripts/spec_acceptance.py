@@ -1,0 +1,173 @@
+"""Speculative-decoding acceptance on LEARNED weights (VERDICT r3 #4).
+
+Random-init greedy decode collapses into repetition loops that flatter
+n-gram speculation; this script removes that caveat without network
+access (zero-egress: no pretrained checkpoints) by TRAINING llama-tiny
+on a real text corpus with the framework's own training step, then
+serving the trained weights with speculation and measuring acceptance on
+held-out prompts from the same distribution. It doubles as the
+train→serve end-to-end proof: the params that come out of
+``value_and_grad``+optax go straight into ``InferenceEngine(params=…)``.
+
+Usage: [SPEC_STEPS=400] [SPEC_G=3] python scripts/spec_acceptance.py
+Prints one JSON line:
+  {"acceptance_tokens_per_step": …, "spec_tps": …, "plain_tps": …, …}
+
+Acceptance reads the engine's own ``app_tpu_spec_tokens_per_step``
+histogram (1.0 = no draft accepted per live step; G+1 = all accepted),
+so the number reported is exactly what production metrics would show.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def corpus_text() -> str:
+    """Real prose from the repo's own docs tree (stable, on-disk)."""
+    import glob
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    parts = []
+    for path in sorted(glob.glob(os.path.join(root, "docs", "**", "*.md",),
+                                 recursive=True)) + [
+        os.path.join(root, "README.md"), os.path.join(root, "SURVEY.md")
+    ]:
+        try:
+            with open(path, encoding="utf-8") as f:
+                parts.append(f.read())
+        except OSError:
+            pass
+    text = "\n\n".join(parts)
+    assert len(text) > 50_000, f"corpus too small: {len(text)}"
+    return text
+
+
+def main() -> None:
+    steps = int(os.environ.get("SPEC_STEPS", "400"))
+    G = int(os.environ.get("SPEC_G", "3"))
+    seq = 128
+    batch = 16
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from gofr_tpu.models.registry import get_model
+    from gofr_tpu.models.transformer import transformer_forward
+    from gofr_tpu.parallel.sharding import cross_entropy_loss
+
+    spec = get_model("llama-tiny")
+    cfg = spec.config
+    text = corpus_text()
+
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    ids = np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(np.int32)
+    split = int(len(ids) * 0.9)
+    train_ids, held = ids[:split], ids[split:]
+
+    params = spec.init(jax.random.PRNGKey(0), cfg)
+    optimizer = optax.adamw(3e-3)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            return cross_entropy_loss(
+                transformer_forward(p, tokens, cfg)[:, :-1], tokens[:, 1:]
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    loss = None
+    for step in range(steps):
+        starts = rng.integers(0, len(train_ids) - seq - 1, size=batch)
+        tokens = jnp.asarray(
+            np.stack([train_ids[s : s + seq] for s in starts])
+        )
+        loss, params, opt_state = train_step(params, opt_state, tokens)
+        if step % 100 == 0 or step == steps - 1:
+            print(
+                f"train step {step}: loss {float(loss):.3f} "
+                f"({time.time() - t0:.0f}s)",
+                file=sys.stderr, flush=True,
+            )
+    final_loss = float(loss)
+
+    # Serve the trained weights, speculation on vs off, same prompts.
+    from gofr_tpu.metrics import new_metrics_manager
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    prompts = []
+    for i in range(8):
+        s = int(rng.integers(0, len(held) - 96))
+        prompts.append(
+            bytes(held[s : s + 64].astype(np.uint8)).decode("utf-8", "replace")
+        )
+
+    def serve(spec_tokens: int):
+        metrics = new_metrics_manager()
+        metrics.new_histogram(
+            "app_tpu_spec_tokens_per_step", "accepted+1 per live step"
+        )
+        eng = InferenceEngine(
+            "llama-tiny", n_slots=8, max_len=256, window_k=8,
+            tokenizer=tok, params=params, spec_tokens=spec_tokens,
+            metrics=metrics,
+        )
+        eng.start_sync()
+        t = time.time()
+        reqs = [
+            eng.submit_generate(
+                p, max_new_tokens=64, temperature=0.0, stop_on_eos=False
+            )
+            for p in prompts
+        ]
+        results = [r.future.result(timeout=600) for r in reqs]
+        wall = time.time() - t
+        eng.stop_sync()
+        total = sum(len(r.token_ids) for r in results)
+        acc = None
+        # Read the histogram through its public collect() shape.
+        for inst in metrics._instruments.values():
+            if inst.name == "app_tpu_spec_tokens_per_step":
+                agg_sum = agg_n = 0.0
+                for _, (_, (s_, n_)) in inst.collect().items():
+                    agg_sum += s_
+                    agg_n += n_
+                if agg_n:
+                    acc = agg_sum / agg_n
+        return total / wall, acc
+
+    spec_tps, acceptance = serve(G)
+    plain_tps, _ = serve(0)
+
+    out = {
+        "metric": "spec_acceptance_tokens_per_step",
+        "acceptance_tokens_per_step": round(acceptance, 3) if acceptance else None,
+        "spec_g": G,
+        "spec_tps": round(spec_tps, 1),
+        "plain_tps": round(plain_tps, 1),
+        "speedup": round(spec_tps / plain_tps, 3) if plain_tps else None,
+        "train_steps": steps,
+        "final_loss": round(final_loss, 3),
+        "platform": jax.devices()[0].platform,
+        "weights": "trained-on-docs-corpus (not random)",
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,44 @@ def main() -> None:
     # sum (3.0) can only come out right if the DCN runtime spans processes.
     gathered = multihost_utils.process_allgather(jnp.array([float(pid + 1)]))
     result = {"pid": pid, "topo": topo, "allgather_sum": float(gathered.sum())}
+    print(f"phase allgather done pid={pid}", flush=True)
+
+    # Multi-host SERVING smoke (VERDICT r3 #9): one engine whose tp=2 mesh
+    # takes one device from EACH process — its decode/prefill collectives
+    # ride the DCN runtime, the serving analog of the training dryrun.
+    # Both processes run the same SPMD program: requests are submitted
+    # one-at-a-time from idle so the two schedulers issue identical jit
+    # sequences (arrival timing can't reorder dispatches mid-stream).
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    import jax
+
+    by_proc: dict = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    mesh_devs = np.array(
+        [sorted(by_proc[p], key=lambda d: d.id)[0] for p in sorted(by_proc)]
+    )
+    mesh = Mesh(mesh_devs, ("tp",))
+    multihost_utils.sync_global_devices("engine-init")
+    print(f"phase engine-init pid={pid}", flush=True)
+    engine = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), mesh=mesh, seed=0,
+    )
+    print(f"phase engine-built pid={pid}", flush=True)
+    engine.start_sync()
+    r = engine.generate_sync(
+        "dcn serving smoke", max_new_tokens=16, temperature=0.0,
+        stop_on_eos=False, timeout=180,
+    )
+    engine.stop_sync()
+    print(f"phase engine-done pid={pid}", flush=True)
+    result["engine_tokens"] = [int(t) for t in r.token_ids]
 
     done_file = os.path.join(tmpdir, "peer_done")
     if pid == 0:

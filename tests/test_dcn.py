@@ -74,3 +74,26 @@ def test_two_process_dcn_runtime_and_service_hop():
         assert r["allgather_sum"] == 3.0  # 1.0 + 2.0 across processes
     assert results[0]["served_peer"] is True
     assert results[1]["hop"]["process_count"] == 2
+
+    # Multi-host serving: the tp=2-over-DCN engine generation must agree
+    # BETWEEN processes (SPMD consistency) and WITH a single-process
+    # engine at the same seed/geometry (the collectives changed the
+    # placement, not the math).
+    toks0, toks1 = results[0]["engine_tokens"], results[1]["engine_tokens"]
+    assert toks0 == toks1 and len(toks0) == 16, (toks0, toks1)
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    ref = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), seed=0,
+    )
+    ref.start_sync()
+    try:
+        base = ref.generate_sync(
+            "dcn serving smoke", max_new_tokens=16, temperature=0.0,
+            stop_on_eos=False,
+        )
+    finally:
+        ref.stop_sync()
+    assert toks0 == [int(t) for t in base.token_ids], (toks0, base.token_ids)
