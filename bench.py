@@ -265,6 +265,7 @@ def main() -> None:
         spec_tokens=spec_tokens,
         kv_block=kv_block,
         mega_windows=mega,
+        prefill_depth=int(os.environ.get("BENCH_PREFILL_DEPTH", "1")),
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")

@@ -153,12 +153,17 @@ def new_google_from_config(config, logger=None, metrics=None) -> GooglePubSubCli
     publisher = pubsub_v1.PublisherClient()
     subscriber = pubsub_v1.SubscriberClient()
 
+    from google.api_core import exceptions as gexc  # type: ignore
+
     class _Driver:
+        # Swallow ONLY AlreadyExists: the client caches ensured topics/
+        # subscriptions, so a transient connection failure swallowed here
+        # would never be retried — creation must raise to stay uncached.
         def ensure_topic(self, topic):
             path = publisher.topic_path(project, topic)
             try:
                 publisher.create_topic(name=path)
-            except Exception:  # noqa: BLE001 — AlreadyExists
+            except gexc.AlreadyExists:
                 pass
 
         def ensure_subscription(self, topic, subscription):
@@ -167,7 +172,7 @@ def new_google_from_config(config, logger=None, metrics=None) -> GooglePubSubCli
                     name=subscriber.subscription_path(project, subscription),
                     topic=publisher.topic_path(project, topic),
                 )
-            except Exception:  # noqa: BLE001 — AlreadyExists
+            except gexc.AlreadyExists:
                 pass
 
         def publish(self, topic, value):

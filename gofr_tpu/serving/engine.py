@@ -129,6 +129,7 @@ class InferenceEngine:
         window_k: int = 8,
         pipeline_depth: int = 2,
         mega_windows: int = 0,
+        prefill_depth: int = 1,
         prefill_chunk: int = 256,
         prefill_batch: int = 8,
         truncate_prompts: bool = False,
@@ -238,17 +239,17 @@ class InferenceEngine:
             # streaming granularity coarsens, so serving defaults keep it
             # off and bursty/offline throughput turns it on.
             self.mega_windows = max(0, mega_windows)
-            if self.mega_windows > 1 and spec_tokens > 0:
-                raise ValueError(
-                    "TPU_MEGA_WINDOWS and TPU_SPEC_TOKENS are mutually "
-                    "exclusive (speculation amortizes dispatch differently; "
-                    "compose-on-demand is future work)"
-                )
             # Chunked prefill: ONE fixed [prefill_batch, prefill_chunk]
             # compile serves every prompt length, and chunk steps interleave
             # with decode windows so admission never stalls active streams.
             self.prefill_chunk = max(16, min(prefill_chunk, self.max_len))
             self.prefill_batch = max(1, min(prefill_batch, n_slots))
+            # Multi-chunk prefill (long-prompt dispatch amortizer): when
+            # every prefilling row has ≥2 full chunks left before its
+            # finalize chunk, run up to this many chunks per dispatch in
+            # a device-side loop. 1 disables (every chunk is its own
+            # dispatch — the latency-interleaving default).
+            self.prefill_depth = max(1, prefill_depth)
             self.truncate_prompts = truncate_prompts
             # Speculative decoding (n-gram prompt lookup): each device step
             # verifies spec_tokens drafts + 1, so windows can emit up to
@@ -474,6 +475,7 @@ class InferenceEngine:
             window_k=int(config.get_or_default("TPU_DECODE_WINDOW", "8")),
             pipeline_depth=int(config.get_or_default("TPU_PIPELINE_DEPTH", "2")),
             mega_windows=int(config.get_or_default("TPU_MEGA_WINDOWS", "0")),
+            prefill_depth=int(config.get_or_default("TPU_PREFILL_DEPTH", "1")),
             kv_quant=config.get_or_default("TPU_KV_QUANT", ""),
             prefix_slots=int(config.get_or_default("TPU_PREFIX_SLOTS", "0")),
             prefill_chunk=int(config.get_or_default("TPU_PREFILL_CHUNK", "256")),
@@ -657,6 +659,61 @@ class InferenceEngine:
             jax.jit, donate_argnums=(1, 11, 12, 13)
         )(_prefill_core)
 
+        def _multi_chunk_core(params, cache, tokens3, slots, starts0,
+                              n_chunks, history):
+            """Up to D FULL (non-finalizing) [P, c] chunks in ONE dispatch
+            — the long-prompt TTFT amortizer: through a network-attached
+            relay every chunk dispatch costs a host↔device RTT, so an 8k
+            prompt at c=256 pays ~32 RTTs (~2.3 s) without this. No
+            sampling and no lengths update happen here (both belong to
+            the finalize chunk, which always runs via the single-chunk
+            step); history recording (speculation) mirrors
+            prefill_chunk_step_hist. tokens3: [D, P, c]; n_chunks ≤ D is
+            a runtime operand, so one compile serves every prompt length."""
+            D, Pb, c = tokens3.shape
+
+            def cond(s):
+                return s[0] < n_chunks
+
+            def body(s):
+                i, cache, history = s
+                toks = jax.lax.dynamic_index_in_dim(
+                    tokens3, i, 0, keepdims=False
+                )
+                starts = starts0 + i * c
+                lens = jnp.full((Pb,), c, jnp.int32)
+                _, cache = transformer_prefill_chunk(
+                    params, toks, cache, slots, starts, lens, cfg,
+                    dense_attn=dense_attn,
+                )
+                if history is not None:
+                    hpos = jnp.clip(
+                        starts[:, None] + jnp.arange(c)[None, :], 0,
+                        history.shape[1] - 1,
+                    )
+                    history = history.at[slots[:, None], hpos].set(toks)
+                return i + 1, cache, history
+
+            _, cache, history = jax.lax.while_loop(
+                cond, body, (jnp.asarray(0, jnp.int32), cache, history)
+            )
+            return cache, history
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_multi_chunk(params, cache, tokens3, slots, starts0,
+                                n_chunks):
+            cache, _ = _multi_chunk_core(
+                params, cache, tokens3, slots, starts0, n_chunks, None
+            )
+            return cache
+
+        @partial(jax.jit, donate_argnums=(1, 6))
+        def prefill_multi_chunk_hist(params, cache, tokens3, slots, starts0,
+                                     n_chunks, history):
+            return _multi_chunk_core(
+                params, cache, tokens3, slots, starts0, n_chunks, history
+            )
+
         @partial(jax.jit, donate_argnums=(1, 11, 12, 13, 14))
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
@@ -758,17 +815,9 @@ class InferenceEngine:
 
         G = self.spec_tokens
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9))
-        def spec_window(params, tokens, logps, cache, active, key, temps,
-                        greedy, topps, history, k):
-            """k speculative steps on device. Each step drafts G tokens by
-            n-gram lookup in the slot's own history, verifies draft+current
-            in ONE [S, G+1] forward (cache read-only), accepts the longest
-            matching prefix (greedy slots — lossless by construction;
-            sampled slots take 0 drafts and resample position 0), commits
-            all layers' K/V in one scatter, and carries the bonus token.
-            Emits per step: tokens [S, G+1] (= the step's inputs), logps,
-            and counts [S] (=accepted+1 valid entries)."""
+        def make_spec_body(params, active, temps, greedy, topps):
+            """One speculative step (scan body), shared by the plain spec
+            window and the mega-spec while_loop."""
             from gofr_tpu.models.transformer import (
                 commit_chunk_kv,
                 ngram_draft,
@@ -839,6 +888,20 @@ class InferenceEngine:
                     (step_tokens, step_logps, counts),
                 )
 
+            return body
+
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9))
+        def spec_window(params, tokens, logps, cache, active, key, temps,
+                        greedy, topps, history, k):
+            """k speculative steps on device. Each step drafts G tokens by
+            n-gram lookup in the slot's own history, verifies draft+current
+            in ONE [S, G+1] forward (cache read-only), accepts the longest
+            matching prefix (greedy slots — lossless by construction;
+            sampled slots take 0 drafts and resample position 0), commits
+            all layers' K/V in one scatter, and carries the bonus token.
+            Emits per step: tokens [S, G+1] (= the step's inputs), logps,
+            and counts [S] (=accepted+1 valid entries)."""
+            body = make_spec_body(params, active, temps, greedy, topps)
             (final, final_lp, cache, key, history), (etoks, elps, ecnt) = (
                 jax.lax.scan(
                     body, (tokens, logps, cache, key, history), length=k
@@ -849,11 +912,69 @@ class InferenceEngine:
             )  # [2, k, S, G+1]
             return rep(emitted), rep(ecnt), final, final_lp, cache, key, history
 
+        @partial(jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 9))
+        def mega_spec_window(params, tokens, logps, cache, active, key,
+                             temps, greedy, topps, history, remaining,
+                             eos_stop, k, m):
+            """Mega × speculation: up to m k-step spec windows in ONE
+            dispatch. `remaining` decrements by the ACTUAL emitted token
+            counts (speculation emits ≥ k per window per live slot, so
+            coverage ≥ the plain-decode guarantee); EOS detection scans
+            only the VALID (first `counts`) entries of each step —
+            rejected draft positions must not zero a budget."""
+            body = make_spec_body(params, active, temps, greedy, topps)
+            S = tokens.shape[0]
+            emitted0 = jnp.zeros((2, m * k, S, G + 1), dtype=jnp.float32)
+            ecnt0 = jnp.zeros((m * k, S), dtype=jnp.int32)
+
+            def win_body(state):
+                (w, tokens, logps, cache, key, history, remaining,
+                 emitted, ecnt) = state
+                ((tokens, logps, cache, key, history),
+                 (etoks, elps, cnts)) = jax.lax.scan(
+                    body, (tokens, logps, cache, key, history), length=k
+                )
+                slab = jnp.stack([etoks.astype(jnp.float32), elps])
+                emitted = jax.lax.dynamic_update_slice(
+                    emitted, slab, (0, w * k, 0, 0)
+                )
+                ecnt = jax.lax.dynamic_update_slice(
+                    ecnt, cnts.astype(jnp.int32), (w * k, 0)
+                )
+                valid = (
+                    jnp.arange(G + 1)[None, None, :] < cnts[:, :, None]
+                )  # [k, S, G+1]
+                hit = (
+                    ((etoks == eos_id) & valid).any(axis=(0, 2)) & eos_stop
+                )
+                delivered = cnts.sum(axis=0).astype(jnp.int32)  # [S]
+                remaining = jnp.where(
+                    hit, 0, jnp.maximum(remaining - delivered, 0)
+                )
+                return (w + 1, tokens, logps, cache, key, history,
+                        remaining, emitted, ecnt)
+
+            def win_cond(state):
+                return (state[0] < m) & jnp.any(state[6] > 0)
+
+            (w, final, final_lp, cache, key, history, _, emitted, ecnt) = (
+                jax.lax.while_loop(
+                    win_cond, win_body,
+                    (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
+                     history, remaining, emitted0, ecnt0),
+                )
+            )
+            return (rep(emitted), rep(ecnt), rep(w), final, final_lp, cache,
+                    key, history)
+
         self._prefill_chunk_step = prefill_chunk_step
         self._prefill_chunk_step_hist = prefill_chunk_step_hist
+        self._prefill_multi_chunk = prefill_multi_chunk
+        self._prefill_multi_chunk_hist = prefill_multi_chunk_hist
         self._decode_window = decode_window
         self._mega_window = mega_window
         self._spec_window = spec_window
+        self._mega_spec_window = mega_spec_window
 
     def _build_encoder_step(self) -> None:
         from gofr_tpu.models.bert import bert_embed
@@ -1252,6 +1373,67 @@ class InferenceEngine:
 
         P, c = self.prefill_batch, self.prefill_chunk
         rows = list(self._prefilling.items())[:P]
+
+        # Multi-chunk fast path: rows with ≥2 full chunks before their
+        # finalize chunk burn through up to prefill_depth of them in one
+        # device-side loop (no sampling, no finalize — the single-chunk
+        # step below always closes a prompt). Only DEEP rows join the
+        # batch — one short prompt admitted alongside an 8k one must not
+        # disable the amortizer for the long row; shallow rows take the
+        # single-chunk step next loop iteration. Paged mode needs no
+        # per-chunk allocation: admission already covered the whole prompt.
+        if self.prefill_depth > 1:
+            deep = [
+                (slot, st, rem)
+                for slot, st in rows
+                for rem in [
+                    (len(st.request.prompt_ids) - st.done - 1) // c
+                ]
+                if rem >= 2
+            ]
+            if deep:
+                d = min(min(rem for _, _, rem in deep), self.prefill_depth)
+            if deep and d >= 2:
+                D = self.prefill_depth
+                tokens3 = np.zeros((D, P, c), dtype=np.int32)
+                slots_m = np.zeros((P,), dtype=np.int32)
+                starts_m = np.zeros((P,), dtype=np.int32)
+                for i, (slot, st, _) in enumerate(deep):
+                    ids = st.request.prompt_ids
+                    for j in range(d):
+                        lo = st.done + j * c
+                        tokens3[j, i, :] = ids[lo : lo + c]
+                    slots_m[i] = slot
+                    starts_m[i] = st.done
+                for i in range(len(deep), P):  # pad rows duplicate row 0
+                    tokens3[:, i, :] = tokens3[:, 0, :]
+                    slots_m[i], starts_m[i] = slots_m[0], starts_m[0]
+                t0 = time.time()
+                self._push_table()
+                margs = (
+                    self.params, self.cache, self._up(tokens3),
+                    self._up(slots_m), self._up(starts_m),
+                    self._up(np.int32(d)),
+                )
+                if self.spec_tokens:
+                    self.cache, self._history_dev = (
+                        self._prefill_multi_chunk_hist(
+                            *margs, self._history_dev
+                        )
+                    )
+                else:
+                    self.cache = self._prefill_multi_chunk(*margs)
+                if self._lockstep:
+                    self._jax.block_until_ready(self.cache.lengths)
+                for _, st, _ in deep:
+                    st.done += d * c
+                if self._metrics is not None:
+                    self._metrics.record_histogram(
+                        "app_tpu_infer_latency", time.time() - t0,
+                        "kind", "prefill_multi",
+                    )
+                return True
+
         tokens = np.zeros((P, c), dtype=np.int32)
         slots = np.zeros((P,), dtype=np.int32)
         starts = np.zeros((P,), dtype=np.int32)
@@ -1421,9 +1603,9 @@ class InferenceEngine:
         # slot — early exit only fires once every remaining hits 0 or EOS,
         # and an EOS slot is retired by processing, so accounting can
         # never strand a live slot).
-        mega = self.mega_windows if not self.spec_tokens else 0
+        mega = self.mega_windows
         remaining_host = eos_stop_host = None
-        cover = self.window_k * mega
+        cover = self.window_k * mega  # guaranteed MINIMUM emissions
         if mega > 1:
             remaining_host = np.zeros((self.n_slots,), dtype=np.int32)
             eos_stop_host = np.zeros((self.n_slots,), dtype=bool)
@@ -1445,7 +1627,13 @@ class InferenceEngine:
                 if seq is None:
                     continue
                 if mega > 1:
-                    wt = min(cover, int(remaining_host[i]))
+                    # Windows this slot still WRITES real K/V for: its
+                    # remaining budget covers in ≤ ceil(remaining/k)
+                    # windows (spec emits ≥ k/window); each window writes
+                    # k*(G+1) positions. Junk past that parks at block 0.
+                    k = self.window_k
+                    windows_i = min(mega, -(-int(remaining_host[i]) // k))
+                    wt = windows_i * k * (self.spec_tokens + 1)
                 req = seq.request
                 base = req.effective_prompt_len or len(req.prompt_ids)
                 need = base + self._dispatched_tokens[i] + wt + 1
@@ -1476,7 +1664,18 @@ class InferenceEngine:
         t0 = time.time()
         counts = None
         wrun = None
-        if mega > 1:
+        if mega > 1 and self.spec_tokens:
+            (emitted, counts, wrun, self._tokens_dev, self._logps_dev,
+             self.cache, self._key_dev, self._history_dev) = (
+                self._mega_spec_window(
+                    self.params, self._tokens_dev, self._logps_dev,
+                    self.cache, self._active_dev, self._key_dev,
+                    self._temps_dev, self._greedy_dev, self._topp_dev,
+                    self._history_dev, self._up(remaining_host),
+                    self._up(eos_stop_host), k=self.window_k, m=mega,
+                )
+            )
+        elif mega > 1:
             (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
              self._key_dev) = (
                 self._mega_window(
@@ -1580,7 +1779,7 @@ class InferenceEngine:
                         (emitted_host[0, step, i, j], emitted_host[1, step, i, j])
                         for j in range(int(counts_host[step, i]))
                     )
-                    for step in range(self.window_k)
+                    for step in range(steps)
                 )
             done = False
             for toks in step_toks:

@@ -36,6 +36,7 @@ run r4-8b-int4-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32
 # 5. Speculation (labeled mechanism rows — random-weight greedy loops
 #    flatter n-gram acceptance).
 run r4-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3
+run r4-1b-spec3-mega8 BENCH_MODEL=llama-1b BENCH_SPEC=3 BENCH_MEGA=8
 # 6. Paged KV, dense vs kernel.
 run r4-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128 GOFR_TPU_FLASH_DECODE=0
 run r4-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
@@ -43,4 +44,6 @@ run r4-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECO
 #    8k with paged KV + int8 kv — the long-context serving row.
 run r4-1b-4k BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32
 run r4-1b-4k-dense BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 GOFR_TPU_FLASH_DECODE=0
-run r4-8b-8k-paged BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64
+run r4-8b-8k-paged BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8
+# 8. Long-prompt TTFT A/B: multi-chunk prefill on vs off (4k prompts).
+run r4-1b-4k-pd8 BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_PREFILL_DEPTH=8
