@@ -17,7 +17,8 @@ Env knobs: BENCH_MODEL (default llama-1b on TPU, llama-tiny on CPU),
 BENCH_REQUESTS (default 64), BENCH_NEW_TOKENS (default 128),
 BENCH_SLOTS (default 32), BENCH_MAX_LEN (default 1024),
 BENCH_WINDOW (default 8), BENCH_DEPTH (default 2), BENCH_MEGA
-(mega-window dispatch amortization, default off),
+(mega-window dispatch amortization; default 8 on TPU, 0 = streaming
+pipelined mode elsewhere), BENCH_PREFILL_DEPTH (multi-chunk prefill),
 BENCH_QUANT (default int8 on TPU — weight-only int8, the production
 serving configuration; set BENCH_QUANT=none for bf16 weights).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
@@ -244,7 +245,10 @@ def main() -> None:
         kv_quant = ""
     spec_tokens = int(os.environ.get("BENCH_SPEC", "0"))
     kv_block = int(os.environ.get("BENCH_KV_BLOCK", "0"))
-    mega = int(os.environ.get("BENCH_MEGA", "0"))
+    # TPU default: mega windows ON (m=8) — the dispatch-RTT amortizer is
+    # the production throughput configuration; BENCH_MEGA=0 restores the
+    # streaming-granularity pipelined mode (the pre-r4 campaign rows).
+    mega = int(os.environ.get("BENCH_MEGA", "8" if on_tpu else "0"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
         f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "

@@ -309,6 +309,110 @@ class App:
                     headers={"Content-Type": "application/json"},
                     body=b'{"status":"UP"}',
                 )
+            # /debug/* — ops surface on the metrics port (net-new: the
+            # closest Go analog is pprof-on-metrics-port, which the
+            # reference does not ship; TPU serving makes the equivalents
+            # indispensable: a wedged device relay shows up as a thread
+            # parked in a jit dispatch, and device traces answer "where
+            # does the step go" without a redeploy).
+            if path == "/debug/threads":
+                import sys as _sys
+                import threading as _threading
+                import traceback as _traceback
+
+                names = {
+                    t.ident: t.name for t in _threading.enumerate()
+                }
+                lines = []
+                for ident, frame in _sys._current_frames().items():
+                    lines.append(
+                        f"Thread {names.get(ident, '?')} (ident {ident}):"
+                    )
+                    lines.extend(
+                        ln.rstrip()
+                        for ln in _traceback.format_stack(frame)
+                    )
+                    lines.append("")
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "text/plain"},
+                    body="\n".join(lines).encode(),
+                )
+            if path == "/debug/engine":
+                import json as _json
+
+                stats = {}
+                for name, eng in (
+                    ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
+                ):
+                    if eng is None:
+                        continue
+                    try:
+                        stats[name] = eng.health_check()
+                    except Exception as exc:  # noqa: BLE001 — debug surface
+                        stats[name] = {"error": str(exc)}
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(stats).encode(),
+                )
+            if path == "/debug/tpu-trace":
+                import asyncio as _aio
+                import json as _json
+                import tempfile
+                import urllib.parse
+
+                q = urllib.parse.parse_qs(raw.target.partition("?")[2])
+                try:
+                    ms = min(int(q.get("ms", ["1000"])[0]), 30_000)
+                except ValueError:
+                    return Response(
+                        status=400,
+                        headers={"Content-Type": "application/json"},
+                        body=b'{"error": "ms must be an integer"}',
+                    )
+                # ONE reusable trace dir per process (each capture
+                # overwrites the last): an unauthenticated loop of trace
+                # requests must not be able to fill the disk. One trace
+                # at a time — the profiler itself is a singleton.
+                if not hasattr(self, "_trace_dir"):
+                    self._trace_dir = tempfile.mkdtemp(prefix="tpu-trace-")
+                    self._trace_lock = _aio.Lock()
+                if self._trace_lock.locked():
+                    return Response(
+                        status=409,
+                        headers={"Content-Type": "application/json"},
+                        body=b'{"error": "a trace capture is already '
+                             b'running"}',
+                    )
+                async with self._trace_lock:
+                    loop = _aio.get_running_loop()
+                    try:
+                        import jax
+
+                        # start/stop serialize trace data to disk — keep
+                        # them off the event loop that also serves
+                        # /metrics and liveness probes.
+                        await loop.run_in_executor(
+                            None, jax.profiler.start_trace, self._trace_dir
+                        )
+                        await _aio.sleep(ms / 1e3)
+                        await loop.run_in_executor(
+                            None, jax.profiler.stop_trace
+                        )
+                        body = {
+                            "trace_dir": self._trace_dir,
+                            "captured_ms": ms,
+                        }
+                        status = 200
+                    except Exception as exc:  # noqa: BLE001 — debug surface
+                        body = {"error": str(exc)}
+                        status = 500
+                return Response(
+                    status=status,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(body).encode(),
+                )
             return Response(status=404, headers={}, body=b"404 page not found")
 
         return handler

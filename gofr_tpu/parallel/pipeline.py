@@ -115,7 +115,7 @@ def pipeline_layer_fn(
     """
 
     def run(x, layer_params, extras):
-        inner = lambda x, lp, ex: pipeline_spmd(
+        inner = lambda x, lp, ex: pipeline_spmd(  # noqa: E731
             x, lp, ex,
             axis_name=axis_name,
             n_microbatches=n_microbatches,

@@ -362,7 +362,7 @@ class InferenceEngine:
                 )
 
                 _rep = _NS(mesh, _P())
-                self._up = lambda x: jax.device_put(x, _rep)
+                self._up = lambda x: jax.device_put(x, _rep)  # noqa: E731
             else:
                 self._up = jnp.asarray
             # Multi-PROCESS mesh on a non-TPU backend: serialize device
@@ -2232,10 +2232,21 @@ class InferenceEngine:
                 "in_use": sum(1 for s in self._slots if s is not None),
             }
             details["max_len"] = self.max_len
+            details["pending"] = self._pending.qsize()
+            details["prefilling"] = len(self._prefilling)
             if self.kv_block:
                 details["kv_blocks"] = {
                     "block": self.kv_block,
                     "total": self.cache.n_blocks - 1,  # block 0 parks
                     "free": len(self._free_blocks),
                 }
+        try:
+            stats = devices[0].memory_stats()
+            if stats:
+                details["hbm"] = {
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit"),
+                }
+        except Exception:  # noqa: BLE001 — not all backends report memory
+            pass
         return {"status": "UP" if self._running else "DOWN", "details": details}

@@ -18,32 +18,33 @@ run() {
 }
 # 1. Mega-window ladder on the 1b headline config (budget 128 = 16
 #    windows of 8; m=16 covers a whole retirement wave in one dispatch).
-run r4-1b BENCH_MODEL=llama-1b
+run r4-1b BENCH_MODEL=llama-1b BENCH_MEGA=0
 run r4-1b-mega4 BENCH_MODEL=llama-1b BENCH_MEGA=4
 run r4-1b-mega8 BENCH_MODEL=llama-1b BENCH_MEGA=8
 run r4-1b-mega16 BENCH_MODEL=llama-1b BENCH_MEGA=16
 # 2. 8B at the r3-best config (32 slots, int8 kv, dense) + mega.
-run r4-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8
+run r4-8b-kv8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=0
 run r4-8b-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=8
 run r4-8b-kv8-mega16 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=16
 # 3. Steady-state workload with mega (arrival-staggered, spread budgets)
 #    — the workload the VERDICT wants as the headline.
-run r4-1b-steady BENCH_MODEL=llama-1b BENCH_ARRIVAL_MS=25 BENCH_TOKEN_SPREAD=0.5
+run r4-1b-steady BENCH_MODEL=llama-1b BENCH_ARRIVAL_MS=25 BENCH_TOKEN_SPREAD=0.5 BENCH_MEGA=0
 run r4-1b-steady-mega8 BENCH_MODEL=llama-1b BENCH_ARRIVAL_MS=25 BENCH_TOKEN_SPREAD=0.5 BENCH_MEGA=8
 # 4. int4 weights (nibble-packed), alone and with mega.
-run r4-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
+run r4-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4 BENCH_MEGA=0
 run r4-8b-int4-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=32 BENCH_QUANT=int4 BENCH_KV_QUANT=int8 BENCH_MEGA=8
 # 5. Speculation (labeled mechanism rows — random-weight greedy loops
 #    flatter n-gram acceptance).
-run r4-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3
+run r4-1b-spec3 BENCH_MODEL=llama-1b BENCH_SPEC=3 BENCH_MEGA=0
 run r4-1b-spec3-mega8 BENCH_MODEL=llama-1b BENCH_SPEC=3 BENCH_MEGA=8
 # 6. Paged KV, dense vs kernel.
-run r4-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128 GOFR_TPU_FLASH_DECODE=0
-run r4-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1
+run r4-1b-paged BENCH_MODEL=llama-1b BENCH_KV_BLOCK=128 GOFR_TPU_FLASH_DECODE=0 BENCH_MEGA=0
+run r4-1b-paged-kern BENCH_MODEL=llama-1b BENCH_KV_BLOCK=256 GOFR_TPU_FLASH_DECODE=1 BENCH_MEGA=0
 # 7. Long context 4k: kernel-vs-dense A/B (the flash_decode verdict), and
 #    8k with paged KV + int8 kv — the long-context serving row.
-run r4-1b-4k BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32
-run r4-1b-4k-dense BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 GOFR_TPU_FLASH_DECODE=0
-run r4-8b-8k-paged BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8
+run r4-1b-4k BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_MEGA=0
+run r4-1b-4k-dense BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 GOFR_TPU_FLASH_DECODE=0 BENCH_MEGA=0
+run r4-8b-8k-paged BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=0
+run r4-8b-8k-paged-mega8 BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=8
 # 8. Long-prompt TTFT A/B: multi-chunk prefill on vs off (4k prompts).
-run r4-1b-4k-pd8 BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_PREFILL_DEPTH=8
+run r4-1b-4k-pd8 BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=0

@@ -263,3 +263,19 @@ def test_favicon(app_harness):
     assert status == 200
     assert headers["Content-Type"] == "image/x-icon"
     assert body[:4] == b"\x00\x00\x01\x00"
+
+
+def test_debug_endpoints_on_metrics_port(app_harness):
+    # /debug/threads: a live thread dump (the tool that diagnoses a
+    # wedged device dispatch without restarting the server).
+    status, _, body = app_harness.request(
+        "GET", "/debug/threads", port=app_harness.app.metrics_port
+    )
+    assert status == 200
+    assert b"Thread" in body or b"thread" in body
+    # /debug/engine: no engine configured → empty JSON object.
+    status, headers, body = app_harness.request(
+        "GET", "/debug/engine", port=app_harness.app.metrics_port
+    )
+    assert status == 200
+    assert json.loads(body) == {}
