@@ -84,6 +84,12 @@ def _register_llms() -> None:
             vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
             n_kv_heads=8, d_ff=14336, max_len=4096, rope_theta=10000.0,
         ),
+        # Qwen2-7B dims (HF loader accepts model_type=qwen2; QKV bias).
+        "qwen2-7b": TransformerConfig(
+            vocab_size=152064, d_model=3584, n_layers=28, n_heads=28,
+            n_kv_heads=4, d_ff=18944, max_len=8192, rope_theta=1e6,
+            attn_bias=True,
+        ),
         # ~1.1B config that fits one v5e chip comfortably for benching.
         "llama-1b": TransformerConfig(
             vocab_size=32768, d_model=2048, n_layers=22, n_heads=16,

@@ -62,6 +62,8 @@ class TransformerConfig:
     # MoE: n_experts == 0 → dense SwiGLU FFN.
     n_experts: int = 0
     n_experts_active: int = 2
+    # Qwen2-style QKV projection bias (llama/mistral/mixtral: False).
+    attn_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -104,6 +106,12 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
         "attn_norm": jnp.ones((L, D), dtype=cfg.dtype),
         "mlp_norm": jnp.ones((L, D), dtype=cfg.dtype),
     }
+    if cfg.attn_bias:
+        layers.update(
+            wq_b=jnp.zeros((L, H * hd), dtype=cfg.dtype),
+            wk_b=jnp.zeros((L, KV * hd), dtype=cfg.dtype),
+            wv_b=jnp.zeros((L, KV * hd), dtype=cfg.dtype),
+        )
     if cfg.is_moe:
         E = cfg.n_experts
         layers.update(
@@ -147,6 +155,12 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
         "attn_norm": P(lax_, None),
         "mlp_norm": P(lax_, None),
     }
+    if cfg.attn_bias:
+        layers.update(
+            wq_b=P(lax_, "tp"),
+            wk_b=P(lax_, "tp"),
+            wv_b=P(lax_, "tp"),
+        )
     if cfg.is_moe:
         layers.update(
             router=P(lax_, None, None),
@@ -269,6 +283,23 @@ def _ffn_moe(x, lp, cfg):
     return jnp.einsum("bsed,bse->bsd", out, weights.astype(x.dtype))
 
 
+def _qkv(h, lp, eq, H, KV, hd, *lead):
+    """QKV projections with optional Qwen2-style bias (bias leaves exist
+    only when cfg.attn_bias — dict membership is trace-time static)."""
+    q = _wein(eq, h, lp["wq"])
+    k = _wein(eq, h, lp["wk"])
+    v = _wein(eq, h, lp["wv"])
+    if "wq_b" in lp:
+        q = q + lp["wq_b"]
+        k = k + lp["wk_b"]
+        v = v + lp["wv_b"]
+    return (
+        q.reshape(*lead, H, hd),
+        k.reshape(*lead, KV, hd),
+        v.reshape(*lead, KV, hd),
+    )
+
+
 def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
                    lengths=None, norm_out=None):
     """One decoder layer over a full sequence. Returns (x, (k, v)).
@@ -290,9 +321,7 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     if norm_out is not None:
         h = norm_out(h)
-    q = _wein("bsd,dh->bsh", h, lp["wq"]).reshape(b, s, H, hd)
-    k = _wein("bsd,dh->bsh", h, lp["wk"]).reshape(b, s, KV, hd)
-    v = _wein("bsd,dh->bsh", h, lp["wv"]).reshape(b, s, KV, hd)
+    q, k, v = _qkv(h, lp, "bsd,dh->bsh", H, KV, hd, b, s)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     if attn_fn is None:
@@ -464,9 +493,7 @@ def transformer_prefill_chunk(
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = _wein("pcd,dh->pch", h, lp["wq"]).reshape(P, c, H, hd)
-        k = _wein("pcd,dh->pch", h, lp["wk"]).reshape(P, c, KV, hd)
-        v = _wein("pcd,dh->pch", h, lp["wv"]).reshape(P, c, KV, hd)
+        q, k, v = _qkv(h, lp, "pcd,dh->pch", H, KV, hd, P, c)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         # Write the chunk's K/V into the cache, then attend against the
@@ -549,9 +576,7 @@ def transformer_decode_step(
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
         h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
-        q = _wein("bd,dh->bh", h, lp["wq"]).reshape(S, H, hd)
-        k = _wein("bd,dh->bh", h, lp["wk"]).reshape(S, KV, hd)
-        v = _wein("bd,dh->bh", h, lp["wv"]).reshape(S, KV, hd)
+        q, k, v = _qkv(h, lp, "bd,dh->bh", H, KV, hd, S)
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
@@ -638,9 +663,7 @@ def transformer_verify_step(
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # read-only cache slices
         h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = _wein("bcd,dh->bch", h, lp["wq"]).reshape(S, c, H, hd)
-        k = _wein("bcd,dh->bch", h, lp["wk"]).reshape(S, c, KV, hd)
-        v = _wein("bcd,dh->bch", h, lp["wv"]).reshape(S, c, KV, hd)
+        q, k, v = _qkv(h, lp, "bcd,dh->bch", H, KV, hd, S, c)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         if cache.quantized:

@@ -533,6 +533,8 @@ class InferenceEngine:
             key = jax.random.fold_in(base, counter[0])
             if name in ("attn_norm", "mlp_norm", "final_norm"):
                 return jnp.ones(sds.shape, cfg.dtype)
+            if name.endswith("_b"):  # QKV biases: zeros, as init_transformer
+                return jnp.zeros(sds.shape, cfg.dtype)
             fan_in = sds.shape[-1] if name == "embed" else sds.shape[-2]
 
             def init_leaf(k):

@@ -47,8 +47,10 @@ def config_from_hf(path: str):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "mixtral"):
-        raise ValueError(f"unsupported HF model_type {mt!r} (llama-family only)")
+    if mt not in ("llama", "mistral", "mixtral", "qwen2"):
+        raise ValueError(
+            f"unsupported HF model_type {mt!r} (llama-family + qwen2 only)"
+        )
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
         d_model=hf["hidden_size"],
@@ -62,6 +64,9 @@ def config_from_hf(path: str):
         dtype=jnp.bfloat16,
         # Mixtral MoE: top-k routing over stacked experts.
         n_experts=int(hf.get("num_local_experts", 0)) if mt == "mixtral" else 0,
+        # Qwen2 ships QKV projection biases (its config.json has no
+        # attention_bias flag in older revisions — the model_type implies it).
+        attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
         n_experts_active=int(hf.get("num_experts_per_tok", 2)),
     )
 
@@ -139,7 +144,7 @@ def load_hf_llama(
     if file_cfg is not None:
         for field in ("vocab_size", "d_model", "n_layers", "n_heads",
                       "n_kv_heads", "d_ff", "n_experts",
-                      "n_experts_active"):
+                      "n_experts_active", "attn_bias"):
             want, have = getattr(cfg, field), getattr(file_cfg, field)
             if want != have:
                 raise ValueError(
@@ -219,6 +224,12 @@ def load_hf_llama(
             "mlp_norm", pre + "post_attention_layernorm.weight", False, False
         ),
     }
+    if cfg.attn_bias:
+        layers.update(
+            wq_b=stacked("wq_b", pre + "self_attn.q_proj.bias", False, False),
+            wk_b=stacked("wk_b", pre + "self_attn.k_proj.bias", False, False),
+            wv_b=stacked("wv_b", pre + "self_attn.v_proj.bias", False, False),
+        )
     if cfg.is_moe:
         moe = "model.layers.{i}.block_sparse_moe."
         layers.update(

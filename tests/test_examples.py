@@ -217,3 +217,31 @@ def test_openai_server_example():
             conn.close()
         assert out["object"] == "text_completion"
         assert out["usage"]["completion_tokens"] >= 1
+
+
+def test_using_train_example(capsys, tmp_path):
+    """Train → orbax checkpoint → serve: the full TPU-native loop through
+    the same CLI + HTTP app surfaces every other example uses."""
+    mod = load_example("using-train")
+    mod.CKPT = str(tmp_path / "ckpt")
+    rc = mod.build_cmd().run(["train", "-steps=2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final_loss" in out
+
+    os.environ["TPU_CHECKPOINT"] = mod.CKPT
+    with Harness(mod.build_app()) as h:
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", h.app.http_port, timeout=180
+        )
+        try:
+            conn.request("POST", "/generate", body=json.dumps({
+                "prompt": "hi", "max_new_tokens": 4, "temperature": 0,
+            }), headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 201, body  # POST default: Created
+            data = json.loads(body)["data"]
+            assert data["tokens"] == 4
+        finally:
+            conn.close()
