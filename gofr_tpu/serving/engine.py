@@ -102,6 +102,9 @@ class _GenRequest:
     # Stop sequences: generation retires early when the decoded text
     # contains one; the result is trimmed at the match.
     stop_texts: list[str] = field(default_factory=list)
+    # OpenAI-style penalties over generated tokens (TPU_PENALTIES=true).
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
@@ -135,6 +138,7 @@ class InferenceEngine:
         truncate_prompts: bool = False,
         top_k: int = 0,
         enable_top_p: bool = False,
+        enable_penalties: bool = False,
         spec_tokens: int = 0,
         kv_block: int = 0,
         kv_pool_blocks: int = 0,
@@ -164,6 +168,16 @@ class InferenceEngine:
         # Nucleus sampling support is a COMPILE choice: the per-step
         # [slots, vocab] sort only exists in the program when enabled.
         self.enable_top_p = bool(enable_top_p)
+        # Frequency/presence penalties are a COMPILE choice too: the
+        # [slots, vocab] generated-token count plane and its per-step
+        # scatter only exist in the program when enabled.
+        self.enable_penalties = bool(enable_penalties)
+        if self.enable_penalties and spec_tokens > 0:
+            raise ValueError(
+                "TPU_PENALTIES and TPU_SPEC_TOKENS are mutually exclusive: "
+                "penalties evolve within a step sequence, which breaks the "
+                "parallel speculative verify"
+            )
         self.tokenizer = tokenizer
         self.mesh = mesh  # multi-chip: NamedSharding placement over ICI
 
@@ -389,6 +403,14 @@ class InferenceEngine:
             self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
             self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
             self._greedy_dev = self._up(np.ones((n_slots,), dtype=bool))
+            # Penalties state: per-slot generated-token counts (a [1]-wide
+            # dummy when the feature is compiled out keeps one signature).
+            pv = self.cfg.vocab_size if self.enable_penalties else 1
+            self._pcounts_dev = self._up(
+                np.zeros((n_slots, pv), dtype=np.int32)
+            )
+            self._fpen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
+            self._ppen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
             self._slot_state_dirty = True
             # Token history per slot (prompt + generated) — the n-gram
             # draft source; only maintained when speculation is on.
@@ -486,6 +508,9 @@ class InferenceEngine:
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
             enable_top_p=config.get_or_default("TPU_TOP_P", "false").lower()
             in ("1", "true", "yes"),
+            enable_penalties=config.get_or_default(
+                "TPU_PENALTIES", "false"
+            ).lower() in ("1", "true", "yes"),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             kv_block=int(config.get_or_default("TPU_KV_BLOCK", "0")),
             kv_pool_blocks=int(
@@ -582,13 +607,30 @@ class InferenceEngine:
                 return x
 
         enable_top_p = self.enable_top_p
+        enable_penalties = self.enable_penalties
 
-        def sample(logits, key, temps, greedy, topps):
-            """Returns (token, logprob) — the logprob is the model's
-            (unscaled) log-softmax at the chosen token, the number the
-            OpenAI logprobs field reports."""
+        def sample(logits, key, temps, greedy, topps, pen=None):
+            """Returns (token, logprob) — the logprob is the log-softmax at
+            the chosen token of the distribution the choice was made from
+            (the model's own when no penalties apply), the number the
+            OpenAI logprobs field reports.
+
+            pen: optional (counts [rows, V] int32, fpen [rows], ppen
+            [rows]) — OpenAI-style frequency/presence penalties over the
+            GENERATED tokens (prompt tokens don't count, the vLLM
+            convention), applied before greedy argmax AND sampling so
+            temperature-0 requests honor them too."""
+            logits = logits.astype(jnp.float32)
+            if pen is not None:
+                counts, fpen, ppen = pen
+                cf = counts.astype(jnp.float32)
+                logits = (
+                    logits
+                    - fpen[:, None] * cf
+                    - ppen[:, None] * (cf > 0).astype(jnp.float32)
+                )
             greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-4)[:, None]
+            scaled = logits / jnp.maximum(temps, 1e-4)[:, None]
             sorted_l = None
             if top_k > 0:
                 sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
@@ -624,19 +666,23 @@ class InferenceEngine:
                 )
             sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
             chosen = jnp.where(greedy, greedy_tok, sampled)
-            logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            logp_all = jax.nn.log_softmax(logits, axis=-1)
             logp = jnp.take_along_axis(logp_all, chosen[:, None], axis=-1)[:, 0]
             return chosen, logp
 
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, key, all_tokens, all_logps,
+            temps, greedy, topps, key, all_tokens, all_logps, pcounts,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
             the decode token vector ON DEVICE. Padding rows duplicate row 0
             (identical K/V writes are idempotent; the merge below is
-            per-slot select, not scatter, so duplicates can't race)."""
+            per-slot select, not scatter, so duplicates can't race).
+            pcounts: per-slot generated-token counts (penalties feature) —
+            finalize RESETS the slot's row (new request) and counts the
+            first sampled token; the first token itself is never penalized
+            (its counts are the zeros just written)."""
             key, sub = jax.random.split(key)
             logits, cache = transformer_prefill_chunk(
                 params, tokens, cache, slots, starts, lens, cfg,
@@ -655,10 +701,16 @@ class InferenceEngine:
             cache = cache._replace(
                 lengths=jnp.where(has, (starts + lens)[idx], cache.lengths)
             )
-            return cache, all_tokens, all_logps, rep(first), rep(first_lp), key
+            if enable_penalties:
+                pcounts = jnp.where(has[:, None], 0, pcounts)
+                pcounts = pcounts.at[
+                    jnp.arange(S), all_tokens
+                ].add(has.astype(jnp.int32))
+            return (cache, all_tokens, all_logps, rep(first), rep(first_lp),
+                    key, pcounts)
 
         prefill_chunk_step = partial(
-            jax.jit, donate_argnums=(1, 11, 12, 13)
+            jax.jit, donate_argnums=(1, 11, 12, 13, 14)
         )(_prefill_core)
 
         def _multi_chunk_core(params, cache, tokens3, slots, starts0,
@@ -716,16 +768,18 @@ class InferenceEngine:
                 params, cache, tokens3, slots, starts0, n_chunks, history
             )
 
-        @partial(jax.jit, donate_argnums=(1, 11, 12, 13, 14))
+        @partial(jax.jit, donate_argnums=(1, 11, 12, 13, 14, 15))
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, key, all_tokens, all_logps, history,
+            temps, greedy, topps, key, all_tokens, all_logps, pcounts,
+            history,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
                 row_valid, temps, greedy, topps, key, all_tokens, all_logps,
+                pcounts,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -735,9 +789,31 @@ class InferenceEngine:
             history = history.at[slots[:, None], hpos].set(tokens)
             return out + (history,)
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5))
+        def make_decode_body(params, active, temps, greedy, topps, fpen,
+                             ppen):
+            """One decode step (scan body): forward + sample + penalty
+            count scatter — shared by the plain window and the mega
+            while_loop so the two dispatch modes cannot drift."""
+
+            def body(carry, _):
+                tokens, logps, cache, key, pcounts = carry
+                key, sub = jax.random.split(key)
+                logits, cache = transformer_decode_step(
+                    params, tokens, cache, active, cfg, dense_attn=dense_attn
+                )
+                pen = (pcounts, fpen, ppen) if enable_penalties else None
+                nxt, nlp = sample(logits, sub, temps, greedy, topps, pen)
+                if enable_penalties:
+                    pcounts = pcounts.at[
+                        jnp.arange(nxt.shape[0]), nxt
+                    ].add(active.astype(jnp.int32))
+                return (nxt, nlp, cache, key, pcounts), (tokens, logps)
+
+            return body
+
+        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 11))
         def decode_window(params, tokens, logps, cache, active, key, temps,
-                          greedy, topps, k):
+                          greedy, topps, fpen, ppen, pcounts, k):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -747,27 +823,24 @@ class InferenceEngine:
             host↔device roundtrip count stays one per window. The PRNG
             key is threaded through ON DEVICE, so steady-state dispatch
             uploads nothing host→device at all."""
-
-            def body(carry, _):
-                tokens, logps, cache, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = transformer_decode_step(
-                    params, tokens, cache, active, cfg, dense_attn=dense_attn
+            body = make_decode_body(params, active, temps, greedy, topps,
+                                    fpen, ppen)
+            (final, final_lp, cache, key, pcounts), (etoks, elps) = (
+                jax.lax.scan(
+                    body, (tokens, logps, cache, key, pcounts), length=k
                 )
-                nxt, nlp = sample(logits, sub, temps, greedy, topps)
-                return (nxt, nlp, cache, key), (tokens, logps)
-
-            (final, final_lp, cache, key), (etoks, elps) = jax.lax.scan(
-                body, (tokens, logps, cache, key), length=k
             )
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
-            return rep(emitted), final, final_lp, cache, key
+            return rep(emitted), final, final_lp, cache, key, pcounts
 
         eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
 
-        @partial(jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5))
+        @partial(
+            jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 11)
+        )
         def mega_window(params, tokens, logps, cache, active, key, temps,
-                        greedy, topps, remaining, eos_stop, k, m):
+                        greedy, topps, fpen, ppen, pcounts, remaining,
+                        eos_stop, k, m):
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
             is covered (decremented k per window; zeroed when the slot
@@ -779,23 +852,17 @@ class InferenceEngine:
             retired region (scatter drops OOB; paged lookups park at
             block 0) and the host drops the tokens post-retirement, so
             the junk is slot-local by construction."""
-
-            def body(carry, _):
-                tokens, logps, cache, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = transformer_decode_step(
-                    params, tokens, cache, active, cfg, dense_attn=dense_attn
-                )
-                nxt, nlp = sample(logits, sub, temps, greedy, topps)
-                return (nxt, nlp, cache, key), (tokens, logps)
-
+            body = make_decode_body(params, active, temps, greedy, topps,
+                                    fpen, ppen)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
 
             def win_body(state):
-                w, tokens, logps, cache, key, remaining, emitted = state
-                (tokens, logps, cache, key), (etoks, elps) = jax.lax.scan(
-                    body, (tokens, logps, cache, key), length=k
+                (w, tokens, logps, cache, key, pcounts, remaining,
+                 emitted) = state
+                ((tokens, logps, cache, key, pcounts),
+                 (etoks, elps)) = jax.lax.scan(
+                    body, (tokens, logps, cache, key, pcounts), length=k
                 )
                 slab = jnp.stack([etoks.astype(jnp.float32), elps])
                 emitted = jax.lax.dynamic_update_slice(
@@ -803,17 +870,20 @@ class InferenceEngine:
                 )
                 hit = jnp.any(etoks == eos_id, axis=0) & eos_stop
                 remaining = jnp.where(hit, 0, jnp.maximum(remaining - k, 0))
-                return (w + 1, tokens, logps, cache, key, remaining, emitted)
+                return (w + 1, tokens, logps, cache, key, pcounts,
+                        remaining, emitted)
 
             def win_cond(state):
-                return (state[0] < m) & jnp.any(state[5] > 0)
+                return (state[0] < m) & jnp.any(state[6] > 0)
 
-            w, final, final_lp, cache, key, _, emitted = jax.lax.while_loop(
-                win_cond, win_body,
-                (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
-                 remaining, emitted0),
+            (w, final, final_lp, cache, key, pcounts, _, emitted) = (
+                jax.lax.while_loop(
+                    win_cond, win_body,
+                    (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
+                     pcounts, remaining, emitted0),
+                )
             )
-            return rep(emitted), rep(w), final, final_lp, cache, key
+            return rep(emitted), rep(w), final, final_lp, cache, key, pcounts
 
         G = self.spec_tokens
 
@@ -1474,15 +1544,19 @@ class InferenceEngine:
             self._up(finalize), self._up(row_valid),
             self._up(temps), self._up(greedy), self._up(topps),
             self._key_dev, self._tokens_dev, self._logps_dev,
+            self._pcounts_dev,
         )
         if self.spec_tokens:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._key_dev, self._history_dev) = (
+             first_lp_dev, self._key_dev, self._pcounts_dev,
+             self._history_dev) = (
                 self._prefill_chunk_step_hist(*args, self._history_dev)
             )
         else:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._key_dev) = self._prefill_chunk_step(*args)
+             first_lp_dev, self._key_dev, self._pcounts_dev) = (
+                self._prefill_chunk_step(*args)
+            )
         if self._lockstep:
             self._jax.block_until_ready(first_dev)
         if self._metrics is not None:
@@ -1586,16 +1660,23 @@ class InferenceEngine:
             temps = np.ones((self.n_slots,), dtype=np.float32)
             topps = np.ones((self.n_slots,), dtype=np.float32)
             greedy = np.ones((self.n_slots,), dtype=bool)
+            fpen = np.zeros((self.n_slots,), dtype=np.float32)
+            ppen = np.zeros((self.n_slots,), dtype=np.float32)
             for i, seq in enumerate(self._slots):
                 if seq is not None:
                     active[i] = True
                     temps[i] = max(seq.request.temperature, 0.0)
                     topps[i] = seq.request.top_p
                     greedy[i] = seq.request.temperature <= 0
+                    fpen[i] = seq.request.frequency_penalty
+                    ppen[i] = seq.request.presence_penalty
             self._active_dev = self._up(active)
             self._temps_dev = self._up(temps)
             self._topp_dev = self._up(topps)
             self._greedy_dev = self._up(greedy)
+            if self.enable_penalties:
+                self._fpen_dev = self._up(fpen)
+                self._ppen_dev = self._up(ppen)
             self._slot_state_dirty = False
 
         # Mega-window mode: compute each slot's remaining budget on the
@@ -1679,11 +1760,12 @@ class InferenceEngine:
             )
         elif mega > 1:
             (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev) = (
+             self._key_dev, self._pcounts_dev) = (
                 self._mega_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._key_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
+                    self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
                     k=self.window_k, m=mega,
                 )
@@ -1700,11 +1782,12 @@ class InferenceEngine:
             )
         else:
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev) = (
+             self._key_dev, self._pcounts_dev) = (
                 self._decode_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._key_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
+                    self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                     k=self.window_k,
                 )
             )
@@ -1934,7 +2017,7 @@ class InferenceEngine:
             greedy = np.ones((P,), dtype=bool)
             t0 = time.perf_counter()
             (self.cache, self._tokens_dev, self._logps_dev, first, _flp,
-             self._key_dev) = (
+             self._key_dev, self._pcounts_dev) = (
                 self._prefill_chunk_step(
                     self.params, self.cache, self._up(tokens),
                     self._up(slots), self._up(starts), self._up(lens),
@@ -1942,6 +2025,7 @@ class InferenceEngine:
                     self._up(temps), self._up(greedy),
                     self._up(topps),
                     self._key_dev, self._tokens_dev, self._logps_dev,
+                    self._pcounts_dev,
                 )
             )
             jax.block_until_ready(first)
@@ -1957,10 +2041,12 @@ class InferenceEngine:
         def window():
             out = self._decode_window(
                 self.params, self._tokens_dev, self._logps_dev, self.cache,
-                active, self._key_dev, tdev, gdev, pdev, k=self.window_k,
+                active, self._key_dev, tdev, gdev, pdev,
+                self._fpen_dev, self._ppen_dev, self._pcounts_dev,
+                k=self.window_k,
             )
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev) = out
+             self._key_dev, self._pcounts_dev) = out
             return emitted
 
         # Warmup (compile) + RTT probe: a blocking fetch of a just-computed
@@ -2040,6 +2126,8 @@ class InferenceEngine:
         stop_on_eos: bool = True,
         stop: "Optional[list[str]]" = None,
         top_p: float = 1.0,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -2054,6 +2142,20 @@ class InferenceEngine:
                 "top_p requires TPU_TOP_P=true (compiles the nucleus "
                 "sort into the sampler)"
             ])
+        if frequency_penalty or presence_penalty:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            if not self.enable_penalties:
+                raise ErrorInvalidParam([
+                    "frequency/presence penalties require TPU_PENALTIES="
+                    "true (compiles the per-slot token-count plane into "
+                    "the sampler)"
+                ])
+            if not (-2.0 <= frequency_penalty <= 2.0
+                    and -2.0 <= presence_penalty <= 2.0):
+                raise ErrorInvalidParam([
+                    "penalties must be in [-2, 2]"
+                ])
         ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -2083,6 +2185,8 @@ class InferenceEngine:
             truncated=truncated,
             stop_texts=list(stop or []),
             top_p=top_p,
+            frequency_penalty=frequency_penalty,
+            presence_penalty=presence_penalty,
         )
         self._enqueue(req)
         return req

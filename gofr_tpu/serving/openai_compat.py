@@ -155,11 +155,15 @@ def add_openai_routes(
             # compiled without the nucleus sampler too. Negative values
             # stay invalid and flow through to the engine's 400.
             top_p, temperature = 1.0, 0.0
+        fpen = body.get("frequency_penalty")
+        ppen = body.get("presence_penalty")
         return dict(
             max_new_tokens=128 if max_tokens is None else int(max_tokens),
             temperature=temperature,
             top_p=top_p,
             stop_on_eos=True,
+            frequency_penalty=0.0 if fpen is None else float(fpen),
+            presence_penalty=0.0 if ppen is None else float(ppen),
         )
 
     def _stream_response(

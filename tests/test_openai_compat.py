@@ -425,3 +425,42 @@ def test_top_p_zero_maps_to_greedy(oai_app):
     r = c.getresponse()
     assert r.status == 200
     assert json.loads(r.read())["usage"]["completion_tokens"] >= 1
+
+
+def test_completions_penalties(oai_app):
+    # The engine behind oai_app is compiled WITHOUT TPU_PENALTIES: the
+    # OpenAI-shaped error must say so (400), mirroring the top_p gate.
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "model": "llama-tiny", "prompt": "hello", "max_tokens": 4,
+        "temperature": 0, "frequency_penalty": 0.8,
+    }))
+    r = c.getresponse()
+    body = json.loads(r.read())
+    assert r.status == 400
+    assert "TPU_PENALTIES" in json.dumps(body)
+    c.close()
+
+    app = App(config=MockConfig({
+        "APP_NAME": "oai-pen", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "128", "TPU_PENALTIES": "true",
+    }))
+    add_openai_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=60)
+    try:
+        c = _conn(app)
+        c.request("POST", "/v1/completions", body=json.dumps({
+            "model": "llama-tiny", "prompt": "hello", "max_tokens": 8,
+            "temperature": 0, "frequency_penalty": 1.5,
+        }))
+        r = c.getresponse()
+        assert r.status == 200
+        out = json.loads(r.read())
+        assert out["choices"][0]["text"]
+        c.close()
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)

@@ -1,0 +1,133 @@
+"""Frequency/presence penalties (TPU_PENALTIES): OpenAI-parity sampling
+controls, compiled into the sampler as a per-slot generated-token count
+plane. Greedy requests honor them too (penalties apply before argmax)."""
+
+from __future__ import annotations
+
+import pytest
+
+from gofr_tpu.errors import ErrorInvalidParam
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+PROMPT = "the quick brown fox"
+
+
+def _engine(**kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("window_k", 4)
+    kw.setdefault("tokenizer", ByteTokenizer())
+    return InferenceEngine("llama-tiny", **kw)
+
+
+def _greedy(eng, n=24, **kw):
+    return eng.generate_sync(
+        PROMPT, max_new_tokens=n, temperature=0.0, stop_on_eos=False,
+        timeout=120, **kw
+    ).token_ids
+
+
+@pytest.fixture(scope="module")
+def base_tokens():
+    eng = _engine()
+    eng.start_sync()
+    try:
+        yield _greedy(eng)
+    finally:
+        eng.stop_sync()
+
+
+def _max_run_frequency(tokens):
+    from collections import Counter
+
+    return max(Counter(tokens).values())
+
+
+def test_zero_penalties_identical_to_base(base_tokens):
+    # The penalties COMPILE path with zero coefficients must not perturb
+    # the stream: penalized logits == raw logits when both are 0.
+    eng = _engine(enable_penalties=True)
+    eng.start_sync()
+    try:
+        assert _greedy(eng) == base_tokens
+    finally:
+        eng.stop_sync()
+
+
+def test_frequency_penalty_breaks_repetition(base_tokens):
+    # Random-weight greedy decode loops hard; a strong frequency penalty
+    # must reduce the most-repeated token's count and change the stream.
+    eng = _engine(enable_penalties=True)
+    eng.start_sync()
+    try:
+        toks = _greedy(eng, frequency_penalty=1.5)
+        assert toks != base_tokens
+        assert _max_run_frequency(toks) < _max_run_frequency(base_tokens)
+        # And independence: a concurrent zero-penalty request on the SAME
+        # engine still matches the base stream (per-slot counts/coeffs).
+        pen = eng.submit_generate(
+            PROMPT, max_new_tokens=24, temperature=0.0, stop_on_eos=False,
+            frequency_penalty=1.5,
+        )
+        plain = eng.submit_generate(
+            PROMPT, max_new_tokens=24, temperature=0.0, stop_on_eos=False,
+        )
+        assert plain.future.result(timeout=120).token_ids == base_tokens
+        assert pen.future.result(timeout=120).token_ids == toks
+    finally:
+        eng.stop_sync()
+
+
+def test_presence_penalty_deviates_and_mild_frequency_differs(base_tokens):
+    # Presence penalizes each seen token ONCE (not per occurrence). At a
+    # strong coefficient both penalties suppress any repeat, so the
+    # distinguishing case is a MILD coefficient: frequency accumulates
+    # per occurrence and eventually overtakes the one-shot presence hit.
+    eng = _engine(enable_penalties=True)
+    eng.start_sync()
+    try:
+        base48 = _greedy(eng, n=48)
+        p = _greedy(eng, n=48, presence_penalty=0.3)
+        f = _greedy(eng, n=48, frequency_penalty=0.3)
+        assert p != base48 and f != base48
+        assert _max_run_frequency(f) <= _max_run_frequency(p)
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_windows_compose(base_tokens):
+    eng = _engine(enable_penalties=True, mega_windows=4)
+    ref = _engine(enable_penalties=True)
+    for e in (eng, ref):
+        e.start_sync()
+    try:
+        assert _greedy(eng, frequency_penalty=1.5) == _greedy(
+            ref, frequency_penalty=1.5
+        )
+        assert _greedy(eng) == base_tokens
+    finally:
+        eng.stop_sync()
+        ref.stop_sync()
+
+
+def test_penalties_require_flag_and_range():
+    eng = _engine()  # feature compiled OUT
+    eng.start_sync()
+    try:
+        with pytest.raises(ErrorInvalidParam, match="TPU_PENALTIES"):
+            eng.submit_generate(PROMPT, frequency_penalty=0.5)
+    finally:
+        eng.stop_sync()
+    eng = _engine(enable_penalties=True)
+    eng.start_sync()
+    try:
+        with pytest.raises(ErrorInvalidParam, match=r"\[-2, 2\]"):
+            eng.submit_generate(PROMPT, presence_penalty=3.0)
+    finally:
+        eng.stop_sync()
+
+
+def test_penalties_reject_speculation():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _engine(enable_penalties=True, spec_tokens=2)
