@@ -105,6 +105,9 @@ class _GenRequest:
     # OpenAI-style penalties over generated tokens (TPU_PENALTIES=true).
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # Per-request sampling seed (counter-based keys: same seed + prompt +
+    # params → same sampled stream regardless of batch/scheduling).
+    seed: int = 0
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
@@ -224,6 +227,7 @@ class InferenceEngine:
                 model_name, n_params / 1e9, time.time() - t0,
             )
 
+        self._seed = seed
         self._key = jax.random.PRNGKey(seed + 1)
         self._running = False
         self._draining = False  # graceful stop: reject new, finish live
@@ -398,7 +402,18 @@ class InferenceEngine:
             # Slot state lives ON DEVICE between windows; re-uploaded only
             # when admissions/retirements change it (dirty flag). Steady-
             # state decode then dispatches with zero host→device traffic.
-            self._key_dev = self._up(np.asarray(jax.random.PRNGKey(seed + 2)))
+            # Sampling is counter-based (seed, n_sampled) per slot — no
+            # PRNG key threads through device state at all.
+            self._nsteps_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
+            self._seeds_host = np.zeros((n_slots,), dtype=np.int32)
+            self._seeds_dev = self._up(self._seeds_host)
+            self._seeds_dirty = False
+            # Host-side default-seed source for requests without one: each
+            # unseeded request gets a fresh draw (OpenAI semantics), while
+            # an explicit seed reproduces exactly.
+            import random as _random
+
+            self._seed_rng = _random.Random(seed + 3)
             self._active_dev = self._up(np.zeros((n_slots,), dtype=bool))
             self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
             self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
@@ -609,7 +624,7 @@ class InferenceEngine:
         enable_top_p = self.enable_top_p
         enable_penalties = self.enable_penalties
 
-        def sample(logits, key, temps, greedy, topps, pen=None):
+        def sample(logits, keys, temps, greedy, topps, pen=None):
             """Returns (token, logprob) — the logprob is the log-softmax at
             the chosen token of the distribution the choice was made from
             (the model's own when no penalties apply), the number the
@@ -664,15 +679,32 @@ class InferenceEngine:
                     (topps < 1.0)[:, None] & (scaled < cutoff),
                     -jnp.inf, scaled,
                 )
-            sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+            sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(
+                jnp.int32
+            )
             chosen = jnp.where(greedy, greedy_tok, sampled)
             logp_all = jax.nn.log_softmax(logits, axis=-1)
             logp = jnp.take_along_axis(logp_all, chosen[:, None], axis=-1)[:, 0]
             return chosen, logp
 
+        # Per-request reproducible sampling: each sampled token's key is
+        # fold_in(fold_in(engine_base, request_seed), n_sampled_so_far) —
+        # counter-based, so a seeded stream is identical regardless of
+        # batch composition, window size, or mega/pipelined scheduling.
+        base_key = jax.random.PRNGKey(self._seed + 2)
+
+        def row_keys(seeds, nsteps):
+            def one(sd, n):
+                return jax.random.fold_in(
+                    jax.random.fold_in(base_key, sd), n
+                )
+
+            return jax.vmap(one)(seeds, nsteps)
+
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, key, all_tokens, all_logps, pcounts,
+            temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
+            nsteps,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -683,11 +715,11 @@ class InferenceEngine:
             finalize RESETS the slot's row (new request) and counts the
             first sampled token; the first token itself is never penalized
             (its counts are the zeros just written)."""
-            key, sub = jax.random.split(key)
             logits, cache = transformer_prefill_chunk(
                 params, tokens, cache, slots, starts, lens, cfg,
                 dense_attn=dense_attn,
             )
+            sub = row_keys(seeds[slots], jnp.zeros_like(slots))
             first, first_lp = sample(logits, sub, temps, greedy, topps)
             S = all_tokens.shape[0]
             match = (
@@ -706,11 +738,14 @@ class InferenceEngine:
                 pcounts = pcounts.at[
                     jnp.arange(S), all_tokens
                 ].add(has.astype(jnp.int32))
+            # The first token was sampled with n=0; the slot's next sample
+            # uses n=1.
+            nsteps = jnp.where(has, 1, nsteps)
             return (cache, all_tokens, all_logps, rep(first), rep(first_lp),
-                    key, pcounts)
+                    pcounts, nsteps)
 
         prefill_chunk_step = partial(
-            jax.jit, donate_argnums=(1, 11, 12, 13, 14)
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15)
         )(_prefill_core)
 
         def _multi_chunk_core(params, cache, tokens3, slots, starts0,
@@ -768,18 +803,18 @@ class InferenceEngine:
                 params, cache, tokens3, slots, starts0, n_chunks, history
             )
 
-        @partial(jax.jit, donate_argnums=(1, 11, 12, 13, 14, 15))
+        @partial(jax.jit, donate_argnums=(1, 12, 13, 14, 15, 16))
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, key, all_tokens, all_logps, pcounts,
-            history,
+            temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
+            nsteps, history,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
-                row_valid, temps, greedy, topps, key, all_tokens, all_logps,
-                pcounts,
+                row_valid, temps, greedy, topps, seeds, all_tokens,
+                all_logps, pcounts, nsteps,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -790,56 +825,62 @@ class InferenceEngine:
             return out + (history,)
 
         def make_decode_body(params, active, temps, greedy, topps, fpen,
-                             ppen):
+                             ppen, seeds):
             """One decode step (scan body): forward + sample + penalty
             count scatter — shared by the plain window and the mega
             while_loop so the two dispatch modes cannot drift."""
 
             def body(carry, _):
-                tokens, logps, cache, key, pcounts = carry
-                key, sub = jax.random.split(key)
+                tokens, logps, cache, nsteps, pcounts = carry
                 logits, cache = transformer_decode_step(
                     params, tokens, cache, active, cfg, dense_attn=dense_attn
                 )
                 pen = (pcounts, fpen, ppen) if enable_penalties else None
+                sub = row_keys(seeds, nsteps)
                 nxt, nlp = sample(logits, sub, temps, greedy, topps, pen)
+                nsteps = nsteps + active.astype(jnp.int32)
                 if enable_penalties:
                     pcounts = pcounts.at[
                         jnp.arange(nxt.shape[0]), nxt
                     ].add(active.astype(jnp.int32))
-                return (nxt, nlp, cache, key, pcounts), (tokens, logps)
+                return (nxt, nlp, cache, nsteps, pcounts), (tokens, logps)
 
             return body
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 11))
-        def decode_window(params, tokens, logps, cache, active, key, temps,
-                          greedy, topps, fpen, ppen, pcounts, k):
+        @partial(
+            jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 11)
+        )
+        def decode_window(params, tokens, logps, cache, active, nsteps,
+                          temps, greedy, topps, fpen, ppen, pcounts, seeds,
+                          k):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
             and carry the (k+1)-th as next input. One host fetch per k
             tokens — emitted tokens and logprobs pack into ONE [2, k, S]
             f32 block (token ids are exact in f32 below 2^24) so the
-            host↔device roundtrip count stays one per window. The PRNG
-            key is threaded through ON DEVICE, so steady-state dispatch
-            uploads nothing host→device at all."""
+            host↔device roundtrip count stays one per window. Sampling
+            keys are counter-based — nsteps threads through ON DEVICE and
+            the seeds plane uploads only on admission — so steady-state
+            dispatch uploads nothing host→device at all."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen)
-            (final, final_lp, cache, key, pcounts), (etoks, elps) = (
+                                    fpen, ppen, seeds)
+            (final, final_lp, cache, nsteps, pcounts), (etoks, elps) = (
                 jax.lax.scan(
-                    body, (tokens, logps, cache, key, pcounts), length=k
+                    body, (tokens, logps, cache, nsteps, pcounts), length=k
                 )
             )
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
-            return rep(emitted), final, final_lp, cache, key, pcounts
+            return rep(emitted), final, final_lp, cache, nsteps, pcounts
 
         eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
 
         @partial(
-            jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 11)
+            jax.jit, static_argnames=("k", "m"),
+            donate_argnums=(3, 5, 11),
         )
-        def mega_window(params, tokens, logps, cache, active, key, temps,
-                        greedy, topps, fpen, ppen, pcounts, remaining,
+        def mega_window(params, tokens, logps, cache, active, nsteps, temps,
+                        greedy, topps, fpen, ppen, pcounts, seeds, remaining,
                         eos_stop, k, m):
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
@@ -853,16 +894,16 @@ class InferenceEngine:
             block 0) and the host drops the tokens post-retirement, so
             the junk is slot-local by construction."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen)
+                                    fpen, ppen, seeds)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
 
             def win_body(state):
-                (w, tokens, logps, cache, key, pcounts, remaining,
+                (w, tokens, logps, cache, nsteps, pcounts, remaining,
                  emitted) = state
-                ((tokens, logps, cache, key, pcounts),
+                ((tokens, logps, cache, nsteps, pcounts),
                  (etoks, elps)) = jax.lax.scan(
-                    body, (tokens, logps, cache, key, pcounts), length=k
+                    body, (tokens, logps, cache, nsteps, pcounts), length=k
                 )
                 slab = jnp.stack([etoks.astype(jnp.float32), elps])
                 emitted = jax.lax.dynamic_update_slice(
@@ -870,24 +911,25 @@ class InferenceEngine:
                 )
                 hit = jnp.any(etoks == eos_id, axis=0) & eos_stop
                 remaining = jnp.where(hit, 0, jnp.maximum(remaining - k, 0))
-                return (w + 1, tokens, logps, cache, key, pcounts,
+                return (w + 1, tokens, logps, cache, nsteps, pcounts,
                         remaining, emitted)
 
             def win_cond(state):
                 return (state[0] < m) & jnp.any(state[6] > 0)
 
-            (w, final, final_lp, cache, key, pcounts, _, emitted) = (
+            (w, final, final_lp, cache, nsteps, pcounts, _, emitted) = (
                 jax.lax.while_loop(
                     win_cond, win_body,
-                    (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
-                     pcounts, remaining, emitted0),
+                    (jnp.asarray(0, jnp.int32), tokens, logps, cache,
+                     nsteps, pcounts, remaining, emitted0),
                 )
             )
-            return rep(emitted), rep(w), final, final_lp, cache, key, pcounts
+            return (rep(emitted), rep(w), final, final_lp, cache, nsteps,
+                    pcounts)
 
         G = self.spec_tokens
 
-        def make_spec_body(params, active, temps, greedy, topps):
+        def make_spec_body(params, active, temps, greedy, topps, seeds):
             """One speculative step (scan body), shared by the plain spec
             window and the mega-spec while_loop."""
             from gofr_tpu.models.transformer import (
@@ -897,8 +939,8 @@ class InferenceEngine:
             )
 
             def body(carry, _):
-                tokens, logps, cache, key, history = carry
-                key, sub = jax.random.split(key)
+                tokens, logps, cache, nsteps, history = carry
+                sub = row_keys(seeds, nsteps)
                 draft = ngram_draft(history, cache.lengths, tokens, G)
                 inputs = jnp.concatenate([tokens[:, None], draft], axis=1)
                 logits, nk, nv = transformer_verify_step(
@@ -955,16 +997,19 @@ class InferenceEngine:
                     jnp.arange(S2)[:, None], hpos
                 ].set(hvals)
                 cache = cache._replace(lengths=cache.lengths + counts)
+                nsteps = nsteps + counts
                 return (
-                    (bonus, bonus_lp, cache, key, history),
+                    (bonus, bonus_lp, cache, nsteps, history),
                     (step_tokens, step_logps, counts),
                 )
 
             return body
 
-        @partial(jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9))
-        def spec_window(params, tokens, logps, cache, active, key, temps,
-                        greedy, topps, history, k):
+        @partial(
+            jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9)
+        )
+        def spec_window(params, tokens, logps, cache, active, nsteps, temps,
+                        greedy, topps, history, seeds, k):
             """k speculative steps on device. Each step drafts G tokens by
             n-gram lookup in the slot's own history, verifies draft+current
             in ONE [S, G+1] forward (cache read-only), accepts the longest
@@ -973,20 +1018,23 @@ class InferenceEngine:
             all layers' K/V in one scatter, and carries the bonus token.
             Emits per step: tokens [S, G+1] (= the step's inputs), logps,
             and counts [S] (=accepted+1 valid entries)."""
-            body = make_spec_body(params, active, temps, greedy, topps)
-            (final, final_lp, cache, key, history), (etoks, elps, ecnt) = (
-                jax.lax.scan(
-                    body, (tokens, logps, cache, key, history), length=k
-                )
+            body = make_spec_body(params, active, temps, greedy, topps,
+                                  seeds)
+            ((final, final_lp, cache, nsteps, history),
+             (etoks, elps, ecnt)) = jax.lax.scan(
+                body, (tokens, logps, cache, nsteps, history), length=k
             )
             emitted = jnp.stack(
                 [etoks.astype(jnp.float32), elps]
             )  # [2, k, S, G+1]
-            return rep(emitted), rep(ecnt), final, final_lp, cache, key, history
+            return (rep(emitted), rep(ecnt), final, final_lp, cache, nsteps,
+                    history)
 
-        @partial(jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 9))
-        def mega_spec_window(params, tokens, logps, cache, active, key,
-                             temps, greedy, topps, history, remaining,
+        @partial(
+            jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 9)
+        )
+        def mega_spec_window(params, tokens, logps, cache, active, nsteps,
+                             temps, greedy, topps, history, seeds, remaining,
                              eos_stop, k, m):
             """Mega × speculation: up to m k-step spec windows in ONE
             dispatch. `remaining` decrements by the ACTUAL emitted token
@@ -994,17 +1042,18 @@ class InferenceEngine:
             coverage ≥ the plain-decode guarantee); EOS detection scans
             only the VALID (first `counts`) entries of each step —
             rejected draft positions must not zero a budget."""
-            body = make_spec_body(params, active, temps, greedy, topps)
+            body = make_spec_body(params, active, temps, greedy, topps,
+                                  seeds)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S, G + 1), dtype=jnp.float32)
             ecnt0 = jnp.zeros((m * k, S), dtype=jnp.int32)
 
             def win_body(state):
-                (w, tokens, logps, cache, key, history, remaining,
+                (w, tokens, logps, cache, nsteps, history, remaining,
                  emitted, ecnt) = state
-                ((tokens, logps, cache, key, history),
+                ((tokens, logps, cache, nsteps, history),
                  (etoks, elps, cnts)) = jax.lax.scan(
-                    body, (tokens, logps, cache, key, history), length=k
+                    body, (tokens, logps, cache, nsteps, history), length=k
                 )
                 slab = jnp.stack([etoks.astype(jnp.float32), elps])
                 emitted = jax.lax.dynamic_update_slice(
@@ -1023,21 +1072,20 @@ class InferenceEngine:
                 remaining = jnp.where(
                     hit, 0, jnp.maximum(remaining - delivered, 0)
                 )
-                return (w + 1, tokens, logps, cache, key, history,
+                return (w + 1, tokens, logps, cache, nsteps, history,
                         remaining, emitted, ecnt)
 
             def win_cond(state):
                 return (state[0] < m) & jnp.any(state[6] > 0)
 
-            (w, final, final_lp, cache, key, history, _, emitted, ecnt) = (
-                jax.lax.while_loop(
-                    win_cond, win_body,
-                    (jnp.asarray(0, jnp.int32), tokens, logps, cache, key,
-                     history, remaining, emitted0, ecnt0),
-                )
+            ((w, final, final_lp, cache, nsteps, history, _, emitted,
+              ecnt)) = jax.lax.while_loop(
+                win_cond, win_body,
+                (jnp.asarray(0, jnp.int32), tokens, logps, cache, nsteps,
+                 history, remaining, emitted0, ecnt0),
             )
             return (rep(emitted), rep(ecnt), rep(w), final, final_lp, cache,
-                    key, history)
+                    nsteps, history)
 
         self._prefill_chunk_step = prefill_chunk_step
         self._prefill_chunk_step_hist = prefill_chunk_step_hist
@@ -1423,6 +1471,8 @@ class InferenceEngine:
             )
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
             slot = free.pop(0)
+            self._seeds_host[slot] = req.seed
+            self._seeds_dirty = True
             state = _PrefillState(request=req)
             if self._prefix_pool is not None and not req.prefix_store:
                 idx, plen = self._prefix_pool.lookup(req.prompt_ids)
@@ -1538,23 +1588,26 @@ class InferenceEngine:
         jnp = self._jnp
         t0 = time.time()
         self._push_table()
+        if self._seeds_dirty:
+            self._seeds_dev = self._up(self._seeds_host)
+            self._seeds_dirty = False
         args = (
             self.params, self.cache, self._up(tokens),
             self._up(slots), self._up(starts), self._up(lens),
             self._up(finalize), self._up(row_valid),
             self._up(temps), self._up(greedy), self._up(topps),
-            self._key_dev, self._tokens_dev, self._logps_dev,
-            self._pcounts_dev,
+            self._seeds_dev, self._tokens_dev, self._logps_dev,
+            self._pcounts_dev, self._nsteps_dev,
         )
         if self.spec_tokens:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._key_dev, self._pcounts_dev,
+             first_lp_dev, self._pcounts_dev, self._nsteps_dev,
              self._history_dev) = (
                 self._prefill_chunk_step_hist(*args, self._history_dev)
             )
         else:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._key_dev, self._pcounts_dev) = (
+             first_lp_dev, self._pcounts_dev, self._nsteps_dev) = (
                 self._prefill_chunk_step(*args)
             )
         if self._lockstep:
@@ -1749,46 +1802,47 @@ class InferenceEngine:
         wrun = None
         if mega > 1 and self.spec_tokens:
             (emitted, counts, wrun, self._tokens_dev, self._logps_dev,
-             self.cache, self._key_dev, self._history_dev) = (
+             self.cache, self._nsteps_dev, self._history_dev) = (
                 self._mega_spec_window(
                     self.params, self._tokens_dev, self._logps_dev,
-                    self.cache, self._active_dev, self._key_dev,
+                    self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
-                    self._history_dev, self._up(remaining_host),
-                    self._up(eos_stop_host), k=self.window_k, m=mega,
-                )
-            )
-        elif mega > 1:
-            (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev, self._pcounts_dev) = (
-                self._mega_window(
-                    self.params, self._tokens_dev, self._logps_dev,
-                    self.cache, self._active_dev, self._key_dev,
-                    self._temps_dev, self._greedy_dev, self._topp_dev,
-                    self._fpen_dev, self._ppen_dev, self._pcounts_dev,
+                    self._history_dev, self._seeds_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
                     k=self.window_k, m=mega,
                 )
             )
+        elif mega > 1:
+            (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
+             self._nsteps_dev, self._pcounts_dev) = (
+                self._mega_window(
+                    self.params, self._tokens_dev, self._logps_dev,
+                    self.cache, self._active_dev, self._nsteps_dev,
+                    self._temps_dev, self._greedy_dev, self._topp_dev,
+                    self._fpen_dev, self._ppen_dev, self._pcounts_dev,
+                    self._seeds_dev, self._up(remaining_host),
+                    self._up(eos_stop_host), k=self.window_k, m=mega,
+                )
+            )
         elif self.spec_tokens:
             (emitted, counts, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev, self._history_dev) = (
+             self._nsteps_dev, self._history_dev) = (
                 self._spec_window(
                     self.params, self._tokens_dev, self._logps_dev,
-                    self.cache, self._active_dev, self._key_dev,
+                    self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
-                    self._history_dev, k=self.window_k,
+                    self._history_dev, self._seeds_dev, k=self.window_k,
                 )
             )
         else:
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev, self._pcounts_dev) = (
+             self._nsteps_dev, self._pcounts_dev) = (
                 self._decode_window(
                     self.params, self._tokens_dev, self._logps_dev,
-                    self.cache, self._active_dev, self._key_dev,
+                    self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
-                    k=self.window_k,
+                    self._seeds_dev, k=self.window_k,
                 )
             )
         extras = [a for a in (counts, wrun) if a is not None]
@@ -2017,15 +2071,15 @@ class InferenceEngine:
             greedy = np.ones((P,), dtype=bool)
             t0 = time.perf_counter()
             (self.cache, self._tokens_dev, self._logps_dev, first, _flp,
-             self._key_dev, self._pcounts_dev) = (
+             self._pcounts_dev, self._nsteps_dev) = (
                 self._prefill_chunk_step(
                     self.params, self.cache, self._up(tokens),
                     self._up(slots), self._up(starts), self._up(lens),
                     self._up(finalize), self._up(row_valid),
                     self._up(temps), self._up(greedy),
                     self._up(topps),
-                    self._key_dev, self._tokens_dev, self._logps_dev,
-                    self._pcounts_dev,
+                    self._seeds_dev, self._tokens_dev, self._logps_dev,
+                    self._pcounts_dev, self._nsteps_dev,
                 )
             )
             jax.block_until_ready(first)
@@ -2041,12 +2095,12 @@ class InferenceEngine:
         def window():
             out = self._decode_window(
                 self.params, self._tokens_dev, self._logps_dev, self.cache,
-                active, self._key_dev, tdev, gdev, pdev,
+                active, self._nsteps_dev, tdev, gdev, pdev,
                 self._fpen_dev, self._ppen_dev, self._pcounts_dev,
-                k=self.window_k,
+                self._seeds_dev, k=self.window_k,
             )
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._key_dev, self._pcounts_dev) = out
+             self._nsteps_dev, self._pcounts_dev) = out
             return emitted
 
         # Warmup (compile) + RTT probe: a blocking fetch of a just-computed
@@ -2128,6 +2182,7 @@ class InferenceEngine:
         top_p: float = 1.0,
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
+        seed: "Optional[int]" = None,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -2187,6 +2242,12 @@ class InferenceEngine:
             top_p=top_p,
             frequency_penalty=frequency_penalty,
             presence_penalty=presence_penalty,
+            # Unseeded requests draw a fresh seed (distinct streams);
+            # int32 range for the device plane.
+            seed=(
+                int(seed) & 0x7FFFFFFF if seed is not None
+                else self._seed_rng.getrandbits(31)
+            ),
         )
         self._enqueue(req)
         return req

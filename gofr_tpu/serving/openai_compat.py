@@ -157,6 +157,7 @@ def add_openai_routes(
             top_p, temperature = 1.0, 0.0
         fpen = body.get("frequency_penalty")
         ppen = body.get("presence_penalty")
+        seed = body.get("seed")
         return dict(
             max_new_tokens=128 if max_tokens is None else int(max_tokens),
             temperature=temperature,
@@ -164,6 +165,7 @@ def add_openai_routes(
             stop_on_eos=True,
             frequency_penalty=0.0 if fpen is None else float(fpen),
             presence_penalty=0.0 if ppen is None else float(ppen),
+            seed=None if seed is None else int(seed),
         )
 
     def _stream_response(
