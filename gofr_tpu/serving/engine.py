@@ -23,6 +23,7 @@ Design:
 from __future__ import annotations
 
 import asyncio
+import os
 import queue
 import threading
 import time
@@ -37,6 +38,11 @@ from gofr_tpu.serving.batcher import DynamicBatcher, pad_bucket
 from gofr_tpu.serving.tokenizer import tokenizer_from_config
 
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+
+# logit_bias entries per request — the OpenAI cap. The [slots, K] planes
+# upload only on admission, so K is cheap padding (~77 KB at 32 slots).
+LOGIT_BIAS_K = 300
 
 
 @dataclass
@@ -108,6 +114,8 @@ class _GenRequest:
     # Per-request sampling seed (counter-based keys: same seed + prompt +
     # params → same sampled stream regardless of batch/scheduling).
     seed: int = 0
+    # OpenAI logit_bias: {token_id: bias}, at most LOGIT_BIAS_K entries.
+    logit_bias: dict = field(default_factory=dict)
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
@@ -392,10 +400,12 @@ class InferenceEngine:
             # collectives nondeterministically across ranks — observed as
             # gloo "Received data size doesn't match expected size".
             self._lockstep = False
+            multiproc = False
             if mesh is not None:
                 procs = {d.process_index for d in mesh.devices.flat}
+                multiproc = len(procs) > 1
                 self._lockstep = (
-                    len(procs) > 1 and jax.default_backend() != "tpu"
+                    multiproc and jax.default_backend() != "tpu"
                 )
             self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
             self._logps_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
@@ -410,10 +420,18 @@ class InferenceEngine:
             self._seeds_dirty = False
             # Host-side default-seed source for requests without one: each
             # unseeded request gets a fresh draw (OpenAI semantics), while
-            # an explicit seed reproduces exactly.
+            # an explicit seed reproduces exactly. Single-process engines
+            # mix in boot entropy so restarts/replicas don't replay; a
+            # multi-PROCESS mesh keeps the engine-seed-derived stream —
+            # every rank must draw IDENTICAL defaults or the SPMD
+            # schedulers diverge (set distinct TPU seeds per replica
+            # group for cross-replica variety).
             import random as _random
 
-            self._seed_rng = _random.Random(seed + 3)
+            self._seed_rng = (
+                _random.Random(seed + 3) if multiproc
+                else _random.Random(os.urandom(16))
+            )
             self._active_dev = self._up(np.zeros((n_slots,), dtype=bool))
             self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
             self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
@@ -426,6 +444,14 @@ class InferenceEngine:
             )
             self._fpen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
             self._ppen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
+            self._bidx_host = np.full(
+                (n_slots, LOGIT_BIAS_K), -1, dtype=np.int32
+            )
+            self._bval_host = np.zeros(
+                (n_slots, LOGIT_BIAS_K), dtype=np.float32
+            )
+            self._bidx_dev = self._up(self._bidx_host)
+            self._bval_dev = self._up(self._bval_host)
             self._slot_state_dirty = True
             # Token history per slot (prompt + generated) — the n-gram
             # draft source; only maintained when speculation is on.
@@ -624,7 +650,8 @@ class InferenceEngine:
         enable_top_p = self.enable_top_p
         enable_penalties = self.enable_penalties
 
-        def sample(logits, keys, temps, greedy, topps, pen=None):
+        def sample(logits, keys, temps, greedy, topps, pen=None,
+                   bias=None):
             """Returns (token, logprob) — the logprob is the log-softmax at
             the chosen token of the distribution the choice was made from
             (the model's own when no penalties apply), the number the
@@ -636,6 +663,15 @@ class InferenceEngine:
             convention), applied before greedy argmax AND sampling so
             temperature-0 requests honor them too."""
             logits = logits.astype(jnp.float32)
+            if bias is not None:
+                # OpenAI logit_bias: sparse per-request (token, bias)
+                # pairs, padded with idx -1. Applied to the raw logits —
+                # before penalties, greedy argmax, and sampling.
+                bidx, bval = bias
+                rows = jnp.arange(logits.shape[0])[:, None]
+                logits = logits.at[rows, jnp.clip(bidx, 0)].add(
+                    jnp.where(bidx >= 0, bval, 0.0)
+                )
             if pen is not None:
                 counts, fpen, ppen = pen
                 cf = counts.astype(jnp.float32)
@@ -704,7 +740,7 @@ class InferenceEngine:
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps,
+            nsteps, bidx, bval, use_bias,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -720,7 +756,10 @@ class InferenceEngine:
                 dense_attn=dense_attn,
             )
             sub = row_keys(seeds[slots], jnp.zeros_like(slots))
-            first, first_lp = sample(logits, sub, temps, greedy, topps)
+            first, first_lp = sample(
+                logits, sub, temps, greedy, topps,
+                bias=(bidx[slots], bval[slots]) if use_bias else None,
+            )
             S = all_tokens.shape[0]
             match = (
                 (jnp.arange(S)[:, None] == slots[None, :])
@@ -745,7 +784,8 @@ class InferenceEngine:
                     pcounts, nsteps)
 
         prefill_chunk_step = partial(
-            jax.jit, donate_argnums=(1, 12, 13, 14, 15)
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15),
+            static_argnames=("use_bias",),
         )(_prefill_core)
 
         def _multi_chunk_core(params, cache, tokens3, slots, starts0,
@@ -803,18 +843,21 @@ class InferenceEngine:
                 params, cache, tokens3, slots, starts0, n_chunks, history
             )
 
-        @partial(jax.jit, donate_argnums=(1, 12, 13, 14, 15, 16))
+        @partial(
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18),
+            static_argnames=("use_bias",),
+        )
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, history,
+            nsteps, bidx, bval, history, use_bias=False,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
                 row_valid, temps, greedy, topps, seeds, all_tokens,
-                all_logps, pcounts, nsteps,
+                all_logps, pcounts, nsteps, bidx, bval, use_bias,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -825,7 +868,7 @@ class InferenceEngine:
             return out + (history,)
 
         def make_decode_body(params, active, temps, greedy, topps, fpen,
-                             ppen, seeds):
+                             ppen, seeds, bidx, bval, use_bias):
             """One decode step (scan body): forward + sample + penalty
             count scatter — shared by the plain window and the mega
             while_loop so the two dispatch modes cannot drift."""
@@ -837,7 +880,10 @@ class InferenceEngine:
                 )
                 pen = (pcounts, fpen, ppen) if enable_penalties else None
                 sub = row_keys(seeds, nsteps)
-                nxt, nlp = sample(logits, sub, temps, greedy, topps, pen)
+                nxt, nlp = sample(
+                    logits, sub, temps, greedy, topps, pen,
+                    bias=(bidx, bval) if use_bias else None,
+                )
                 nsteps = nsteps + active.astype(jnp.int32)
                 if enable_penalties:
                     pcounts = pcounts.at[
@@ -848,11 +894,12 @@ class InferenceEngine:
             return body
 
         @partial(
-            jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 11)
+            jax.jit, static_argnames=("k", "use_bias"),
+            donate_argnums=(3, 5, 11),
         )
         def decode_window(params, tokens, logps, cache, active, nsteps,
                           temps, greedy, topps, fpen, ppen, pcounts, seeds,
-                          k):
+                          bidx, bval, k, use_bias):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -864,7 +911,7 @@ class InferenceEngine:
             the seeds plane uploads only on admission — so steady-state
             dispatch uploads nothing host→device at all."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen, seeds)
+                                    fpen, ppen, seeds, bidx, bval, use_bias)
             (final, final_lp, cache, nsteps, pcounts), (etoks, elps) = (
                 jax.lax.scan(
                     body, (tokens, logps, cache, nsteps, pcounts), length=k
@@ -876,12 +923,12 @@ class InferenceEngine:
         eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
 
         @partial(
-            jax.jit, static_argnames=("k", "m"),
+            jax.jit, static_argnames=("k", "m", "use_bias"),
             donate_argnums=(3, 5, 11),
         )
         def mega_window(params, tokens, logps, cache, active, nsteps, temps,
-                        greedy, topps, fpen, ppen, pcounts, seeds, remaining,
-                        eos_stop, k, m):
+                        greedy, topps, fpen, ppen, pcounts, seeds, bidx,
+                        bval, remaining, eos_stop, k, m, use_bias):
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
             is covered (decremented k per window; zeroed when the slot
@@ -894,7 +941,7 @@ class InferenceEngine:
             block 0) and the host drops the tokens post-retirement, so
             the junk is slot-local by construction."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen, seeds)
+                                    fpen, ppen, seeds, bidx, bval, use_bias)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
 
@@ -1472,6 +1519,11 @@ class InferenceEngine:
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
             slot = free.pop(0)
             self._seeds_host[slot] = req.seed
+            self._bidx_host[slot, :] = -1
+            self._bval_host[slot, :] = 0.0
+            for j, (tok, bv) in enumerate(req.logit_bias.items()):
+                self._bidx_host[slot, j] = tok
+                self._bval_host[slot, j] = bv
             self._seeds_dirty = True
             state = _PrefillState(request=req)
             if self._prefix_pool is not None and not req.prefix_store:
@@ -1590,6 +1642,8 @@ class InferenceEngine:
         self._push_table()
         if self._seeds_dirty:
             self._seeds_dev = self._up(self._seeds_host)
+            self._bidx_dev = self._up(self._bidx_host)
+            self._bval_dev = self._up(self._bval_host)
             self._seeds_dirty = False
         args = (
             self.params, self.cache, self._up(tokens),
@@ -1597,18 +1651,26 @@ class InferenceEngine:
             self._up(finalize), self._up(row_valid),
             self._up(temps), self._up(greedy), self._up(topps),
             self._seeds_dev, self._tokens_dev, self._logps_dev,
-            self._pcounts_dev, self._nsteps_dev,
+            self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
+            self._bval_dev,
+        )
+        # Static compile choice: the no-bias program has no bias scatter
+        # at all (each variant compiles once, then caches).
+        use_bias = any(
+            st.request.logit_bias for _, st in rows
         )
         if self.spec_tokens:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
              first_lp_dev, self._pcounts_dev, self._nsteps_dev,
              self._history_dev) = (
-                self._prefill_chunk_step_hist(*args, self._history_dev)
+                self._prefill_chunk_step_hist(
+                    *args, self._history_dev, use_bias=use_bias
+                )
             )
         else:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
              first_lp_dev, self._pcounts_dev, self._nsteps_dev) = (
-                self._prefill_chunk_step(*args)
+                self._prefill_chunk_step(*args, use_bias=use_bias)
             )
         if self._lockstep:
             self._jax.block_until_ready(first_dev)
@@ -1740,6 +1802,10 @@ class InferenceEngine:
         # and an EOS slot is retired by processing, so accounting can
         # never strand a live slot).
         mega = self.mega_windows
+        use_bias = any(
+            seq is not None and seq.request.logit_bias
+            for seq in self._slots
+        )
         remaining_host = eos_stop_host = None
         cover = self.window_k * mega  # guaranteed MINIMUM emissions
         if mega > 1:
@@ -1820,8 +1886,9 @@ class InferenceEngine:
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
-                    self._seeds_dev, self._up(remaining_host),
-                    self._up(eos_stop_host), k=self.window_k, m=mega,
+                    self._seeds_dev, self._bidx_dev, self._bval_dev,
+                    self._up(remaining_host), self._up(eos_stop_host),
+                    k=self.window_k, m=mega, use_bias=use_bias,
                 )
             )
         elif self.spec_tokens:
@@ -1842,7 +1909,8 @@ class InferenceEngine:
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
-                    self._seeds_dev, k=self.window_k,
+                    self._seeds_dev, self._bidx_dev, self._bval_dev,
+                    k=self.window_k, use_bias=use_bias,
                 )
             )
         extras = [a for a in (counts, wrun) if a is not None]
@@ -2079,7 +2147,8 @@ class InferenceEngine:
                     self._up(temps), self._up(greedy),
                     self._up(topps),
                     self._seeds_dev, self._tokens_dev, self._logps_dev,
-                    self._pcounts_dev, self._nsteps_dev,
+                    self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
+                    self._bval_dev, use_bias=False,
                 )
             )
             jax.block_until_ready(first)
@@ -2097,7 +2166,8 @@ class InferenceEngine:
                 self.params, self._tokens_dev, self._logps_dev, self.cache,
                 active, self._nsteps_dev, tdev, gdev, pdev,
                 self._fpen_dev, self._ppen_dev, self._pcounts_dev,
-                self._seeds_dev, k=self.window_k,
+                self._seeds_dev, self._bidx_dev, self._bval_dev,
+                k=self.window_k, use_bias=False,
             )
             (emitted, self._tokens_dev, self._logps_dev, self.cache,
              self._nsteps_dev, self._pcounts_dev) = out
@@ -2183,6 +2253,7 @@ class InferenceEngine:
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         seed: "Optional[int]" = None,
+        logit_bias: "Optional[dict]" = None,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -2210,6 +2281,44 @@ class InferenceEngine:
                     and -2.0 <= presence_penalty <= 2.0):
                 raise ErrorInvalidParam([
                     "penalties must be in [-2, 2]"
+                ])
+        bias: dict = {}
+        if logit_bias:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            if not isinstance(logit_bias, dict):
+                raise ErrorInvalidParam([
+                    "logit_bias must be an object mapping token ids to "
+                    "numbers"
+                ])
+            if self.spec_tokens:
+                raise ErrorInvalidParam([
+                    "logit_bias is not supported with speculative "
+                    "decoding (TPU_SPEC_TOKENS) — biased greedy picks "
+                    "would invalidate the draft-acceptance rule"
+                ])
+            if len(logit_bias) > LOGIT_BIAS_K:
+                raise ErrorInvalidParam([
+                    f"logit_bias supports at most {LOGIT_BIAS_K} entries"
+                ])
+            try:
+                if any(
+                    isinstance(t, float) and t != int(t) for t in logit_bias
+                ):
+                    raise ValueError("fractional token id")
+                bias = {
+                    int(t): float(b) for t, b in logit_bias.items()
+                }
+            except (TypeError, ValueError):
+                raise ErrorInvalidParam([
+                    "logit_bias must map integral token ids to numbers"
+                ]) from None
+            if any(
+                not 0 <= t < self.cfg.vocab_size for t in bias
+            ) or any(not -100.0 <= b <= 100.0 for b in bias.values()):
+                raise ErrorInvalidParam([
+                    f"logit_bias token ids must be in [0, "
+                    f"{self.cfg.vocab_size}) and biases in [-100, 100]"
                 ])
         ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
@@ -2248,6 +2357,7 @@ class InferenceEngine:
                 int(seed) & 0x7FFFFFFF if seed is not None
                 else self._seed_rng.getrandbits(31)
             ),
+            logit_bias=bias,
         )
         self._enqueue(req)
         return req

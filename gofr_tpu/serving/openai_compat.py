@@ -158,6 +158,7 @@ def add_openai_routes(
         fpen = body.get("frequency_penalty")
         ppen = body.get("presence_penalty")
         seed = body.get("seed")
+        logit_bias = body.get("logit_bias")
         return dict(
             max_new_tokens=128 if max_tokens is None else int(max_tokens),
             temperature=temperature,
@@ -166,6 +167,7 @@ def add_openai_routes(
             frequency_penalty=0.0 if fpen is None else float(fpen),
             presence_penalty=0.0 if ppen is None else float(ppen),
             seed=None if seed is None else int(seed),
+            logit_bias=logit_bias or None,
         )
 
     def _stream_response(

@@ -131,3 +131,59 @@ def test_penalties_require_flag_and_range():
 def test_penalties_reject_speculation():
     with pytest.raises(ValueError, match="mutually exclusive"):
         _engine(enable_penalties=True, spec_tokens=2)
+
+
+class TestLogitBias:
+    """OpenAI logit_bias: sparse per-request (token, bias) planes applied
+    to raw logits before penalties, argmax, and sampling."""
+
+    def test_minus_100_bans_and_plus_forces(self, base_tokens):
+        eng = _engine()
+        eng.start_sync()
+        try:
+            # Ban the greedy stream's first token: the stream must change
+            # and never contain it.
+            banned = int(base_tokens[0])
+            toks = _greedy(eng, logit_bias={banned: -100})
+            assert banned not in toks
+            # +100 on one token forces it everywhere (greedy).
+            forced = 7
+            toks = _greedy(eng, n=8, logit_bias={forced: 100})
+            assert toks == [forced] * 8
+            # No bias → base stream intact on the same engine.
+            assert _greedy(eng) == base_tokens
+        finally:
+            eng.stop_sync()
+
+    def test_bias_validation(self):
+        from gofr_tpu.errors import ErrorInvalidParam
+
+        eng = _engine()
+        eng.start_sync()
+        try:
+            with pytest.raises(ErrorInvalidParam, match="at most"):
+                eng.submit_generate(
+                    PROMPT, logit_bias={i: 1.0 for i in range(301)}
+                )
+            with pytest.raises(ErrorInvalidParam, match="integral"):
+                eng.submit_generate(PROMPT, logit_bias={7.9: -100.0})
+            with pytest.raises(ErrorInvalidParam, match="token ids"):
+                eng.submit_generate(PROMPT, logit_bias={10_000_000: 1.0})
+            with pytest.raises(ErrorInvalidParam, match="object"):
+                eng.submit_generate(PROMPT, logit_bias=[5])
+        finally:
+            eng.stop_sync()
+
+    def test_bias_with_mega_and_penalties(self, base_tokens):
+        eng = _engine(enable_penalties=True, mega_windows=4)
+        eng.start_sync()
+        try:
+            banned = int(base_tokens[0])
+            toks = eng.generate_sync(
+                PROMPT, max_new_tokens=16, temperature=0.0,
+                stop_on_eos=False, logit_bias={banned: -100},
+                frequency_penalty=0.5, timeout=120,
+            ).token_ids
+            assert banned not in toks
+        finally:
+            eng.stop_sync()
