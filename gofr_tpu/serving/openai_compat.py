@@ -12,9 +12,10 @@ expect, backed by the container's TPU engine:
 Responses use the OpenAI wire shapes directly (``Raw`` / ``Stream``
 bypass the framework's ``{"data": ...}`` envelope), so off-the-shelf
 OpenAI SDKs can point their ``base_url`` at this server. Chat messages
-are flattened with a minimal generic template; models loaded from HF
-checkpoints with their own chat template should pre-format prompts
-client-side or override ``chat_template``.
+render through the model's OWN chat template when the configured HF
+tokenizer carries one (``apply_chat_template``, token-id output so BOS
+isn't doubled), falling back to a minimal role-tagged flattening; an
+explicit ``chat_template`` arg to ``add_openai_routes`` overrides both.
 """
 
 from __future__ import annotations
