@@ -58,6 +58,9 @@ class GenerationResult:
     # "stop" (eos or a stop sequence matched) | "length" (token budget or
     # context window exhausted).
     finish_reason: str = "stop"
+    # Per-token [(token_id, logprob), ...] alternatives when the request
+    # asked for top_logprobs (None otherwise).
+    token_top_logprobs: "Optional[list]" = None
 
     @property
     def tokens_per_sec(self) -> float:
@@ -116,6 +119,10 @@ class _GenRequest:
     seed: int = 0
     # OpenAI logit_bias: {token_id: bias}, at most LOGIT_BIAS_K entries.
     logit_bias: dict = field(default_factory=dict)
+    # OpenAI top_logprobs: alternatives per emitted token (≤ engine's
+    # compiled TPU_TOP_LOGPROBS).
+    top_logprobs: int = 0
+    token_top_logprobs: list = field(default_factory=list)
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
@@ -150,6 +157,7 @@ class InferenceEngine:
         top_k: int = 0,
         enable_top_p: bool = False,
         enable_penalties: bool = False,
+        top_logprobs: int = 0,
         spec_tokens: int = 0,
         kv_block: int = 0,
         kv_pool_blocks: int = 0,
@@ -188,6 +196,15 @@ class InferenceEngine:
                 "TPU_PENALTIES and TPU_SPEC_TOKENS are mutually exclusive: "
                 "penalties evolve within a step sequence, which breaks the "
                 "parallel speculative verify"
+            )
+        # OpenAI top_logprobs alternatives: a compile choice — the per-
+        # step [slots, vocab] top_k only exists in the program when >0.
+        self.top_logprobs = max(0, top_logprobs)
+        if self.top_logprobs and spec_tokens > 0:
+            raise ValueError(
+                "TPU_TOP_LOGPROBS and TPU_SPEC_TOKENS are mutually "
+                "exclusive (the verify step has no per-emission "
+                "alternatives plane)"
             )
         self.tokenizer = tokenizer
         self.mesh = mesh  # multi-chip: NamedSharding placement over ICI
@@ -452,6 +469,13 @@ class InferenceEngine:
             )
             self._bidx_dev = self._up(self._bidx_host)
             self._bval_dev = self._up(self._bval_host)
+            tlk = max(1, self.top_logprobs)
+            self._topi_dev = self._up(
+                np.zeros((n_slots, tlk), dtype=np.int32)
+            )
+            self._topl_dev = self._up(
+                np.zeros((n_slots, tlk), dtype=np.float32)
+            )
             self._slot_state_dirty = True
             # Token history per slot (prompt + generated) — the n-gram
             # draft source; only maintained when speculation is on.
@@ -547,6 +571,7 @@ class InferenceEngine:
                 "TPU_TRUNCATE_PROMPTS", "false"
             ).lower() in ("1", "true", "yes"),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
+            top_logprobs=int(config.get_or_default("TPU_TOP_LOGPROBS", "0")),
             enable_top_p=config.get_or_default("TPU_TOP_P", "false").lower()
             in ("1", "true", "yes"),
             enable_penalties=config.get_or_default(
@@ -649,6 +674,7 @@ class InferenceEngine:
 
         enable_top_p = self.enable_top_p
         enable_penalties = self.enable_penalties
+        top_lp_k = self.top_logprobs
 
         def sample(logits, keys, temps, greedy, topps, pen=None,
                    bias=None):
@@ -721,7 +747,12 @@ class InferenceEngine:
             chosen = jnp.where(greedy, greedy_tok, sampled)
             logp_all = jax.nn.log_softmax(logits, axis=-1)
             logp = jnp.take_along_axis(logp_all, chosen[:, None], axis=-1)[:, 0]
-            return chosen, logp
+            if top_lp_k:
+                # OpenAI top_logprobs alternatives, from the same
+                # (biased/penalized) distribution the choice used.
+                tl, ti = jax.lax.top_k(logp_all, top_lp_k)
+                return chosen, logp, ti.astype(jnp.int32), tl
+            return chosen, logp, None, None
 
         # Per-request reproducible sampling: each sampled token's key is
         # fold_in(fold_in(engine_base, request_seed), n_sampled_so_far) —
@@ -740,7 +771,7 @@ class InferenceEngine:
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, use_bias,
+            nsteps, bidx, bval, topi, topl, use_bias,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -756,7 +787,7 @@ class InferenceEngine:
                 dense_attn=dense_attn,
             )
             sub = row_keys(seeds[slots], jnp.zeros_like(slots))
-            first, first_lp = sample(
+            first, first_lp, ftopi, ftopl = sample(
                 logits, sub, temps, greedy, topps,
                 bias=(bidx[slots], bval[slots]) if use_bias else None,
             )
@@ -780,11 +811,17 @@ class InferenceEngine:
             # The first token was sampled with n=0; the slot's next sample
             # uses n=1.
             nsteps = jnp.where(has, 1, nsteps)
+            if top_lp_k:
+                topi = jnp.where(has[:, None], ftopi[idx], topi)
+                topl = jnp.where(has[:, None], ftopl[idx], topl)
+                return (cache, all_tokens, all_logps, rep(first),
+                        rep(first_lp), pcounts, nsteps, topi, topl,
+                        rep(ftopi), rep(ftopl))
             return (cache, all_tokens, all_logps, rep(first), rep(first_lp),
-                    pcounts, nsteps)
+                    pcounts, nsteps, topi, topl, None, None)
 
         prefill_chunk_step = partial(
-            jax.jit, donate_argnums=(1, 12, 13, 14, 15),
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19),
             static_argnames=("use_bias",),
         )(_prefill_core)
 
@@ -844,20 +881,21 @@ class InferenceEngine:
             )
 
         @partial(
-            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18),
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19, 20),
             static_argnames=("use_bias",),
         )
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, history, use_bias=False,
+            nsteps, bidx, bval, topi, topl, history, use_bias=False,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
                 row_valid, temps, greedy, topps, seeds, all_tokens,
-                all_logps, pcounts, nsteps, bidx, bval, use_bias,
+                all_logps, pcounts, nsteps, bidx, bval, topi, topl,
+                use_bias,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -874,13 +912,13 @@ class InferenceEngine:
             while_loop so the two dispatch modes cannot drift."""
 
             def body(carry, _):
-                tokens, logps, cache, nsteps, pcounts = carry
+                tokens, logps, cache, nsteps, pcounts, topi, topl = carry
                 logits, cache = transformer_decode_step(
                     params, tokens, cache, active, cfg, dense_attn=dense_attn
                 )
                 pen = (pcounts, fpen, ppen) if enable_penalties else None
                 sub = row_keys(seeds, nsteps)
-                nxt, nlp = sample(
+                nxt, nlp, ntopi, ntopl = sample(
                     logits, sub, temps, greedy, topps, pen,
                     bias=(bidx, bval) if use_bias else None,
                 )
@@ -889,17 +927,25 @@ class InferenceEngine:
                     pcounts = pcounts.at[
                         jnp.arange(nxt.shape[0]), nxt
                     ].add(active.astype(jnp.int32))
-                return (nxt, nlp, cache, nsteps, pcounts), (tokens, logps)
+                # Alternatives travel WITH their token: the carried planes
+                # belong to the token entering this step (ys), the fresh
+                # ones to the token just chosen (next carry).
+                ys = (tokens, logps, topi, topl) if top_lp_k else (
+                    tokens, logps
+                )
+                if not top_lp_k:
+                    ntopi, ntopl = topi, topl
+                return (nxt, nlp, cache, nsteps, pcounts, ntopi, ntopl), ys
 
             return body
 
         @partial(
             jax.jit, static_argnames=("k", "use_bias"),
-            donate_argnums=(3, 5, 11),
+            donate_argnums=(3, 5, 11, 15, 16),
         )
         def decode_window(params, tokens, logps, cache, active, nsteps,
                           temps, greedy, topps, fpen, ppen, pcounts, seeds,
-                          bidx, bval, k, use_bias):
+                          bidx, bval, topi, topl, k, use_bias):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -912,23 +958,33 @@ class InferenceEngine:
             dispatch uploads nothing host→device at all."""
             body = make_decode_body(params, active, temps, greedy, topps,
                                     fpen, ppen, seeds, bidx, bval, use_bias)
-            (final, final_lp, cache, nsteps, pcounts), (etoks, elps) = (
+            (final, final_lp, cache, nsteps, pcounts, topi, topl), ys = (
                 jax.lax.scan(
-                    body, (tokens, logps, cache, nsteps, pcounts), length=k
+                    body,
+                    (tokens, logps, cache, nsteps, pcounts, topi, topl),
+                    length=k,
                 )
             )
+            if top_lp_k:
+                etoks, elps, etopi, etopl = ys
+                etops = rep(jnp.stack([etopi.astype(jnp.float32), etopl]))
+            else:
+                etoks, elps = ys
+                etops = None
             emitted = jnp.stack([etoks.astype(jnp.float32), elps])
-            return rep(emitted), final, final_lp, cache, nsteps, pcounts
+            return (rep(emitted), etops, final, final_lp, cache, nsteps,
+                    pcounts, topi, topl)
 
         eos_id = self.tokenizer.eos_id if self.tokenizer is not None else -1
 
         @partial(
             jax.jit, static_argnames=("k", "m", "use_bias"),
-            donate_argnums=(3, 5, 11),
+            donate_argnums=(3, 5, 11, 15, 16),
         )
         def mega_window(params, tokens, logps, cache, active, nsteps, temps,
                         greedy, topps, fpen, ppen, pcounts, seeds, bidx,
-                        bval, remaining, eos_stop, k, m, use_bias):
+                        bval, topi, topl, remaining, eos_stop, k, m,
+                        use_bias):
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
             is covered (decremented k per window; zeroed when the slot
@@ -944,14 +1000,29 @@ class InferenceEngine:
                                     fpen, ppen, seeds, bidx, bval, use_bias)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
+            etops0 = (
+                jnp.zeros((2, m * k, S, top_lp_k), dtype=jnp.float32)
+                if top_lp_k else jnp.zeros((0,), dtype=jnp.float32)
+            )
 
             def win_body(state):
                 (w, tokens, logps, cache, nsteps, pcounts, remaining,
-                 emitted) = state
-                ((tokens, logps, cache, nsteps, pcounts),
-                 (etoks, elps)) = jax.lax.scan(
-                    body, (tokens, logps, cache, nsteps, pcounts), length=k
+                 emitted, etops, topi, topl) = state
+                ((tokens, logps, cache, nsteps, pcounts, topi, topl),
+                 ys) = jax.lax.scan(
+                    body,
+                    (tokens, logps, cache, nsteps, pcounts, topi, topl),
+                    length=k,
                 )
+                if top_lp_k:
+                    etoks, elps, etopi, etopl = ys
+                    etops = jax.lax.dynamic_update_slice(
+                        etops,
+                        jnp.stack([etopi.astype(jnp.float32), etopl]),
+                        (0, w * k, 0, 0),
+                    )
+                else:
+                    etoks, elps = ys
                 slab = jnp.stack([etoks.astype(jnp.float32), elps])
                 emitted = jax.lax.dynamic_update_slice(
                     emitted, slab, (0, w * k, 0)
@@ -959,20 +1030,19 @@ class InferenceEngine:
                 hit = jnp.any(etoks == eos_id, axis=0) & eos_stop
                 remaining = jnp.where(hit, 0, jnp.maximum(remaining - k, 0))
                 return (w + 1, tokens, logps, cache, nsteps, pcounts,
-                        remaining, emitted)
+                        remaining, emitted, etops, topi, topl)
 
             def win_cond(state):
                 return (state[0] < m) & jnp.any(state[6] > 0)
 
-            (w, final, final_lp, cache, nsteps, pcounts, _, emitted) = (
-                jax.lax.while_loop(
-                    win_cond, win_body,
-                    (jnp.asarray(0, jnp.int32), tokens, logps, cache,
-                     nsteps, pcounts, remaining, emitted0),
-                )
+            (w, final, final_lp, cache, nsteps, pcounts, _, emitted, etops,
+             topi, topl) = jax.lax.while_loop(
+                win_cond, win_body,
+                (jnp.asarray(0, jnp.int32), tokens, logps, cache,
+                 nsteps, pcounts, remaining, emitted0, etops0, topi, topl),
             )
-            return (rep(emitted), rep(w), final, final_lp, cache, nsteps,
-                    pcounts)
+            return (rep(emitted), rep(etops) if top_lp_k else None, rep(w),
+                    final, final_lp, cache, nsteps, pcounts, topi, topl)
 
         G = self.spec_tokens
 
@@ -994,7 +1064,9 @@ class InferenceEngine:
                     params, inputs, cache, cfg
                 )
                 greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                samp0, samp0_lp = sample(logits[:, 0], sub, temps, greedy, topps)
+                samp0, samp0_lp, _, _ = sample(
+                    logits[:, 0], sub, temps, greedy, topps
+                )
                 match = draft == greedy_next[:, :G]
                 acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
                 acc = jnp.where(greedy, acc, 0)  # sampled slots: no drafts
@@ -1652,7 +1724,7 @@ class InferenceEngine:
             self._up(temps), self._up(greedy), self._up(topps),
             self._seeds_dev, self._tokens_dev, self._logps_dev,
             self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
-            self._bval_dev,
+            self._bval_dev, self._topi_dev, self._topl_dev,
         )
         # Static compile choice: the no-bias program has no bias scatter
         # at all (each variant compiles once, then caches).
@@ -1662,6 +1734,7 @@ class InferenceEngine:
         if self.spec_tokens:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
              first_lp_dev, self._pcounts_dev, self._nsteps_dev,
+             self._topi_dev, self._topl_dev, ftopi_dev, ftopl_dev,
              self._history_dev) = (
                 self._prefill_chunk_step_hist(
                     *args, self._history_dev, use_bias=use_bias
@@ -1669,7 +1742,8 @@ class InferenceEngine:
             )
         else:
             (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._pcounts_dev, self._nsteps_dev) = (
+             first_lp_dev, self._pcounts_dev, self._nsteps_dev,
+             self._topi_dev, self._topl_dev, ftopi_dev, ftopl_dev) = (
                 self._prefill_chunk_step(*args, use_bias=use_bias)
             )
         if self._lockstep:
@@ -1708,13 +1782,17 @@ class InferenceEngine:
                     # the pipeline (~3 windows ≈ 300 ms on the relay).
                     if not emits_started:
                         emits_started = True
-                        for arr in (first_dev, first_lp_dev):
+                        fetches = [first_dev, first_lp_dev]
+                        if self.top_logprobs:
+                            fetches += [ftopi_dev, ftopl_dev]
+                        for arr in fetches:
                             try:
                                 arr.copy_to_host_async()
                             except AttributeError:
                                 pass
                     self._prefill_emits.append(
-                        (first_dev, first_lp_dev, i, slot, seq)
+                        (first_dev, first_lp_dev, ftopi_dev, ftopl_dev, i,
+                         slot, seq)
                     )
         self._update_slot_gauges()
         return True
@@ -1730,7 +1808,7 @@ class InferenceEngine:
             return
         keep = []
         for entry in self._prefill_emits:
-            first_dev, lp_dev, row, slot, seq = entry
+            first_dev, lp_dev, ftopi_dev, ftopl_dev, row, slot, seq = entry
             req = seq.request
             # The window emission path won the race (token already out),
             # or the request is gone — nothing to do.
@@ -1744,13 +1822,21 @@ class InferenceEngine:
                 pass
             tok = int(np.asarray(first_dev)[row])
             lp = float(np.asarray(lp_dev)[row])
+            top = None
+            if self.top_logprobs and req.top_logprobs:
+                ti = np.asarray(ftopi_dev)[row]
+                tl = np.asarray(ftopl_dev)[row]
+                top = [
+                    (int(ti[j]), float(tl[j]))
+                    for j in range(req.top_logprobs)
+                ]
             now = time.time()
             req.ttft_s = now - req.enqueued_at
             seq.first_token_at = now
             seq.first_emitted = True
             seq.last_token = tok
             seq.n_generated += 1
-            self._emit_token(seq, tok, lp)
+            self._emit_token(seq, tok, lp, top)
             if self._finished(seq):
                 self._retire(slot, seq)
                 if self._slots[slot] is seq:
@@ -1866,6 +1952,7 @@ class InferenceEngine:
         t0 = time.time()
         counts = None
         wrun = None
+        etops = None
         if mega > 1 and self.spec_tokens:
             (emitted, counts, wrun, self._tokens_dev, self._logps_dev,
              self.cache, self._nsteps_dev, self._history_dev) = (
@@ -1879,14 +1966,16 @@ class InferenceEngine:
                 )
             )
         elif mega > 1:
-            (emitted, wrun, self._tokens_dev, self._logps_dev, self.cache,
-             self._nsteps_dev, self._pcounts_dev) = (
+            (emitted, etops, wrun, self._tokens_dev, self._logps_dev,
+             self.cache, self._nsteps_dev, self._pcounts_dev,
+             self._topi_dev, self._topl_dev) = (
                 self._mega_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                     self._seeds_dev, self._bidx_dev, self._bval_dev,
+                    self._topi_dev, self._topl_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
                     k=self.window_k, m=mega, use_bias=use_bias,
                 )
@@ -1902,18 +1991,28 @@ class InferenceEngine:
                 )
             )
         else:
-            (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._nsteps_dev, self._pcounts_dev) = (
+            (emitted, etops, self._tokens_dev, self._logps_dev, self.cache,
+             self._nsteps_dev, self._pcounts_dev, self._topi_dev,
+             self._topl_dev) = (
                 self._decode_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                     self._seeds_dev, self._bidx_dev, self._bval_dev,
+                    self._topi_dev, self._topl_dev,
                     k=self.window_k, use_bias=use_bias,
                 )
             )
-        extras = [a for a in (counts, wrun) if a is not None]
+        if etops is not None and not any(
+            seq is not None and seq.request.top_logprobs
+            for seq in self._slots
+        ):
+            # Nobody asked for alternatives: skip the [2, m*k, S, K]
+            # device→host block entirely (the program computes it either
+            # way; the fetch is what costs on the dispatch path).
+            etops = None
+        extras = [a for a in (counts, wrun, etops) if a is not None]
         for arr in (emitted, *extras):
             try:
                 arr.copy_to_host_async()
@@ -1921,9 +2020,10 @@ class InferenceEngine:
                 pass
         if self._lockstep:
             self._jax.block_until_ready(emitted)
-        return emitted, counts, list(self._slots), t0, wrun
+        return emitted, counts, list(self._slots), t0, wrun, etops
 
-    def _process_window(self, emitted, counts, snapshot, t0, wrun=None) -> None:
+    def _process_window(self, emitted, counts, snapshot, t0, wrun=None,
+                        etops=None) -> None:
         t_fetch = time.time()
         # Interruptible wait: while this window's block is in flight, flush
         # any prefill first-token fetches that land first (unloaded TTFT
@@ -1943,6 +2043,7 @@ class InferenceEngine:
         # Spec: [2, k, S, G+1] + counts [k, S].
         emitted_host = np.asarray(emitted)
         counts_host = np.asarray(counts) if counts is not None else None
+        etops_host = np.asarray(etops) if etops is not None else None
         steps = (
             self.window_k if wrun is None
             else int(np.asarray(wrun)) * self.window_k
@@ -1979,7 +2080,7 @@ class InferenceEngine:
                 step_toks = (
                     ((emitted_host[0, step, i], emitted_host[1, step, i]),)
                     for step in range(steps)
-                )
+                )  # enumerate() below recovers the step index for etops
             else:
                 step_toks = (
                     tuple(
@@ -1988,8 +2089,11 @@ class InferenceEngine:
                     )
                     for step in range(steps)
                 )
+            want_top = (
+                etops_host is not None and seq.request.top_logprobs
+            )
             done = False
-            for toks in step_toks:
+            for step, toks in enumerate(step_toks):
                 for tok_f, lp in toks:
                     if seq.first_emitted and not seq.first_skip_done:
                         # This position repeats the prefill-sampled token
@@ -1997,9 +2101,16 @@ class InferenceEngine:
                         seq.first_skip_done = True
                         continue
                     tok = int(tok_f)
+                    top = None
+                    if want_top:
+                        top = [
+                            (int(etops_host[0, step, i, j]),
+                             float(etops_host[1, step, i, j]))
+                            for j in range(seq.request.top_logprobs)
+                        ]
                     seq.last_token = tok
                     seq.n_generated += 1
-                    self._emit_token(seq, tok, float(lp))
+                    self._emit_token(seq, tok, float(lp), top)
                     if self._finished(seq):
                         self._retire(i, seq)
                         if self._slots[i] is seq:
@@ -2020,7 +2131,10 @@ class InferenceEngine:
                 )
         self._update_slot_gauges()
 
-    def _emit_token(self, seq: _ActiveSeq, tok: int, logprob: float) -> None:
+    def _emit_token(self, seq: _ActiveSeq, tok: int, logprob: float,
+                    top=None) -> None:
+        if seq.request.top_logprobs:
+            seq.request.token_top_logprobs.append(top)
         seq.request.token_ids.append(tok)
         seq.request.token_logprobs.append(logprob)
         seq.request.stream.put(tok)
@@ -2052,6 +2166,7 @@ class InferenceEngine:
         req = seq.request
         text = self.tokenizer.decode(req.token_ids) if self.tokenizer else ""
         ids, lps = list(req.token_ids), list(req.token_logprobs)
+        tops = list(req.token_top_logprobs) if req.top_logprobs else None
         eos = self.tokenizer.eos_id if self.tokenizer is not None else -1
         if req.stop_cut >= 0:
             # Stop sequence: trim the text at the match and the token/
@@ -2065,6 +2180,8 @@ class InferenceEngine:
                 else:
                     break
             ids, lps = ids[:keep], lps[:keep]
+            if tops is not None:
+                tops = tops[:keep]
             reason = "stop"
         elif req.stop_on_eos and ids and ids[-1] == eos:
             reason = "stop"
@@ -2079,6 +2196,7 @@ class InferenceEngine:
             truncated=req.truncated,
             token_logprobs=lps,
             finish_reason=reason,
+            token_top_logprobs=tops,
         )
         if not req.future.done():
             req.future.set_result(result)
@@ -2139,7 +2257,8 @@ class InferenceEngine:
             greedy = np.ones((P,), dtype=bool)
             t0 = time.perf_counter()
             (self.cache, self._tokens_dev, self._logps_dev, first, _flp,
-             self._pcounts_dev, self._nsteps_dev) = (
+             self._pcounts_dev, self._nsteps_dev, self._topi_dev,
+             self._topl_dev, _fti, _ftl) = (
                 self._prefill_chunk_step(
                     self.params, self.cache, self._up(tokens),
                     self._up(slots), self._up(starts), self._up(lens),
@@ -2148,7 +2267,8 @@ class InferenceEngine:
                     self._up(topps),
                     self._seeds_dev, self._tokens_dev, self._logps_dev,
                     self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
-                    self._bval_dev, use_bias=False,
+                    self._bval_dev, self._topi_dev, self._topl_dev,
+                    use_bias=False,
                 )
             )
             jax.block_until_ready(first)
@@ -2167,10 +2287,12 @@ class InferenceEngine:
                 active, self._nsteps_dev, tdev, gdev, pdev,
                 self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                 self._seeds_dev, self._bidx_dev, self._bval_dev,
+                self._topi_dev, self._topl_dev,
                 k=self.window_k, use_bias=False,
             )
-            (emitted, self._tokens_dev, self._logps_dev, self.cache,
-             self._nsteps_dev, self._pcounts_dev) = out
+            (emitted, _etops, self._tokens_dev, self._logps_dev, self.cache,
+             self._nsteps_dev, self._pcounts_dev, self._topi_dev,
+             self._topl_dev) = out
             return emitted
 
         # Warmup (compile) + RTT probe: a blocking fetch of a just-computed
@@ -2254,6 +2376,7 @@ class InferenceEngine:
         presence_penalty: float = 0.0,
         seed: "Optional[int]" = None,
         logit_bias: "Optional[dict]" = None,
+        top_logprobs: int = 0,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -2281,6 +2404,18 @@ class InferenceEngine:
                     and -2.0 <= presence_penalty <= 2.0):
                 raise ErrorInvalidParam([
                     "penalties must be in [-2, 2]"
+                ])
+        if top_logprobs:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            if not 0 < int(top_logprobs) <= self.top_logprobs:
+                raise ErrorInvalidParam([
+                    f"top_logprobs must be in [1, {self.top_logprobs}] "
+                    f"(the engine compiles TPU_TOP_LOGPROBS="
+                    f"{self.top_logprobs} alternatives)"
+                    if self.top_logprobs else
+                    "top_logprobs requires TPU_TOP_LOGPROBS>0 (compiles "
+                    "the per-step alternatives top_k into the sampler)"
                 ])
         bias: dict = {}
         if logit_bias:
@@ -2358,6 +2493,7 @@ class InferenceEngine:
                 else self._seed_rng.getrandbits(31)
             ),
             logit_bias=bias,
+            top_logprobs=int(top_logprobs or 0),
         )
         self._enqueue(req)
         return req

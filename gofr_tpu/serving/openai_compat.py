@@ -101,15 +101,22 @@ def _n_choices(body: dict, streaming: bool) -> int:
 
 
 def _completion_logprobs(engine, result) -> dict:
-    """OpenAI completions logprobs block (no top_logprobs alternatives)."""
-    tokens = [
-        engine.tokenizer.decode([t]) if engine.tokenizer else ""
-        for t in result.token_ids
-    ]
+    """OpenAI completions logprobs block."""
+    dec = (
+        (lambda t: engine.tokenizer.decode([t]))
+        if engine.tokenizer else (lambda t: "")
+    )
+    tokens = [dec(t) for t in result.token_ids]
+    top = None
+    if result.token_top_logprobs is not None:
+        top = [
+            {dec(t): round(lp, 6) for t, lp in (alts or [])}
+            for alts in result.token_top_logprobs
+        ]
     return {
         "tokens": tokens,
         "token_logprobs": [round(lp, 6) for lp in result.token_logprobs],
-        "top_logprobs": None,
+        "top_logprobs": top,
         "text_offset": None,
     }
 
@@ -318,7 +325,18 @@ def add_openai_routes(
                 engine, prompts[0], params, rid=rid, model=model, chat=False,
                 stop_seqs=stop_seqs,
             )
-        want_logprobs = body.get("logprobs") not in (None, False, 0)
+        lp_req = body.get("logprobs")
+        want_logprobs = lp_req not in (None, False, 0)
+        if (want_logprobs and isinstance(lp_req, int)
+                and not isinstance(lp_req, bool) and lp_req >= 1):
+            # completions semantics: logprobs=N → N alternatives/token,
+            # CLAMPED to what the engine compiled (requests that were
+            # valid before TPU_TOP_LOGPROBS existed must not start
+            # 400ing: engines without the feature return null
+            # alternatives as before).
+            eng_k = getattr(engine, "top_logprobs", 0)
+            if eng_k:
+                params = dict(params, top_logprobs=min(int(lp_req), eng_k))
         results = await asyncio.gather(
             *(engine.generate(p, stop=stop_seqs, **params)
               for p in prompts for _ in range(n))
@@ -378,6 +396,9 @@ def add_openai_routes(
                 stop_seqs=stop_seqs,
             )
         want_logprobs = bool(body.get("logprobs"))
+        chat_top = body.get("top_logprobs")
+        if want_logprobs and chat_top:
+            params = dict(params, top_logprobs=int(chat_top))
         results = await asyncio.gather(
             *(engine.generate(prompt, stop=stop_seqs, **params)
               for _ in range(n))
@@ -390,13 +411,23 @@ def add_openai_routes(
                 "finish_reason": r.finish_reason,
             }
             if want_logprobs:
+                dec = (
+                    (lambda t: engine.tokenizer.decode([t]))
+                    if engine.tokenizer else (lambda t: "")
+                )
+                tops = r.token_top_logprobs or [None] * len(r.token_ids)
                 choice["logprobs"] = {"content": [
                     {
-                        "token": engine.tokenizer.decode([t])
-                        if engine.tokenizer else "",
+                        "token": dec(t),
                         "logprob": round(lp, 6),
+                        "top_logprobs": [
+                            {"token": dec(at), "logprob": round(alp, 6)}
+                            for at, alp in (alts or [])
+                        ],
                     }
-                    for t, lp in zip(r.token_ids, r.token_logprobs)
+                    for t, lp, alts in zip(
+                        r.token_ids, r.token_logprobs, tops
+                    )
                 ]}
             choices.append(choice)
         return Raw({

@@ -464,3 +464,60 @@ def test_completions_penalties(oai_app):
     finally:
         asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_completions_top_logprobs():
+    app = App(config=MockConfig({
+        "APP_NAME": "oai-lp", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "128", "TPU_TOP_LOGPROBS": "4",
+    }))
+    add_openai_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=60)
+    try:
+        c = _conn(app)
+        # completions: logprobs=N → N alternatives per token.
+        c.request("POST", "/v1/completions", body=json.dumps({
+            "prompt": "hello", "max_tokens": 4, "temperature": 0,
+            "logprobs": 3,
+        }))
+        r = c.getresponse()
+        assert r.status == 200
+        lp = json.loads(r.read())["choices"][0]["logprobs"]
+        assert len(lp["top_logprobs"]) == 4
+        # Keyed by decoded token STRING (the OpenAI completions schema):
+        # distinct ids may decode identically and collapse, so <= 3.
+        assert all(1 <= len(d) <= 3 for d in lp["top_logprobs"])
+        # chat: logprobs=true + top_logprobs=N.
+        c.request("POST", "/v1/chat/completions", body=json.dumps({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0,
+            "logprobs": True, "top_logprobs": 2,
+        }))
+        r = c.getresponse()
+        assert r.status == 200
+        content = json.loads(r.read())["choices"][0]["logprobs"]["content"]
+        assert len(content) == 3
+        assert all(len(e["top_logprobs"]) == 2 for e in content)
+        c.close()
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def test_completions_logprobs_backcompat_without_flag(oai_app):
+    # logprobs=N on an engine WITHOUT TPU_TOP_LOGPROBS must stay a 200
+    # with null alternatives (pre-flag behavior), never a 400.
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "hello", "max_tokens": 3, "temperature": 0,
+        "logprobs": 2,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    lp = json.loads(r.read())["choices"][0]["logprobs"]
+    assert lp["top_logprobs"] is None
+    assert len(lp["token_logprobs"]) == 3
+    c.close()

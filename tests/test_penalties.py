@@ -187,3 +187,81 @@ class TestLogitBias:
             assert banned not in toks
         finally:
             eng.stop_sync()
+
+
+class TestTopLogprobs:
+    """OpenAI top_logprobs alternatives (TPU_TOP_LOGPROBS compile gate)."""
+
+    def test_alternatives_align_and_contain_chosen(self):
+        eng = _engine(top_logprobs=4)
+        eng.start_sync()
+        try:
+            r = eng.generate_sync(
+                PROMPT, max_new_tokens=12, temperature=0.0,
+                stop_on_eos=False, top_logprobs=3, timeout=120,
+            )
+            assert r.token_top_logprobs is not None
+            assert len(r.token_top_logprobs) == len(r.token_ids) == 12
+            for tok, lp, alts in zip(
+                r.token_ids, r.token_logprobs, r.token_top_logprobs
+            ):
+                assert len(alts) == 3
+                # Greedy: the chosen token IS the top-1 alternative and
+                # its logprob matches.
+                assert alts[0][0] == tok
+                assert abs(alts[0][1] - lp) < 1e-4
+                # Sorted descending.
+                assert alts[0][1] >= alts[1][1] >= alts[2][1]
+        finally:
+            eng.stop_sync()
+
+    def test_mega_and_plain_agree(self):
+        a = _engine(top_logprobs=2)
+        b = _engine(top_logprobs=2, mega_windows=4)
+        for e in (a, b):
+            e.start_sync()
+        try:
+            ra, rb = (
+                e.generate_sync(
+                    PROMPT, max_new_tokens=10, temperature=0.0,
+                    stop_on_eos=False, top_logprobs=2, timeout=120,
+                )
+                for e in (a, b)
+            )
+            assert ra.token_ids == rb.token_ids
+            assert [
+                [t for t, _ in alts] for alts in ra.token_top_logprobs
+            ] == [
+                [t for t, _ in alts] for alts in rb.token_top_logprobs
+            ]
+        finally:
+            a.stop_sync()
+            b.stop_sync()
+
+    def test_requires_compile_flag_and_cap(self):
+        eng = _engine()
+        eng.start_sync()
+        try:
+            with pytest.raises(ErrorInvalidParam, match="TPU_TOP_LOGPROBS"):
+                eng.submit_generate(PROMPT, top_logprobs=2)
+        finally:
+            eng.stop_sync()
+        eng = _engine(top_logprobs=2)
+        eng.start_sync()
+        try:
+            with pytest.raises(ErrorInvalidParam, match=r"\[1, 2\]"):
+                eng.submit_generate(PROMPT, top_logprobs=5)
+        finally:
+            eng.stop_sync()
+
+    def test_without_request_flag_no_alternatives(self):
+        eng = _engine(top_logprobs=2)
+        eng.start_sync()
+        try:
+            r = eng.generate_sync(
+                PROMPT, max_new_tokens=6, temperature=0.0,
+                stop_on_eos=False, timeout=120,
+            )
+            assert r.token_top_logprobs is None
+        finally:
+            eng.stop_sync()
