@@ -100,19 +100,27 @@ def _n_choices(body: dict, streaming: bool) -> int:
     return n
 
 
+def _decoder(engine):
+    if engine.tokenizer:
+        return lambda t: engine.tokenizer.decode([t])
+    return lambda t: ""
+
+
 def _completion_logprobs(engine, result) -> dict:
     """OpenAI completions logprobs block."""
-    dec = (
-        (lambda t: engine.tokenizer.decode([t]))
-        if engine.tokenizer else (lambda t: "")
-    )
+    dec = _decoder(engine)
     tokens = [dec(t) for t in result.token_ids]
     top = None
     if result.token_top_logprobs is not None:
-        top = [
-            {dec(t): round(lp, 6) for t, lp in (alts or [])}
-            for alts in result.token_top_logprobs
-        ]
+        # Keyed by decoded token STRING per the completions schema; when
+        # two ids decode identically, the FIRST (highest logprob — alts
+        # are sorted descending) wins.
+        top = []
+        for alts in result.token_top_logprobs:
+            d: dict = {}
+            for t, lp in (alts or []):
+                d.setdefault(dec(t), round(lp, 6))
+            top.append(d)
     return {
         "tokens": tokens,
         "token_logprobs": [round(lp, 6) for lp in result.token_logprobs],
@@ -180,7 +188,7 @@ def add_openai_routes(
 
     def _stream_response(
         engine, prompt, params: dict, *, rid: str, model: str, chat: bool,
-        stop_seqs: Optional[list[str]] = None,
+        stop_seqs: Optional[list[str]] = None, include_usage: bool = False,
     ) -> Stream:
         # Submit BEFORE returning the Stream: prompt validation
         # (ErrorPromptTooLong → 413 etc.) must fail the request proper,
@@ -267,6 +275,27 @@ def add_openai_routes(
                     {"text": "", "index": 0, "finish_reason": reason}
                 )
                 yield _sse(rid, object_name, model, created, done)
+                if include_usage:
+                    # stream_options.include_usage: one final chunk with
+                    # empty choices and the usage block (OpenAI wire).
+                    # The retired result's trimmed token list is the
+                    # authoritative count (the SSE loop drains tokens
+                    # past a stop cut before detecting it).
+                    try:
+                        n_out = len(
+                            req.future.result(timeout=30).token_ids
+                        )
+                    except Exception:  # noqa: BLE001 — cancelled stream
+                        n_out = len(emitted_ids)
+                    usage_chunk = {
+                        "id": rid,
+                        "object": object_name,
+                        "created": created,
+                        "model": model,
+                        "choices": [],
+                        "usage": _usage(len(req.prompt_ids), n_out),
+                    }
+                    yield f"data: {json.dumps(usage_chunk)}\n\n"
                 yield "data: [DONE]\n\n"
             finally:
                 # Client disconnected (GeneratorExit via the server's
@@ -324,6 +353,9 @@ def add_openai_routes(
             return _stream_response(
                 engine, prompts[0], params, rid=rid, model=model, chat=False,
                 stop_seqs=stop_seqs,
+                include_usage=bool(
+                    (body.get("stream_options") or {}).get("include_usage")
+                ),
             )
         lp_req = body.get("logprobs")
         want_logprobs = lp_req not in (None, False, 0)
@@ -394,11 +426,21 @@ def add_openai_routes(
             return _stream_response(
                 engine, prompt, params, rid=rid, model=model, chat=True,
                 stop_seqs=stop_seqs,
+                include_usage=bool(
+                    (body.get("stream_options") or {}).get("include_usage")
+                ),
             )
         want_logprobs = bool(body.get("logprobs"))
         chat_top = body.get("top_logprobs")
         if want_logprobs and chat_top:
-            params = dict(params, top_logprobs=int(chat_top))
+            # Clamp to the engine's compiled K — pre-flag requests that
+            # passed top_logprobs must keep getting 200s with empty
+            # alternatives on engines without the feature.
+            eng_k = getattr(engine, "top_logprobs", 0)
+            if eng_k:
+                params = dict(
+                    params, top_logprobs=min(int(chat_top), eng_k)
+                )
         results = await asyncio.gather(
             *(engine.generate(prompt, stop=stop_seqs, **params)
               for _ in range(n))
@@ -411,10 +453,7 @@ def add_openai_routes(
                 "finish_reason": r.finish_reason,
             }
             if want_logprobs:
-                dec = (
-                    (lambda t: engine.tokenizer.decode([t]))
-                    if engine.tokenizer else (lambda t: "")
-                )
+                dec = _decoder(engine)
                 tops = r.token_top_logprobs or [None] * len(r.token_ids)
                 choice["logprobs"] = {"content": [
                     {

@@ -521,3 +521,43 @@ def test_completions_logprobs_backcompat_without_flag(oai_app):
     assert lp["top_logprobs"] is None
     assert len(lp["token_logprobs"]) == 3
     c.close()
+
+
+def test_stream_options_include_usage(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "hi", "max_tokens": 4, "temperature": 0, "stream": True,
+        "stream_options": {"include_usage": True},
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    raw = r.read().decode()
+    chunks = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    assert raw.rstrip().endswith("data: [DONE]")
+    usage_chunks = [ch for ch in chunks if "usage" in ch]
+    assert len(usage_chunks) == 1
+    u = usage_chunks[0]
+    assert u["choices"] == []
+    assert u["usage"]["completion_tokens"] == 4
+    assert u["usage"]["total_tokens"] == (
+        u["usage"]["prompt_tokens"] + 4
+    )
+    c.close()
+
+
+def test_chat_top_logprobs_backcompat_without_flag(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 3, "temperature": 0,
+        "logprobs": True, "top_logprobs": 2,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    content = json.loads(r.read())["choices"][0]["logprobs"]["content"]
+    assert all(e["top_logprobs"] == [] for e in content)
+    c.close()

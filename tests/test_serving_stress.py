@@ -146,3 +146,60 @@ def test_stop_start_cycle_preserves_service_and_prefixes(engine):
     )
     # Pool and params survive the cycle; greedy output is reproducible.
     assert after.token_ids == before.token_ids
+
+
+def test_mixed_sampling_features_concurrent_stress():
+    """Cross-feature interaction stress: concurrent requests mixing
+    seeds, penalties, logit_bias, top_logprobs, and uneven budgets on a
+    mega-window engine — per-request invariants must hold even as the
+    slot-state/admission uploads interleave."""
+    import random
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=4, max_len=128, window_k=4, mega_windows=4,
+        enable_penalties=True, top_logprobs=2, tokenizer=ByteTokenizer(),
+    )
+    eng.start_sync()
+    rng = random.Random(0)
+    try:
+        reqs = []
+        for i in range(24):
+            kw = {"max_new_tokens": rng.choice([3, 7, 12, 20])}
+            style = i % 4
+            if style == 0:
+                kw.update(temperature=0.9, seed=1234)  # repro pair group
+            elif style == 1:
+                kw.update(temperature=0.0, frequency_penalty=1.2)
+            elif style == 2:
+                kw.update(temperature=0.0, logit_bias={9: -100})
+            else:
+                kw.update(temperature=0.0, top_logprobs=2)
+            prompt = f"prompt {i % 3}"
+            kw["_prompt"] = prompt
+            reqs.append((kw, eng.submit_generate(
+                prompt, stop_on_eos=False,
+                **{k: v for k, v in kw.items() if k != "_prompt"}
+            )))
+        results = [(kw, r.future.result(timeout=180)) for kw, r in reqs]
+        seeded = {}
+        for kw, res in results:
+            assert len(res.token_ids) == kw["max_new_tokens"]
+            if "seed" in kw:
+                key = (kw["max_new_tokens"], kw["_prompt"])
+                if key in seeded:
+                    assert res.token_ids == seeded[key]  # same seed+params
+                else:
+                    seeded[key] = res.token_ids
+            if "logit_bias" in kw:
+                assert 9 not in res.token_ids
+            if "top_logprobs" in kw:
+                assert len(res.token_top_logprobs) == len(res.token_ids)
+                for tok, alts in zip(res.token_ids, res.token_top_logprobs):
+                    assert alts[0][0] == tok  # greedy == top-1
+            else:
+                assert res.token_top_logprobs is None
+    finally:
+        eng.stop_sync()
