@@ -350,6 +350,10 @@ def add_openai_routes(
                 raise OpenAIRequestError(
                     "streaming supports a single prompt per request"
                 )
+            if body.get("echo"):
+                raise OpenAIRequestError(
+                    "echo is not supported with streaming"
+                )
             return _stream_response(
                 engine, prompts[0], params, rid=rid, model=model, chat=False,
                 stop_seqs=stop_seqs,
@@ -369,16 +373,31 @@ def add_openai_routes(
             eng_k = getattr(engine, "top_logprobs", 0)
             if eng_k:
                 params = dict(params, top_logprobs=min(int(lp_req), eng_k))
+        echo = bool(body.get("echo"))
         results = await asyncio.gather(
             *(engine.generate(p, stop=stop_seqs, **params)
               for p in prompts for _ in range(n))
         )
         choices = []
+        req_prompts = [p for p in prompts for _ in range(n)]
         for i, r in enumerate(results):
             # The engine trims text/tokens at the stop match and reports
             # finish_reason itself, so logprobs stay text-aligned.
+            text = r.text
+            if echo:
+                # OpenAI legacy `echo`: prompt text prepended to the
+                # completion (logprobs stay completion-only — prompt
+                # logprob capture is not supported).
+                pr = req_prompts[i]
+                if not isinstance(pr, str):
+                    if engine.tokenizer is None:
+                        raise OpenAIRequestError(
+                            "echo with token-id prompts needs a tokenizer"
+                        )
+                    pr = engine.tokenizer.decode(pr)
+                text = pr + text
             choices.append({
-                "text": r.text,
+                "text": text,
                 "index": i,
                 "logprobs": _completion_logprobs(engine, r)
                 if want_logprobs else None,

@@ -561,3 +561,17 @@ def test_chat_top_logprobs_backcompat_without_flag(oai_app):
     content = json.loads(r.read())["choices"][0]["logprobs"]["content"]
     assert all(e["top_logprobs"] == [] for e in content)
     c.close()
+
+
+def test_completions_echo(oai_app):
+    c = _conn(oai_app)
+    c.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": "hello there", "max_tokens": 3, "temperature": 0,
+        "echo": True,
+    }))
+    r = c.getresponse()
+    assert r.status == 200
+    text = json.loads(r.read())["choices"][0]["text"]
+    assert text.startswith("hello there")
+    assert len(text) > len("hello there")
+    c.close()
