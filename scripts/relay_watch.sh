@@ -30,8 +30,8 @@ while true; do
         python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
     else
       echo "short window (${remaining}s): mini harvest — mega A/B first"
-      mini r4-1b BENCH_MODEL=llama-1b
-      mini r4-1b-mega16 BENCH_MODEL=llama-1b BENCH_MEGA=16
+      mini r4-1b BENCH_MODEL=llama-1b BENCH_MEGA=0
+      mini r4-1b-mega8 BENCH_MODEL=llama-1b BENCH_MEGA=8
       mini r4-8b-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=8
       mini r4-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
     fi
