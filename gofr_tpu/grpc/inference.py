@@ -45,6 +45,8 @@ class InferenceServicer:
         )
         if request.get("top_p") is not None:
             kw["top_p"] = float(request["top_p"])
+        if request.get("adapter"):
+            kw["adapter"] = str(request["adapter"])
         return kw
 
     async def Generate(self, request, context):

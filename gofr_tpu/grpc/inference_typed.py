@@ -43,6 +43,8 @@ class TypedInferenceServicer(_Base):
         }
         if request.top_p:  # proto default 0 = "not set"
             kw["top_p"] = request.top_p
+        if request.adapter:
+            kw["adapter"] = request.adapter
         return prompt, kw
 
     async def Generate(self, request, context):

@@ -90,6 +90,22 @@ def _register_llms() -> None:
             n_kv_heads=4, d_ff=18944, max_len=8192, rope_theta=1e6,
             attn_bias=True,
         ),
+        # Gemma-7B dims (HF loader accepts model_type=gemma): GeGLU FFN,
+        # (1+w) RMSNorm, sqrt(d_model)-scaled tied embeddings, and an
+        # explicit head_dim 256 (n_heads*head_dim = 4096 ≠ d_model 3072).
+        "gemma-7b": TransformerConfig(
+            vocab_size=256000, d_model=3072, n_layers=28, n_heads=16,
+            n_kv_heads=16, d_ff=24576, max_len=8192, rope_theta=10000.0,
+            norm_eps=1e-6, head_dim_override=256, act="gelu",
+            norm_offset=True, embed_scale=True,
+        ),
+        # Gemma-2B: MQA (1 kv head), head_dim 256.
+        "gemma-2b": TransformerConfig(
+            vocab_size=256000, d_model=2048, n_layers=18, n_heads=8,
+            n_kv_heads=1, d_ff=16384, max_len=8192, rope_theta=10000.0,
+            norm_eps=1e-6, head_dim_override=256, act="gelu",
+            norm_offset=True, embed_scale=True,
+        ),
         # ~1.1B config that fits one v5e chip comfortably for benching.
         "llama-1b": TransformerConfig(
             vocab_size=32768, d_model=2048, n_layers=22, n_heads=16,
@@ -113,10 +129,22 @@ def _register_llms() -> None:
             n_kv_heads=2, d_ff=256, max_len=256, rope_theta=10000.0,
             n_experts=4, n_experts_active=2,
         ),
+        # Gemma-arch test size: exercises head_dim override (64 ≠ 128/4),
+        # GeGLU, (1+w) norms, and scaled embeddings on the fast CPU path.
+        "gemma-tiny": TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, d_ff=256, max_len=256, rope_theta=10000.0,
+            norm_eps=1e-6, head_dim_override=64, act="gelu",
+            norm_offset=True, embed_scale=True,
+        ),
     }
+    eos_tokens = {"gemma-7b": 1, "gemma-2b": 1, "gemma-tiny": 1}
     for name, cfg in llm_configs.items():
         register_model(
-            ModelSpec(name=name, family="llm", config=cfg, init=init_transformer)
+            ModelSpec(
+                name=name, family="llm", config=cfg, init=init_transformer,
+                eos_token=eos_tokens.get(name, 2),
+            )
         )
 
 

@@ -64,10 +64,17 @@ class TransformerConfig:
     n_experts_active: int = 2
     # Qwen2-style QKV projection bias (llama/mistral/mixtral: False).
     attn_bias: bool = False
+    # Gemma-family switches: explicit head_dim (Gemma-7B: 256 with
+    # n_heads*head_dim != d_model), tanh-approximate GeGLU FFN, RMSNorm
+    # computed as x/rms * (1 + w), and sqrt(d_model)-scaled embeddings.
+    head_dim_override: int = 0
+    act: str = "silu"  # "silu" | "gelu"
+    norm_offset: bool = False
+    embed_scale: bool = False
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def is_moe(self) -> bool:
@@ -103,8 +110,10 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
         "wk": dense_init(ks[1], (L, D, KV * hd), D),
         "wv": dense_init(ks[2], (L, D, KV * hd), D),
         "wo": dense_init(ks[3], (L, H * hd, D), H * hd),
-        "attn_norm": jnp.ones((L, D), dtype=cfg.dtype),
-        "mlp_norm": jnp.ones((L, D), dtype=cfg.dtype),
+        # norm_offset models (Gemma) store w with the +1 applied in the
+        # forward, so identity init is zeros there, ones otherwise.
+        "attn_norm": jnp.full((L, D), 0.0 if cfg.norm_offset else 1.0, cfg.dtype),
+        "mlp_norm": jnp.full((L, D), 0.0 if cfg.norm_offset else 1.0, cfg.dtype),
     }
     if cfg.attn_bias:
         layers.update(
@@ -129,7 +138,9 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
     return {
         "embed": dense_init(k_embed, (cfg.vocab_size, D), D),
         "layers": layers,
-        "final_norm": jnp.ones((D,), dtype=cfg.dtype),
+        "final_norm": jnp.full(
+            (D,), 0.0 if cfg.norm_offset else 1.0, cfg.dtype
+        ),
         "lm_head": dense_init(k_head, (D, cfg.vocab_size), D),
     }
 
@@ -254,10 +265,128 @@ def _wein(subscripts, x, w):
     return jnp.einsum(subscripts, x, w)
 
 
-def _ffn_dense(x, lp, cfg):
-    gate = _wein("bsd,df->bsf", x, lp["w_gate"])
-    up = _wein("bsd,df->bsf", x, lp["w_up"])
-    return _wein("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+# ---------------------------------------------------------------------------
+# multi-LoRA (batched per-slot adapters)
+# ---------------------------------------------------------------------------
+
+# Projections LoRA can target (MoE expert weights excluded: per-token
+# routing × per-slot adapters would need a double gather; out of scope).
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def lora_dims(cfg: TransformerConfig, target: str) -> tuple[int, int]:
+    """(d_in, d_out) of a LoRA-targetable projection."""
+    D, F = cfg.d_model, cfg.d_ff
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    return {
+        "wq": (D, H * hd),
+        "wk": (D, KV * hd),
+        "wv": (D, KV * hd),
+        "wo": (H * hd, D),
+        "w_gate": (D, F),
+        "w_up": (D, F),
+        "w_down": (F, D),
+    }[target]
+
+
+def init_lora(
+    cfg: TransformerConfig,
+    n_adapters: int,
+    rank: int,
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo"),
+) -> dict:
+    """Zero LoRA leaves to merge into ``params["layers"]``.
+
+    Layout ``{t}_lora_a: [L, N, d_in, r]`` / ``{t}_lora_b: [L, N, r,
+    d_out]`` — layer-major so the leaves ride the existing ``lax.scan``
+    over ``params["layers"]`` (each step sees the per-layer [N, ...]
+    slice), adapter-slot second so a per-row gather ``a[aids]`` batches
+    every live adapter into one einsum. All-zero init makes every
+    adapter slot — and in particular slot 0, which requests without an
+    adapter use — an exact no-op on the base model.
+    """
+    if cfg.is_moe:
+        raise ValueError("LoRA serving does not support MoE models")
+    leaves = {}
+    for t in targets:
+        if t not in LORA_TARGETS:
+            raise ValueError(f"unknown LoRA target {t!r} (of {LORA_TARGETS})")
+        d_in, d_out = lora_dims(cfg, t)
+        leaves[t + "_lora_a"] = jnp.zeros(
+            (cfg.n_layers, n_adapters, d_in, rank), dtype=cfg.dtype
+        )
+        leaves[t + "_lora_b"] = jnp.zeros(
+            (cfg.n_layers, n_adapters, rank, d_out), dtype=cfg.dtype
+        )
+    return leaves
+
+
+def lora_param_specs(
+    targets: tuple[str, ...], pp: bool = False
+) -> dict:
+    """PartitionSpecs for the stacked LoRA leaves, matching the base
+    projection's Megatron sharding: column-parallel targets shard B's
+    output axis over ``tp`` (delta lands tp-sharded like the base
+    output); row-parallel targets (wo, w_down) shard A's input axis so
+    the rank-space contraction partial-sums over tp exactly where the
+    base matmul does. Rank axes stay replicated (r is tiny)."""
+    lax_ = "pp" if pp else None
+    specs = {}
+    for t in targets:
+        if t in ("wo", "w_down"):
+            specs[t + "_lora_a"] = P(lax_, None, "tp", None)
+            specs[t + "_lora_b"] = P(lax_, None, None, None)
+        else:
+            specs[t + "_lora_a"] = P(lax_, None, None, None)
+            specs[t + "_lora_b"] = P(lax_, None, None, "tp")
+    return specs
+
+
+def _lora(x, lp, name, aids):
+    """Per-row LoRA delta for projection ``name``; 0.0 when the engine
+    compiled without adapters (leaf absent — trace-time static) or the
+    caller has no adapter plane. x rows map 1:1 onto ``aids`` entries;
+    the rank-space bottleneck keeps the gathered [rows, d, r] operands
+    small."""
+    ka = name + "_lora_a"
+    if aids is None or ka not in lp:
+        return 0.0
+    a = lp[ka][aids]  # [rows, d_in, r]
+    b = lp[name + "_lora_b"][aids]  # [rows, r, d_out]
+    if x.ndim == 2:
+        xa = jnp.einsum("sd,sdr->sr", x, a)
+        return jnp.einsum("sr,sro->so", xa, b)
+    xa = jnp.einsum("btd,bdr->btr", x, a)
+    return jnp.einsum("btr,bro->bto", xa, b)
+
+
+def _act(cfg):
+    """FFN gate activation — silu (Llama/SwiGLU) or tanh-approximate gelu
+    (Gemma/GeGLU); static per config, so each compiles its own program."""
+    if cfg.act == "gelu":
+        return partial(jax.nn.gelu, approximate=True)
+    return jax.nn.silu
+
+
+def _norm(x, w, cfg):
+    return rms_norm(x, w, cfg.norm_eps, 1.0 if cfg.norm_offset else 0.0)
+
+
+def _embed(params, tokens, cfg):
+    """Token embedding lookup; Gemma scales by sqrt(d_model) — the scalar
+    is cast to the activation dtype first (HF casts the normalizer to the
+    hidden dtype, and bf16 parity needs the same rounding)."""
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.d_model**0.5, dtype=x.dtype)
+    return x
+
+
+def _ffn_dense(x, lp, cfg, aids=None):
+    gate = _wein("bsd,df->bsf", x, lp["w_gate"]) + _lora(x, lp, "w_gate", aids)
+    up = _wein("bsd,df->bsf", x, lp["w_up"]) + _lora(x, lp, "w_up", aids)
+    h = _act(cfg)(gate) * up
+    return _wein("bsf,fd->bsd", h, lp["w_down"]) + _lora(h, lp, "w_down", aids)
 
 
 def _ffn_moe(x, lp, cfg):
@@ -278,17 +407,17 @@ def _ffn_moe(x, lp, cfg):
     ].set(topk_probs)
     gate = _wein("bsd,edf->bsef", x, lp["w_gate"])
     up = _wein("bsd,edf->bsef", x, lp["w_up"])
-    hidden = jax.nn.silu(gate) * up
+    hidden = _act(cfg)(gate) * up
     out = _wein("bsef,efd->bsed", hidden, lp["w_down"])
     return jnp.einsum("bsed,bse->bsd", out, weights.astype(x.dtype))
 
 
-def _qkv(h, lp, eq, H, KV, hd, *lead):
+def _qkv(h, lp, eq, H, KV, hd, *lead, aids=None):
     """QKV projections with optional Qwen2-style bias (bias leaves exist
     only when cfg.attn_bias — dict membership is trace-time static)."""
-    q = _wein(eq, h, lp["wq"])
-    k = _wein(eq, h, lp["wk"])
-    v = _wein(eq, h, lp["wv"])
+    q = _wein(eq, h, lp["wq"]) + _lora(h, lp, "wq", aids)
+    k = _wein(eq, h, lp["wk"]) + _lora(h, lp, "wk", aids)
+    v = _wein(eq, h, lp["wv"]) + _lora(h, lp, "wv", aids)
     if "wq_b" in lp:
         q = q + lp["wq_b"]
         k = k + lp["wk_b"]
@@ -301,7 +430,7 @@ def _qkv(h, lp, eq, H, KV, hd, *lead):
 
 
 def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
-                   lengths=None, norm_out=None):
+                   lengths=None, norm_out=None, aids=None):
     """One decoder layer over a full sequence. Returns (x, (k, v)).
 
     attn_fn: optional override for the attention call, e.g. a
@@ -318,22 +447,23 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
     b, s, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    h = _norm(x, lp["attn_norm"], cfg)
     if norm_out is not None:
         h = norm_out(h)
-    q, k, v = _qkv(h, lp, "bsd,dh->bsh", H, KV, hd, b, s)
+    q, k, v = _qkv(h, lp, "bsd,dh->bsh", H, KV, hd, b, s, aids=aids)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     if attn_fn is None:
         attn = attention(q, k, v, causal=True, mask=mask, lengths=lengths)
     else:
         attn = attn_fn(q, k, v, mask)
-    x = x + _wein("bsh,hd->bsd", attn.reshape(b, s, H * hd), lp["wo"])
+    ao = attn.reshape(b, s, H * hd)
+    x = x + _wein("bsh,hd->bsd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    h = _norm(x, lp["mlp_norm"], cfg)
     if norm_out is not None:
         h = norm_out(h)
-    ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+    ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg, aids)
     return x + ffn, (k, v)
 
 
@@ -348,21 +478,24 @@ def transformer_forward(
     tokens: jnp.ndarray,
     cfg: TransformerConfig,
     remat: bool = False,
+    aids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Training/eval forward: tokens [b, s] → logits [b, s, vocab] (f32)."""
     b, s = tokens.shape
-    x = params["embed"][tokens]
+    x = _embed(params, tokens, cfg)
     cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
     def body(x, lp):
-        out, _ = _layer_prefill(x, lp, cfg, cos, sin, positions, mask=None)
+        out, _ = _layer_prefill(
+            x, lp, cfg, cos, sin, positions, mask=None, aids=aids
+        )
         return out, None
 
     if remat:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     return _wein("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
@@ -373,6 +506,7 @@ def transformer_prefill(
     cache: KVCache,
     slots: jnp.ndarray,
     cfg: TransformerConfig,
+    aids: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Serving prefill: right-padded prompt batch → last-token logits +
     populated cache.
@@ -380,7 +514,7 @@ def transformer_prefill(
     tokens: [b, s_pad]; lengths: [b] true lengths; slots: [b] cache slots.
     """
     b, s = tokens.shape
-    x = params["embed"][tokens]
+    x = _embed(params, tokens, cfg)
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     # Per-row lengths mask invalid (right-padding) keys INSIDE the flash
@@ -390,7 +524,8 @@ def transformer_prefill(
 
     def body(x, lp):
         out, kv = _layer_prefill(
-            x, lp, cfg, cos, sin, positions, mask=None, lengths=lengths
+            x, lp, cfg, cos, sin, positions, mask=None, lengths=lengths,
+            aids=aids,
         )
         return out, kv
 
@@ -418,7 +553,7 @@ def transformer_prefill(
     cache = cache._replace(k=new_k, v=new_v)
     cache = cache._replace(lengths=cache.lengths.at[slots].set(lengths.astype(jnp.int32)))
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = _wein("bd,dv->bv", x_last, params["lm_head"]).astype(jnp.float32)
@@ -434,6 +569,7 @@ def transformer_prefill_chunk(
     lens: jnp.ndarray,
     cfg: TransformerConfig,
     dense_attn: bool = False,
+    aids: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Chunked serving prefill: one fixed-shape [P, c] chunk step.
 
@@ -452,7 +588,7 @@ def transformer_prefill_chunk(
     """
     P, c = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["embed"][tokens]  # [P, c, D]
+    x = _embed(params, tokens, cfg)  # [P, c, D]
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
     positions = starts[:, None] + jnp.arange(c)[None, :]  # [P, c] global
     paged = isinstance(cache, PagedKVCache)
@@ -492,8 +628,8 @@ def transformer_prefill_chunk(
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _qkv(h, lp, "pcd,dh->pch", H, KV, hd, P, c)
+        h = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(h, lp, "pcd,dh->pch", H, KV, hd, P, c, aids=aids)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         # Write the chunk's K/V into the cache, then attend against the
@@ -515,9 +651,12 @@ def transformer_prefill_chunk(
             block_table=cache.block_table if paged else None,
             kernel=False if dense_attn else None,
         )
-        x = x + _wein("pch,hd->pcd", attn.reshape(P, c, H * hd), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+        ao = attn.reshape(P, c, H * hd)
+        x = x + _wein("pch,hd->pcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        h = _norm(x, lp["mlp_norm"], cfg)
+        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
+            h, lp, cfg, aids
+        )
         return x + ffn, (ck, cv, cks, cvs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
@@ -525,7 +664,7 @@ def transformer_prefill_chunk(
     )
     cache = cache._replace(k=new_k, v=new_v, k_s=new_ks, v_s=new_vs)
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     last_idx = jnp.maximum(lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = _wein("pd,dv->pv", x_last, params["lm_head"]).astype(jnp.float32)
@@ -539,6 +678,7 @@ def transformer_decode_step(
     active: jnp.ndarray,
     cfg: TransformerConfig,
     dense_attn: bool = False,
+    aids: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, KVCache]:
     """One decode step over ALL cache slots (static batch = n_slots).
 
@@ -552,7 +692,7 @@ def transformer_decode_step(
     S = cache.n_slots
     L = cfg.n_layers
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["embed"][tokens]  # [S, D]
+    x = _embed(params, tokens, cfg)  # [S, D]
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
 
     positions = cache.lengths  # [S] — write position for each slot's new token
@@ -575,8 +715,8 @@ def transformer_decode_step(
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
-        h = rms_norm(x[:, None, :], lp["attn_norm"], cfg.norm_eps)[:, 0]
-        q, k, v = _qkv(h, lp, "bd,dh->bh", H, KV, hd, S)
+        h = _norm(x[:, None, :], lp["attn_norm"], cfg)[:, 0]
+        q, k, v = _qkv(h, lp, "bd,dh->bh", H, KV, hd, S, aids=aids)
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
         k = apply_rope(k[:, None], cos, sin, pos2)[:, 0]
@@ -591,9 +731,12 @@ def transformer_decode_step(
             block_table=cache.block_table if paged else None,
             kernel=False if dense_attn else None,
         )
-        x = x + _wein("bh,hd->bd", attn.reshape(S, H * hd), lp["wo"])
-        h = rms_norm(x[:, None, :], lp["mlp_norm"], cfg.norm_eps)
-        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+        ao = attn.reshape(S, H * hd)
+        x = x + _wein("bh,hd->bd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        h = _norm(x[:, None, :], lp["mlp_norm"], cfg)
+        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
+            h, lp, cfg, aids
+        )
         x = x + ffn[:, 0]
         return x, (k, v)
 
@@ -633,7 +776,7 @@ def transformer_decode_step(
         v=cache.v.at[li, row, ki, wp].set(new_v.astype(cache.v.dtype)),
         lengths=cache.lengths + active.astype(jnp.int32),
     )
-    x = rms_norm(x[:, None, :], params["final_norm"], cfg.norm_eps)[:, 0]
+    x = _norm(x[:, None, :], params["final_norm"], cfg)[:, 0]
     logits = _wein("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
@@ -643,6 +786,7 @@ def transformer_verify_step(
     tokens: jnp.ndarray,
     cache: KVCache,
     cfg: TransformerConfig,
+    aids: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-verify forward: ``c`` candidate tokens per slot in one
     pass, cache READ-ONLY (rejected drafts need no rollback — the caller
@@ -654,7 +798,7 @@ def transformer_verify_step(
     """
     S, c = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    x = params["embed"][tokens]  # [S, c, D]
+    x = _embed(params, tokens, cfg)  # [S, c, D]
     cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
     positions = cache.lengths[:, None] + jnp.arange(c)[None, :]  # [S, c]
     paged = isinstance(cache, PagedKVCache)
@@ -662,8 +806,8 @@ def transformer_verify_step(
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # read-only cache slices
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q, k, v = _qkv(h, lp, "bcd,dh->bch", H, KV, hd, S, c)
+        h = _norm(x, lp["attn_norm"], cfg)
+        q, k, v = _qkv(h, lp, "bcd,dh->bch", H, KV, hd, S, c, aids=aids)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         if cache.quantized:
@@ -676,15 +820,18 @@ def transformer_verify_step(
         attn = verify_chunk_attention(
             q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs
         )
-        x = x + _wein("bch,hd->bcd", attn.reshape(S, c, H * hd), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg)
+        ao = attn.reshape(S, c, H * hd)
+        x = x + _wein("bch,hd->bcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        h = _norm(x, lp["mlp_norm"], cfg)
+        ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
+            h, lp, cfg, aids
+        )
         return x + ffn, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
     )
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    x = _norm(x, params["final_norm"], cfg)
     logits = _wein("bcd,dv->bcv", x, params["lm_head"]).astype(jnp.float32)
     return logits, new_k, new_v
 

@@ -9,13 +9,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
-    """RMSNorm (Llama-family). ``weight`` has shape [d_model]."""
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    offset: float = 0.0,
+) -> jnp.ndarray:
+    """RMSNorm (Llama-family). ``weight`` has shape [d_model].
+
+    ``offset``: Gemma stores its scale as ``w`` with the forward applying
+    ``(offset + w)`` (offset=1.0), so identity is w=0 there.
+    """
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     out = xf * jnp.reciprocal(jnp.sqrt(var + eps))
-    return (out * weight.astype(jnp.float32)).astype(dtype)
+    return (out * (weight.astype(jnp.float32) + offset)).astype(dtype)
 
 
 def layer_norm(

@@ -126,6 +126,8 @@ class _GenRequest:
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
+    # Multi-LoRA: adapter slot index (0 = base model, no adapter).
+    aid: int = 0
 
 
 @dataclass
@@ -165,6 +167,9 @@ class InferenceEngine:
         quant: str = "",
         kv_quant: str = "",
         prefix_slots: int = 0,
+        lora_slots: int = 0,
+        lora_rank: int = 16,
+        lora_targets: str = "wq,wk,wv,wo",
         params=None,
         logger=None,
         metrics=None,
@@ -435,6 +440,13 @@ class InferenceEngine:
             self._seeds_host = np.zeros((n_slots,), dtype=np.int32)
             self._seeds_dev = self._up(self._seeds_host)
             self._seeds_dirty = False
+            # Multi-LoRA adapter plane: per-slot adapter index into the
+            # stacked [L, 1+lora_slots, ...] adapter leaves (0 = base).
+            # Allocated unconditionally so every compiled signature is
+            # uniform; without adapter leaves in params the operand is
+            # dead and XLA drops it.
+            self._aids_host = np.zeros((n_slots,), dtype=np.int32)
+            self._aids_dev = self._up(self._aids_host)
             # Host-side default-seed source for requests without one: each
             # unseeded request gets a fresh draw (OpenAI semantics), while
             # an explicit seed reproduces exactly. Single-process engines
@@ -483,6 +495,51 @@ class InferenceEngine:
                 self._up(np.zeros((n_slots, self.max_len), dtype=np.int32))
                 if self.spec_tokens else None
             )
+            # Multi-LoRA serving: merge zeroed stacked adapter leaves
+            # into params["layers"] (slot 0 = base; load_lora fills
+            # slots 1..lora_slots). A COMPILE choice: engines without
+            # TPU_LORA_SLOTS carry no adapter gather/einsums at all.
+            self.lora_slots = max(0, lora_slots)
+            self.lora_rank = max(1, lora_rank)
+            self._lora_targets = tuple(
+                t.strip() for t in lora_targets.split(",") if t.strip()
+            )
+            self._lora_names: dict[str, int] = {}
+            if self.lora_slots:
+                if prefix_slots > 0:
+                    raise ValueError(
+                        "TPU_LORA_SLOTS and TPU_PREFIX_SLOTS are mutually "
+                        "exclusive: pooled prefix K/V is computed with the "
+                        "base model and would corrupt adapter requests"
+                    )
+                from gofr_tpu.models.transformer import (
+                    init_lora,
+                    lora_param_specs,
+                )
+
+                leaves = init_lora(
+                    self.cfg, 1 + self.lora_slots, self.lora_rank,
+                    self._lora_targets,
+                )
+                if mesh is not None:
+                    from gofr_tpu.parallel.sharding import (
+                        named_shardings,
+                        prune_specs,
+                    )
+
+                    lspecs = prune_specs(
+                        lora_param_specs(self._lora_targets), mesh
+                    )
+                    leaves = {
+                        k: jax.device_put(
+                            v, named_shardings(lspecs[k], mesh)
+                        )
+                        for k, v in leaves.items()
+                    }
+                self.params = {
+                    **self.params,
+                    "layers": {**self.params["layers"], **leaves},
+                }
             self._build_llm_steps()
         elif self.family == "encoder":
             self.max_len = min(max_len, self.cfg.max_len)
@@ -579,6 +636,11 @@ class InferenceEngine:
             ).lower() in ("1", "true", "yes"),
             spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
             kv_block=int(config.get_or_default("TPU_KV_BLOCK", "0")),
+            lora_slots=int(config.get_or_default("TPU_LORA_SLOTS", "0")),
+            lora_rank=int(config.get_or_default("TPU_LORA_RANK", "16")),
+            lora_targets=config.get_or_default(
+                "TPU_LORA_TARGETS", "wq,wk,wv,wo"
+            ),
             kv_pool_blocks=int(
                 config.get_or_default("TPU_KV_POOL_BLOCKS", "0")
             ),
@@ -592,6 +654,22 @@ class InferenceEngine:
 
             engine.params = maybe_restore_params(config, engine.params, logger)
             engine.apply_quantization(quant_cfg)
+        # Boot-time LoRA adapters: TPU_LORA_ADAPTERS="name=path,name2=p2"
+        # (HF PEFT checkpoint dirs). More can load at runtime via
+        # engine.load_lora.
+        adapters_cfg = config.get_or_default("TPU_LORA_ADAPTERS", "")
+        if adapters_cfg:
+            for entry in adapters_cfg.replace(";", ",").split(","):
+                entry = entry.strip()
+                if not entry:
+                    continue
+                if "=" not in entry:
+                    raise ValueError(
+                        f"TPU_LORA_ADAPTERS entry {entry!r} is not "
+                        f"name=path"
+                    )
+                name, path = entry.split("=", 1)
+                engine.load_lora(name.strip(), path.strip())
         return engine
 
     def _init_llm_quantized(self, seed: int) -> dict:
@@ -623,7 +701,10 @@ class InferenceEngine:
             counter[0] += 1
             key = jax.random.fold_in(base, counter[0])
             if name in ("attn_norm", "mlp_norm", "final_norm"):
-                return jnp.ones(sds.shape, cfg.dtype)
+                # (1+w) norm models (Gemma) use zeros as identity.
+                return jnp.full(
+                    sds.shape, 0.0 if cfg.norm_offset else 1.0, cfg.dtype
+                )
             if name.endswith("_b"):  # QKV biases: zeros, as init_transformer
                 return jnp.zeros(sds.shape, cfg.dtype)
             fan_in = sds.shape[-1] if name == "embed" else sds.shape[-2]
@@ -771,7 +852,7 @@ class InferenceEngine:
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, use_bias,
+            nsteps, bidx, bval, topi, topl, aids, use_bias,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -784,7 +865,7 @@ class InferenceEngine:
             (its counts are the zeros just written)."""
             logits, cache = transformer_prefill_chunk(
                 params, tokens, cache, slots, starts, lens, cfg,
-                dense_attn=dense_attn,
+                dense_attn=dense_attn, aids=aids[slots],
             )
             sub = row_keys(seeds[slots], jnp.zeros_like(slots))
             first, first_lp, ftopi, ftopl = sample(
@@ -826,7 +907,7 @@ class InferenceEngine:
         )(_prefill_core)
 
         def _multi_chunk_core(params, cache, tokens3, slots, starts0,
-                              n_chunks, history):
+                              n_chunks, history, aids):
             """Up to D FULL (non-finalizing) [P, c] chunks in ONE dispatch
             — the long-prompt TTFT amortizer: through a network-attached
             relay every chunk dispatch costs a host↔device RTT, so an 8k
@@ -850,7 +931,7 @@ class InferenceEngine:
                 lens = jnp.full((Pb,), c, jnp.int32)
                 _, cache = transformer_prefill_chunk(
                     params, toks, cache, slots, starts, lens, cfg,
-                    dense_attn=dense_attn,
+                    dense_attn=dense_attn, aids=aids[slots],
                 )
                 if history is not None:
                     hpos = jnp.clip(
@@ -867,34 +948,35 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_multi_chunk(params, cache, tokens3, slots, starts0,
-                                n_chunks):
+                                n_chunks, aids):
             cache, _ = _multi_chunk_core(
-                params, cache, tokens3, slots, starts0, n_chunks, None
+                params, cache, tokens3, slots, starts0, n_chunks, None, aids
             )
             return cache
 
         @partial(jax.jit, donate_argnums=(1, 6))
         def prefill_multi_chunk_hist(params, cache, tokens3, slots, starts0,
-                                     n_chunks, history):
+                                     n_chunks, history, aids):
             return _multi_chunk_core(
-                params, cache, tokens3, slots, starts0, n_chunks, history
+                params, cache, tokens3, slots, starts0, n_chunks, history,
+                aids,
             )
 
         @partial(
-            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19, 20),
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19, 21),
             static_argnames=("use_bias",),
         )
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, history, use_bias=False,
+            nsteps, bidx, bval, topi, topl, aids, history, use_bias=False,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
                 params, cache, tokens, slots, starts, lens, finalize,
                 row_valid, temps, greedy, topps, seeds, all_tokens,
-                all_logps, pcounts, nsteps, bidx, bval, topi, topl,
+                all_logps, pcounts, nsteps, bidx, bval, topi, topl, aids,
                 use_bias,
             )
             c = tokens.shape[1]
@@ -906,7 +988,7 @@ class InferenceEngine:
             return out + (history,)
 
         def make_decode_body(params, active, temps, greedy, topps, fpen,
-                             ppen, seeds, bidx, bval, use_bias):
+                             ppen, seeds, bidx, bval, use_bias, aids):
             """One decode step (scan body): forward + sample + penalty
             count scatter — shared by the plain window and the mega
             while_loop so the two dispatch modes cannot drift."""
@@ -914,7 +996,8 @@ class InferenceEngine:
             def body(carry, _):
                 tokens, logps, cache, nsteps, pcounts, topi, topl = carry
                 logits, cache = transformer_decode_step(
-                    params, tokens, cache, active, cfg, dense_attn=dense_attn
+                    params, tokens, cache, active, cfg,
+                    dense_attn=dense_attn, aids=aids,
                 )
                 pen = (pcounts, fpen, ppen) if enable_penalties else None
                 sub = row_keys(seeds, nsteps)
@@ -945,7 +1028,7 @@ class InferenceEngine:
         )
         def decode_window(params, tokens, logps, cache, active, nsteps,
                           temps, greedy, topps, fpen, ppen, pcounts, seeds,
-                          bidx, bval, topi, topl, k, use_bias):
+                          bidx, bval, topi, topl, aids, k, use_bias):
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -957,7 +1040,8 @@ class InferenceEngine:
             the seeds plane uploads only on admission — so steady-state
             dispatch uploads nothing host→device at all."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen, seeds, bidx, bval, use_bias)
+                                    fpen, ppen, seeds, bidx, bval, use_bias,
+                                    aids)
             (final, final_lp, cache, nsteps, pcounts, topi, topl), ys = (
                 jax.lax.scan(
                     body,
@@ -983,7 +1067,7 @@ class InferenceEngine:
         )
         def mega_window(params, tokens, logps, cache, active, nsteps, temps,
                         greedy, topps, fpen, ppen, pcounts, seeds, bidx,
-                        bval, topi, topl, remaining, eos_stop, k, m,
+                        bval, topi, topl, remaining, eos_stop, aids, k, m,
                         use_bias):
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
@@ -997,7 +1081,8 @@ class InferenceEngine:
             block 0) and the host drops the tokens post-retirement, so
             the junk is slot-local by construction."""
             body = make_decode_body(params, active, temps, greedy, topps,
-                                    fpen, ppen, seeds, bidx, bval, use_bias)
+                                    fpen, ppen, seeds, bidx, bval, use_bias,
+                                    aids)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S), dtype=jnp.float32)
             etops0 = (
@@ -1046,7 +1131,8 @@ class InferenceEngine:
 
         G = self.spec_tokens
 
-        def make_spec_body(params, active, temps, greedy, topps, seeds):
+        def make_spec_body(params, active, temps, greedy, topps, seeds,
+                           aids):
             """One speculative step (scan body), shared by the plain spec
             window and the mega-spec while_loop."""
             from gofr_tpu.models.transformer import (
@@ -1061,7 +1147,7 @@ class InferenceEngine:
                 draft = ngram_draft(history, cache.lengths, tokens, G)
                 inputs = jnp.concatenate([tokens[:, None], draft], axis=1)
                 logits, nk, nv = transformer_verify_step(
-                    params, inputs, cache, cfg
+                    params, inputs, cache, cfg, aids=aids
                 )
                 greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 samp0, samp0_lp, _, _ = sample(
@@ -1128,7 +1214,7 @@ class InferenceEngine:
             jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9)
         )
         def spec_window(params, tokens, logps, cache, active, nsteps, temps,
-                        greedy, topps, history, seeds, k):
+                        greedy, topps, history, seeds, aids, k):
             """k speculative steps on device. Each step drafts G tokens by
             n-gram lookup in the slot's own history, verifies draft+current
             in ONE [S, G+1] forward (cache read-only), accepts the longest
@@ -1138,7 +1224,7 @@ class InferenceEngine:
             Emits per step: tokens [S, G+1] (= the step's inputs), logps,
             and counts [S] (=accepted+1 valid entries)."""
             body = make_spec_body(params, active, temps, greedy, topps,
-                                  seeds)
+                                  seeds, aids)
             ((final, final_lp, cache, nsteps, history),
              (etoks, elps, ecnt)) = jax.lax.scan(
                 body, (tokens, logps, cache, nsteps, history), length=k
@@ -1154,7 +1240,7 @@ class InferenceEngine:
         )
         def mega_spec_window(params, tokens, logps, cache, active, nsteps,
                              temps, greedy, topps, history, seeds, remaining,
-                             eos_stop, k, m):
+                             eos_stop, aids, k, m):
             """Mega × speculation: up to m k-step spec windows in ONE
             dispatch. `remaining` decrements by the ACTUAL emitted token
             counts (speculation emits ≥ k per window per live slot, so
@@ -1162,7 +1248,7 @@ class InferenceEngine:
             only the VALID (first `counts`) entries of each step —
             rejected draft positions must not zero a budget."""
             body = make_spec_body(params, active, temps, greedy, topps,
-                                  seeds)
+                                  seeds, aids)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S, G + 1), dtype=jnp.float32)
             ecnt0 = jnp.zeros((m * k, S), dtype=jnp.int32)
@@ -1591,6 +1677,7 @@ class InferenceEngine:
             req.max_new_tokens = max(1, min(req.max_new_tokens, room))
             slot = free.pop(0)
             self._seeds_host[slot] = req.seed
+            self._aids_host[slot] = req.aid
             self._bidx_host[slot, :] = -1
             self._bval_host[slot, :] = 0.0
             for j, (tok, bv) in enumerate(req.logit_bias.items()):
@@ -1616,6 +1703,16 @@ class InferenceEngine:
             self._prefilling[slot] = state
         if not self._prefilling:
             return False
+        if self._seeds_dirty:
+            # Upload the admission-scoped planes BEFORE any dispatch —
+            # the deep multi-chunk branch below reads _aids_dev, so a
+            # flush only on the single-chunk path would prefill a long
+            # prompt with the slot's PREVIOUS occupant's adapter.
+            self._seeds_dev = self._up(self._seeds_host)
+            self._bidx_dev = self._up(self._bidx_host)
+            self._bval_dev = self._up(self._bval_host)
+            self._aids_dev = self._up(self._aids_host)
+            self._seeds_dirty = False
 
         P, c = self.prefill_batch, self.prefill_chunk
         rows = list(self._prefilling.items())[:P]
@@ -1664,11 +1761,13 @@ class InferenceEngine:
                 if self.spec_tokens:
                     self.cache, self._history_dev = (
                         self._prefill_multi_chunk_hist(
-                            *margs, self._history_dev
+                            *margs, self._history_dev, self._aids_dev
                         )
                     )
                 else:
-                    self.cache = self._prefill_multi_chunk(*margs)
+                    self.cache = self._prefill_multi_chunk(
+                        *margs, self._aids_dev
+                    )
                 if self._lockstep:
                     self._jax.block_until_ready(self.cache.lengths)
                 for _, st, _ in deep:
@@ -1712,11 +1811,6 @@ class InferenceEngine:
         jnp = self._jnp
         t0 = time.time()
         self._push_table()
-        if self._seeds_dirty:
-            self._seeds_dev = self._up(self._seeds_host)
-            self._bidx_dev = self._up(self._bidx_host)
-            self._bval_dev = self._up(self._bval_host)
-            self._seeds_dirty = False
         args = (
             self.params, self.cache, self._up(tokens),
             self._up(slots), self._up(starts), self._up(lens),
@@ -1725,6 +1819,7 @@ class InferenceEngine:
             self._seeds_dev, self._tokens_dev, self._logps_dev,
             self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
             self._bval_dev, self._topi_dev, self._topl_dev,
+            self._aids_dev,
         )
         # Static compile choice: the no-bias program has no bias scatter
         # at all (each variant compiles once, then caches).
@@ -1962,6 +2057,7 @@ class InferenceEngine:
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._history_dev, self._seeds_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
+                    self._aids_dev,
                     k=self.window_k, m=mega,
                 )
             )
@@ -1977,6 +2073,7 @@ class InferenceEngine:
                     self._seeds_dev, self._bidx_dev, self._bval_dev,
                     self._topi_dev, self._topl_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
+                    self._aids_dev,
                     k=self.window_k, m=mega, use_bias=use_bias,
                 )
             )
@@ -1987,7 +2084,8 @@ class InferenceEngine:
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
-                    self._history_dev, self._seeds_dev, k=self.window_k,
+                    self._history_dev, self._seeds_dev, self._aids_dev,
+                    k=self.window_k,
                 )
             )
         else:
@@ -2000,7 +2098,7 @@ class InferenceEngine:
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                     self._seeds_dev, self._bidx_dev, self._bval_dev,
-                    self._topi_dev, self._topl_dev,
+                    self._topi_dev, self._topl_dev, self._aids_dev,
                     k=self.window_k, use_bias=use_bias,
                 )
             )
@@ -2377,9 +2475,20 @@ class InferenceEngine:
         seed: "Optional[int]" = None,
         logit_bias: "Optional[dict]" = None,
         top_logprobs: int = 0,
+        adapter: str = "",
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
+        aid = 0
+        if adapter:
+            from gofr_tpu.errors import ErrorInvalidParam
+
+            if adapter not in self._lora_names:
+                raise ErrorInvalidParam([
+                    f"unknown LoRA adapter {adapter!r}; loaded: "
+                    f"{sorted(self._lora_names)}"
+                ])
+            aid = self._lora_names[adapter]
         if not 0.0 < top_p <= 1.0:
             from gofr_tpu.errors import ErrorInvalidParam
 
@@ -2494,9 +2603,117 @@ class InferenceEngine:
             ),
             logit_bias=bias,
             top_logprobs=int(top_logprobs or 0),
+            aid=aid,
         )
         self._enqueue(req)
         return req
+
+    def load_lora(self, name: str, source) -> int:
+        """Load a LoRA adapter into a free adapter slot under ``name``.
+
+        source: an HF PEFT checkpoint dir (``adapter_config.json`` +
+        safetensors) or a raw ``{target: (a [L, d_in, r], b [L, r,
+        d_out])}`` dict. Re-loading an existing name overwrites its slot.
+        Returns the adapter slot index (≥1). Safe while serving: leaf
+        updates build new device arrays; in-flight windows keep the old
+        tree, and the name routes to the slot only after the write lands.
+        """
+        if self.family != "llm":
+            raise RuntimeError("LoRA adapters are for llm engines")
+        if not self.lora_slots:
+            raise RuntimeError(
+                "engine compiled without adapter slots — set "
+                "TPU_LORA_SLOTS>0"
+            )
+        from gofr_tpu.serving.lora import (
+            load_peft_adapter,
+            validate_adapter_leaves,
+        )
+
+        if isinstance(source, str):
+            leaves = load_peft_adapter(
+                source, self.cfg, self.lora_rank, self._lora_targets
+            )
+        else:
+            leaves = dict(source)
+            validate_adapter_leaves(
+                leaves, self.cfg, self.lora_rank, self._lora_targets
+            )
+        idx = self._lora_names.get(name)
+        if idx is None:
+            used = set(self._lora_names.values())
+            idx = next(
+                (
+                    i
+                    for i in range(1, self.lora_slots + 1)
+                    if i not in used
+                ),
+                None,
+            )
+            if idx is None:
+                raise RuntimeError(
+                    f"all {self.lora_slots} adapter slots in use "
+                    f"(TPU_LORA_SLOTS); unload_lora one first"
+                )
+        layers = dict(self.params["layers"])
+        # Zero the WHOLE slot first: a reload with fewer targets than the
+        # previous version must not leave the old version's deltas live.
+        for t in self._lora_targets:
+            if t in leaves:
+                continue
+            for suffix in ("_lora_a", "_lora_b"):
+                leaf = layers[t + suffix]
+                layers[t + suffix] = (
+                    leaf.at[:, idx].set(self._jnp.zeros_like(leaf[:, idx]))
+                )
+        for t, (a, b) in leaves.items():
+            dt = self.cfg.dtype
+            layers[t + "_lora_a"] = (
+                layers[t + "_lora_a"].at[:, idx].set(a.astype(dt))
+            )
+            layers[t + "_lora_b"] = (
+                layers[t + "_lora_b"].at[:, idx].set(b.astype(dt))
+            )
+        self.params = {**self.params, "layers": layers}
+        self._lora_names[name] = idx
+        if self._logger is not None:
+            self._logger.infof(
+                "LoRA adapter %s loaded into slot %d (targets: %s)",
+                name, idx, ",".join(sorted(leaves)),
+            )
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_lora_adapters", float(len(self._lora_names)),
+                "model", self.model_name,
+            )
+        return idx
+
+    def unload_lora(self, name: str) -> None:
+        """Zero ``name``'s adapter slot and free it. In-flight requests
+        routed to the slot finish against the zeroed (= base) weights —
+        callers should drain first if that matters."""
+        idx = self._lora_names.pop(name, None)
+        if idx is None:
+            raise KeyError(f"no loaded LoRA adapter {name!r}")
+        layers = dict(self.params["layers"])
+        for t in self._lora_targets:
+            for suffix in ("_lora_a", "_lora_b"):
+                leaf = layers[t + suffix]
+                layers[t + suffix] = (
+                    leaf.at[:, idx].set(self._jnp.zeros_like(leaf[:, idx]))
+                )
+        self.params = {**self.params, "layers": layers}
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_lora_adapters", float(len(self._lora_names)),
+                "model", self.model_name,
+            )
+
+    def lora_names(self) -> list[str]:
+        """Loaded adapter names (OpenAI surface lists them as models)."""
+        if self.family != "llm" or not getattr(self, "lora_slots", 0):
+            return []
+        return sorted(self._lora_names)
 
     def register_prefix(self, prompt: str | list[int]) -> _GenRequest:
         """Prefill a shared prompt prefix ONCE and park its KV rows in the

@@ -47,9 +47,10 @@ def config_from_hf(path: str):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "mixtral", "qwen2"):
+    if mt not in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
         raise ValueError(
-            f"unsupported HF model_type {mt!r} (llama-family + qwen2 only)"
+            f"unsupported HF model_type {mt!r} "
+            "(llama-family + qwen2 + gemma only)"
         )
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
@@ -68,6 +69,13 @@ def config_from_hf(path: str):
         # attention_bias flag in older revisions — the model_type implies it).
         attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
         n_experts_active=int(hf.get("num_experts_per_tok", 2)),
+        # Gemma: explicit head_dim (7B: 256 ≠ 3072/16), GeGLU FFN,
+        # (1+w) RMSNorm, sqrt(d_model)-scaled embeddings, tied lm_head
+        # (resolved below from the embedding transpose).
+        head_dim_override=int(hf.get("head_dim", 0)) if mt == "gemma" else 0,
+        act="gelu" if mt == "gemma" else "silu",
+        norm_offset=(mt == "gemma"),
+        embed_scale=(mt == "gemma"),
     )
 
 
@@ -144,7 +152,8 @@ def load_hf_llama(
     if file_cfg is not None:
         for field in ("vocab_size", "d_model", "n_layers", "n_heads",
                       "n_kv_heads", "d_ff", "n_experts",
-                      "n_experts_active", "attn_bias"):
+                      "n_experts_active", "attn_bias", "head_dim_override",
+                      "act", "norm_offset", "embed_scale"):
             want, have = getattr(cfg, field), getattr(file_cfg, field)
             if want != have:
                 raise ValueError(

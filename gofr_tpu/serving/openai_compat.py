@@ -144,16 +144,24 @@ def add_openai_routes(
             )
         return engine
 
-    def _check_model(body: dict, engine) -> None:
+    def _check_model(body: dict, engine) -> str:
         """A request naming a model that is NOT the loaded one gets the
-        OpenAI 404, not the loaded model's output."""
+        OpenAI 404, not the loaded model's output. A loaded LoRA
+        adapter's name IS a model here (the vLLM convention): the
+        request runs on the base engine with that adapter's slot
+        selected per-request — one batch serves many adapters.
+        Returns the adapter name ("" = base)."""
         want = body.get("model")
-        if want and want != engine.model_name:
-            raise OpenAIModelNotFound(
-                f"model {want!r} is not loaded (serving "
-                f"{engine.model_name!r}); GET /v1/models lists "
-                f"availability"
-            )
+        if not want or want == engine.model_name:
+            return ""
+        names = engine.lora_names() if hasattr(engine, "lora_names") else []
+        if want in names:
+            return want
+        raise OpenAIModelNotFound(
+            f"model {want!r} is not loaded (serving "
+            f"{engine.model_name!r}); GET /v1/models lists "
+            f"availability"
+        )
 
     def _params(body: dict) -> dict:
         # Explicit nulls are legal per the OpenAI spec → fall back to
@@ -337,9 +345,9 @@ def add_openai_routes(
     async def completions(ctx):  # noqa: ANN001
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
-        _check_model(body, engine)
+        adapter = _check_model(body, engine)
         prompts = _normalize_prompts(body.get("prompt", ""))
-        params = _params(body)
+        params = dict(_params(body), adapter=adapter)
         stop_seqs = _stop_list(body)
         streaming = bool(body.get("stream"))
         n = _n_choices(body, streaming)
@@ -419,7 +427,7 @@ def add_openai_routes(
     async def chat_completions(ctx):  # noqa: ANN001
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
-        _check_model(body, engine)
+        adapter = _check_model(body, engine)
         messages = body.get("messages") or []
         if not isinstance(messages, list) or not messages:
             raise OpenAIRequestError("messages must be a non-empty list")
@@ -435,7 +443,7 @@ def add_openai_routes(
                 prompt = template(messages)
         else:
             prompt = template(messages)
-        params = _params(body)
+        params = dict(_params(body), adapter=adapter)
         stop_seqs = _stop_list(body)
         streaming = bool(body.get("stream"))
         n = _n_choices(body, streaming)
@@ -556,6 +564,10 @@ def add_openai_routes(
         loaded = {
             e.model_name for e in (engine, embedder) if e is not None
         }
+        adapters = (
+            engine.lora_names()
+            if engine is not None and hasattr(engine, "lora_names") else []
+        )
         return Raw({
             "object": "list",
             "data": [
@@ -566,5 +578,16 @@ def add_openai_routes(
                     "loaded": name in loaded,
                 }
                 for name in list_models()
+            ] + [
+                # Loaded LoRA adapters are servable model ids (request
+                # them via the "model" field; vLLM convention).
+                {
+                    "id": name,
+                    "object": "model",
+                    "owned_by": "gofr-tpu",
+                    "loaded": True,
+                    "parent": engine.model_name,
+                }
+                for name in adapters
             ],
         })

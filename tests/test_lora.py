@@ -1,0 +1,469 @@
+"""Multi-LoRA serving: per-request adapters batched into one program.
+
+The oracle is weight merging: serving with adapter slot a must equal
+serving a model whose weights were merged W' = W + A_a @ B_a offline
+(f32 tiny model, greedy). Batch isolation: concurrent requests on
+different adapters must reproduce their solo outputs exactly — the
+per-slot gather cannot leak across rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gofr_tpu.models.registry import get_model
+from gofr_tpu.models.transformer import (
+    TransformerConfig,
+    init_lora,
+    init_transformer,
+    lora_dims,
+    transformer_forward,
+)
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+CFG: TransformerConfig = get_model("llama-tiny-f32").config
+TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _rand_adapter(seed: int, rank: int = 4, scale: float = 0.5) -> dict:
+    """{target: (a, b)} random leaves in the engine's load_lora form."""
+    key = jax.random.PRNGKey(seed)
+    leaves = {}
+    for t in TARGETS:
+        d_in, d_out = lora_dims(CFG, t)
+        key, k1, k2 = jax.random.split(key, 3)
+        leaves[t] = (
+            scale * jax.random.normal(k1, (CFG.n_layers, d_in, rank)),
+            scale * jax.random.normal(k2, (CFG.n_layers, rank, d_out)),
+        )
+    return leaves
+
+
+def _merged_params(params: dict, leaves: dict) -> dict:
+    merged = {**params, "layers": dict(params["layers"])}
+    for t, (a, b) in leaves.items():
+        delta = jnp.einsum("ldr,lro->ldo", a, b).astype(
+            merged["layers"][t].dtype
+        )
+        merged["layers"][t] = merged["layers"][t] + delta
+    return merged
+
+
+def _engine(**kw):
+    eng = InferenceEngine(
+        "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), lora_slots=2, lora_rank=4, **kw,
+    )
+    eng.start_sync()
+    return eng
+
+
+def _gen(eng, prompt, n=10, **kw):
+    return eng.generate_sync(
+        prompt, max_new_tokens=n, temperature=0.0, stop_on_eos=False,
+        timeout=120, **kw,
+    ).token_ids
+
+
+def test_forward_adapter_matches_merged_weights():
+    """transformer_forward with aids == forward on merged weights; rows
+    with aid 0 are untouched base rows."""
+    params = init_transformer(jax.random.PRNGKey(0), CFG)
+    leaves = _rand_adapter(7)
+    lora = init_lora(CFG, 3, 4, TARGETS)
+    for t, (a, b) in leaves.items():
+        lora[t + "_lora_a"] = lora[t + "_lora_a"].at[:, 2].set(a)
+        lora[t + "_lora_b"] = lora[t + "_lora_b"].at[:, 2].set(b)
+    p_lora = {**params, "layers": {**params["layers"], **lora}}
+    tokens = jnp.array([[1, 5, 9, 2], [3, 8, 4, 6]], dtype=jnp.int32)
+    out = np.asarray(transformer_forward(
+        p_lora, tokens, CFG, aids=jnp.array([0, 2], dtype=jnp.int32)
+    ))
+    base = np.asarray(transformer_forward(params, tokens, CFG))
+    merged = np.asarray(transformer_forward(
+        _merged_params(params, leaves), tokens, CFG
+    ))
+    np.testing.assert_allclose(out[0], base[0], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(out[1], merged[1], atol=1e-4, rtol=1e-4)
+    assert not np.allclose(out[1], base[1], atol=1e-2)
+
+
+def test_engine_adapter_matches_merged_engine():
+    """Greedy generation with adapter == generation on an engine booted
+    from the merged checkpoint."""
+    leaves = _rand_adapter(11)
+    eng = _engine()
+    try:
+        base = _gen(eng, "hello")
+        eng.load_lora("tuned", leaves)
+        tuned = _gen(eng, "hello", adapter="tuned")
+        base_params = init_transformer(
+            jax.random.PRNGKey(0), CFG
+        )  # engine seed=0 default
+        merged_eng = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(),
+            params=_merged_params(eng.params, leaves),
+        )
+        merged_eng.start_sync()
+        try:
+            want = _gen(merged_eng, "hello")
+        finally:
+            merged_eng.stop_sync()
+        assert tuned == want
+        assert tuned != base
+        assert _gen(eng, "hello") == base  # base unaffected
+        del base_params
+    finally:
+        eng.stop_sync()
+
+
+def test_concurrent_adapters_batch_isolation():
+    """Requests on base + two adapters running CONCURRENTLY in one
+    engine reproduce their solo outputs token for token."""
+    a1, a2 = _rand_adapter(21), _rand_adapter(22)
+    eng = _engine()
+    try:
+        eng.load_lora("a1", a1)
+        eng.load_lora("a2", a2)
+        solo = {
+            "": _gen(eng, "hello"),
+            "a1": _gen(eng, "hello", adapter="a1"),
+            "a2": _gen(eng, "hello", adapter="a2"),
+        }
+        assert len({tuple(v) for v in solo.values()}) == 3
+        reqs = [
+            eng.submit_generate(
+                "hello", max_new_tokens=10, temperature=0.0,
+                stop_on_eos=False, adapter=name,
+            )
+            for name in ("", "a1", "a2", "a1")
+        ]
+        outs = [r.future.result(timeout=120).token_ids for r in reqs]
+        assert outs[0] == solo[""]
+        assert outs[1] == solo["a1"]
+        assert outs[2] == solo["a2"]
+        assert outs[3] == solo["a1"]
+    finally:
+        eng.stop_sync()
+
+
+def test_mega_window_adapter_parity():
+    """Mega-window dispatch honors per-slot adapters identically."""
+    leaves = _rand_adapter(31)
+    plain = _engine()
+    mega = _engine(mega_windows=4)
+    try:
+        plain.load_lora("t", leaves)
+        mega.load_lora("t", leaves)
+        assert _gen(plain, "ab", adapter="t") == _gen(
+            mega, "ab", adapter="t"
+        )
+    finally:
+        plain.stop_sync()
+        mega.stop_sync()
+
+
+def test_spec_window_adapter_parity():
+    """Greedy speculative decoding is lossless under an adapter too."""
+    leaves = _rand_adapter(41)
+    plain = _engine()
+    spec = InferenceEngine(
+        "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), lora_slots=2, lora_rank=4,
+        spec_tokens=2,
+    )
+    spec.start_sync()
+    try:
+        plain.load_lora("t", leaves)
+        spec.load_lora("t", leaves)
+        assert _gen(plain, "ab", adapter="t") == _gen(
+            spec, "ab", adapter="t"
+        )
+    finally:
+        plain.stop_sync()
+        spec.stop_sync()
+
+
+def test_ffn_targets_through_engine():
+    """FFN LoRA targets (w_gate/w_up/w_down) apply on EVERY serving path
+    — chunked prefill, decode, and speculative verify — not just the
+    full-sequence forward (regression: the three inline layer bodies
+    dropped aids on their _ffn_dense calls)."""
+    all_targets = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+    key = jax.random.PRNGKey(61)
+    leaves = {}
+    for t in all_targets:
+        d_in, d_out = lora_dims(CFG, t)
+        key, k1, k2 = jax.random.split(key, 3)
+        leaves[t] = (
+            0.5 * jax.random.normal(k1, (CFG.n_layers, d_in, 4)),
+            0.5 * jax.random.normal(k2, (CFG.n_layers, 4, d_out)),
+        )
+    for spec_tokens in (0, 2):
+        eng = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(), lora_slots=1, lora_rank=4,
+            lora_targets=",".join(all_targets), spec_tokens=spec_tokens,
+        )
+        eng.start_sync()
+        try:
+            eng.load_lora("full", leaves)
+            got = _gen(eng, "hello", adapter="full")
+            merged_eng = InferenceEngine(
+                "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+                tokenizer=ByteTokenizer(),
+                params=_merged_params(eng.params, leaves),
+            )
+            merged_eng.start_sync()
+            try:
+                assert got == _gen(merged_eng, "hello"), (
+                    f"spec_tokens={spec_tokens}"
+                )
+            finally:
+                merged_eng.stop_sync()
+        finally:
+            eng.stop_sync()
+
+
+def test_multi_chunk_prefill_uses_fresh_adapter():
+    """Deep multi-chunk prefill (prefill_depth>1) must prefill with the
+    REQUEST's adapter, not the slot's previous occupant's (regression:
+    the aids plane uploaded only on the single-chunk path)."""
+    leaves = _rand_adapter(71)
+    long_prompt = "abcdefgh" * 16  # 128 chars → 8 chunks of 16
+    kw = dict(
+        n_slots=2, max_len=256, window_k=4, tokenizer=ByteTokenizer(),
+        prefill_chunk=16, prefill_depth=4,
+    )
+    eng = InferenceEngine(
+        "llama-tiny-f32", lora_slots=1, lora_rank=4, **kw
+    )
+    eng.start_sync()
+    try:
+        eng.load_lora("t", leaves)
+        # Park the base request in slot 0 first so the adapter request
+        # reuses a slot whose host aid was 0.
+        base_out = _gen(eng, long_prompt)
+        got = _gen(eng, long_prompt, adapter="t")
+        merged_eng = InferenceEngine(
+            "llama-tiny-f32",
+            params=_merged_params(eng.params, leaves), **kw,
+        )
+        merged_eng.start_sync()
+        try:
+            want = _gen(merged_eng, long_prompt)
+        finally:
+            merged_eng.stop_sync()
+        assert got == want
+        assert got != base_out
+    finally:
+        eng.stop_sync()
+
+
+def test_reload_with_fewer_targets_zeroes_stale_deltas():
+    """Re-loading a name with fewer targets must clear the old version's
+    other-target deltas (regression: load_lora wrote without zeroing)."""
+    v1 = _rand_adapter(81)  # wq, wk, wv, wo
+    v2 = {"wq": v1["wq"]}  # only wq survives
+    eng = _engine()
+    try:
+        eng.load_lora("a", v1)
+        eng.load_lora("a", v2)
+        got = _gen(eng, "hello", adapter="a")
+        merged_eng = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(),
+            params=_merged_params(eng.params, v2),
+        )
+        merged_eng.start_sync()
+        try:
+            assert got == _gen(merged_eng, "hello")
+        finally:
+            merged_eng.stop_sync()
+    finally:
+        eng.stop_sync()
+
+
+def test_adapter_slot_management():
+    eng = _engine()
+    try:
+        assert eng.lora_names() == []
+        eng.load_lora("x", _rand_adapter(1))
+        eng.load_lora("y", _rand_adapter(2))
+        assert eng.lora_names() == ["x", "y"]
+        with pytest.raises(RuntimeError, match="slots in use"):
+            eng.load_lora("z", _rand_adapter(3))
+        base = _gen(eng, "hi")
+        x_out = _gen(eng, "hi", adapter="x")
+        eng.unload_lora("x")
+        assert eng.lora_names() == ["y"]
+        with pytest.raises(Exception):
+            _gen(eng, "hi", adapter="x")
+        # Freed slot is reusable; zeroed slot serves base until then.
+        eng.load_lora("z", _rand_adapter(3))
+        assert eng.lora_names() == ["y", "z"]
+        assert _gen(eng, "hi") == base
+        assert x_out != base
+    finally:
+        eng.stop_sync()
+
+
+def test_engine_without_lora_rejects():
+    eng = InferenceEngine(
+        "llama-tiny-f32", n_slots=2, max_len=64,
+        tokenizer=ByteTokenizer(),
+    )
+    try:
+        with pytest.raises(RuntimeError, match="TPU_LORA_SLOTS"):
+            eng.load_lora("x", _rand_adapter(1))
+    finally:
+        eng.close()
+
+
+def test_peft_checkpoint_load(tmp_path):
+    """HF PEFT format: adapter_config.json + safetensors, rank below the
+    compiled rank (zero-pad), alpha scaling folded in — output equals
+    the merged oracle with scale alpha/r."""
+    from safetensors.numpy import save_file
+
+    r, alpha = 2, 8.0
+    rng = np.random.default_rng(5)
+    tensors = {}
+    leaves_scaled = {}
+    for t in ("wq", "wv"):
+        d_in, d_out = lora_dims(CFG, t)
+        mod = {"wq": "q_proj", "wv": "v_proj"}[t]
+        a = np.zeros((CFG.n_layers, d_in, 4), dtype=np.float32)
+        b = np.zeros((CFG.n_layers, 4, d_out), dtype=np.float32)
+        for i in range(CFG.n_layers):
+            wa = rng.standard_normal((r, d_in)).astype(np.float32) * 0.5
+            wb = rng.standard_normal((d_out, r)).astype(np.float32) * 0.5
+            tensors[
+                f"base_model.model.model.layers.{i}.self_attn.{mod}"
+                f".lora_A.weight"
+            ] = wa
+            tensors[
+                f"base_model.model.model.layers.{i}.self_attn.{mod}"
+                f".lora_B.weight"
+            ] = wb
+            a[i, :, :r] = wa.T
+            b[i, :r, :] = wb.T * (alpha / r)
+        leaves_scaled[t] = (jnp.asarray(a), jnp.asarray(b))
+    (tmp_path / "adapter_config.json").write_text(json.dumps({
+        "r": r, "lora_alpha": alpha,
+        "target_modules": ["q_proj", "v_proj"],
+    }))
+    save_file(tensors, str(tmp_path / "adapter_model.safetensors"))
+
+    eng = _engine()
+    try:
+        eng.load_lora("peft", str(tmp_path))
+        got = _gen(eng, "hello", adapter="peft")
+        merged_eng = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(),
+            params=_merged_params(eng.params, leaves_scaled),
+        )
+        merged_eng.start_sync()
+        try:
+            assert got == _gen(merged_eng, "hello")
+        finally:
+            merged_eng.stop_sync()
+    finally:
+        eng.stop_sync()
+
+
+def test_peft_rank_too_big_rejected(tmp_path):
+    (tmp_path / "adapter_config.json").write_text(json.dumps({
+        "r": 64, "lora_alpha": 64, "target_modules": ["q_proj"],
+    }))
+    eng = _engine()
+    try:
+        with pytest.raises(ValueError, match="TPU_LORA_RANK"):
+            eng.load_lora("big", str(tmp_path))
+    finally:
+        eng.stop_sync()
+
+
+def test_grpc_kwargs_pass_adapter():
+    """Both gRPC surfaces (JSON + typed proto) forward the adapter."""
+    from gofr_tpu.grpc import inference_pb2
+    from gofr_tpu.grpc.inference import InferenceServicer
+    from gofr_tpu.grpc.inference_typed import TypedInferenceServicer
+
+    class _Eng:
+        tokenizer = None
+
+    kw = InferenceServicer(_Eng())._gen_kwargs(
+        {"prompt": "x", "adapter": "tuned"}, False
+    )
+    assert kw["adapter"] == "tuned"
+    kw2 = InferenceServicer(_Eng())._gen_kwargs({"prompt": "x"}, False)
+    assert "adapter" not in kw2
+    req = inference_pb2.GenerateRequest(prompt="x", adapter="tuned")
+    _, tkw = TypedInferenceServicer(_Eng())._gen_kwargs(req)
+    assert tkw["adapter"] == "tuned"
+
+
+def test_openai_surface_routes_adapters():
+    """The OpenAI surface serves adapters as model ids: /v1/models lists
+    them, completions route by model name, unknown models still 404."""
+    import asyncio
+    import http.client
+    import threading
+
+    from gofr_tpu import App
+    from gofr_tpu.config import MockConfig
+    from gofr_tpu.serving.openai_compat import add_openai_routes
+
+    app = App(config=MockConfig({
+        "APP_NAME": "lora-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny-f32", "TPU_KV_SLOTS": "4",
+        "TPU_MAX_LEN": "128", "TPU_LORA_SLOTS": "2", "TPU_LORA_RANK": "4",
+    }))
+    add_openai_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=120)
+    try:
+        app.container.tpu.load_lora("tuned", _rand_adapter(51))
+
+        def call(method, path, body=None):
+            c = http.client.HTTPConnection(
+                "127.0.0.1", app.http_port, timeout=120
+            )
+            c.request(
+                method, path, body=json.dumps(body) if body else None
+            )
+            r = c.getresponse()
+            return r.status, json.loads(r.read())
+
+        _, models = call("GET", "/v1/models")
+        ids = {m["id"] for m in models["data"]}
+        assert "tuned" in ids
+        body = {
+            "model": "tuned", "prompt": "hello", "max_tokens": 6,
+            "temperature": 0,
+        }
+        st, r_tuned = call("POST", "/v1/completions", body)
+        assert st == 200
+        st, r_base = call(
+            "POST", "/v1/completions", {**body, "model": "llama-tiny-f32"}
+        )
+        assert st == 200
+        assert r_tuned["choices"][0]["text"] != r_base["choices"][0]["text"]
+        st, _ = call(
+            "POST", "/v1/completions", {**body, "model": "missing"}
+        )
+        assert st == 404
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
