@@ -10,6 +10,10 @@ DEADLINE_EPOCH=${DEADLINE_EPOCH:-$(date -d '15:05' +%s 2>/dev/null || echo 0)}
 mkdir -p campaign
 mini() {
   name=$1; shift
+  if grep -q '"platform": "tpu"' "campaign/$name.json" 2>/dev/null; then
+    echo "=== $name: already measured on tpu, skipping ==="
+    return 0
+  fi
   echo "=== $name: $* ==="
   env BENCH_ATTEMPTS=1 BENCH_TIMEOUT=600 BENCH_TOTAL_BUDGET=600 "$@" \
     timeout 700 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
@@ -25,7 +29,16 @@ while true; do
     echo "relay up at $(date)"
     remaining=$(( DEADLINE_EPOCH - $(date +%s) ))
     if [ "$DEADLINE_EPOCH" -le 0 ] || [ "$remaining" -gt 5400 ]; then
+      # Campaign 5 is resumable (per-config tpu-row skip + fail-fast
+      # relay probe, exit 3 on mid-campaign wedge): on exit 3, go back
+      # to probing instead of giving up the round's remaining windows.
       bash scripts/tpu_campaign5.sh
+      rc=$?
+      if [ "$rc" -eq 3 ]; then
+        echo "campaign aborted on relay wedge at $(date); resuming watch"
+        sleep 300
+        continue
+      fi
       PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
         python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
     else

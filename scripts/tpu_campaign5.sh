@@ -10,6 +10,20 @@ cd "$(dirname "$0")/.."
 mkdir -p campaign
 run() {
   name=$1; shift
+  # Resumable: a config that already produced a real TPU row is skipped,
+  # so the watcher can re-fire this script after a mid-campaign relay
+  # wedge without repeating completed measurements.
+  if grep -q '"platform": "tpu"' "campaign/$name.json" 2>/dev/null; then
+    echo "=== $name: already measured on tpu, skipping ==="
+    return 0
+  fi
+  # Fail fast when the relay is wedged: a 90 s jax-init probe costs
+  # little; without it every config burns its full timeout degrading
+  # to CPU and the ladder wastes hours.
+  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "=== $name: relay down at probe, aborting campaign ==="
+    exit 3
+  fi
   echo "=== $name: $* ==="
   env BENCH_ATTEMPTS=1 BENCH_TIMEOUT=900 BENCH_TOTAL_BUDGET=900 "$@" \
     timeout 1000 python bench.py >"campaign/$name.json" 2>"campaign/$name.log"
