@@ -286,3 +286,140 @@ def make_train_step(
         donate_argnums=(0, 1),
     )
     return init_state, step_jit, optimizer
+
+
+def make_lora_train_step(
+    cfg,
+    base_params: Any,
+    rank: int,
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo"),
+    mesh: Optional[Mesh] = None,
+    learning_rate: float = 1e-3,
+) -> tuple[Callable, Callable]:
+    """Adapter fine-tuning on a FROZEN base: the train→serve loop for
+    multi-LoRA (train here, then ``engine.load_lora(name, leaves)``).
+
+    Only the LoRA factors train — AdamW state is O(rank), and the frozen
+    base may be int8/int4-quantized (QLoRA shape: the ``_wein`` base
+    matmul dequantizes on the fly; deltas add after it, and no gradient
+    flows into the quantized leaves). Trainable leaves are kept in the
+    exact raw-dict form ``load_lora`` accepts: ``{target: (a [L, d_in,
+    r] f32, b [L, r, d_out] f32)}``; standard init (a ~ N(0, 1/r),
+    b = 0) makes step 0 exactly the base model.
+
+    Under a mesh, factors shard like their base projections minus the
+    adapter axis (column-parallel targets shard b's output dim over
+    ``tp``; row-parallel a's input dim) and the batch shards over ``dp``.
+    Returns ``(init_lora_state, lora_train_step)``:
+    ``init_lora_state(key) -> (lora, opt_state)``;
+    ``lora_train_step(lora, opt_state, tokens) -> (loss, lora,
+    opt_state)``.
+    """
+    from gofr_tpu.models.transformer import (
+        LORA_TARGETS,
+        lora_dims,
+        lora_param_specs,
+        transformer_forward,
+    )
+
+    # Mirror init_lora's guards: on a MoE base the FFN routes through
+    # _ffn_moe, which has no adapter path — FFN factors would train as
+    # silent no-ops (zero gradient) and be unservable anyway.
+    if cfg.is_moe:
+        raise ValueError("LoRA training does not support MoE models")
+    for t in targets:
+        if t not in LORA_TARGETS:
+            raise ValueError(
+                f"unknown LoRA target {t!r} (of {LORA_TARGETS})"
+            )
+
+    optimizer = optax.adamw(learning_rate)
+
+    def _merged(lora):
+        # Splice the trainable factors into the base tree with a
+        # 1-adapter axis; aids=0 then selects them for every row. The
+        # per-step stack is rank-sized — noise next to the forward.
+        layers = dict(base_params["layers"])
+        for t in targets:
+            a, b = lora[t]
+            layers[t + "_lora_a"] = a[:, None].astype(cfg.dtype)
+            layers[t + "_lora_b"] = b[:, None].astype(cfg.dtype)
+        return {**base_params, "layers": layers}
+
+    def loss_fn(lora, tokens):
+        aids = jnp.zeros((tokens.shape[0],), dtype=jnp.int32)
+        logits = transformer_forward(_merged(lora), tokens, cfg, aids=aids)
+        return cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+
+    def train_step(lora, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(lora, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return loss, lora, opt_state
+
+    def _init(key):
+        lora = {}
+        for t in targets:
+            d_in, d_out = lora_dims(cfg, t)
+            key, k1 = jax.random.split(key)
+            lora[t] = (
+                jax.random.normal(k1, (cfg.n_layers, d_in, rank)) / rank,
+                jnp.zeros((cfg.n_layers, rank, d_out), dtype=jnp.float32),
+            )
+        return lora
+
+    if mesh is None:
+        step_jit = jax.jit(train_step, donate_argnums=(0, 1))
+
+        def init_state(key):
+            lora = jax.jit(_init)(key)
+            return lora, optimizer.init(lora)
+
+        return init_state, step_jit
+
+    full = lora_param_specs(targets)
+    lora_specs = {
+        t: (
+            P(*(s for i, s in enumerate(full[t + "_lora_a"]) if i != 1)),
+            P(*(s for i, s in enumerate(full[t + "_lora_b"]) if i != 1)),
+        )
+        for t in targets
+    }
+    lora_specs = prune_specs(lora_specs, mesh)
+    lora_sh = named_shardings(lora_specs, mesh)
+    sample = jax.eval_shape(_init, jax.random.PRNGKey(0))
+    opt_shape = jax.eval_shape(optimizer.init, sample)
+    lora_treedef = jax.tree_util.tree_structure(sample)
+
+    def is_lora_tree(x):
+        try:
+            return jax.tree_util.tree_structure(x) == lora_treedef
+        except Exception:
+            return False
+
+    children, treedef = jax.tree_util.tree_flatten(
+        opt_shape, is_leaf=is_lora_tree
+    )
+    opt_sh = jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            lora_sh if is_lora_tree(c) else NamedSharding(mesh, P())
+            for c in children
+        ],
+    )
+    data_sh = NamedSharding(mesh, prune_specs(P("dp", None), mesh))
+    step_jit = jax.jit(
+        train_step,
+        in_shardings=(lora_sh, opt_sh, data_sh),
+        out_shardings=(NamedSharding(mesh, P()), lora_sh, opt_sh),
+        donate_argnums=(0, 1),
+    )
+    init_jit = jax.jit(_init, out_shardings=lora_sh)
+    opt_init_jit = jax.jit(optimizer.init, out_shardings=opt_sh)
+
+    def init_state(key):
+        lora = init_jit(key)
+        return lora, opt_init_jit(lora)
+
+    return init_state, step_jit
+

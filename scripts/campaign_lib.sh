@@ -1,0 +1,15 @@
+# Shared helpers for the TPU measurement scripts (sourced by
+# tpu_campaign5.sh and relay_watch.sh so the resumability condition
+# cannot drift between the full campaign and the watcher's mini set).
+
+# already_measured NAME — true if campaign/NAME.json holds a real
+# (non-degraded would also say platform=tpu) TPU row worth keeping.
+already_measured() {
+  grep -q '"platform": "tpu"' "campaign/$1.json" 2>/dev/null
+}
+
+# relay_up — a bounded jax-init probe; the relay wedges at init when it
+# is down, so a 90 s timeout is the detection, not a race.
+relay_up() {
+  timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1
+}

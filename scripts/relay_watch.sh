@@ -6,11 +6,12 @@
 # end-of-round bench must not contend with a long campaign.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/campaign_lib.sh
 DEADLINE_EPOCH=${DEADLINE_EPOCH:-$(date -d '15:05' +%s 2>/dev/null || echo 0)}
 mkdir -p campaign
 mini() {
   name=$1; shift
-  if grep -q '"platform": "tpu"' "campaign/$name.json" 2>/dev/null; then
+  if already_measured "$name"; then
     echo "=== $name: already measured on tpu, skipping ==="
     return 0
   fi
@@ -25,7 +26,7 @@ while true; do
     echo "deadline passed at $(date); exiting without measurements"
     exit 0
   fi
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if relay_up; then
     echo "relay up at $(date)"
     remaining=$(( DEADLINE_EPOCH - $(date +%s) ))
     if [ "$DEADLINE_EPOCH" -le 0 ] || [ "$remaining" -gt 5400 ]; then

@@ -7,20 +7,21 @@
 # 4k kernel A/B) at their best-known configs.
 set -u
 cd "$(dirname "$0")/.."
+. scripts/campaign_lib.sh
 mkdir -p campaign
 run() {
   name=$1; shift
   # Resumable: a config that already produced a real TPU row is skipped,
   # so the watcher can re-fire this script after a mid-campaign relay
   # wedge without repeating completed measurements.
-  if grep -q '"platform": "tpu"' "campaign/$name.json" 2>/dev/null; then
+  if already_measured "$name"; then
     echo "=== $name: already measured on tpu, skipping ==="
     return 0
   fi
   # Fail fast when the relay is wedged: a 90 s jax-init probe costs
   # little; without it every config burns its full timeout degrading
   # to CPU and the ladder wastes hours.
-  if ! timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if ! relay_up; then
     echo "=== $name: relay down at probe, aborting campaign ==="
     exit 3
   fi

@@ -393,6 +393,118 @@ def test_peft_rank_too_big_rejected(tmp_path):
         eng.stop_sync()
 
 
+def _memorize_tokens() -> list[int]:
+    text = b"the quick brown fox jumps over the lazy dog. " * 3
+    return np.frombuffer(text, dtype=np.uint8).astype(np.int32)[
+        :128
+    ].tolist()
+
+
+def test_train_adapter_then_serve():
+    """The train→serve loop: fine-tune LoRA factors on a frozen base
+    (the base tree must come out bit-identical), load them into a
+    serving engine, and the adapter stream must reproduce the memorized
+    text while the base stream does not."""
+    from gofr_tpu.parallel.sharding import make_lora_train_step
+
+    base = init_transformer(jax.random.PRNGKey(0), CFG)
+    base_flat = [np.asarray(x) for x in jax.tree_util.tree_leaves(base)]
+    init_state, step = make_lora_train_step(
+        CFG, base, rank=8, learning_rate=3e-3
+    )
+    lora, opt = init_state(jax.random.PRNGKey(1))
+    toks = jnp.asarray(_memorize_tokens())[None, :]
+    first = last = None
+    for _ in range(60):
+        loss, lora, opt = step(lora, opt, toks)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert last < first * 0.5
+    for before, after in zip(
+        base_flat, jax.tree_util.tree_leaves(base)
+    ):
+        np.testing.assert_array_equal(before, np.asarray(after))
+
+    eng = InferenceEngine(
+        "llama-tiny-f32", n_slots=2, max_len=160, window_k=4,
+        tokenizer=ByteTokenizer(), params=base, lora_slots=1, lora_rank=8,
+    )
+    eng.start_sync()
+    try:
+        idx = eng.load_lora("memorized", {t: lora[t] for t in lora})
+        assert idx == 1
+        prompt = bytes(_memorize_tokens()[:20]).decode()
+        cont = bytes(_memorize_tokens()[20:36]).decode()
+        tuned = eng.generate_sync(
+            prompt, max_new_tokens=16, temperature=0.0, stop_on_eos=False,
+            adapter="memorized", timeout=120,
+        )
+        plain = eng.generate_sync(
+            prompt, max_new_tokens=16, temperature=0.0, stop_on_eos=False,
+            timeout=120,
+        )
+        assert tuned.text == cont  # memorization served through the engine
+        assert plain.text != cont
+    finally:
+        eng.stop_sync()
+
+
+def test_train_adapter_qlora_int8_base():
+    """QLoRA shape: the frozen base is int8-quantized; training still
+    converges (gradients flow only through the f32 factors)."""
+    from gofr_tpu.ops.quant import Q8
+    from gofr_tpu.parallel.sharding import make_lora_train_step
+    from gofr_tpu.serving.engine import InferenceEngine as _E
+
+    eng = _E(
+        "llama-tiny", n_slots=2, max_len=64, tokenizer=ByteTokenizer(),
+        quant="int8",
+    )
+    base = eng.params
+    eng.close()
+    assert isinstance(base["layers"]["wq"], Q8)
+    cfg = get_model("llama-tiny").config
+    init_state, step = make_lora_train_step(
+        cfg, base, rank=4, learning_rate=3e-3
+    )
+    lora, opt = init_state(jax.random.PRNGKey(1))
+    toks = jnp.asarray(_memorize_tokens())[None, :64]
+    first = last = None
+    for _ in range(30):
+        loss, lora, opt = step(lora, opt, toks)
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert last < first * 0.8
+
+
+def test_train_adapter_on_mesh():
+    """LoRA factors shard with their base projections (minus the adapter
+    axis) over a dp×tp mesh; one step runs and the loss is finite."""
+    from gofr_tpu.parallel import make_mesh
+    from gofr_tpu.parallel.sharding import (
+        make_lora_train_step,
+        named_shardings,
+        prune_specs,
+    )
+    from gofr_tpu.models.transformer import transformer_param_specs
+
+    mesh = make_mesh({"dp": 2, "tp": 2})
+    specs = prune_specs(transformer_param_specs(CFG), mesh)
+    base = jax.jit(
+        lambda k: init_transformer(k, CFG),
+        out_shardings=named_shardings(specs, mesh),
+    )(jax.random.PRNGKey(0))
+    init_state, step = make_lora_train_step(
+        CFG, base, rank=4, mesh=mesh, learning_rate=3e-3
+    )
+    lora, opt = init_state(jax.random.PRNGKey(1))
+    assert "tp" in str(lora["wq"][1].sharding.spec)  # b shards out over tp
+    toks = jnp.asarray(_memorize_tokens())[None, :64]
+    toks = jnp.broadcast_to(toks, (2, 64))
+    loss, lora, opt = step(lora, opt, toks)
+    assert np.isfinite(float(loss))
+
+
 def test_grpc_kwargs_pass_adapter():
     """Both gRPC surfaces (JSON + typed proto) forward the adapter."""
     from gofr_tpu.grpc import inference_pb2
