@@ -20,7 +20,9 @@ BENCH_WINDOW (default 8), BENCH_DEPTH (default 2), BENCH_MEGA
 (mega-window dispatch amortization; default 8 on TPU, 0 = streaming
 pipelined mode elsewhere), BENCH_PREFILL_DEPTH (multi-chunk prefill),
 BENCH_QUANT (default int8 on TPU — weight-only int8, the production
-serving configuration; set BENCH_QUANT=none for bf16 weights).
+serving configuration; set BENCH_QUANT=none for bf16 weights),
+BENCH_LORA / BENCH_LORA_RANK (N random adapters, requests round-robin
+over base + adapters — the multi-LoRA overhead A/B).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
 steady-state; the reported value is then the mid-window sustained rate,
 with the end-to-end rate in e2e_tps; set both to 0 for the synchronized
@@ -121,7 +123,8 @@ def run_with_retry() -> int:
     for knob in ("BENCH_MODEL", "BENCH_NEW_TOKENS", "BENCH_SLOTS",
                  "BENCH_MAX_LEN", "BENCH_QUANT", "BENCH_SPEC",
                  "BENCH_KV_BLOCK", "BENCH_KV_QUANT", "GOFR_TPU_FLASH_DECODE",
-                 "BENCH_ARRIVAL_MS", "BENCH_TOKEN_SPREAD", "BENCH_MEGA"):
+                 "BENCH_ARRIVAL_MS", "BENCH_TOKEN_SPREAD", "BENCH_MEGA",
+                 "BENCH_LORA", "BENCH_LORA_RANK"):
         env.pop(knob, None)
     env["BENCH_REQUESTS"] = "8"
     # The production dispatch-amortizer is part of the engine now; the
@@ -252,11 +255,17 @@ def main() -> None:
     # the production throughput configuration; BENCH_MEGA=0 restores the
     # streaming-granularity pipelined mode (the pre-r4 campaign rows).
     mega = int(os.environ.get("BENCH_MEGA", "8" if on_tpu else "0"))
+    # Multi-LoRA workload: BENCH_LORA=N loads N random rank-BENCH_LORA_RANK
+    # adapters and assigns requests round-robin over (base + adapters) —
+    # measures the per-slot gather + rank-einsum cost of heterogeneous
+    # adapter batches against the same config with BENCH_LORA=0.
+    n_lora = int(os.environ.get("BENCH_LORA", "0"))
+    lora_rank = int(os.environ.get("BENCH_LORA_RANK", "16"))
 
     log(f"bench: platform={platform} model={model} requests={n_requests} "
         f"new_tokens={new_tokens} slots={n_slots} quant={quant or 'bf16'} "
         f"kv_quant={kv_quant or 'bf16'} spec={spec_tokens} "
-        f"kv_block={kv_block} mega={mega}")
+        f"kv_block={kv_block} mega={mega} lora={n_lora}")
 
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -273,9 +282,38 @@ def main() -> None:
         kv_block=kv_block,
         mega_windows=mega,
         prefill_depth=int(os.environ.get("BENCH_PREFILL_DEPTH", "1")),
+        lora_slots=n_lora,
+        lora_rank=lora_rank,
     )
     engine.start_sync()
     log(f"engine up in {time.time() - t0:.1f}s")
+    adapters = [""]
+    if n_lora:
+        import jax as _jax
+
+        from gofr_tpu.models.transformer import lora_dims
+
+        _set_stage("lora-load")
+        for ai in range(n_lora):
+            leaves = {}
+            for ti, t in enumerate(("wq", "wk", "wv", "wo")):
+                d_in, d_out = lora_dims(engine.cfg, t)
+                k1, k2 = _jax.random.split(
+                    _jax.random.fold_in(_jax.random.PRNGKey(1000 + ai), ti),
+                    2,
+                )
+                leaves[t] = (
+                    0.02 * _jax.random.normal(
+                        k1, (engine.cfg.n_layers, d_in, lora_rank)
+                    ),
+                    0.02 * _jax.random.normal(
+                        k2, (engine.cfg.n_layers, lora_rank, d_out)
+                    ),
+                )
+            engine.load_lora(f"bench-{ai}", leaves)
+            adapters.append(f"bench-{ai}")
+        log(f"loaded {n_lora} rank-{lora_rank} adapters; requests cycle "
+            f"over base + adapters")
 
     prompt = "The quick brown fox jumps over the lazy dog. " * 3  # ~135 bytes
 
@@ -350,7 +388,8 @@ def main() -> None:
         if spread > 0:
             nt = max(8, int(new_tokens * (1 - spread + 2 * spread * rng.random())))
         reqs.append(engine.submit_generate(
-            prompt, max_new_tokens=nt, temperature=0.0, stop_on_eos=False
+            prompt, max_new_tokens=nt, temperature=0.0, stop_on_eos=False,
+            adapter=adapters[i % len(adapters)],
         ))
     results = [r.future.result(timeout=1800) for r in reqs]
     # NB: must not be named `wall` — that would rebind the watchdog
@@ -425,6 +464,7 @@ def main() -> None:
         "model": model,
         "workload": workload,
         "e2e_tps": round(tps, 2),
+        **({"lora": n_lora} if n_lora else {}),
     }), flush=True)
 
     # Skip interpreter teardown: the TPU runtime client keeps background

@@ -2366,6 +2366,7 @@ class InferenceEngine:
                     self._seeds_dev, self._tokens_dev, self._logps_dev,
                     self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
                     self._bval_dev, self._topi_dev, self._topl_dev,
+                    self._aids_dev,
                     use_bias=False,
                 )
             )
@@ -2385,7 +2386,7 @@ class InferenceEngine:
                 active, self._nsteps_dev, tdev, gdev, pdev,
                 self._fpen_dev, self._ppen_dev, self._pcounts_dev,
                 self._seeds_dev, self._bidx_dev, self._bval_dev,
-                self._topi_dev, self._topl_dev,
+                self._topi_dev, self._topl_dev, self._aids_dev,
                 k=self.window_k, use_bias=False,
             )
             (emitted, _etops, self._tokens_dev, self._logps_dev, self.cache,

@@ -63,3 +63,6 @@ run r4-8b-8k-paged BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH
 run r4-8b-8k-paged-mega8 BENCH_MODEL=llama-3-8b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_KV_QUANT=int8 BENCH_KV_BLOCK=512 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=8
 # 8. Long-prompt TTFT A/B: multi-chunk prefill on vs off (4k prompts).
 run r4-1b-4k-pd8 BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_REQUESTS=32 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=0
+# 9. Multi-LoRA serving overhead: 4 rank-16 adapters round-robin vs base.
+run r4-1b-lora4 BENCH_MODEL=llama-1b BENCH_LORA=4 BENCH_MEGA=0
+run r4-1b-lora4-mega8 BENCH_MODEL=llama-1b BENCH_LORA=4 BENCH_MEGA=8
