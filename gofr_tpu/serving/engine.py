@@ -126,8 +126,11 @@ class _GenRequest:
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1
-    # Multi-LoRA: adapter slot index (0 = base model, no adapter).
+    # Multi-LoRA: adapter slot index (0 = base model, no adapter) and
+    # the slot's load-generation at submit time (prefix_store requests
+    # whose adapter was reloaded/unloaded in flight must not register).
     aid: int = 0
+    lora_gen: int = 0
 
 
 @dataclass
@@ -505,13 +508,11 @@ class InferenceEngine:
                 t.strip() for t in lora_targets.split(",") if t.strip()
             )
             self._lora_names: dict[str, int] = {}
+            # Per-adapter-slot load generation: bumped by every load/
+            # unload so in-flight prefix registrations against an old
+            # generation can be detected and dropped.
+            self._lora_gen = [0] * (self.lora_slots + 1)
             if self.lora_slots:
-                if prefix_slots > 0:
-                    raise ValueError(
-                        "TPU_LORA_SLOTS and TPU_PREFIX_SLOTS are mutually "
-                        "exclusive: pooled prefix K/V is computed with the "
-                        "base model and would corrupt adapter requests"
-                    )
                 from gofr_tpu.models.transformer import (
                     init_lora,
                     lora_param_specs,
@@ -1686,7 +1687,10 @@ class InferenceEngine:
             self._seeds_dirty = True
             state = _PrefillState(request=req)
             if self._prefix_pool is not None and not req.prefix_store:
-                idx, plen = self._prefix_pool.lookup(req.prompt_ids)
+                # Per-adapter pools: pooled K/V is a function of the
+                # weights that prefilled it, so a request only reuses a
+                # prefix registered under its OWN adapter.
+                idx, plen = self._prefix_pool.lookup(req.prompt_ids, req.aid)
                 if idx >= 0:
                     # Copy pooled KV rows in; prefill only the remainder.
                     # done < len(prompt) always, so the final chunk still
@@ -1859,12 +1863,22 @@ class InferenceEngine:
                 del self._prefilling[slot]
                 if st.request.prefix_store:
                     # Park the rows in the pool instead of decoding; the
-                    # slot goes straight back to the free list.
-                    idx = self._prefix_pool.store(
-                        st.request.prompt_ids, self.cache, slot
-                    )
-                    if not st.request.future.done():
-                        st.request.future.set_result(idx)
+                    # slot goes straight back to the free list. A prefix
+                    # whose adapter was reloaded/unloaded while this
+                    # prefill was in flight prefilled under the WRONG
+                    # weights — drop it (resolve -1) instead of
+                    # registering stale K/V under a reusable slot id.
+                    r_aid = st.request.aid
+                    if r_aid and st.request.lora_gen != self._lora_gen[r_aid]:
+                        if not st.request.future.done():
+                            st.request.future.set_result(-1)
+                    else:
+                        idx = self._prefix_pool.store(
+                            st.request.prompt_ids, self.cache, slot,
+                            r_aid,
+                        )
+                        if not st.request.future.done():
+                            st.request.future.set_result(idx)
                     st.request.stream.put(None)
                 else:
                     seq = _ActiveSeq(request=st.request, last_token=-1)
@@ -2656,6 +2670,12 @@ class InferenceEngine:
                     f"all {self.lora_slots} adapter slots in use "
                     f"(TPU_LORA_SLOTS); unload_lora one first"
                 )
+        # New weights for this slot: invalidate pooled prefixes computed
+        # under the previous occupant (reload keeps the same idx; a fresh
+        # idx may still have stale entries from a late in-flight store).
+        self._lora_gen[idx] += 1
+        if self._prefix_pool is not None:
+            self._prefix_pool.purge_aid(idx)
         layers = dict(self.params["layers"])
         # Zero the WHOLE slot first: a reload with fewer targets than the
         # previous version must not leave the old version's deltas live.
@@ -2696,6 +2716,11 @@ class InferenceEngine:
         idx = self._lora_names.pop(name, None)
         if idx is None:
             raise KeyError(f"no loaded LoRA adapter {name!r}")
+        self._lora_gen[idx] += 1
+        if self._prefix_pool is not None:
+            # The adapter slot id may be reused by a later load; pooled
+            # prefixes prefilled under it are stale the moment it frees.
+            self._prefix_pool.purge_aid(idx)
         layers = dict(self.params["layers"])
         for t in self._lora_targets:
             for suffix in ("_lora_a", "_lora_b"):
@@ -2716,14 +2741,22 @@ class InferenceEngine:
             return []
         return sorted(self._lora_names)
 
-    def register_prefix(self, prompt: str | list[int]) -> _GenRequest:
+    def register_prefix(
+        self, prompt: str | list[int], adapter: str = ""
+    ) -> _GenRequest:
         """Prefill a shared prompt prefix ONCE and park its KV rows in the
         device prefix pool; later prompts starting with it skip straight
         to their remainder (admission-time row copy). The request's future
         resolves with the pool row index. Requires ``prefix_slots > 0``
-        (``TPU_PREFIX_SLOTS``)."""
+        (``TPU_PREFIX_SLOTS``). With ``adapter``, the prefix prefills
+        under that LoRA adapter and only same-adapter requests reuse it."""
         if self.family != "llm":
             raise RuntimeError("prefix registration is for llm engines")
+        aid = 0
+        if adapter:
+            if adapter not in self._lora_names:
+                raise KeyError(f"no loaded LoRA adapter {adapter!r}")
+            aid = self._lora_names[adapter]
         if self._prefix_pool is None:
             raise RuntimeError(
                 "prefix pool disabled — construct the engine with "
@@ -2741,13 +2774,18 @@ class InferenceEngine:
             raise ErrorPromptTooLong(len(ids), self.max_prompt_tokens)
         req = _GenRequest(
             prompt_ids=ids, max_new_tokens=1, temperature=0.0,
-            stop_on_eos=False, prefix_store=True,
+            stop_on_eos=False, prefix_store=True, aid=aid,
+            lora_gen=self._lora_gen[aid] if aid else 0,
         )
         self._enqueue(req)
         return req
 
-    def register_prefix_sync(self, prompt, timeout: float = 300.0) -> int:
-        return self.register_prefix(prompt).future.result(timeout=timeout)
+    def register_prefix_sync(
+        self, prompt, timeout: float = 300.0, adapter: str = ""
+    ) -> int:
+        return self.register_prefix(prompt, adapter=adapter).future.result(
+            timeout=timeout
+        )
 
     def generate_sync(self, prompt, timeout: float = 300.0, **kw) -> GenerationResult:
         return self.submit_generate(prompt, **kw).future.result(timeout=timeout)

@@ -16,12 +16,18 @@ bucket sizes means a handful of compiles, and positions ≥ the copied
 bucket are never attended (attention masks by slot length; the
 remainder's prefill overwrites the boundary before it is read).
 
-Registry (token-tuple → pool row + length) lives host-side in the
-scheduler thread; eviction is LRU over registered prefixes.
+Registry ((adapter, token-tuple) → pool row + length) lives host-side
+in the scheduler thread; eviction is LRU over registered prefixes.
+Multi-LoRA composition: pooled K/V is a function of the weights that
+prefilled it, so entries are keyed by the adapter slot id and a request
+only ever reuses a prefix prefilled under its OWN adapter (base
+requests match only base-prefilled prefixes); unloading an adapter
+purges its entries.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from functools import partial
 from typing import Optional
@@ -38,8 +44,13 @@ class PrefixPool:
 
         self.n_entries = n_entries
         self.max_len = cache.max_len
-        # registry: token-tuple → pool row; ordered for LRU eviction.
-        self._registry: "OrderedDict[tuple[int, ...], int]" = OrderedDict()
+        # registry: (aid, token-tuple) → pool row; ordered for LRU
+        # eviction. aid is the engine's adapter slot (0 = base).
+        # The lock serializes registry access: lookup/store run in the
+        # scheduler thread, but purge_aid runs in whichever thread calls
+        # load_lora/unload_lora.
+        self._lock = threading.Lock()
+        self._registry: "OrderedDict[tuple, int]" = OrderedDict()
 
         def make_pool():
             def like(arr):
@@ -110,41 +121,61 @@ class PrefixPool:
         self._load_fn = load
 
     def __len__(self) -> int:
-        return len(self._registry)
+        with self._lock:
+            return len(self._registry)
 
     def _bucket(self, plen: int) -> int:
         b = -(-plen // _COPY_BUCKET) * _COPY_BUCKET
         return min(b, self.max_len)
 
-    def lookup(self, ids) -> tuple[int, int]:
-        """Longest registered prefix of ``ids`` → (pool_row, prefix_len);
-        (-1, 0) on miss. Hit refreshes LRU order."""
+    def lookup(self, ids, aid: int = 0) -> tuple[int, int]:
+        """Longest prefix of ``ids`` registered under adapter ``aid`` →
+        (pool_row, prefix_len); (-1, 0) on miss. Hit refreshes LRU
+        order."""
         best: Optional[tuple[int, ...]] = None
         ids = tuple(ids)
-        for prefix in self._registry:
-            if len(prefix) <= len(ids) and ids[: len(prefix)] == prefix:
-                if best is None or len(prefix) > len(best):
-                    best = prefix
-        if best is None:
-            return -1, 0
-        self._registry.move_to_end(best)
-        return self._registry[best], len(best)
+        with self._lock:
+            for key in self._registry:
+                p_aid, prefix = key
+                if p_aid != aid:
+                    continue
+                if len(prefix) <= len(ids) and ids[: len(prefix)] == prefix:
+                    if best is None or len(prefix) > len(best):
+                        best = prefix
+            if best is None:
+                return -1, 0
+            self._registry.move_to_end((aid, best))
+            return self._registry[(aid, best)], len(best)
 
-    def store(self, ids, cache, slot: int) -> int:
+    def store(self, ids, cache, slot: int, aid: int = 0) -> int:
         """Copy a just-prefilled slot's prefix rows into the pool."""
-        ids = tuple(ids)
-        if ids in self._registry:
-            idx = self._registry[ids]
-        elif len(self._registry) < self.n_entries:
-            idx = len(self._registry)
-        else:  # LRU eviction
-            _, idx = self._registry.popitem(last=False)
-        self._pool = self._store_fn(
-            self._pool, cache, idx, slot, self._bucket(len(ids))
-        )
-        self._registry[ids] = idx
-        self._registry.move_to_end(ids)
-        return idx
+        key = (aid, tuple(ids))
+        with self._lock:
+            if key in self._registry:
+                idx = self._registry[key]
+            elif len(self._registry) < self.n_entries:
+                # Rows freed by purge_aid are reusable: pick the smallest
+                # row index not currently referenced.
+                used = set(self._registry.values())
+                idx = next(i for i in range(self.n_entries) if i not in used)
+            else:  # LRU eviction
+                _, idx = self._registry.popitem(last=False)
+            self._pool = self._store_fn(
+                self._pool, cache, idx, slot, self._bucket(len(key[1]))
+            )
+            self._registry[key] = idx
+            self._registry.move_to_end(key)
+            return idx
+
+    def purge_aid(self, aid: int) -> int:
+        """Drop every prefix registered under adapter ``aid`` (called on
+        unload_lora — the slot id may be reused by a different adapter).
+        Device rows stay; they are simply unreferenced. Returns count."""
+        with self._lock:
+            stale = [k for k in self._registry if k[0] == aid]
+            for k in stale:
+                del self._registry[k]
+            return len(stale)
 
     def load(self, cache, idx: int, slot: int, plen: int):
         """Returns the cache with pool row ``idx``'s prefix copied into

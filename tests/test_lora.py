@@ -316,6 +316,135 @@ def test_adapter_slot_management():
         eng.stop_sync()
 
 
+def test_prefix_pool_per_adapter():
+    """Prefix-KV reuse composes with LoRA: a prefix registered under an
+    adapter is reused ONLY by same-adapter requests, outputs match the
+    no-pool engines exactly, and unloading the adapter purges its
+    pooled prefixes."""
+    leaves = _rand_adapter(91)
+    prefix = "system: answer briefly. "
+    suffix = "hello there"
+    kw = dict(
+        n_slots=4, max_len=128, window_k=4, tokenizer=ByteTokenizer(),
+        lora_slots=2, lora_rank=4,
+    )
+    eng = InferenceEngine("llama-tiny-f32", prefix_slots=2, **kw)
+    eng.start_sync()
+    try:
+        eng.load_lora("t", leaves)
+        eng.register_prefix_sync(prefix)
+        eng.register_prefix_sync(prefix, adapter="t")
+        assert len(eng._prefix_pool) == 2
+        got_base = _gen(eng, prefix + suffix)
+        got_tuned = _gen(eng, prefix + suffix, adapter="t")
+        ref = InferenceEngine("llama-tiny-f32", **kw)
+        ref.start_sync()
+        try:
+            ref.load_lora("t", leaves)
+            assert got_base == _gen(ref, prefix + suffix)
+            assert got_tuned == _gen(ref, prefix + suffix, adapter="t")
+        finally:
+            ref.stop_sync()
+        assert got_base != got_tuned
+        eng.unload_lora("t")
+        assert len(eng._prefix_pool) == 1  # adapter prefix purged
+    finally:
+        eng.stop_sync()
+
+
+def test_prefix_pool_purged_on_adapter_reload():
+    """Re-loading an adapter name invalidates its pooled prefixes (the
+    pooled K/V was computed under the old weights), and a prefix
+    registration still in flight across the reload is dropped with -1
+    instead of registering stale rows."""
+    v1, v2 = _rand_adapter(95), _rand_adapter(96)
+    kw = dict(
+        n_slots=4, max_len=128, window_k=4, tokenizer=ByteTokenizer(),
+        lora_slots=2, lora_rank=4, prefix_slots=2,
+    )
+    eng = InferenceEngine("llama-tiny-f32", **kw)
+    eng.start_sync()
+    try:
+        eng.load_lora("t", v1)
+        eng.register_prefix_sync("shared preamble. ", adapter="t")
+        assert len(eng._prefix_pool) == 1
+        eng.load_lora("t", v2)  # reload → v1-weight prefix must die
+        assert len(eng._prefix_pool) == 0
+        got = _gen(eng, "shared preamble. hi", adapter="t")
+        ref = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(),
+            params=_merged_params(eng.params, v2),
+        )
+        ref.start_sync()
+        try:
+            assert got == _gen(ref, "shared preamble. hi")
+        finally:
+            ref.stop_sync()
+    finally:
+        eng.stop_sync()
+
+    # In-flight registration racing a reload: whichever side wins, no
+    # stale entry may survive — either the store is dropped (-1) or the
+    # reload's purge removes the just-stored entry.
+    eng = InferenceEngine("llama-tiny-f32", **kw)
+    eng.start_sync()
+    try:
+        eng.load_lora("t", v1)
+        req = eng.register_prefix("stale preamble. ", adapter="t")
+        eng.load_lora("t", v2)
+        res = req.future.result(timeout=120)
+        assert res == -1 or len(eng._prefix_pool) == 0
+        assert len(eng._prefix_pool) == 0
+    finally:
+        eng.stop_sync()
+
+
+def test_adapter_churn_under_load():
+    """load_lora/unload_lora while the engine is serving: in-flight base
+    streams must be unaffected, every request must complete, and the
+    engine must return to idle with all slots free."""
+    import threading
+
+    eng = _engine()
+    try:
+        expected = _gen(eng, "hello", n=24)
+        stop = threading.Event()
+        churn_err = []
+
+        def churn():
+            i = 0
+            try:
+                while not stop.is_set():
+                    name = f"churn-{i % 2}"
+                    eng.load_lora(name, _rand_adapter(100 + i % 3))
+                    eng.unload_lora(name)
+                    i += 1
+            except Exception as exc:  # noqa: BLE001
+                churn_err.append(exc)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            reqs = [
+                eng.submit_generate(
+                    "hello", max_new_tokens=24, temperature=0.0,
+                    stop_on_eos=False,
+                )
+                for _ in range(8)
+            ]
+            outs = [r.future.result(timeout=120).token_ids for r in reqs]
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not churn_err, churn_err
+        assert all(o == expected for o in outs)
+        assert eng.lora_names() == []
+        assert all(s is None for s in eng._slots)
+    finally:
+        eng.stop_sync()
+
+
 def test_engine_without_lora_rejects():
     eng = InferenceEngine(
         "llama-tiny-f32", n_slots=2, max_len=64,
