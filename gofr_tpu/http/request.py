@@ -121,8 +121,14 @@ class Request:
         fields: dict[str, str] = {}
         files: dict[str, UploadedFile] = {}
         for part in self._raw.body.split(boundary)[1:]:
-            part = part.strip(b"\r\n")
-            if part in (b"", b"--"):
+            # Strip exactly the delimiter CRLFs, not all leading/trailing
+            # newline bytes — file DATA may legitimately end in newlines
+            # (e.g. a JSONL upload) and must round-trip byte-exact.
+            if part.startswith(b"\r\n"):
+                part = part[2:]
+            if part.endswith(b"\r\n"):
+                part = part[:-2]
+            if part.strip(b"\r\n \t") in (b"", b"--"):
                 continue
             header_blob, _, content = part.partition(b"\r\n\r\n")
             headers: dict[str, str] = {}

@@ -1,0 +1,378 @@
+"""OpenAI Files + Batches API: offline batch inference over the online
+surface.
+
+``POST /v1/files`` (multipart, purpose=batch) uploads a JSONL request
+file; ``POST /v1/batches`` runs every line — ``{"custom_id", "method":
+"POST", "url": "/v1/chat/completions" | "/v1/completions" |
+"/v1/embeddings", "body": {...}}`` — and produces OpenAI-shaped output
+and error files, polled via ``GET /v1/batches/{id}`` and downloaded via
+``GET /v1/files/{id}/content``.
+
+Design: each line dispatches through the app's OWN router in-process
+(the exact online code path — model/adapter routing, validation errors,
+middleware spans and metrics all behave identically to a live HTTP
+call), and the serving engine's continuous batching coalesces the
+concurrent lines onto the chips; a bounded semaphore just keeps the
+admission queue sane. This is the API-level twin of the pub/sub offline
+path (``subscriber → infer → publisher``, BASELINE config 4): same
+engine machinery, jobs-over-HTTP instead of jobs-over-broker.
+
+Reference analog: none (GoFr has no async-job API); the storage shape
+follows its in-memory idioms, and files/batches live in process memory
+— per-replica, like the prefix pool. A 24h completion window is
+accepted and ignored (batches start immediately).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from gofr_tpu.errors import ErrorEntityNotFound, ErrorInvalidParam
+from gofr_tpu.http.proto import RawRequest
+from gofr_tpu.http.responder import File as FileResponse, Raw
+
+_ENDPOINTS = ("/v1/chat/completions", "/v1/completions", "/v1/embeddings")
+_MAX_CONCURRENCY = 32
+
+
+@dataclass
+class _StoredFile:
+    id: str
+    filename: str
+    purpose: str
+    content: bytes
+    created_at: int
+
+    def meta(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "file",
+            "bytes": len(self.content),
+            "created_at": self.created_at,
+            "filename": self.filename,
+            "purpose": self.purpose,
+        }
+
+
+@dataclass
+class _Batch:
+    id: str
+    endpoint: str
+    input_file_id: str
+    completion_window: str
+    metadata: Optional[dict]
+    created_at: int
+    status: str = "validating"
+    output_file_id: Optional[str] = None
+    error_file_id: Optional[str] = None
+    errors: Optional[dict] = None
+    in_progress_at: Optional[int] = None
+    completed_at: Optional[int] = None
+    cancelled_at: Optional[int] = None
+    counts: dict = field(
+        default_factory=lambda: {"total": 0, "completed": 0, "failed": 0}
+    )
+    _cancel: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "object": "batch",
+            "endpoint": self.endpoint,
+            "errors": self.errors,
+            "input_file_id": self.input_file_id,
+            "completion_window": self.completion_window,
+            "status": self.status,
+            "output_file_id": self.output_file_id,
+            "error_file_id": self.error_file_id,
+            "created_at": self.created_at,
+            "in_progress_at": self.in_progress_at,
+            "completed_at": self.completed_at,
+            "cancelled_at": self.cancelled_at,
+            "request_counts": dict(self.counts),
+            "metadata": self.metadata,
+        }
+
+
+class BatchStore:
+    """In-memory files + batches + the batch runner."""
+
+    def __init__(self, app) -> None:
+        self._app = app
+        self.files: dict[str, _StoredFile] = {}
+        self.batches: dict[str, _Batch] = {}
+        # Strong refs to runner tasks: asyncio keeps only weak ones, and
+        # a GC'd runner would strand its batch in 'in_progress'.
+        self._tasks: set = set()
+
+    # -- files -----------------------------------------------------------
+
+    def add_file(self, filename: str, purpose: str, content: bytes) -> dict:
+        fid = f"file-{uuid.uuid4().hex[:24]}"
+        self.files[fid] = _StoredFile(
+            fid, filename, purpose, content, int(time.time())
+        )
+        return self.files[fid].meta()
+
+    # -- batch execution -------------------------------------------------
+
+    async def _dispatch_line(self, batch: _Batch, line: dict) -> tuple:
+        """One JSONL request line through the app router. Returns
+        (custom_id, status_code, body_dict_or_error)."""
+        if not isinstance(line, dict):
+            return (
+                None,
+                400,
+                {"error": {"message": "line must be a JSON object"}},
+            )
+        custom_id = line.get("custom_id")
+        method = (line.get("method") or "POST").upper()
+        url = line.get("url")
+        body = line.get("body")
+        if (
+            not isinstance(custom_id, str)
+            or method != "POST"
+            or url != batch.endpoint
+            or not isinstance(body, dict)
+        ):
+            return (
+                custom_id,
+                400,
+                {
+                    "error": {
+                        "message": (
+                            "line must be {custom_id: str, method: 'POST', "
+                            f"url: {batch.endpoint!r}, body: object}}"
+                        )
+                    }
+                },
+            )
+        if body.get("stream"):
+            return (
+                custom_id,
+                400,
+                {"error": {"message": "stream is not supported in batches"}},
+            )
+        raw = RawRequest(
+            method="POST",
+            target=batch.endpoint,
+            version="HTTP/1.1",
+            headers={"content-type": "application/json"},
+            body=json.dumps(body).encode(),
+        )
+        resp = await self._app.router(raw)
+        try:
+            payload = json.loads(resp.body or b"{}")
+        except json.JSONDecodeError:
+            payload = {"error": {"message": "non-JSON handler response"}}
+        return custom_id, resp.status, payload
+
+    async def run_batch(self, batch: _Batch) -> None:
+        # Any escape from the runner must land the batch in a terminal
+        # state — a stuck 'in_progress' hangs every poller.
+        try:
+            await self._run_batch(batch)
+        except Exception as exc:  # noqa: BLE001
+            batch.status = "failed"
+            batch.errors = {
+                "object": "list",
+                "data": [{
+                    "code": "runner_error",
+                    "message": f"{type(exc).__name__}: {exc}",
+                }],
+            }
+
+    async def _run_batch(self, batch: _Batch) -> None:
+        inp = self.files[batch.input_file_id]
+        lines = []
+        try:
+            for ln in inp.content.decode("utf-8").splitlines():
+                if ln.strip():
+                    lines.append(json.loads(ln))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            batch.status = "failed"
+            batch.errors = {
+                "object": "list",
+                "data": [{
+                    "code": "invalid_jsonl",
+                    "message": f"input file is not valid JSONL: {exc}",
+                }],
+            }
+            return
+        batch.counts["total"] = len(lines)
+        batch.status = "in_progress"
+        batch.in_progress_at = int(time.time())
+
+        sem = asyncio.Semaphore(_MAX_CONCURRENCY)
+        results: list = [None] * len(lines)
+
+        async def one(i: int, line: dict) -> None:
+            async with sem:
+                if batch._cancel:
+                    return
+                results[i] = await self._dispatch_line(batch, line)
+
+        await asyncio.gather(*(one(i, ln) for i, ln in enumerate(lines)))
+
+        out_lines, err_lines = [], []
+        for i, res in enumerate(results):
+            if res is None:  # cancelled before dispatch
+                continue
+            custom_id, status, payload = res
+            rid = f"batch_req_{batch.id[len('batch_'):]}_{i}"
+            if status == 200:
+                batch.counts["completed"] += 1
+                out_lines.append(json.dumps({
+                    "id": rid,
+                    "custom_id": custom_id,
+                    "response": {
+                        "status_code": status,
+                        "request_id": rid,
+                        "body": payload,
+                    },
+                    "error": None,
+                }))
+            else:
+                batch.counts["failed"] += 1
+                msg = (
+                    payload.get("error", {}).get("message")
+                    if isinstance(payload.get("error"), dict)
+                    else str(payload)
+                )
+                err_lines.append(json.dumps({
+                    "id": rid,
+                    "custom_id": custom_id,
+                    "response": {"status_code": status, "body": payload},
+                    "error": {"code": str(status), "message": msg},
+                }))
+        if out_lines:
+            batch.output_file_id = self.add_file(
+                f"{batch.id}_output.jsonl", "batch_output",
+                ("\n".join(out_lines) + "\n").encode(),
+            )["id"]
+        if err_lines:
+            batch.error_file_id = self.add_file(
+                f"{batch.id}_errors.jsonl", "batch_output",
+                ("\n".join(err_lines) + "\n").encode(),
+            )["id"]
+        if batch._cancel:
+            batch.status = "cancelled"
+            batch.cancelled_at = int(time.time())
+        else:
+            batch.status = "completed"
+            batch.completed_at = int(time.time())
+
+
+def add_openai_batch_routes(app) -> BatchStore:
+    """Register /v1/files + /v1/batches on a gofr_tpu App. Returns the
+    store (tests and ops can reach in)."""
+    store = BatchStore(app)
+
+    @app.post("/v1/files")
+    async def upload_file(ctx):  # noqa: ANN001
+        bound = ctx.request.bind({})
+        part = bound.get("file")
+        purpose = bound.get("purpose") or ""
+        if part is None or not hasattr(part, "data"):
+            raise ErrorInvalidParam([
+                "multipart field 'file' (the JSONL upload) is required"
+            ])
+        if purpose != "batch":
+            raise ErrorInvalidParam(["purpose must be 'batch'"])
+        return Raw(
+            store.add_file(part.filename or "upload.jsonl", purpose, part.data),
+            status=200,
+        )
+
+    @app.get("/v1/files/{id}")
+    async def file_meta(ctx):  # noqa: ANN001
+        fid = ctx.request.path_param("id")
+        f = store.files.get(fid)
+        if f is None:
+            raise ErrorEntityNotFound("file", fid)
+        return Raw(f.meta())
+
+    @app.get("/v1/files/{id}/content")
+    async def file_content(ctx):  # noqa: ANN001
+        fid = ctx.request.path_param("id")
+        f = store.files.get(fid)
+        if f is None:
+            raise ErrorEntityNotFound("file", fid)
+        # octet-stream, like the upstream API: downloads are raw bytes.
+        return FileResponse(f.content, content_type="application/octet-stream")
+
+    @app.post("/v1/batches")
+    async def create_batch(ctx):  # noqa: ANN001
+        body = ctx.request.json()
+        if not isinstance(body, dict):
+            raise ErrorInvalidParam(["body"])
+        endpoint = body.get("endpoint")
+        input_file_id = body.get("input_file_id")
+        if endpoint not in _ENDPOINTS:
+            raise ErrorInvalidParam([
+                f"endpoint must be one of {list(_ENDPOINTS)}"
+            ])
+        if input_file_id not in store.files:
+            raise ErrorInvalidParam([
+                f"input_file_id {input_file_id!r} is not an uploaded file"
+            ])
+        batch = _Batch(
+            id=f"batch_{uuid.uuid4().hex[:24]}",
+            endpoint=endpoint,
+            input_file_id=input_file_id,
+            completion_window=body.get("completion_window") or "24h",
+            metadata=body.get("metadata"),
+            created_at=int(time.time()),
+        )
+        store.batches[batch.id] = batch
+        task = asyncio.get_running_loop().create_task(
+            store.run_batch(batch)
+        )
+        store._tasks.add(task)
+        task.add_done_callback(store._tasks.discard)
+        return Raw(batch.as_dict(), status=200)
+
+    @app.get("/v1/batches")
+    async def list_batches(ctx):  # noqa: ANN001
+        raw_limit = ctx.request.param("limit") or "20"
+        try:
+            limit = max(0, int(raw_limit))
+        except ValueError:
+            raise ErrorInvalidParam(["limit must be an integer"]) from None
+        data = [
+            b.as_dict()
+            for b in sorted(
+                store.batches.values(), key=lambda b: -b.created_at
+            )[:limit]
+        ]
+        return Raw({
+            "object": "list",
+            "data": data,
+            "has_more": len(store.batches) > limit,
+        })
+
+    @app.get("/v1/batches/{id}")
+    async def get_batch(ctx):  # noqa: ANN001
+        bid = ctx.request.path_param("id")
+        b = store.batches.get(bid)
+        if b is None:
+            raise ErrorEntityNotFound("batch", bid)
+        return Raw(b.as_dict())
+
+    @app.post("/v1/batches/{id}/cancel")
+    async def cancel_batch(ctx):  # noqa: ANN001
+        bid = ctx.request.path_param("id")
+        b = store.batches.get(bid)
+        if b is None:
+            raise ErrorEntityNotFound("batch", bid)
+        if b.status in ("validating", "in_progress"):
+            b._cancel = True
+            b.status = "cancelling"
+        return Raw(b.as_dict(), status=200)  # OpenAI wire-compat POST
+
+    return store

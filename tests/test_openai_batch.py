@@ -1,0 +1,309 @@
+"""OpenAI Files + Batches API: upload a JSONL of requests, run them as a
+batch through the app's own router, poll to completion, download
+OpenAI-shaped output/error files. Batch outputs must equal direct
+online calls (same engine, same code path)."""
+
+from __future__ import annotations
+
+import asyncio
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from gofr_tpu import App
+from gofr_tpu.config import MockConfig
+from gofr_tpu.serving.openai_batch import add_openai_batch_routes
+from gofr_tpu.serving.openai_compat import add_openai_routes
+
+
+@pytest.fixture(scope="module")
+def batch_app():
+    app = App(config=MockConfig({
+        "APP_NAME": "batch-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "4", "TPU_MAX_LEN": "128",
+        "TPU_EMBED_MODEL": "bert-tiny",
+    }))
+    add_openai_routes(app)
+    app.batch_store = add_openai_batch_routes(app)
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=120)
+    yield app
+    asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+    loop.call_soon_threadsafe(loop.stop)
+
+
+def _call(app, method, path, body=None, headers=None):
+    c = http.client.HTTPConnection("127.0.0.1", app.http_port, timeout=120)
+    if isinstance(body, (dict, list)):
+        body = json.dumps(body)
+    c.request(method, path, body=body, headers=headers or {})
+    r = c.getresponse()
+    data = r.read()
+    if "json" not in (r.getheader("Content-Type") or ""):
+        return r.status, data  # raw download (file content)
+    try:
+        return r.status, json.loads(data)
+    except json.JSONDecodeError:
+        return r.status, data
+
+
+def _upload(app, content: bytes, purpose: str = "batch"):
+    boundary = "testboundary42"
+    body = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="purpose"\r\n\r\n'
+        f"{purpose}\r\n"
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="file"; '
+        f'filename="reqs.jsonl"\r\n'
+        f"Content-Type: application/jsonl\r\n\r\n"
+    ).encode() + content + f"\r\n--{boundary}--\r\n".encode()
+    return _call(
+        app, "POST", "/v1/files", body=body,
+        headers={"Content-Type": f"multipart/form-data; boundary={boundary}"},
+    )
+
+
+def _wait_batch(app, bid, timeout=120.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        st, b = _call(app, "GET", f"/v1/batches/{bid}")
+        assert st == 200
+        if b["status"] in ("completed", "failed", "cancelled"):
+            return b
+        time.sleep(0.3)
+    raise AssertionError("batch did not finish")
+
+
+def test_file_upload_and_content(batch_app):
+    st, meta = _upload(batch_app, b'{"x": 1}\n')
+    assert st == 200
+    assert meta["object"] == "file" and meta["purpose"] == "batch"
+    assert meta["bytes"] == len(b'{"x": 1}\n')
+    st, got = _call(batch_app, "GET", f"/v1/files/{meta['id']}")
+    assert st == 200 and got["id"] == meta["id"]
+    st, content = _call(batch_app, "GET", f"/v1/files/{meta['id']}/content")
+    assert st == 200 and content == b'{"x": 1}\n'
+    st, _ = _call(batch_app, "GET", "/v1/files/file-nope")
+    assert st == 404
+    st, err = _upload(batch_app, b"x", purpose="fine-tune")
+    assert st == 400
+
+
+def test_batch_completions_match_online(batch_app):
+    prompts = ["hello there", "general kenobi", "a third prompt"]
+    lines = "\n".join(
+        json.dumps({
+            "custom_id": f"req-{i}",
+            "method": "POST",
+            "url": "/v1/completions",
+            "body": {
+                "model": "llama-tiny", "prompt": p, "max_tokens": 8,
+                "temperature": 0,
+            },
+        })
+        for i, p in enumerate(prompts)
+    ).encode()
+    st, meta = _upload(batch_app, lines)
+    assert st == 200
+    st, batch = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta["id"],
+        "endpoint": "/v1/completions",
+        "completion_window": "24h",
+        "metadata": {"suite": "test"},
+    })
+    assert st == 200 and batch["object"] == "batch"
+    done = _wait_batch(batch_app, batch["id"])
+    assert done["status"] == "completed"
+    assert done["request_counts"] == {
+        "total": 3, "completed": 3, "failed": 0,
+    }
+    assert done["error_file_id"] is None
+    st, out = _call(
+        batch_app, "GET", f"/v1/files/{done['output_file_id']}/content"
+    )
+    assert st == 200
+    rows = [json.loads(x) for x in out.decode().splitlines()]
+    assert {r["custom_id"] for r in rows} == {"req-0", "req-1", "req-2"}
+    by_id = {r["custom_id"]: r for r in rows}
+    for i, p in enumerate(prompts):
+        st, direct = _call(batch_app, "POST", "/v1/completions", {
+            "model": "llama-tiny", "prompt": p, "max_tokens": 8,
+            "temperature": 0,
+        })
+        assert st == 200
+        got = by_id[f"req-{i}"]["response"]
+        assert got["status_code"] == 200
+        assert (
+            got["body"]["choices"][0]["text"]
+            == direct["choices"][0]["text"]
+        )
+
+
+def test_batch_error_lines_and_chat(batch_app):
+    lines = "\n".join([
+        json.dumps({
+            "custom_id": "good",
+            "method": "POST",
+            "url": "/v1/chat/completions",
+            "body": {
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+            },
+        }),
+        json.dumps({
+            "custom_id": "bad-model",
+            "method": "POST",
+            "url": "/v1/chat/completions",
+            "body": {
+                "model": "missing-model",
+                "messages": [{"role": "user", "content": "hi"}],
+            },
+        }),
+        json.dumps({
+            "custom_id": "bad-stream",
+            "method": "POST",
+            "url": "/v1/chat/completions",
+            "body": {
+                "messages": [{"role": "user", "content": "hi"}],
+                "stream": True,
+            },
+        }),
+        json.dumps({"custom_id": "bad-url", "url": "/v1/embeddings",
+                    "body": {}}),
+    ]).encode()
+    st, meta = _upload(batch_app, lines)
+    st, batch = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta["id"], "endpoint": "/v1/chat/completions",
+    })
+    assert st == 200
+    done = _wait_batch(batch_app, batch["id"])
+    assert done["status"] == "completed"
+    assert done["request_counts"]["completed"] == 1
+    assert done["request_counts"]["failed"] == 3
+    st, err = _call(
+        batch_app, "GET", f"/v1/files/{done['error_file_id']}/content"
+    )
+    rows = {json.loads(x)["custom_id"]: json.loads(x)
+            for x in err.decode().splitlines()}
+    assert rows["bad-model"]["response"]["status_code"] == 404
+    assert rows["bad-stream"]["error"]["message"].startswith(
+        "stream is not supported"
+    )
+    st, out = _call(
+        batch_app, "GET", f"/v1/files/{done['output_file_id']}/content"
+    )
+    good = json.loads(out.decode().splitlines()[0])
+    assert good["custom_id"] == "good"
+    msg = good["response"]["body"]["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+
+
+def test_batch_embeddings_endpoint(batch_app):
+    lines = json.dumps({
+        "custom_id": "emb-0",
+        "method": "POST",
+        "url": "/v1/embeddings",
+        "body": {"input": "embed me", "model": "bert-tiny"},
+    }).encode()
+    st, meta = _upload(batch_app, lines)
+    st, batch = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta["id"], "endpoint": "/v1/embeddings",
+    })
+    assert st == 200
+    done = _wait_batch(batch_app, batch["id"])
+    assert done["status"] == "completed"
+    assert done["request_counts"]["completed"] == 1
+    st, out = _call(
+        batch_app, "GET", f"/v1/files/{done['output_file_id']}/content"
+    )
+    row = json.loads(out.decode().splitlines()[0])
+    emb = row["response"]["body"]["data"][0]["embedding"]
+    assert isinstance(emb, list) and len(emb) > 8
+
+
+def test_batch_validation_and_listing(batch_app):
+    st, err = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": "file-nope", "endpoint": "/v1/completions",
+    })
+    assert st == 400
+    st, err = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": "x", "endpoint": "/v2/other",
+    })
+    assert st == 400
+    st, meta = _upload(batch_app, b"not json at all {{{")
+    st, batch = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta["id"], "endpoint": "/v1/completions",
+    })
+    assert st == 200
+    done = _wait_batch(batch_app, batch["id"])
+    assert done["status"] == "failed"
+    assert done["errors"]["data"][0]["code"] == "invalid_jsonl"
+    # A valid-JSON-but-not-object line must fail that LINE, not hang the
+    # batch (the runner used to die on AttributeError → stuck in_progress).
+    st, meta2 = _upload(batch_app, b"42\n")
+    st, b2 = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta2["id"], "endpoint": "/v1/completions",
+    })
+    done2 = _wait_batch(batch_app, b2["id"])
+    assert done2["status"] == "completed"
+    assert done2["request_counts"]["failed"] == 1
+    st, _ = _call(batch_app, "GET", "/v1/batches?limit=abc")
+    assert st == 400
+    st, listing = _call(batch_app, "GET", "/v1/batches")
+    assert st == 200 and listing["object"] == "list"
+    assert any(b["id"] == batch["id"] for b in listing["data"])
+    st, _ = _call(batch_app, "GET", "/v1/batches/batch_nope")
+    assert st == 404
+
+
+def test_batch_cancel(batch_app):
+    # Deterministic mid-flight cancel: every dispatch waits on a gate the
+    # test holds closed until the cancel response has landed, so lines
+    # beyond the runner's concurrency window are provably never issued.
+    store = batch_app.batch_store
+    gate: dict = {}
+    orig = store._dispatch_line
+
+    async def gated(batch, line):
+        if "event" not in gate:
+            gate["event"] = asyncio.Event()
+            gate["loop"] = asyncio.get_running_loop()
+        await gate["event"].wait()
+        return await orig(batch, line)
+
+    store._dispatch_line = gated
+    try:
+        lines = "\n".join(
+            json.dumps({
+                "custom_id": f"slow-{i}",
+                "method": "POST",
+                "url": "/v1/completions",
+                "body": {"prompt": "x", "max_tokens": 8, "temperature": 0},
+            })
+            for i in range(48)  # > the 32-concurrency window
+        ).encode()
+        st, meta = _upload(batch_app, lines)
+        assert st == 200
+        st, batch = _call(batch_app, "POST", "/v1/batches", {
+            "input_file_id": meta["id"], "endpoint": "/v1/completions",
+        })
+        assert st == 200
+        st, b = _call(batch_app, "POST", f"/v1/batches/{batch['id']}/cancel")
+        assert st == 200 and b["status"] in ("cancelling", "cancelled")
+        # Open the gate AFTER the cancel landed: gated in-flight lines
+        # proceed, the 16 still queued at the semaphore are skipped.
+        t0 = time.time()
+        while "event" not in gate and time.time() - t0 < 30:
+            time.sleep(0.05)
+        assert "event" in gate, "runner never reached the gate"
+        gate["loop"].call_soon_threadsafe(gate["event"].set)
+        done = _wait_batch(batch_app, batch["id"])
+        assert done["status"] == "cancelled"
+        assert 0 < done["request_counts"]["completed"] < 48
+    finally:
+        store._dispatch_line = orig
