@@ -67,6 +67,10 @@ class _Batch:
     completion_window: str
     metadata: Optional[dict]
     created_at: int
+    # Auth headers captured from the CREATING request: internal line
+    # dispatch re-runs the full middleware chain, so an authenticated
+    # deployment's auth middleware must see the creator's credentials.
+    auth_headers: dict = field(default_factory=dict)
     status: str = "validating"
     output_file_id: Optional[str] = None
     error_file_id: Optional[str] = None
@@ -162,7 +166,10 @@ class BatchStore:
             method="POST",
             target=batch.endpoint,
             version="HTTP/1.1",
-            headers={"content-type": "application/json"},
+            headers={
+                "content-type": "application/json",
+                **batch.auth_headers,
+            },
             body=json.dumps(body).encode(),
         )
         resp = await self._app.router(raw)
@@ -306,6 +313,14 @@ def add_openai_batch_routes(app) -> BatchStore:
         # octet-stream, like the upstream API: downloads are raw bytes.
         return FileResponse(f.content, content_type="application/octet-stream")
 
+    @app.delete("/v1/files/{id}")
+    async def delete_file(ctx):  # noqa: ANN001
+        fid = ctx.request.path_param("id")
+        if store.files.pop(fid, None) is None:
+            raise ErrorEntityNotFound("file", fid)
+        # 200 + body (OpenAI wire shape), not the framework DELETE→204.
+        return Raw({"id": fid, "object": "file", "deleted": True}, status=200)
+
     @app.post("/v1/batches")
     async def create_batch(ctx):  # noqa: ANN001
         body = ctx.request.json()
@@ -328,6 +343,11 @@ def add_openai_batch_routes(app) -> BatchStore:
             completion_window=body.get("completion_window") or "24h",
             metadata=body.get("metadata"),
             created_at=int(time.time()),
+            auth_headers={
+                k: v
+                for k, v in ctx.request.headers.items()
+                if k in ("authorization", "x-api-key")
+            },
         )
         store.batches[batch.id] = batch
         task = asyncio.get_running_loop().create_task(
@@ -344,16 +364,27 @@ def add_openai_batch_routes(app) -> BatchStore:
             limit = max(0, int(raw_limit))
         except ValueError:
             raise ErrorInvalidParam(["limit must be an integer"]) from None
-        data = [
-            b.as_dict()
-            for b in sorted(
-                store.batches.values(), key=lambda b: -b.created_at
-            )[:limit]
-        ]
+        ordered = sorted(
+            store.batches.values(), key=lambda b: (-b.created_at, b.id)
+        )
+        # OpenAI cursor pagination: `after` names the last id of the
+        # previous page; SDK auto-pagination depends on it.
+        after = ctx.request.param("after")
+        start = 0
+        if after:
+            for i, b in enumerate(ordered):
+                if b.id == after:
+                    start = i + 1
+                    break
+            else:
+                raise ErrorInvalidParam([f"unknown 'after' cursor {after!r}"])
+        page = ordered[start : start + limit]
         return Raw({
             "object": "list",
-            "data": data,
-            "has_more": len(store.batches) > limit,
+            "data": [b.as_dict() for b in page],
+            "first_id": page[0].id if page else None,
+            "last_id": page[-1].id if page else None,
+            "has_more": start + limit < len(ordered),
         })
 
     @app.get("/v1/batches/{id}")

@@ -245,3 +245,53 @@ def test_using_train_example(capsys, tmp_path):
             assert data["tokens"] == 4
         finally:
             conn.close()
+
+
+def test_using_lora_example(capsys, tmp_path):
+    """Train a LoRA adapter → HF-PEFT export → serve it as an OpenAI
+    model id next to the base, one engine."""
+    mod = load_example("using-lora")
+    mod.ADAPTER = str(tmp_path / "adapter")
+    rc = mod.build_cmd().run(["train", "-steps=30"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "final_loss" in out
+    assert os.path.exists(
+        os.path.join(mod.ADAPTER, "adapter_model.safetensors")
+    )
+
+    os.environ["TPU_LORA_ADAPTERS"] = f"tuned={mod.ADAPTER}"
+    try:
+        with Harness(mod.build_app()) as h:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", h.app.http_port, timeout=180
+            )
+            try:
+                conn.request("GET", "/v1/models")
+                models = json.loads(conn.getresponse().read())
+                ids = {m["id"] for m in models["data"]}
+                assert "tuned" in ids
+                body = {
+                    "model": "tuned", "prompt": "gofr serves tp",
+                    "max_tokens": 8, "temperature": 0,
+                }
+                conn.request(
+                    "POST", "/v1/completions", body=json.dumps(body),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                tuned = json.loads(resp.read())
+                assert resp.status == 200
+                conn.request(
+                    "POST", "/v1/completions",
+                    body=json.dumps({**body, "model": "llama-tiny"}),
+                    headers={"Content-Type": "application/json"},
+                )
+                base = json.loads(conn.getresponse().read())
+                assert (
+                    tuned["choices"][0]["text"] != base["choices"][0]["text"]
+                )
+            finally:
+                conn.close()
+    finally:
+        os.environ.pop("TPU_LORA_ADAPTERS", None)

@@ -307,3 +307,95 @@ def test_batch_cancel(batch_app):
         assert 0 < done["request_counts"]["completed"] < 48
     finally:
         store._dispatch_line = orig
+
+
+def test_batch_pagination_and_file_delete(batch_app):
+    # Create 3 tiny batches so pagination is self-contained regardless
+    # of which other tests ran.
+    for _ in range(3):
+        st, meta = _upload(batch_app, json.dumps({
+            "custom_id": "p", "method": "POST", "url": "/v1/completions",
+            "body": {"prompt": "x", "max_tokens": 2, "temperature": 0},
+        }).encode())
+        st, b = _call(batch_app, "POST", "/v1/batches", {
+            "input_file_id": meta["id"], "endpoint": "/v1/completions",
+        })
+        _wait_batch(batch_app, b["id"])
+    st, page1 = _call(batch_app, "GET", "/v1/batches?limit=2")
+    assert st == 200 and len(page1["data"]) == 2
+    assert page1["last_id"] == page1["data"][-1]["id"]
+    st, page2 = _call(
+        batch_app, "GET", f"/v1/batches?limit=2&after={page1['last_id']}"
+    )
+    assert st == 200
+    ids1 = {b["id"] for b in page1["data"]}
+    ids2 = {b["id"] for b in page2["data"]}
+    assert not ids1 & ids2  # no overlap: the cursor advanced
+    st, _ = _call(batch_app, "GET", "/v1/batches?after=batch_bogus")
+    assert st == 400
+
+    st, meta = _upload(batch_app, b'{"y": 2}\n')
+    fid = meta["id"]
+    st, gone = _call(batch_app, "DELETE", f"/v1/files/{fid}")
+    assert st == 200 and gone["deleted"] is True
+    st, _ = _call(batch_app, "GET", f"/v1/files/{fid}")
+    assert st == 404
+    st, _ = _call(batch_app, "DELETE", f"/v1/files/{fid}")
+    assert st == 404
+
+
+def test_batch_forwards_auth_headers():
+    """On an authenticated app the internal line dispatch re-runs the
+    middleware chain — the creator's credentials must ride along or
+    every line 401s."""
+    app = App(config=MockConfig({
+        "APP_NAME": "batch-auth", "HTTP_PORT": "0", "METRICS_PORT": "0",
+        "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+    }))
+    add_openai_routes(app)
+    app.batch_store = add_openai_batch_routes(app)
+    app.enable_api_key_auth("sekrit")
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=120)
+    try:
+        auth = {"X-API-KEY": "sekrit"}
+        line = json.dumps({
+            "custom_id": "a", "method": "POST", "url": "/v1/completions",
+            "body": {"prompt": "hi", "max_tokens": 4, "temperature": 0},
+        }).encode()
+        boundary = "tb9"
+        body = (
+            f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="purpose"\r\n\r\nbatch\r\n'
+            f"--{boundary}\r\n"
+            f'Content-Disposition: form-data; name="file"; '
+            f'filename="r.jsonl"\r\n\r\n'
+        ).encode() + line + f"\r\n--{boundary}--\r\n".encode()
+        st, meta = _call(
+            app, "POST", "/v1/files", body=body,
+            headers={
+                "Content-Type": f"multipart/form-data; boundary={boundary}",
+                **auth,
+            },
+        )
+        assert st == 200
+        st, batch = _call(app, "POST", "/v1/batches", {
+            "input_file_id": meta["id"], "endpoint": "/v1/completions",
+        }, headers=auth)
+        assert st == 200
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            st, b = _call(
+                app, "GET", f"/v1/batches/{batch['id']}", headers=auth
+            )
+            if b["status"] in ("completed", "failed", "cancelled"):
+                break
+            time.sleep(0.3)
+        assert b["status"] == "completed"
+        assert b["request_counts"] == {
+            "total": 1, "completed": 1, "failed": 0,
+        }
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
+        loop.call_soon_threadsafe(loop.stop)
