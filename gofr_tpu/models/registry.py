@@ -129,6 +129,23 @@ def _register_llms() -> None:
             n_kv_heads=2, d_ff=256, max_len=256, rope_theta=10000.0,
             n_experts=4, n_experts_active=2,
         ),
+        # Pythia-6.9B dims (HF loader accepts model_type=gpt_neox):
+        # LayerNorm+bias, parallel residual, partial rotary (25% of
+        # head_dim), non-gated erf-gelu MLP, biases on every projection.
+        "pythia-6.9b": TransformerConfig(
+            vocab_size=50432, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=32, d_ff=16384, max_len=2048, rope_theta=10000.0,
+            norm_eps=1e-5, norm="ln", parallel_residual=True,
+            rotary_pct=0.25, ffn="mlp", act="gelu_exact", attn_bias=True,
+            proj_bias=True,
+        ),
+        # GPT-NeoX-arch test size.
+        "neox-tiny": TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=256, max_len=256, rope_theta=10000.0,
+            norm="ln", parallel_residual=True, rotary_pct=0.25,
+            ffn="mlp", act="gelu_exact", attn_bias=True, proj_bias=True,
+        ),
         # Gemma-arch test size: exercises head_dim override (64 ≠ 128/4),
         # GeGLU, (1+w) norms, and scaled embeddings on the fast CPU path.
         "gemma-tiny": TransformerConfig(
@@ -138,7 +155,8 @@ def _register_llms() -> None:
             norm_offset=True, embed_scale=True,
         ),
     }
-    eos_tokens = {"gemma-7b": 1, "gemma-2b": 1, "gemma-tiny": 1}
+    eos_tokens = {"gemma-7b": 1, "gemma-2b": 1, "gemma-tiny": 1,
+                  "pythia-6.9b": 0, "neox-tiny": 0}
     for name, cfg in llm_configs.items():
         register_model(
             ModelSpec(

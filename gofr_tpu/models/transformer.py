@@ -43,7 +43,7 @@ from gofr_tpu.ops.kv_cache import (
     paged_view,
     quantize_kv,
 )
-from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.ops.norms import layer_norm, rms_norm
 from gofr_tpu.ops.rotary import apply_rope, rope_frequencies
 
 
@@ -68,13 +68,27 @@ class TransformerConfig:
     # n_heads*head_dim != d_model), tanh-approximate GeGLU FFN, RMSNorm
     # computed as x/rms * (1 + w), and sqrt(d_model)-scaled embeddings.
     head_dim_override: int = 0
-    act: str = "silu"  # "silu" | "gelu"
+    act: str = "silu"  # "silu" | "gelu" | "gelu_exact"
     norm_offset: bool = False
     embed_scale: bool = False
+    # GPT-NeoX/Pythia-family switches: LayerNorm (with bias) instead of
+    # RMSNorm, x + attn(ln1 x) + mlp(ln2 x) parallel residual, partial
+    # rotary (rope on the first rotary_pct of head_dim), a non-gated
+    # act(x·W_up)·W_down MLP, and biases on every projection.
+    norm: str = "rms"  # "rms" | "ln"
+    parallel_residual: bool = False
+    rotary_pct: float = 1.0
+    ffn: str = "swiglu"  # "swiglu" | "mlp"
+    proj_bias: bool = False  # wo/w_up/w_down biases (NeoX dense biases)
 
     @property
     def head_dim(self) -> int:
         return self.head_dim_override or self.d_model // self.n_heads
+
+    @property
+    def rope_dims(self) -> int:
+        nd = int(self.head_dim * self.rotary_pct)
+        return nd - (nd % 2)  # rotate-half needs an even subspace
 
     @property
     def is_moe(self) -> bool:
@@ -115,6 +129,17 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
         "attn_norm": jnp.full((L, D), 0.0 if cfg.norm_offset else 1.0, cfg.dtype),
         "mlp_norm": jnp.full((L, D), 0.0 if cfg.norm_offset else 1.0, cfg.dtype),
     }
+    if cfg.norm == "ln":
+        layers.update(
+            attn_norm_b=jnp.zeros((L, D), dtype=cfg.dtype),
+            mlp_norm_b=jnp.zeros((L, D), dtype=cfg.dtype),
+        )
+    if cfg.proj_bias:
+        layers.update(
+            wo_b=jnp.zeros((L, D), dtype=cfg.dtype),
+            w_up_b=jnp.zeros((L, F), dtype=cfg.dtype),
+            w_down_b=jnp.zeros((L, D), dtype=cfg.dtype),
+        )
     if cfg.attn_bias:
         layers.update(
             wq_b=jnp.zeros((L, H * hd), dtype=cfg.dtype),
@@ -129,13 +154,18 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
             w_up=dense_init(ks[6], (L, E, D, F), D),
             w_down=dense_init(ks[7], (L, E, F, D), F),
         )
+    elif cfg.ffn == "mlp":
+        layers.update(
+            w_up=dense_init(ks[6], (L, D, F), D),
+            w_down=dense_init(ks[7], (L, F, D), F),
+        )
     else:
         layers.update(
             w_gate=dense_init(ks[5], (L, D, F), D),
             w_up=dense_init(ks[6], (L, D, F), D),
             w_down=dense_init(ks[7], (L, F, D), F),
         )
-    return {
+    out = {
         "embed": dense_init(k_embed, (cfg.vocab_size, D), D),
         "layers": layers,
         "final_norm": jnp.full(
@@ -143,6 +173,9 @@ def init_transformer(key: jax.Array, cfg: TransformerConfig) -> dict:
         ),
         "lm_head": dense_init(k_head, (D, cfg.vocab_size), D),
     }
+    if cfg.norm == "ln":
+        out["final_norm_b"] = jnp.zeros((D,), dtype=cfg.dtype)
+    return out
 
 
 def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
@@ -172,6 +205,16 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
             wk_b=P(lax_, "tp"),
             wv_b=P(lax_, "tp"),
         )
+    if cfg.norm == "ln":
+        layers.update(attn_norm_b=P(lax_, None), mlp_norm_b=P(lax_, None))
+    if cfg.proj_bias:
+        # Row-parallel outputs (wo, w_down) have replicated biases; the
+        # column-parallel up-projection bias shards with its outputs.
+        layers.update(
+            wo_b=P(lax_, None),
+            w_up_b=P(lax_, "tp"),
+            w_down_b=P(lax_, None),
+        )
     if cfg.is_moe:
         layers.update(
             router=P(lax_, None, None),
@@ -179,18 +222,26 @@ def transformer_param_specs(cfg: TransformerConfig, pp: bool = False) -> dict:
             w_up=P(lax_, "tp", None, None),
             w_down=P(lax_, "tp", None, None),
         )
+    elif cfg.ffn == "mlp":
+        layers.update(
+            w_up=P(lax_, None, "tp"),
+            w_down=P(lax_, "tp", None),
+        )
     else:
         layers.update(
             w_gate=P(lax_, None, "tp"),
             w_up=P(lax_, None, "tp"),
             w_down=P(lax_, "tp", None),
         )
-    return {
+    out = {
         "embed": P("tp", None),
         "layers": layers,
         "final_norm": P(None),
         "lm_head": P(None, "tp"),
     }
+    if cfg.norm == "ln":
+        out["final_norm_b"] = P(None)
+    return out
 
 
 def kv_cache_specs(
@@ -361,14 +412,19 @@ def _lora(x, lp, name, aids):
 
 
 def _act(cfg):
-    """FFN gate activation — silu (Llama/SwiGLU) or tanh-approximate gelu
-    (Gemma/GeGLU); static per config, so each compiles its own program."""
+    """FFN activation — silu (Llama/SwiGLU), tanh-approximate gelu
+    (Gemma/GeGLU), or erf gelu (GPT-NeoX); static per config, so each
+    compiles its own program."""
     if cfg.act == "gelu":
         return partial(jax.nn.gelu, approximate=True)
+    if cfg.act == "gelu_exact":
+        return partial(jax.nn.gelu, approximate=False)
     return jax.nn.silu
 
 
-def _norm(x, w, cfg):
+def _norm(x, w, cfg, b=None):
+    if cfg.norm == "ln":
+        return layer_norm(x, w, b, cfg.norm_eps)
     return rms_norm(x, w, cfg.norm_eps, 1.0 if cfg.norm_offset else 0.0)
 
 
@@ -383,6 +439,18 @@ def _embed(params, tokens, cfg):
 
 
 def _ffn_dense(x, lp, cfg, aids=None):
+    if cfg.ffn == "mlp":
+        # Non-gated act(x·W_up + b)·W_down + b (GPT-NeoX/GPT-2 shape).
+        h = _wein("bsd,df->bsf", x, lp["w_up"]) + _lora(x, lp, "w_up", aids)
+        if "w_up_b" in lp:
+            h = h + lp["w_up_b"]
+        h = _act(cfg)(h)
+        out = _wein("bsf,fd->bsd", h, lp["w_down"]) + _lora(
+            h, lp, "w_down", aids
+        )
+        if "w_down_b" in lp:
+            out = out + lp["w_down_b"]
+        return out
     gate = _wein("bsd,df->bsf", x, lp["w_gate"]) + _lora(x, lp, "w_gate", aids)
     up = _wein("bsd,df->bsf", x, lp["w_up"]) + _lora(x, lp, "w_up", aids)
     h = _act(cfg)(gate) * up
@@ -447,7 +515,7 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
     b, s, _ = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
-    h = _norm(x, lp["attn_norm"], cfg)
+    h = _norm(x, lp["attn_norm"], cfg, lp.get("attn_norm_b"))
     if norm_out is not None:
         h = norm_out(h)
     q, k, v = _qkv(h, lp, "bsd,dh->bsh", H, KV, hd, b, s, aids=aids)
@@ -458,13 +526,20 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
     else:
         attn = attn_fn(q, k, v, mask)
     ao = attn.reshape(b, s, H * hd)
-    x = x + _wein("bsh,hd->bsd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+    attn_out = _wein("bsh,hd->bsd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+    if "wo_b" in lp:
+        attn_out = attn_out + lp["wo_b"]
 
-    h = _norm(x, lp["mlp_norm"], cfg)
+    # Parallel residual (GPT-NeoX): both branches read the SAME input;
+    # sequential (default): the MLP reads the attention-updated stream.
+    mlp_in = x if cfg.parallel_residual else x + attn_out
+    h = _norm(mlp_in, lp["mlp_norm"], cfg, lp.get("mlp_norm_b"))
     if norm_out is not None:
         h = norm_out(h)
     ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(h, lp, cfg, aids)
-    return x + ffn, (k, v)
+    if cfg.parallel_residual:
+        return x + attn_out + ffn, (k, v)
+    return mlp_in + ffn, (k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -483,7 +558,7 @@ def transformer_forward(
     """Training/eval forward: tokens [b, s] → logits [b, s, vocab] (f32)."""
     b, s = tokens.shape
     x = _embed(params, tokens, cfg)
-    cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+    cos, sin = rope_frequencies(cfg.rope_dims, s, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
     def body(x, lp):
@@ -495,7 +570,7 @@ def transformer_forward(
     if remat:
         body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_b"))
     return _wein("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
 
@@ -515,7 +590,7 @@ def transformer_prefill(
     """
     b, s = tokens.shape
     x = _embed(params, tokens, cfg)
-    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(cfg.rope_dims, cache.max_len, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     # Per-row lengths mask invalid (right-padding) keys INSIDE the flash
     # kernel — prefill stays on the O(s)-memory kernel path instead of the
@@ -553,7 +628,7 @@ def transformer_prefill(
     cache = cache._replace(k=new_k, v=new_v)
     cache = cache._replace(lengths=cache.lengths.at[slots].set(lengths.astype(jnp.int32)))
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_b"))
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = _wein("bd,dv->bv", x_last, params["lm_head"]).astype(jnp.float32)
@@ -589,7 +664,7 @@ def transformer_prefill_chunk(
     P, c = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = _embed(params, tokens, cfg)  # [P, c, D]
-    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(cfg.rope_dims, cache.max_len, cfg.rope_theta)
     positions = starts[:, None] + jnp.arange(c)[None, :]  # [P, c] global
     paged = isinstance(cache, PagedKVCache)
 
@@ -628,7 +703,7 @@ def transformer_prefill_chunk(
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
-        h = _norm(x, lp["attn_norm"], cfg)
+        h = _norm(x, lp["attn_norm"], cfg, lp.get("attn_norm_b"))
         q, k, v = _qkv(h, lp, "pcd,dh->pch", H, KV, hd, P, c, aids=aids)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
@@ -652,19 +727,25 @@ def transformer_prefill_chunk(
             kernel=False if dense_attn else None,
         )
         ao = attn.reshape(P, c, H * hd)
-        x = x + _wein("pch,hd->pcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
-        h = _norm(x, lp["mlp_norm"], cfg)
+        attn_out = (
+            _wein("pch,hd->pcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        )
+        if "wo_b" in lp:
+            attn_out = attn_out + lp["wo_b"]
+        mlp_in = x if cfg.parallel_residual else x + attn_out
+        h = _norm(mlp_in, lp["mlp_norm"], cfg, lp.get("mlp_norm_b"))
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
             h, lp, cfg, aids
         )
-        return x + ffn, (ck, cv, cks, cvs)
+        x = x + attn_out + ffn if cfg.parallel_residual else mlp_in + ffn
+        return x, (ck, cv, cks, cvs)
 
     x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
     )
     cache = cache._replace(k=new_k, v=new_v, k_s=new_ks, v_s=new_vs)
 
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_b"))
     last_idx = jnp.maximum(lens - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = _wein("pd,dv->pv", x_last, params["lm_head"]).astype(jnp.float32)
@@ -693,7 +774,7 @@ def transformer_decode_step(
     L = cfg.n_layers
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = _embed(params, tokens, cfg)  # [S, D]
-    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(cfg.rope_dims, cache.max_len, cfg.rope_theta)
 
     positions = cache.lengths  # [S] — write position for each slot's new token
     # Inactive slots must not write at their stale ``lengths`` position: a
@@ -715,7 +796,9 @@ def transformer_decode_step(
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # ck/cv: [S, KV, max_len, hd]
-        h = _norm(x[:, None, :], lp["attn_norm"], cfg)[:, 0]
+        h = _norm(
+            x[:, None, :], lp["attn_norm"], cfg, lp.get("attn_norm_b")
+        )[:, 0]
         q, k, v = _qkv(h, lp, "bd,dh->bh", H, KV, hd, S, aids=aids)
         pos2 = positions[:, None]  # [S, 1]
         q = apply_rope(q[:, None], cos, sin, pos2)[:, 0]
@@ -732,12 +815,20 @@ def transformer_decode_step(
             kernel=False if dense_attn else None,
         )
         ao = attn.reshape(S, H * hd)
-        x = x + _wein("bh,hd->bd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
-        h = _norm(x[:, None, :], lp["mlp_norm"], cfg)
+        attn_out = _wein("bh,hd->bd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        if "wo_b" in lp:
+            attn_out = attn_out + lp["wo_b"]
+        mlp_in = x if cfg.parallel_residual else x + attn_out
+        h = _norm(
+            mlp_in[:, None, :], lp["mlp_norm"], cfg, lp.get("mlp_norm_b")
+        )
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
             h, lp, cfg, aids
         )
-        x = x + ffn[:, 0]
+        if cfg.parallel_residual:
+            x = x + attn_out + ffn[:, 0]
+        else:
+            x = mlp_in + ffn[:, 0]
         return x, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -776,7 +867,7 @@ def transformer_decode_step(
         v=cache.v.at[li, row, ki, wp].set(new_v.astype(cache.v.dtype)),
         lengths=cache.lengths + active.astype(jnp.int32),
     )
-    x = _norm(x[:, None, :], params["final_norm"], cfg)[:, 0]
+    x = _norm(x[:, None, :], params["final_norm"], cfg, params.get("final_norm_b"))[:, 0]
     logits = _wein("bd,dv->bv", x, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
@@ -799,14 +890,14 @@ def transformer_verify_step(
     S, c = tokens.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     x = _embed(params, tokens, cfg)  # [S, c, D]
-    cos, sin = rope_frequencies(cfg.head_dim, cache.max_len, cfg.rope_theta)
+    cos, sin = rope_frequencies(cfg.rope_dims, cache.max_len, cfg.rope_theta)
     positions = cache.lengths[:, None] + jnp.arange(c)[None, :]  # [S, c]
     paged = isinstance(cache, PagedKVCache)
     rows = jnp.arange(S)
 
     def body(x, scanned):
         lp, ck, cv, cks, cvs = scanned  # read-only cache slices
-        h = _norm(x, lp["attn_norm"], cfg)
+        h = _norm(x, lp["attn_norm"], cfg, lp.get("attn_norm_b"))
         q, k, v = _qkv(h, lp, "bcd,dh->bch", H, KV, hd, S, c, aids=aids)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
@@ -821,17 +912,23 @@ def transformer_verify_step(
             q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs
         )
         ao = attn.reshape(S, c, H * hd)
-        x = x + _wein("bch,hd->bcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
-        h = _norm(x, lp["mlp_norm"], cfg)
+        attn_out = (
+            _wein("bch,hd->bcd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
+        )
+        if "wo_b" in lp:
+            attn_out = attn_out + lp["wo_b"]
+        mlp_in = x if cfg.parallel_residual else x + attn_out
+        h = _norm(mlp_in, lp["mlp_norm"], cfg, lp.get("mlp_norm_b"))
         ffn = _ffn_moe(h, lp, cfg) if cfg.is_moe else _ffn_dense(
             h, lp, cfg, aids
         )
-        return x + ffn, (k, v)
+        x = x + attn_out + ffn if cfg.parallel_residual else mlp_in + ffn
+        return x, (k, v)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache.k, cache.v, cache.k_s, cache.v_s)
     )
-    x = _norm(x, params["final_norm"], cfg)
+    x = _norm(x, params["final_norm"], cfg, params.get("final_norm_b"))
     logits = _wein("bcd,dv->bcv", x, params["lm_head"]).astype(jnp.float32)
     return logits, new_k, new_v
 

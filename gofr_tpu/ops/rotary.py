@@ -22,12 +22,21 @@ def apply_rope(
 
     x: [batch, seq, heads, head_dim]; positions: [batch, seq] absolute
     positions (gathered into the tables — decode passes per-slot offsets).
+
+    Partial rotary (GPT-NeoX ``rotary_pct``): the TABLE width defines the
+    rotated subspace — tables built with ``rope_frequencies(nd, ...)``
+    for nd < head_dim rotate only the first nd dims and pass the rest
+    through unchanged.
     """
     dtype = x.dtype
-    cos_p = cos[positions][:, :, None, :]  # [b, s, 1, hd/2]
+    cos_p = cos[positions][:, :, None, :]  # [b, s, 1, nd/2]
     sin_p = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    nd = 2 * cos.shape[-1]
+    rot, rest = x[..., :nd], x[..., nd:]
+    x1, x2 = jnp.split(rot.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate(
         [x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1
-    )
-    return out.astype(dtype)
+    ).astype(dtype)
+    if rest.shape[-1]:
+        out = jnp.concatenate([out, rest], axis=-1)
+    return out

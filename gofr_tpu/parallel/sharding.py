@@ -96,11 +96,12 @@ def make_train_step(
     jitted with donated state.
     """
     from gofr_tpu.models.transformer import (
+        _embed,
+        _layer_prefill,
+        _norm,
         init_transformer,
         transformer_param_specs,
-        _layer_prefill,
     )
-    from gofr_tpu.ops.norms import rms_norm
     from gofr_tpu.ops.rotary import rope_frequencies
     from gofr_tpu.parallel.mesh import mesh_axis_sizes
 
@@ -151,8 +152,8 @@ def make_train_step(
     def forward(params, tokens):
         params = _to_compute(params)
         b, s = tokens.shape
-        x = params["embed"][tokens]
-        cos, sin = rope_frequencies(cfg.head_dim, s, cfg.rope_theta)
+        x = _embed(params, tokens, cfg)
+        cos, sin = rope_frequencies(cfg.rope_dims, s, cfg.rope_theta)
         positions = jnp.arange(s)[None, :]  # [1, s], broadcasts over batch
 
         def constrain(h):
@@ -212,7 +213,7 @@ def make_train_step(
         else:
             x = constrain(x)
             x, _ = jax.lax.scan(make_body(cos, sin, positions), x, params["layers"])
-        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        x = _norm(x, params["final_norm"], cfg, params.get("final_norm_b"))
         return jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
 
     def loss_fn(params, tokens):

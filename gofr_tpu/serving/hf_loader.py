@@ -47,10 +47,47 @@ def config_from_hf(path: str):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
-    if mt not in ("llama", "mistral", "mixtral", "qwen2", "gemma"):
+    if mt not in ("llama", "mistral", "mixtral", "qwen2", "gemma",
+                  "gpt_neox"):
         raise ValueError(
             f"unsupported HF model_type {mt!r} "
-            "(llama-family + qwen2 + gemma only)"
+            "(llama-family + qwen2 + gemma + gpt_neox only)"
+        )
+    if mt == "gpt_neox":
+        # GPT-NeoX/Pythia: LayerNorm + parallel residual + partial
+        # rotary + non-gated gelu MLP + biases everywhere; MHA.
+        hidden_act = hf.get("hidden_act", "gelu")
+        act = {
+            # erf gelu vs the tanh approximation the weights trained on.
+            "gelu": "gelu_exact",
+            "gelu_fast": "gelu",
+            "gelu_new": "gelu",
+            "gelu_pytorch_tanh": "gelu",
+        }.get(hidden_act)
+        if act is None:
+            raise ValueError(
+                f"unsupported gpt_neox hidden_act {hidden_act!r}"
+            )
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["hidden_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            n_kv_heads=hf["num_attention_heads"],
+            d_ff=hf["intermediate_size"],
+            max_len=hf.get("max_position_embeddings", 2048),
+            rope_theta=float(
+                hf.get("rope_theta", hf.get("rotary_emb_base", 10000.0))
+            ),
+            norm_eps=float(hf.get("layer_norm_eps", 1e-5)),
+            dtype=jnp.bfloat16,
+            attn_bias=True,
+            proj_bias=True,
+            norm="ln",
+            parallel_residual=bool(hf.get("use_parallel_residual", True)),
+            rotary_pct=float(hf.get("rotary_pct", 0.25)),
+            ffn="mlp",
+            act=act,
         )
     return TransformerConfig(
         vocab_size=hf["vocab_size"],
@@ -153,7 +190,9 @@ def load_hf_llama(
         for field in ("vocab_size", "d_model", "n_layers", "n_heads",
                       "n_kv_heads", "d_ff", "n_experts",
                       "n_experts_active", "attn_bias", "head_dim_override",
-                      "act", "norm_offset", "embed_scale"):
+                      "act", "norm_offset", "embed_scale", "norm",
+                      "parallel_residual", "rotary_pct", "ffn",
+                      "proj_bias"):
             want, have = getattr(cfg, field), getattr(file_cfg, field)
             if want != have:
                 raise ValueError(
@@ -219,6 +258,103 @@ def load_hf_llama(
         if logger is not None:
             logger.debugf("loaded %s x%dx%d", fmt, cfg.n_layers, cfg.n_experts)
         return out
+
+    if "gpt_neox.embed_in.weight" in src:
+        # GPT-NeoX/Pythia layout: fused QKV [3*D, D] whose output rows
+        # reshape to (heads, 3, head_dim) — split into our separate
+        # q/k/v leaves — plus LayerNorm weight+bias pairs and dense
+        # biases on every projection.
+        H, hd, D = cfg.n_heads, cfg.head_dim, cfg.d_model
+        npre = "gpt_neox.layers.{}."
+        cpu = jax.devices("cpu")[0]
+        qkv_w: dict[str, list] = {"wq": [], "wk": [], "wv": []}
+        qkv_b: dict[str, list] = {"wq_b": [], "wk_b": [], "wv_b": []}
+        with jax.default_device(cpu):
+            for i in range(cfg.n_layers):
+                w = src.get(
+                    npre.format(i) + "attention.query_key_value.weight"
+                ).reshape(H, 3, hd, D)
+                b = src.get(
+                    npre.format(i) + "attention.query_key_value.bias"
+                ).reshape(H, 3, hd)
+                for j, t in enumerate(("wq", "wk", "wv")):
+                    qkv_w[t].append(
+                        jnp.swapaxes(w[:, j].reshape(H * hd, D), 0, 1)
+                    )
+                    qkv_b[t + "_b"].append(b[:, j].reshape(H * hd))
+            qkv_stacked = {
+                t: jnp.stack(leaves) for t, leaves in qkv_w.items()
+            }
+            qkvb_stacked = {
+                t: jnp.stack(leaves) for t, leaves in qkv_b.items()
+            }
+        layers = {
+            t: to_device(
+                a, True, specs["layers"][t] if specs is not None else None
+            )
+            for t, a in qkv_stacked.items()
+        }
+        layers.update({
+            t: to_device(
+                a, False,
+                specs["layers"][t] if specs is not None else None,
+            )
+            for t, a in qkvb_stacked.items()
+        })
+        layers.update(
+            wo=stacked("wo", npre + "attention.dense.weight", True),
+            wo_b=stacked(
+                "wo_b", npre + "attention.dense.bias", False, False
+            ),
+            w_up=stacked("w_up", npre + "mlp.dense_h_to_4h.weight", True),
+            w_up_b=stacked(
+                "w_up_b", npre + "mlp.dense_h_to_4h.bias", False, False
+            ),
+            w_down=stacked(
+                "w_down", npre + "mlp.dense_4h_to_h.weight", True
+            ),
+            w_down_b=stacked(
+                "w_down_b", npre + "mlp.dense_4h_to_h.bias", False, False
+            ),
+            attn_norm=stacked(
+                "attn_norm", npre + "input_layernorm.weight", False, False
+            ),
+            attn_norm_b=stacked(
+                "attn_norm_b", npre + "input_layernorm.bias", False, False
+            ),
+            mlp_norm=stacked(
+                "mlp_norm", npre + "post_attention_layernorm.weight",
+                False, False,
+            ),
+            mlp_norm_b=stacked(
+                "mlp_norm_b", npre + "post_attention_layernorm.bias",
+                False, False,
+            ),
+        )
+        sp = specs if specs is not None else {}
+        with jax.default_device(cpu):
+            head = jnp.swapaxes(src.get("embed_out.weight"), -1, -2)
+        params = {
+            "embed": to_device(
+                src.get("gpt_neox.embed_in.weight"), False, sp.get("embed")
+            ),
+            "layers": layers,
+            "final_norm": to_device(
+                src.get("gpt_neox.final_layer_norm.weight"), False,
+                sp.get("final_norm"),
+            ),
+            "final_norm_b": to_device(
+                src.get("gpt_neox.final_layer_norm.bias"), False,
+                sp.get("final_norm_b"),
+            ),
+            "lm_head": to_device(head, True, sp.get("lm_head")),
+        }
+        if logger is not None:
+            logger.infof(
+                "loaded HF gpt_neox checkpoint from %s (%d layers%s)",
+                path, cfg.n_layers, f", {quant}" if quant else "",
+            )
+        return params
 
     pre = "model.layers.{}."
     layers = {
