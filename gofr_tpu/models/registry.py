@@ -139,6 +139,21 @@ def _register_llms() -> None:
             rotary_pct=0.25, ffn="mlp", act="gelu_exact", attn_bias=True,
             proj_bias=True,
         ),
+        # GPT-2 (124M) dims (HF loader accepts model_type=gpt2):
+        # learned positions, LayerNorm+bias, tanh-gelu MLP, tied head.
+        "gpt2": TransformerConfig(
+            vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
+            n_kv_heads=12, d_ff=3072, max_len=1024, norm="ln",
+            ffn="mlp", act="gelu", attn_bias=True, proj_bias=True,
+            pos_emb="learned",
+        ),
+        # GPT-2-arch test size (learned positions).
+        "gpt2-tiny": TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            n_kv_heads=4, d_ff=256, max_len=256, norm="ln",
+            ffn="mlp", act="gelu", attn_bias=True, proj_bias=True,
+            pos_emb="learned",
+        ),
         # GPT-NeoX-arch test size.
         "neox-tiny": TransformerConfig(
             vocab_size=512, d_model=128, n_layers=2, n_heads=4,
@@ -156,7 +171,8 @@ def _register_llms() -> None:
         ),
     }
     eos_tokens = {"gemma-7b": 1, "gemma-2b": 1, "gemma-tiny": 1,
-                  "pythia-6.9b": 0, "neox-tiny": 0}
+                  "pythia-6.9b": 0, "neox-tiny": 0,
+                  "gpt2": 50256, "gpt2-tiny": 0}
     for name, cfg in llm_configs.items():
         register_model(
             ModelSpec(

@@ -152,9 +152,9 @@ def make_train_step(
     def forward(params, tokens):
         params = _to_compute(params)
         b, s = tokens.shape
-        x = _embed(params, tokens, cfg)
-        cos, sin = rope_frequencies(cfg.rope_dims, s, cfg.rope_theta)
         positions = jnp.arange(s)[None, :]  # [1, s], broadcasts over batch
+        x = _embed(params, tokens, cfg, positions)
+        cos, sin = rope_frequencies(cfg.rope_dims, s, cfg.rope_theta)
 
         def constrain(h):
             if use_cp:

@@ -48,10 +48,45 @@ def config_from_hf(path: str):
         hf = json.load(f)
     mt = hf.get("model_type", "llama")
     if mt not in ("llama", "mistral", "mixtral", "qwen2", "gemma",
-                  "gpt_neox"):
+                  "gpt_neox", "gpt2"):
         raise ValueError(
             f"unsupported HF model_type {mt!r} "
-            "(llama-family + qwen2 + gemma + gpt_neox only)"
+            "(llama-family + qwen2 + gemma + gpt_neox + gpt2 only)"
+        )
+    if mt == "gpt2":
+        # GPT-2: learned absolute positions, LayerNorm+bias, sequential
+        # residual, gelu MLP, biases everywhere.
+        g2act = {
+            "gelu_new": "gelu",
+            "gelu_pytorch_tanh": "gelu",
+            "gelu_fast": "gelu",
+            "gelu": "gelu_exact",
+        }.get(hf.get("activation_function", "gelu_new"))
+        if g2act is None:
+            raise ValueError(
+                "unsupported gpt2 activation_function "
+                f"{hf.get('activation_function')!r}"
+            )
+        if hf.get("scale_attn_by_inverse_layer_idx"):
+            raise ValueError(
+                "gpt2 scale_attn_by_inverse_layer_idx is not supported"
+            )
+        return TransformerConfig(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["n_embd"],
+            n_layers=hf["n_layer"],
+            n_heads=hf["n_head"],
+            n_kv_heads=hf["n_head"],
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_len=hf.get("n_positions", 1024),
+            norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+            dtype=jnp.bfloat16,
+            attn_bias=True,
+            proj_bias=True,
+            norm="ln",
+            ffn="mlp",
+            act=g2act,
+            pos_emb="learned",
         )
     if mt == "gpt_neox":
         # GPT-NeoX/Pythia: LayerNorm + parallel residual + partial
@@ -192,13 +227,24 @@ def load_hf_llama(
                       "n_experts_active", "attn_bias", "head_dim_override",
                       "act", "norm_offset", "embed_scale", "norm",
                       "parallel_residual", "rotary_pct", "ffn",
-                      "proj_bias"):
+                      "proj_bias", "pos_emb"):
             want, have = getattr(cfg, field), getattr(file_cfg, field)
             if want != have:
                 raise ValueError(
                     f"checkpoint/config mismatch: {field}={have} in "
                     f"{path}/config.json but engine expects {want}"
                 )
+        if (
+            file_cfg.pos_emb == "learned"
+            and cfg.max_len > file_cfg.max_len
+        ):
+            # The position table IS the context limit for learned-pos
+            # models; _embed's clip would otherwise silently reuse the
+            # last row past it.
+            raise ValueError(
+                f"max_len={cfg.max_len} exceeds the checkpoint's learned "
+                f"position table ({file_cfg.max_len} rows)"
+            )
     if quant and quant not in ("int8", "int4"):
         raise ValueError(f"unsupported quant {quant!r}")
 
@@ -258,6 +304,89 @@ def load_hf_llama(
         if logger is not None:
             logger.debugf("loaded %s x%dx%d", fmt, cfg.n_layers, cfg.n_experts)
         return out
+
+    if "wte.weight" in src or "transformer.wte.weight" in src:
+        # GPT-2 layout. Conv1D stores weights [in, out] — ALREADY our
+        # contraction convention, so no transpose anywhere; c_attn packs
+        # q,k,v contiguously along the output axis.
+        D = cfg.d_model
+        gpre = "transformer." if "transformer.wte.weight" in src else ""
+        lpre = gpre + "h.{}."
+        cpu = jax.devices("cpu")[0]
+        qw: dict[str, list] = {"wq": [], "wk": [], "wv": []}
+        qb: dict[str, list] = {"wq_b": [], "wk_b": [], "wv_b": []}
+        with jax.default_device(cpu):
+            for i in range(cfg.n_layers):
+                w = src.get(lpre.format(i) + "attn.c_attn.weight")  # [D, 3D]
+                b = src.get(lpre.format(i) + "attn.c_attn.bias")  # [3D]
+                for j, t in enumerate(("wq", "wk", "wv")):
+                    qw[t].append(w[:, j * D : (j + 1) * D])
+                    qb[t + "_b"].append(b[j * D : (j + 1) * D])
+            qw_st = {t: jnp.stack(v) for t, v in qw.items()}
+            qb_st = {t: jnp.stack(v) for t, v in qb.items()}
+        layers = {
+            t: to_device(
+                a, True, specs["layers"][t] if specs is not None else None
+            )
+            for t, a in qw_st.items()
+        }
+        layers.update({
+            t: to_device(
+                a, False,
+                specs["layers"][t] if specs is not None else None,
+            )
+            for t, a in qb_st.items()
+        })
+        layers.update(
+            wo=stacked("wo", lpre + "attn.c_proj.weight", False),
+            wo_b=stacked("wo_b", lpre + "attn.c_proj.bias", False, False),
+            w_up=stacked("w_up", lpre + "mlp.c_fc.weight", False),
+            w_up_b=stacked("w_up_b", lpre + "mlp.c_fc.bias", False, False),
+            w_down=stacked("w_down", lpre + "mlp.c_proj.weight", False),
+            w_down_b=stacked(
+                "w_down_b", lpre + "mlp.c_proj.bias", False, False
+            ),
+            attn_norm=stacked(
+                "attn_norm", lpre + "ln_1.weight", False, False
+            ),
+            attn_norm_b=stacked(
+                "attn_norm_b", lpre + "ln_1.bias", False, False
+            ),
+            mlp_norm=stacked("mlp_norm", lpre + "ln_2.weight", False, False),
+            mlp_norm_b=stacked(
+                "mlp_norm_b", lpre + "ln_2.bias", False, False
+            ),
+        )
+        sp = specs if specs is not None else {}
+        with jax.default_device(cpu):
+            # Tied by default; honor an untied fine-tune's own head.
+            head_name = (
+                "lm_head.weight" if "lm_head.weight" in src
+                else gpre + "wte.weight"
+            )
+            head = jnp.swapaxes(src.get(head_name), -1, -2)
+        params = {
+            "embed": to_device(
+                src.get(gpre + "wte.weight"), False, sp.get("embed")
+            ),
+            "pos_embed": to_device(
+                src.get(gpre + "wpe.weight"), False, sp.get("pos_embed")
+            ),
+            "layers": layers,
+            "final_norm": to_device(
+                src.get(gpre + "ln_f.weight"), False, sp.get("final_norm")
+            ),
+            "final_norm_b": to_device(
+                src.get(gpre + "ln_f.bias"), False, sp.get("final_norm_b")
+            ),
+            "lm_head": to_device(head, True, sp.get("lm_head")),
+        }
+        if logger is not None:
+            logger.infof(
+                "loaded HF gpt2 checkpoint from %s (%d layers%s)",
+                path, cfg.n_layers, f", {quant}" if quant else "",
+            )
+        return params
 
     if "gpt_neox.embed_in.weight" in src:
         # GPT-NeoX/Pythia layout: fused QKV [3*D, D] whose output rows

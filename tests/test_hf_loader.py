@@ -467,6 +467,75 @@ def test_hf_neox_serves_through_engine(hf_neox_checkpoint):
     assert outs[0] == outs[1] and len(outs[0]) == 10
 
 
+@pytest.fixture(scope="module")
+def hf_gpt2_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf-gpt2")
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=128,
+        n_inner=None, layer_norm_epsilon=1e-5,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    torch.manual_seed(3)
+    model = transformers.GPT2LMHeadModel(hf_cfg)
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def test_hf_gpt2_logit_parity(hf_gpt2_checkpoint):
+    """GPT-2 vs torch oracle: validates the learned position table, the
+    Conv1D [in, out] no-transpose layout, the contiguous c_attn q/k/v
+    split, LayerNorm pairs, tanh-gelu MLP, and the tied lm_head."""
+    import dataclasses
+
+    path, model = hf_gpt2_checkpoint
+    cfg = config_from_hf(path)
+    assert cfg.pos_emb == "learned" and cfg.norm == "ln"
+    assert cfg.d_ff == 256  # n_inner None → 4*n_embd
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = load_hf_llama(path, cfg)
+    assert params["pos_embed"].shape == (128, 64)
+    tokens = np.array([[1, 5, 9, 2, 7, 3, 11, 90]], dtype=np.int32)
+    ours = np.asarray(transformer_forward(params, jnp.asarray(tokens), cfg))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
+
+
+def test_hf_gpt2_serves_through_engine(hf_gpt2_checkpoint):
+    """Learned positions hold through chunked prefill + decode + verify
+    (positions come from cache lengths, not rope tables): deterministic,
+    spec-lossless generation."""
+    import dataclasses
+
+    from gofr_tpu.models.registry import ModelSpec, register_model
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    path, _ = hf_gpt2_checkpoint
+    cfg = dataclasses.replace(config_from_hf(path), dtype=jnp.float32)
+    register_model(ModelSpec(
+        name="gpt2-test", family="llm", config=cfg,
+        init=lambda key, c: load_hf_llama(path, c),
+    ))
+    outs = []
+    for spec_tokens in (0, 2):
+        eng = InferenceEngine(
+            "gpt2-test", n_slots=2, max_len=96, window_k=4,
+            tokenizer=ByteTokenizer(), params=load_hf_llama(path, cfg),
+            spec_tokens=spec_tokens,
+        )
+        eng.start_sync()
+        try:
+            outs.append(eng.generate_sync(
+                "ab", max_new_tokens=10, temperature=0.0, stop_on_eos=False,
+                timeout=120,
+            ).token_ids)
+        finally:
+            eng.stop_sync()
+    assert outs[0] == outs[1] and len(outs[0]) == 10
+
+
 def test_hf_qwen2_serves_through_engine(hf_qwen2_checkpoint):
     """Decode + prefill + (speculative) verify paths all apply the bias:
     engine generation from the qwen2 checkpoint must be deterministic and
@@ -499,3 +568,17 @@ def test_hf_qwen2_serves_through_engine(hf_qwen2_checkpoint):
         finally:
             eng.stop_sync()
     assert outs[0] == outs[1] and len(outs[0]) == 10
+
+
+def test_gpt2_learned_pos_guards(hf_gpt2_checkpoint):
+    """max_len beyond the learned position table is rejected at load
+    (the clip in _embed would silently reuse the last row), and an
+    untied fine-tune's own lm_head wins over the wte transpose."""
+    import dataclasses
+
+    path, model = hf_gpt2_checkpoint
+    cfg = dataclasses.replace(
+        config_from_hf(path), dtype=jnp.float32, max_len=4096
+    )
+    with pytest.raises(ValueError, match="position table"):
+        load_hf_llama(path, cfg)
