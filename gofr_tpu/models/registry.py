@@ -217,6 +217,27 @@ def _register_vision() -> None:
             init=init_resnet,
         )
     )
+    from gofr_tpu.models.vit import ViTConfig, init_vit
+
+    register_model(
+        ModelSpec(
+            name="vit-base",
+            family="vision",
+            config=ViTConfig(),
+            init=init_vit,
+        )
+    )
+    register_model(
+        ModelSpec(
+            name="vit-tiny",
+            family="vision",
+            config=ViTConfig(
+                image_size=32, patch_size=8, d_model=64, n_layers=2,
+                n_heads=4, d_ff=128, num_classes=10,
+            ),
+            init=init_vit,
+        )
+    )
     register_model(
         ModelSpec(
             name="resnet-tiny",

@@ -1,0 +1,142 @@
+"""Vision Transformer (ViT) image classifier — the transformer half of
+the vision family next to ResNet (BASELINE config 2's model class).
+
+TPU-first shape: patch embedding is a RESHAPE + one [N, P·P·3]×[P·P·3, D]
+matmul (mathematically identical to the stride-P conv, but explicitly a
+single large MXU matmul), layers are stacked and scanned like the
+decoder (one compiled body regardless of depth), and attention reuses
+``ops/attention`` with ``causal=False``. Pre-LN encoder, learned
+position embeddings, CLS-token classification head — the ViT-B/16
+architecture.
+
+Reference analog: none (GoFr has no models); fills the same serving
+slot as ``models/resnet.py`` behind the engine's vision family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.attention import attention
+from gofr_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    num_classes: int = 1000
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_vit(key: jax.Array, cfg: ViTConfig) -> dict:
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5
+        ).astype(cfg.dtype)
+
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    pdim = cfg.patch_size * cfg.patch_size * 3
+    ks = jax.random.split(key, 12)
+    layers = {
+        "ln1": jnp.ones((L, D), cfg.dtype),
+        "ln1_b": jnp.zeros((L, D), cfg.dtype),
+        "wq": dense(ks[0], (L, D, D), D),
+        "wq_b": jnp.zeros((L, D), cfg.dtype),
+        "wk": dense(ks[1], (L, D, D), D),
+        "wk_b": jnp.zeros((L, D), cfg.dtype),
+        "wv": dense(ks[2], (L, D, D), D),
+        "wv_b": jnp.zeros((L, D), cfg.dtype),
+        "wo": dense(ks[3], (L, D, D), D),
+        "wo_b": jnp.zeros((L, D), cfg.dtype),
+        "ln2": jnp.ones((L, D), cfg.dtype),
+        "ln2_b": jnp.zeros((L, D), cfg.dtype),
+        "w_up": dense(ks[4], (L, D, F), D),
+        "w_up_b": jnp.zeros((L, F), cfg.dtype),
+        "w_down": dense(ks[5], (L, F, D), F),
+        "w_down_b": jnp.zeros((L, D), cfg.dtype),
+    }
+    return {
+        "patch_proj": dense(ks[6], (pdim, D), pdim),
+        "patch_proj_b": jnp.zeros((D,), cfg.dtype),
+        "cls_token": dense(ks[7], (1, 1, D), D),
+        "pos_embed": dense(ks[8], (1 + cfg.n_patches, D), D),
+        "layers": layers,
+        "ln_f": jnp.ones((D,), cfg.dtype),
+        "ln_f_b": jnp.zeros((D,), cfg.dtype),
+        "head": dense(ks[9], (D, cfg.num_classes), D),
+        "head_b": jnp.zeros((cfg.num_classes,), cfg.dtype),
+    }
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[b, H, W, 3] → [b, N, patch·patch·3], each patch flattened
+    row-major (rows, cols, channels) — the order the HF conv kernel
+    transposes to in the parity test."""
+    b, H, W, C = images.shape
+    hp, wp = H // patch, W // patch
+    x = images.reshape(b, hp, patch, wp, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [b, hp, wp, patch, patch, C]
+    return x.reshape(b, hp * wp, patch * patch * C)
+
+
+def vit_forward(
+    params: dict, images: jnp.ndarray, cfg: ViTConfig
+) -> jnp.ndarray:
+    """images [b, H, W, 3] (f32) → class logits [b, num_classes] (f32)."""
+    b = images.shape[0]
+    H, hd = cfg.n_heads, cfg.head_dim
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size)
+    x = jnp.einsum("bnp,pd->bnd", x, params["patch_proj"])
+    x = x + params["patch_proj_b"]
+    cls = jnp.broadcast_to(
+        params["cls_token"], (b, 1, cfg.d_model)
+    ).astype(x.dtype)
+    x = jnp.concatenate([cls, x], axis=1)  # [b, 1+N, D]
+    x = x + params["pos_embed"]
+
+    def body(x, lp):
+        bsz, s, D = x.shape
+        h = layer_norm(x, lp["ln1"], lp["ln1_b"], cfg.norm_eps)
+        q = (jnp.einsum("bsd,dh->bsh", h, lp["wq"]) + lp["wq_b"]).reshape(
+            bsz, s, H, hd
+        )
+        k = (jnp.einsum("bsd,dh->bsh", h, lp["wk"]) + lp["wk_b"]).reshape(
+            bsz, s, H, hd
+        )
+        v = (jnp.einsum("bsd,dh->bsh", h, lp["wv"]) + lp["wv_b"]).reshape(
+            bsz, s, H, hd
+        )
+        attn = attention(q, k, v, causal=False).reshape(bsz, s, D)
+        x = x + jnp.einsum("bsh,hd->bsd", attn, lp["wo"]) + lp["wo_b"]
+        h = layer_norm(x, lp["ln2"], lp["ln2_b"], cfg.norm_eps)
+        h = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", h, lp["w_up"]) + lp["w_up_b"],
+            approximate=False,
+        )
+        x = x + jnp.einsum("bsf,fd->bsd", h, lp["w_down"]) + lp["w_down_b"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["ln_f"], params["ln_f_b"], cfg.norm_eps)
+    logits = (
+        jnp.einsum("bd,dc->bc", x[:, 0], params["head"]) + params["head_b"]
+    )
+    return logits.astype(jnp.float32)

@@ -1312,10 +1312,12 @@ class InferenceEngine:
 
     def _build_vision_step(self) -> None:
         from gofr_tpu.models.resnet import resnet_forward
+        from gofr_tpu.models.vit import ViTConfig, vit_forward
 
         cfg = self.cfg
+        fwd = vit_forward if isinstance(cfg, ViTConfig) else resnet_forward
         self._classify_step = self._jax.jit(
-            lambda params, images: resnet_forward(params, images, cfg)
+            lambda params, images: fwd(params, images, cfg)
         )
 
     # ------------------------------------------------------------------

@@ -71,6 +71,11 @@ def config_from_hf(path: str):
             raise ValueError(
                 "gpt2 scale_attn_by_inverse_layer_idx is not supported"
             )
+        if hf.get("scale_attn_weights") is False:
+            raise ValueError(
+                "gpt2 scale_attn_weights=false is not supported (attention "
+                "always applies the 1/sqrt(head_dim) scale)"
+            )
         return TransformerConfig(
             vocab_size=hf["vocab_size"],
             d_model=hf["n_embd"],

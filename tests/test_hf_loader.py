@@ -572,8 +572,7 @@ def test_hf_qwen2_serves_through_engine(hf_qwen2_checkpoint):
 
 def test_gpt2_learned_pos_guards(hf_gpt2_checkpoint):
     """max_len beyond the learned position table is rejected at load
-    (the clip in _embed would silently reuse the last row), and an
-    untied fine-tune's own lm_head wins over the wte transpose."""
+    (the clip in _embed would silently reuse the last row)."""
     import dataclasses
 
     path, model = hf_gpt2_checkpoint
@@ -582,3 +581,39 @@ def test_gpt2_learned_pos_guards(hf_gpt2_checkpoint):
     )
     with pytest.raises(ValueError, match="position table"):
         load_hf_llama(path, cfg)
+
+
+def test_gpt2_untied_head_wins(hf_gpt2_checkpoint, tmp_path):
+    """An untied fine-tune's own lm_head.weight overrides the wte
+    transpose (safetensors dedups the tied case, so this copies the
+    checkpoint and injects a distinct head)."""
+    import dataclasses
+    import shutil
+
+    from safetensors.numpy import save_file
+    from safetensors import safe_open
+
+    path, _ = hf_gpt2_checkpoint
+    dst = tmp_path / "untied"
+    shutil.copytree(path, dst)
+    st = next(iter(dst.glob("*.safetensors")))
+    tensors = {}
+    with safe_open(str(st), framework="numpy") as h:
+        for name in h.keys():
+            tensors[name] = h.get_tensor(name)
+    rng = np.random.default_rng(7)
+    wte_name = (
+        "wte.weight" if "wte.weight" in tensors
+        else "transformer.wte.weight"
+    )
+    head = rng.standard_normal(
+        tensors[wte_name].shape
+    ).astype(np.float32) * 0.02
+    tensors["lm_head.weight"] = head
+    save_file(tensors, str(st))
+
+    cfg = dataclasses.replace(config_from_hf(str(dst)), dtype=jnp.float32)
+    params = load_hf_llama(str(dst), cfg)
+    np.testing.assert_allclose(
+        np.asarray(params["lm_head"]), head.T, atol=1e-6
+    )

@@ -330,3 +330,116 @@ def test_mistral_7b_registered():
 
     cfg = get_model("mistral-7b").config
     assert cfg.n_kv_heads == 8 and cfg.d_ff == 14336
+
+
+def test_vit_forward_and_engine_classify():
+    """ViT joins the vision family: forward shape and the engine's
+    batched classify path (same surface ResNet serves)."""
+    import jax
+
+    from gofr_tpu.models.vit import vit_forward
+    from gofr_tpu.serving.engine import InferenceEngine
+
+    spec = get_model("vit-tiny")
+    params = spec.init(jax.random.PRNGKey(0), spec.config)
+    img = jnp.ones((1, 32, 32, 3), jnp.float32)
+    logits = vit_forward(params, img, spec.config)
+    assert logits.shape == (1, 10)
+
+    eng = InferenceEngine("vit-tiny", max_batch=4)
+    eng.start_sync()
+    try:
+        out = eng.classify_sync(np.ones((32, 32, 3), np.float32))
+        assert np.asarray(out).shape[-1] == 10
+    finally:
+        eng.stop_sync()
+
+
+def test_vit_matches_torch_oracle():
+    """Patchify + one-matmul patch embedding must equal the HF conv
+    patch embedding, and the whole encoder must match
+    ViTForImageClassification logits (validates q/k/v/o maps, pre-LN
+    placement, CLS head)."""
+    import dataclasses
+
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from gofr_tpu.models.vit import ViTConfig, vit_forward
+
+    hf_cfg = transformers.ViTConfig(
+        image_size=32, patch_size=8, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128, num_labels=10,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12,
+    )
+    torch.manual_seed(4)
+    model = transformers.ViTForImageClassification(hf_cfg)
+    model.eval()
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+
+    cfg = dataclasses.replace(
+        ViTConfig(
+            image_size=32, patch_size=8, d_model=64, n_layers=2,
+            n_heads=4, d_ff=128, num_classes=10,
+        ),
+        dtype=jnp.float32,
+    )
+    L = cfg.n_layers
+    pre = "vit.encoder.layer.{}."
+
+    def stack(fmt, transpose=False):
+        a = np.stack([sd[fmt.format(i)] for i in range(L)])
+        return jnp.asarray(
+            np.swapaxes(a, -1, -2) if transpose else a, jnp.float32
+        )
+
+    conv_w = sd["vit.embeddings.patch_embeddings.projection.weight"]
+    # HF conv kernel [D, 3, P, P] → our flattened [(P, P, 3) row-major, D].
+    patch_proj = jnp.asarray(
+        conv_w.transpose(2, 3, 1, 0).reshape(-1, conv_w.shape[0]),
+        jnp.float32,
+    )
+    params = {
+        "patch_proj": patch_proj,
+        "patch_proj_b": jnp.asarray(
+            sd["vit.embeddings.patch_embeddings.projection.bias"]
+        ),
+        "cls_token": jnp.asarray(sd["vit.embeddings.cls_token"]),
+        "pos_embed": jnp.asarray(
+            sd["vit.embeddings.position_embeddings"][0]
+        ),
+        "layers": {
+            "ln1": stack(pre + "layernorm_before.weight"),
+            "ln1_b": stack(pre + "layernorm_before.bias"),
+            "wq": stack(pre + "attention.attention.query.weight", True),
+            "wq_b": stack(pre + "attention.attention.query.bias"),
+            "wk": stack(pre + "attention.attention.key.weight", True),
+            "wk_b": stack(pre + "attention.attention.key.bias"),
+            "wv": stack(pre + "attention.attention.value.weight", True),
+            "wv_b": stack(pre + "attention.attention.value.bias"),
+            "wo": stack(pre + "attention.output.dense.weight", True),
+            "wo_b": stack(pre + "attention.output.dense.bias"),
+            "ln2": stack(pre + "layernorm_after.weight"),
+            "ln2_b": stack(pre + "layernorm_after.bias"),
+            "w_up": stack(pre + "intermediate.dense.weight", True),
+            "w_up_b": stack(pre + "intermediate.dense.bias"),
+            "w_down": stack(pre + "output.dense.weight", True),
+            "w_down_b": stack(pre + "output.dense.bias"),
+        },
+        "ln_f": jnp.asarray(sd["vit.layernorm.weight"]),
+        "ln_f_b": jnp.asarray(sd["vit.layernorm.bias"]),
+        "head": jnp.asarray(np.swapaxes(sd["classifier.weight"], 0, 1)),
+        "head_b": jnp.asarray(sd["classifier.bias"]),
+    }
+    rng = np.random.default_rng(0)
+    img = rng.standard_normal((1, 32, 32, 3)).astype(np.float32)
+    ours = np.asarray(vit_forward(params, jnp.asarray(img), cfg))
+    with torch.no_grad():
+        # HF expects NCHW.
+        theirs = model(
+            torch.tensor(img.transpose(0, 3, 1, 2))
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=2e-3)
