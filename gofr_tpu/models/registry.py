@@ -23,6 +23,9 @@ class ModelSpec:
     config: Any
     init: Callable
     eos_token: int = 2
+    # Per-model forward (vision family): fn(params, inputs, cfg) → logits.
+    # LLM/encoder paths are architecture-generic and ignore this.
+    forward: Any = None
 
     def describe(self) -> dict:
         return {"name": self.name, "family": self.family}
@@ -76,13 +79,14 @@ def _register_llms() -> None:
             n_kv_heads=8, d_ff=14336, max_len=8192, rope_theta=1e6,
             n_experts=8, n_experts_active=2,
         ),
-        # Mistral-7B dims (HF loader accepts model_type=mistral).
-        # max_len capped at the model's 4096 sliding window: attention
-        # here is dense causal, which matches the reference only within
-        # the window.
+        # Mistral-7B dims (HF loader accepts model_type=mistral):
+        # sliding-window attention — every token attends the last 4096
+        # positions, so max_len can exceed the window (the cache stores
+        # max_len positions; the window is a masking contract).
         "mistral-7b": TransformerConfig(
             vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
-            n_kv_heads=8, d_ff=14336, max_len=4096, rope_theta=10000.0,
+            n_kv_heads=8, d_ff=14336, max_len=8192, rope_theta=10000.0,
+            sliding_window=4096,
         ),
         # Qwen2-7B dims (HF loader accepts model_type=qwen2; QKV bias).
         "qwen2-7b": TransformerConfig(
@@ -207,7 +211,7 @@ def _register_encoders() -> None:
 
 
 def _register_vision() -> None:
-    from gofr_tpu.models.resnet import ResNetConfig, init_resnet
+    from gofr_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
 
     register_model(
         ModelSpec(
@@ -215,9 +219,10 @@ def _register_vision() -> None:
             family="vision",
             config=ResNetConfig(),
             init=init_resnet,
+            forward=resnet_forward,
         )
     )
-    from gofr_tpu.models.vit import ViTConfig, init_vit
+    from gofr_tpu.models.vit import ViTConfig, init_vit, vit_forward
 
     register_model(
         ModelSpec(
@@ -225,6 +230,7 @@ def _register_vision() -> None:
             family="vision",
             config=ViTConfig(),
             init=init_vit,
+            forward=vit_forward,
         )
     )
     register_model(
@@ -236,6 +242,7 @@ def _register_vision() -> None:
                 n_heads=4, d_ff=128, num_classes=10,
             ),
             init=init_vit,
+            forward=vit_forward,
         )
     )
     register_model(
@@ -244,6 +251,7 @@ def _register_vision() -> None:
             family="vision",
             config=ResNetConfig(stage_sizes=(1, 1, 1, 1), width=16, num_classes=10),
             init=init_resnet,
+            forward=resnet_forward,
         )
     )
 

@@ -83,6 +83,11 @@ class TransformerConfig:
     # GPT-2: learned absolute position embeddings instead of RoPE (a
     # [max_len, d_model] table added at the embedding; rope is skipped).
     pos_emb: str = "rope"  # "rope" | "learned"
+    # Mistral: sliding-window attention — every query attends only the
+    # last `sliding_window` positions (0 = full causal). The cache still
+    # stores max_len positions; the window is a masking contract, which
+    # is what lets max_len exceed the window.
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -537,8 +542,16 @@ def _layer_prefill(x, lp, cfg, cos, sin, positions, mask, attn_fn=None,
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
     if attn_fn is None:
-        attn = attention(q, k, v, causal=True, mask=mask, lengths=lengths)
+        attn = attention(
+            q, k, v, causal=True, mask=mask, lengths=lengths,
+            window=cfg.sliding_window,
+        )
     else:
+        if cfg.sliding_window:
+            raise ValueError(
+                "sliding_window is not supported with ring/Ulysses "
+                "context-parallel attention"
+            )
         attn = attn_fn(q, k, v, mask)
     ao = attn.reshape(b, s, H * hd)
     attn_out = _wein("bsh,hd->bsd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
@@ -741,6 +754,7 @@ def transformer_prefill_chunk(
             q, ck, cv, slots, starts, lens, k_scale=cks, v_scale=cvs,
             block_table=cache.block_table if paged else None,
             kernel=False if dense_attn else None,
+            window=cfg.sliding_window,
         )
         ao = attn.reshape(P, c, H * hd)
         attn_out = (
@@ -830,6 +844,7 @@ def transformer_decode_step(
             v_scale=cvs,
             block_table=cache.block_table if paged else None,
             kernel=False if dense_attn else None,
+            window=cfg.sliding_window,
         )
         ao = attn.reshape(S, H * hd)
         attn_out = _wein("bh,hd->bd", ao, lp["wo"]) + _lora(ao, lp, "wo", aids)
@@ -927,7 +942,8 @@ def transformer_verify_step(
         if paged:
             ck, cv, cks, cvs = paged_view(cache.block_table, ck, cv, rows, cks, cvs)
         attn = verify_chunk_attention(
-            q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs
+            q, ck, cv, cache.lengths, k, v, k_scale=cks, v_scale=cvs,
+            window=cfg.sliding_window,
         )
         ao = attn.reshape(S, c, H * hd)
         attn_out = (

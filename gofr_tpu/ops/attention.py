@@ -85,9 +85,14 @@ def attention(
     lengths: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Full-sequence attention (prefill / encoder).
 
+    window: sliding-window attention (Mistral) — each query attends only
+    the last ``window`` keys (positions in (q_pos-window, q_pos]); 0 =
+    full. Takes the dense path (the flash kernels don't skip interior
+    blocks yet; the masking is exact either way).
     q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd].
     mask: optional [b, s_q, s_kv] additive-validity bool mask (True = attend).
     lengths: optional [b] valid key-prefix lengths (right-padded batches) —
@@ -98,6 +103,10 @@ def attention(
     """
     if mask is not None and lengths is not None:
         raise ValueError("pass either mask or lengths, not both")
+    if window and window >= k.shape[1]:
+        window = 0  # cannot bind: plain causal, keep the kernel path
+    if window:
+        kernel = False
     if kernel is None:
         kernel = _flash_enabled() and mask is None
     if kernel and mask is None:
@@ -130,10 +139,13 @@ def attention(
 
     if causal:
         # Offset so the last query attends to all keys (s_kv >= s_q case).
-        causal_mask = (
-            jnp.arange(s_kv)[None, :] <= (jnp.arange(s_q)[:, None] + (s_kv - s_q))
-        )
+        q_pos = jnp.arange(s_q)[:, None] + (s_kv - s_q)
+        causal_mask = jnp.arange(s_kv)[None, :] <= q_pos
+        if window:
+            causal_mask &= jnp.arange(s_kv)[None, :] > q_pos - window
         scores = jnp.where(causal_mask[None, None, None], scores, NEG_INF)
+    elif window:
+        raise ValueError("window requires causal attention")
     if mask is not None:
         scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
@@ -155,9 +167,12 @@ def decode_attention(
     block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Single-token decode attention against per-slot caches.
 
+    window: sliding-window (Mistral) — the query attends only the last
+    ``window`` positions including itself; 0 = full. Dense path only.
     q: [b, n_heads, hd] (one query per sequence);
     k_cache, v_cache: [b, n_kv_heads, max_len, hd] (heads-major — the
     TPU-native cache layout, see ``ops/kv_cache.py``);
@@ -182,6 +197,10 @@ def decode_attention(
     """
     if (k_new is None) != (v_new is None):
         raise ValueError("pass k_new and v_new together")
+    if window and window >= k_cache.shape[2]:
+        window = 0  # cannot bind within max_len: keep the kernel path
+    if window:
+        kernel = False
     if kernel is None:
         kernel = _flash_decode_enabled()
         if (
@@ -237,6 +256,12 @@ def decode_attention(
         scores = scores * k_scale[:, :, 0, None, :]
 
     valid = jnp.arange(max_len)[None, :] < lengths[:, None]  # [b, max_len]
+    if window:
+        # Query position: ``lengths`` (split path — the new token) or
+        # ``lengths-1`` (already-written convention). Keys must sit in
+        # (q_pos - window, q_pos].
+        q_pos = lengths if k_new is not None else lengths - 1
+        valid &= jnp.arange(max_len)[None, :] > (q_pos - window)[:, None]
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
 
     if k_new is None:
@@ -276,6 +301,7 @@ def verify_chunk_attention(
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
     scale: float | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Speculative-verify attention: ``c`` fresh tokens per slot attend the
     cache prefix PLUS themselves (causal within the chunk) — the cache
@@ -290,6 +316,8 @@ def verify_chunk_attention(
     """
     b, c, n_heads, hd = q.shape
     n_kv, max_len = k_cache.shape[1], k_cache.shape[2]
+    if window and window >= max_len + c:
+        window = 0  # cannot bind: skip the mask work
     rep = n_heads // n_kv
     if scale is None:
         scale = hd**-0.5
@@ -306,13 +334,27 @@ def verify_chunk_attention(
     if quant:
         s_c = s_c * k_scale[:, :, 0, :][:, :, None, None, :]
     valid = jnp.arange(max_len)[None, :] < prev_lengths[:, None]  # [b, T]
-    s_c = jnp.where(valid[:, None, None, None, :], s_c, NEG_INF)
+    valid = jnp.broadcast_to(valid[:, None, :], (b, c, max_len))
+    if window:
+        # Query j sits at global prev_lengths+j; cache keys must be in
+        # (q_pos - window, q_pos].
+        q_pos = prev_lengths[:, None] + jnp.arange(c)[None, :]  # [b, c]
+        valid = valid & (
+            jnp.arange(max_len)[None, None, :]
+            > (q_pos - window)[:, :, None]
+        )
+    # valid is [b, c, max_len]; scores are [b, kv, rep, c, max_len].
+    s_c = jnp.where(valid[:, None, None, :, :], s_c, NEG_INF)
 
     # In-chunk scores: [b, kv, rep, c, c], causal (key pos <= query pos).
     s_n = jnp.einsum(
         "bcgrd,btgd->bgrct", qg, k_new, preferred_element_type=jnp.float32
     ) * scale
     causal = jnp.arange(c)[None, :] <= jnp.arange(c)[:, None]  # [c_q, c_k]
+    if window:
+        causal = causal & (
+            jnp.arange(c)[None, :] > jnp.arange(c)[:, None] - window
+        )
     s_n = jnp.where(causal[None, None, None], s_n, NEG_INF)
 
     # Merged softmax over both key sets.
@@ -343,6 +385,7 @@ def cache_chunk_attention(
     block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     kernel: bool | None = None,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Chunked-prefill attention: a [P, c] chunk of queries per row attends
     to its slot's cache prefix [0, starts[p]+t] (causal at global
@@ -357,6 +400,10 @@ def cache_chunk_attention(
     (the CPU/tests fallback). Rows with t >= lens[p] return 0.
     kernel: None → auto (pallas on TPU).
     """
+    if window and window >= k_cache.shape[2] and block_table is None:
+        window = 0  # cannot bind within max_len: keep the kernel path
+    if window:
+        kernel = False
     if kernel is None:
         kernel = _flash_enabled()
     if kernel:
@@ -399,6 +446,11 @@ def cache_chunk_attention(
     t = jnp.arange(c)
     pos = starts[:, None] + t[None, :]  # [P, c] global query positions
     valid = jnp.arange(max_len)[None, None, :] <= pos[:, :, None]
+    if window:
+        valid &= (
+            jnp.arange(max_len)[None, None, :]
+            > (pos - window)[:, :, None]
+        )
     valid = jnp.logical_and(valid, (t[None, :] < lens[:, None])[:, :, None])
     scores = jnp.where(valid[:, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -1311,11 +1311,13 @@ class InferenceEngine:
         )
 
     def _build_vision_step(self) -> None:
-        from gofr_tpu.models.resnet import resnet_forward
-        from gofr_tpu.models.vit import ViTConfig, vit_forward
-
         cfg = self.cfg
-        fwd = vit_forward if isinstance(cfg, ViTConfig) else resnet_forward
+        fwd = self.spec.forward
+        if fwd is None:
+            raise ValueError(
+                f"vision model {self.model_name} registered without a "
+                f"forward fn (ModelSpec.forward)"
+            )
         self._classify_step = self._jax.jit(
             lambda params, images: fwd(params, images, cfg)
         )

@@ -146,6 +146,10 @@ def config_from_hf(path: str):
         # attention_bias flag in older revisions — the model_type implies it).
         attn_bias=(mt == "qwen2") or bool(hf.get("attention_bias", False)),
         n_experts_active=int(hf.get("num_experts_per_tok", 2)),
+        # Mistral sliding-window attention (null/absent → full causal;
+        # mixtral configs carry the field too).
+        sliding_window=int(hf.get("sliding_window") or 0)
+        if mt in ("mistral", "mixtral") else 0,
         # Gemma: explicit head_dim (7B: 256 ≠ 3072/16), GeGLU FFN,
         # (1+w) RMSNorm, sqrt(d_model)-scaled embeddings, tied lm_head
         # (resolved below from the embedding transpose).
@@ -238,6 +242,19 @@ def load_hf_llama(
                 raise ValueError(
                     f"checkpoint/config mismatch: {field}={have} in "
                     f"{path}/config.json but engine expects {want}"
+                )
+        if cfg.sliding_window != file_cfg.sliding_window:
+            # v0.2/v0.3 Mistral checkpoints carry sliding_window: null;
+            # a hard mismatch error would reject them against the v0.1
+            # registry entry. Serving proceeds with the ENGINE's window
+            # (a masking choice, not a weight-layout difference) — warn
+            # so an unintended mismatch is visible.
+            if logger is not None:
+                logger.warnf(
+                    "sliding_window mismatch: checkpoint %s declares %d, "
+                    "engine serves with %d (masking follows the engine "
+                    "config)", path, file_cfg.sliding_window,
+                    cfg.sliding_window,
                 )
         if (
             file_cfg.pos_emb == "learned"
