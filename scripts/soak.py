@@ -21,6 +21,7 @@ import random
 import resource
 import sys
 import time
+from concurrent.futures import CancelledError
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -95,13 +96,9 @@ def main() -> int:
                 eng.unload_lora("b")
             # Cancel ~20% mid-flight (future.cancel() is the public
             # cancellation seam; False = already finished).
-            cancelled = set()
             for r in reqs:
                 if rng.random() < 0.2 and r.future.cancel():
                     cancels += 1
-                    cancelled.add(id(r))
-            from concurrent.futures import CancelledError
-
             for r in reqs:
                 try:
                     r.future.result(timeout=180)
