@@ -210,6 +210,35 @@ def _register_encoders() -> None:
     )
 
 
+def _register_seq2seq() -> None:
+    from gofr_tpu.models.t5 import T5Config, init_t5
+
+    register_model(
+        ModelSpec(
+            name="flan-t5-small",
+            family="seq2seq",
+            # t5-v1.1-small / flan-t5-small dims: gated-gelu, untied head.
+            config=T5Config(
+                d_model=512, d_kv=64, n_heads=6, n_layers=8, d_ff=1024,
+            ),
+            init=init_t5,
+            eos_token=1,
+        )
+    )
+    register_model(
+        ModelSpec(
+            name="t5-tiny",
+            family="seq2seq",
+            config=T5Config(
+                vocab_size=512, d_model=64, d_kv=16, n_heads=4,
+                n_layers=2, d_ff=128, max_len=128,
+            ),
+            init=init_t5,
+            eos_token=1,
+        )
+    )
+
+
 def _register_vision() -> None:
     from gofr_tpu.models.resnet import ResNetConfig, init_resnet, resnet_forward
 
@@ -258,4 +287,5 @@ def _register_vision() -> None:
 
 _register_llms()
 _register_encoders()
+_register_seq2seq()
 _register_vision()

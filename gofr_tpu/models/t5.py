@@ -1,0 +1,282 @@
+"""T5 encoder-decoder (text-to-text) — the seq2seq architecture class
+next to the decoder-only, encoder-only, and vision families.
+
+T5 particulars honored for HF parity: RMSNorm without bias, **unscaled**
+attention scores (T5 folds the 1/sqrt(d) into its initialization),
+learned RELATIVE position bias added to the scores (one bucket table
+per attention kind, owned by layer 0 and shared by all layers; none on
+cross-attention), explicit per-head ``d_kv`` (not d_model/heads), a
+gated-gelu FFN for the v1.1 lineage (plain relu for original T5), and
+the tied-head logit scaling ``d_model**-0.5`` only when tied.
+
+TPU-first shape: encoder and decoder layers are stacked and scanned;
+generation is one jitted program over a fixed ``[b, 1+max_new]``
+decoder buffer — each step re-attends the whole buffer with causal +
+validity masking (static shapes; O(n²) over a short answer buffer
+beats dynamic-shape recompiles). Serving runs it behind the same
+DynamicBatcher the encoder/vision families use.
+
+Reference analog: none (GoFr has no models).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from gofr_tpu.ops.norms import rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64  # per-head; T5 does NOT require d_model/n_heads
+    n_heads: int = 8
+    n_layers: int = 6  # encoder layers == decoder layers
+    d_ff: int = 2048
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    norm_eps: float = 1e-6
+    gated_ffn: bool = True  # v1.1 gated-gelu; False = original relu
+    tied_head: bool = False  # v1.1 unties; tied scales logits by d^-0.5
+    max_len: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_kv
+
+
+def _rel_bucket(
+    rel_pos: jnp.ndarray, bidirectional: bool, num_buckets: int, max_dist: int
+) -> jnp.ndarray:
+    """HF T5 bucketing: exact small distances, log-spaced large ones."""
+    ret = jnp.zeros_like(rel_pos)
+    n = rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = -jnp.minimum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / jnp.log(max_dist / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _rel_bias(
+    table: jnp.ndarray, q_len: int, k_len: int, bidirectional: bool,
+    cfg: T5Config,
+) -> jnp.ndarray:
+    """[buckets, heads] table → [1, heads, q_len, k_len] score bias."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _rel_bucket(
+        mem - ctx, bidirectional, cfg.rel_buckets, cfg.rel_max_distance
+    )
+    return table[buckets].transpose(2, 0, 1)[None].astype(jnp.float32)
+
+
+def init_t5(key: jax.Array, cfg: T5Config) -> dict:
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5
+        ).astype(cfg.dtype)
+
+    D, H, hd, F, L = cfg.d_model, cfg.n_heads, cfg.d_kv, cfg.d_ff, cfg.n_layers
+    ks = iter(jax.random.split(key, 64))
+
+    def attn_leaves():
+        return {
+            "wq": dense(next(ks), (L, D, H * hd), D),
+            "wk": dense(next(ks), (L, D, H * hd), D),
+            "wv": dense(next(ks), (L, D, H * hd), D),
+            "wo": dense(next(ks), (L, H * hd, D), H * hd),
+        }
+
+    def ffn_leaves():
+        leaves = {
+            "w_up": dense(next(ks), (L, D, F), D),
+            "w_down": dense(next(ks), (L, F, D), F),
+        }
+        if cfg.gated_ffn:
+            leaves["w_gate"] = dense(next(ks), (L, D, F), D)
+        return leaves
+
+    enc = {
+        "ln1": jnp.ones((L, D), cfg.dtype),
+        "ln2": jnp.ones((L, D), cfg.dtype),
+        **{f"sa_{k}": v for k, v in attn_leaves().items()},
+        **ffn_leaves(),
+    }
+    dec = {
+        "ln1": jnp.ones((L, D), cfg.dtype),
+        "ln2": jnp.ones((L, D), cfg.dtype),
+        "ln3": jnp.ones((L, D), cfg.dtype),
+        **{f"sa_{k}": v for k, v in attn_leaves().items()},
+        **{f"ca_{k}": v for k, v in attn_leaves().items()},
+        **ffn_leaves(),
+    }
+    params = {
+        "embed": dense(next(ks), (cfg.vocab_size, D), D),
+        "enc_rel_bias": dense(
+            next(ks), (cfg.rel_buckets, H), cfg.rel_buckets
+        ),
+        "dec_rel_bias": dense(
+            next(ks), (cfg.rel_buckets, H), cfg.rel_buckets
+        ),
+        "encoder": enc,
+        "decoder": dec,
+        "enc_norm": jnp.ones((D,), cfg.dtype),
+        "dec_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tied_head:
+        params["lm_head"] = dense(next(ks), (D, cfg.vocab_size), D)
+    return params
+
+
+def _mha(h_q, h_kv, lp, pre, cfg, bias, mask):
+    """Unscaled T5 attention. h_q: [b, s_q, D]; h_kv: [b, s_kv, D];
+    bias: [1, H, s_q, s_kv] or None; mask: [b, 1, s_q, s_kv] bool or
+    None."""
+    b, s_q, _ = h_q.shape
+    s_kv = h_kv.shape[1]
+    H, hd = cfg.n_heads, cfg.d_kv
+    q = jnp.einsum("bsd,dh->bsh", h_q, lp[pre + "wq"]).reshape(b, s_q, H, hd)
+    k = jnp.einsum("bsd,dh->bsh", h_kv, lp[pre + "wk"]).reshape(b, s_kv, H, hd)
+    v = jnp.einsum("bsd,dh->bsh", h_kv, lp[pre + "wv"]).reshape(b, s_kv, H, hd)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )  # NO 1/sqrt(d) scale — T5 convention
+    if bias is not None:
+        scores = scores + bias
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(h_q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s_q, H * hd)
+    return jnp.einsum("bsh,hd->bsd", out, lp[pre + "wo"])
+
+
+def _ffn(h, lp, cfg):
+    if cfg.gated_ffn:
+        g = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", h, lp["w_gate"]), approximate=True
+        )
+        u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+        return jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
+    u = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, lp["w_up"]))
+    return jnp.einsum("bsf,fd->bsd", u, lp["w_down"])
+
+
+def t5_encode(
+    params: dict, tokens: jnp.ndarray, lengths: jnp.ndarray, cfg: T5Config
+) -> jnp.ndarray:
+    """tokens [b, s], lengths [b] → encoder states [b, s, D]."""
+    b, s = tokens.shape
+    x = params["embed"][tokens]
+    bias = _rel_bias(params["enc_rel_bias"], s, s, True, cfg)
+    key_ok = (jnp.arange(s)[None, :] < lengths[:, None])[:, None, None, :]
+
+    def body(x, lp):
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        x = x + _mha(h, h, lp, "sa_", cfg, bias, key_ok)
+        h = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        return x + _ffn(h, lp, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["encoder"])
+    return rms_norm(x, params["enc_norm"], cfg.norm_eps)
+
+
+def t5_decode(
+    params: dict,
+    dec_tokens: jnp.ndarray,
+    enc_states: jnp.ndarray,
+    enc_lengths: jnp.ndarray,
+    cfg: T5Config,
+    dec_lengths: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """dec_tokens [b, t] (starts with pad=0, the T5 BOS) → logits
+    [b, t, vocab] f32. dec_lengths masks decoder self-attention keys
+    beyond the valid prefix (generation's fixed buffer)."""
+    b, t = dec_tokens.shape
+    s = enc_states.shape[1]
+    x = params["embed"][dec_tokens]
+    bias = _rel_bias(params["dec_rel_bias"], t, t, False, cfg)
+    causal = (
+        jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+    )[None, None]  # [1, 1, t, t]
+    self_mask = causal
+    if dec_lengths is not None:
+        self_mask = self_mask & (
+            jnp.arange(t)[None, :] < dec_lengths[:, None]
+        )[:, None, None, :]
+    cross_mask = (
+        jnp.arange(s)[None, :] < enc_lengths[:, None]
+    )[:, None, None, :]
+
+    def body(x, lp):
+        h = rms_norm(x, lp["ln1"], cfg.norm_eps)
+        x = x + _mha(h, h, lp, "sa_", cfg, bias, self_mask)
+        h = rms_norm(x, lp["ln2"], cfg.norm_eps)
+        x = x + _mha(h, enc_states, lp, "ca_", cfg, None, cross_mask)
+        h = rms_norm(x, lp["ln3"], cfg.norm_eps)
+        return x + _ffn(h, lp, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["decoder"])
+    x = rms_norm(x, params["dec_norm"], cfg.norm_eps)
+    if cfg.tied_head:
+        x = x * (cfg.d_model**-0.5)
+        head = jnp.swapaxes(params["embed"], 0, 1)
+    else:
+        head = params["lm_head"]
+    return jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+
+
+def t5_generate(
+    params: dict,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    cfg: T5Config,
+    max_new: int = 32,
+    eos_id: int = 1,
+) -> jnp.ndarray:
+    """Batched greedy generation: tokens [b, s] + lengths [b] →
+    generated ids [b, max_new] (entries after EOS are pad=0).
+
+    One jitted program: encode once, then a ``lax.scan`` over a fixed
+    ``[b, 1+max_new]`` decoder buffer — step i re-runs the decoder over
+    the buffer with validity masking and writes position i+1. Static
+    shapes throughout; the quadratic recompute over a short answer
+    buffer is the compile-friendly trade.
+    """
+    enc = t5_encode(params, tokens, lengths, cfg)
+    b = tokens.shape[0]
+    buf0 = jnp.zeros((b, 1 + max_new), dtype=jnp.int32)  # pos 0 = T5 BOS
+    done0 = jnp.zeros((b,), dtype=bool)
+
+    def step(carry, i):
+        buf, done = carry
+        logits = t5_decode(
+            params, buf, enc, lengths, cfg,
+            dec_lengths=jnp.full((b,), i + 1, jnp.int32),
+        )
+        nxt = jnp.argmax(logits[:, i], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, 0, nxt)
+        buf = buf.at[:, i + 1].set(nxt)
+        done = done | (nxt == eos_id)
+        return (buf, done), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf0, done0), jnp.arange(max_new)
+    )
+    return buf[:, 1:]

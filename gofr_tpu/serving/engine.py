@@ -555,6 +555,13 @@ class InferenceEngine:
                 self._execute_classify, max_batch=max_batch, max_wait_s=max_wait_s,
                 metrics=metrics, name="classify",
             )
+        elif self.family == "seq2seq":
+            self.max_len = min(max_len, self.cfg.max_len)
+            self._build_seq2seq_step()
+            self._batcher = DynamicBatcher(
+                self._execute_seq2seq, max_batch=max_batch,
+                max_wait_s=max_wait_s, metrics=metrics, name="seq2seq",
+            )
         else:
             raise ValueError(f"unknown model family {self.family}")
 
@@ -1308,6 +1315,20 @@ class InferenceEngine:
         cfg = self.cfg
         self._embed_step = self._jax.jit(
             lambda params, tokens, mask: bert_embed(params, tokens, mask, cfg)
+        )
+
+    def _build_seq2seq_step(self) -> None:
+        from gofr_tpu.models.t5 import t5_generate
+
+        cfg = self.cfg
+        max_new = self._seq2seq_max_new = int(
+            os.environ.get("TPU_SEQ2SEQ_MAX_NEW", "64")
+        )
+        eos = self.spec.eos_token
+        self._seq2seq_step = self._jax.jit(
+            lambda params, tokens, lengths: t5_generate(
+                params, tokens, lengths, cfg, max_new=max_new, eos_id=eos
+            )
         )
 
     def _build_vision_step(self) -> None:
@@ -2847,6 +2868,49 @@ class InferenceEngine:
             )
         return [logits[i] for i in range(len(images))]
 
+    def _execute_seq2seq(self, texts: list) -> list:
+        jnp = self._jnp
+        encoded = [
+            self.tokenizer.encode(t)[: self.max_len]
+            if isinstance(t, str) else list(t)
+            for t in texts
+        ]
+        bucket = pad_bucket(max(len(e) for e in encoded), _PREFILL_BUCKETS)
+        bucket = min(bucket, self.max_len)
+        tokens = np.zeros((len(encoded), bucket), dtype=np.int32)
+        lengths = np.zeros((len(encoded),), dtype=np.int32)
+        for i, ids in enumerate(encoded):
+            ids = ids[:bucket]
+            tokens[i, : len(ids)] = ids
+            lengths[i] = len(ids)
+        t0 = time.time()
+        out = np.asarray(self._seq2seq_step(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths)
+        ))
+        if self._metrics is not None:
+            self._metrics.record_histogram(
+                "app_tpu_infer_latency", time.time() - t0, "kind", "seq2seq"
+            )
+        eos = self.spec.eos_token
+        results = []
+        for i in range(len(encoded)):
+            ids = out[i].tolist()
+            # Trim at EOS only: pad zeros exist solely AFTER an emitted
+            # EOS (t5_generate), and id 0 is a legitimate vocab token a
+            # model may emit mid-sequence.
+            if eos in ids:
+                ids = ids[: ids.index(eos)]
+            results.append(ids)
+        return results
+
+    def seq2seq_sync(self, text, timeout: float = 120.0) -> list:
+        """Text-to-text generation (T5 family): returns generated token
+        ids (EOS-trimmed, unpadded)."""
+        return self._batcher.submit(text).result(timeout=timeout)
+
+    async def seq2seq(self, text) -> list:
+        return await asyncio.wrap_future(self._batcher.submit(text))
+
     def embed_sync(self, text, timeout: float = 60.0) -> np.ndarray:
         return self._batcher.submit(text).result(timeout=timeout)
 
@@ -2875,6 +2939,13 @@ class InferenceEngine:
         if self.family == "encoder":
             emb = await self.embed(inputs)
             return {"embedding": emb.tolist()}
+        if self.family == "seq2seq":
+            ids = await self.seq2seq(inputs)
+            text = (
+                self.tokenizer.decode(ids)
+                if self.tokenizer is not None else ""
+            )
+            return {"text": text, "token_ids": ids}
         vec = await self.classify(inputs)
         return {"logits": vec.tolist(), "class": int(np.argmax(vec))}
 
@@ -2888,6 +2959,13 @@ class InferenceEngine:
             }
         if self.family == "encoder":
             return {"embedding": self.embed_sync(inputs).tolist()}
+        if self.family == "seq2seq":
+            ids = self.seq2seq_sync(inputs)
+            text = (
+                self.tokenizer.decode(ids)
+                if self.tokenizer is not None else ""
+            )
+            return {"text": text, "token_ids": ids}
         vec = self.classify_sync(inputs)
         return {"logits": vec.tolist(), "class": int(np.argmax(vec))}
 
