@@ -280,3 +280,135 @@ def t5_generate(
         step, (buf0, done0), jnp.arange(max_new)
     )
     return buf[:, 1:]
+
+
+def config_from_hf_t5(path: str) -> T5Config:
+    """Build a T5Config from an HF t5/flan-t5 ``config.json``."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    if hf.get("model_type") != "t5":
+        raise ValueError(f"not a t5 checkpoint: {hf.get('model_type')!r}")
+    if hf.get("num_decoder_layers", hf["num_layers"]) != hf["num_layers"]:
+        raise ValueError(
+            "asymmetric encoder/decoder depths are not supported"
+        )
+    proj = hf.get("feed_forward_proj", "relu")
+    if proj not in ("relu", "gated-gelu"):
+        # 'gated-relu' / plain 'gelu' would silently run the wrong
+        # activation in _ffn — reject loudly like unsupported model types.
+        raise ValueError(f"unsupported t5 feed_forward_proj {proj!r}")
+    return T5Config(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["d_model"],
+        d_kv=hf["d_kv"],
+        n_heads=hf["num_heads"],
+        n_layers=hf["num_layers"],
+        d_ff=hf["d_ff"],
+        rel_buckets=hf.get("relative_attention_num_buckets", 32),
+        rel_max_distance=hf.get("relative_attention_max_distance", 128),
+        norm_eps=float(hf.get("layer_norm_epsilon", 1e-6)),
+        gated_ffn=proj.startswith("gated"),
+        tied_head=bool(hf.get("tie_word_embeddings", True)),
+    )
+
+
+def load_hf_t5(path: str, cfg: T5Config | None = None) -> dict:
+    """Load an HF t5/flan-t5 safetensors checkpoint into the t5 pytree.
+
+    Same conventions as the decoder loader (``serving/hf_loader``): HF
+    linears are [out, in] → transposed to [in, out]; per-layer tensors
+    stack along the scan axis; the relative-attention bias tables live
+    on block 0 only. ``gated_ffn`` maps wi_0→gate, wi_1→up; plain relu
+    maps wi→up.
+    """
+    import numpy as np
+
+    from gofr_tpu.serving.hf_loader import _TensorSource
+
+    file_cfg = config_from_hf_t5(path)
+    if cfg is None:
+        cfg = file_cfg
+    else:
+        for field in ("vocab_size", "d_model", "d_kv", "n_heads",
+                      "n_layers", "d_ff", "rel_buckets",
+                      "rel_max_distance", "gated_ffn", "tied_head"):
+            want, have = getattr(cfg, field), getattr(file_cfg, field)
+            if want != have:
+                raise ValueError(
+                    f"checkpoint/config mismatch: {field}={have} in "
+                    f"{path}/config.json but engine expects {want}"
+                )
+    # Lazy per-leaf access (the hf_loader memory discipline: the full
+    # tree never materializes twice on host).
+    src = _TensorSource(path)
+
+    L = cfg.n_layers
+
+    def stack(fmt: str, transpose: bool = True):
+        a = np.stack([np.asarray(src.get(fmt.format(i))) for i in range(L)])
+        if transpose:
+            a = np.swapaxes(a, -1, -2)
+        return jnp.asarray(a, cfg.dtype)
+
+    def attn(side: str, layer_idx: int, pre: str) -> dict:
+        base = f"{side}.block.{{}}.layer.{layer_idx}."
+        kind = "SelfAttention" if layer_idx == 0 else "EncDecAttention"
+        return {
+            f"{pre}{w}": stack(base + kind + f".{h}.weight")
+            for w, h in (("wq", "q"), ("wk", "k"), ("wv", "v"), ("wo", "o"))
+        }
+
+    def ffn(side: str, layer_idx: int) -> dict:
+        base = f"{side}.block.{{}}.layer.{layer_idx}.DenseReluDense."
+        if cfg.gated_ffn:
+            return {
+                "w_gate": stack(base + "wi_0.weight"),
+                "w_up": stack(base + "wi_1.weight"),
+                "w_down": stack(base + "wo.weight"),
+            }
+        return {
+            "w_up": stack(base + "wi.weight"),
+            "w_down": stack(base + "wo.weight"),
+        }
+
+    enc = {
+        "ln1": stack("encoder.block.{}.layer.0.layer_norm.weight", False),
+        "ln2": stack("encoder.block.{}.layer.1.layer_norm.weight", False),
+        **attn("encoder", 0, "sa_"),
+        **ffn("encoder", 1),
+    }
+    dec = {
+        "ln1": stack("decoder.block.{}.layer.0.layer_norm.weight", False),
+        "ln2": stack("decoder.block.{}.layer.1.layer_norm.weight", False),
+        "ln3": stack("decoder.block.{}.layer.2.layer_norm.weight", False),
+        **attn("decoder", 0, "sa_"),
+        **attn("decoder", 1, "ca_"),
+        **ffn("decoder", 2),
+    }
+    params = {
+        "embed": jnp.asarray(src.get("shared.weight"), cfg.dtype),
+        "enc_rel_bias": jnp.asarray(src.get(
+            "encoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight"
+        ), cfg.dtype),
+        "dec_rel_bias": jnp.asarray(src.get(
+            "decoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight"
+        ), cfg.dtype),
+        "encoder": enc,
+        "decoder": dec,
+        "enc_norm": jnp.asarray(
+            src.get("encoder.final_layer_norm.weight"), cfg.dtype
+        ),
+        "dec_norm": jnp.asarray(
+            src.get("decoder.final_layer_norm.weight"), cfg.dtype
+        ),
+    }
+    if not cfg.tied_head:
+        params["lm_head"] = jnp.asarray(
+            np.swapaxes(np.asarray(src.get("lm_head.weight")), 0, 1), cfg.dtype
+        )
+    return params

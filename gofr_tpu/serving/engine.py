@@ -611,10 +611,24 @@ class InferenceEngine:
                 # onto the tp mesh when one is configured.
                 from gofr_tpu.models.registry import get_model
 
-                params = load_hf_llama(
-                    ckpt, get_model(model_name).config, quant=quant_cfg,
-                    mesh=mesh, logger=logger,
-                )
+                spec = get_model(model_name)
+                if spec.family == "seq2seq":
+                    from gofr_tpu.models.t5 import load_hf_t5
+
+                    if quant_cfg or mesh is not None:
+                        # Silently serving unquantized/replicated would
+                        # defeat the operator's explicit memory and
+                        # parallelism settings.
+                        raise ValueError(
+                            "TPU_QUANT / TPU_MESH_* are not supported "
+                            "for seq2seq checkpoints yet"
+                        )
+                    params = load_hf_t5(ckpt, spec.config)
+                else:
+                    params = load_hf_llama(
+                        ckpt, spec.config, quant=quant_cfg,
+                        mesh=mesh, logger=logger,
+                    )
         engine = cls(
             model_name,
             mesh=mesh,
