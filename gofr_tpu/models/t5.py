@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from gofr_tpu.ops.norms import rms_norm
+from gofr_tpu.models.transformer import _wein
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,9 +153,9 @@ def _mha(h_q, h_kv, lp, pre, cfg, bias, mask):
     b, s_q, _ = h_q.shape
     s_kv = h_kv.shape[1]
     H, hd = cfg.n_heads, cfg.d_kv
-    q = jnp.einsum("bsd,dh->bsh", h_q, lp[pre + "wq"]).reshape(b, s_q, H, hd)
-    k = jnp.einsum("bsd,dh->bsh", h_kv, lp[pre + "wk"]).reshape(b, s_kv, H, hd)
-    v = jnp.einsum("bsd,dh->bsh", h_kv, lp[pre + "wv"]).reshape(b, s_kv, H, hd)
+    q = _wein("bsd,dh->bsh", h_q, lp[pre + "wq"]).reshape(b, s_q, H, hd)
+    k = _wein("bsd,dh->bsh", h_kv, lp[pre + "wk"]).reshape(b, s_kv, H, hd)
+    v = _wein("bsd,dh->bsh", h_kv, lp[pre + "wv"]).reshape(b, s_kv, H, hd)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     )  # NO 1/sqrt(d) scale — T5 convention
@@ -164,18 +165,18 @@ def _mha(h_q, h_kv, lp, pre, cfg, bias, mask):
         scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(h_q.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, s_q, H * hd)
-    return jnp.einsum("bsh,hd->bsd", out, lp[pre + "wo"])
+    return _wein("bsh,hd->bsd", out, lp[pre + "wo"])
 
 
 def _ffn(h, lp, cfg):
     if cfg.gated_ffn:
         g = jax.nn.gelu(
-            jnp.einsum("bsd,df->bsf", h, lp["w_gate"]), approximate=True
+            _wein("bsd,df->bsf", h, lp["w_gate"]), approximate=True
         )
-        u = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-        return jnp.einsum("bsf,fd->bsd", g * u, lp["w_down"])
-    u = jax.nn.relu(jnp.einsum("bsd,df->bsf", h, lp["w_up"]))
-    return jnp.einsum("bsf,fd->bsd", u, lp["w_down"])
+        u = _wein("bsd,df->bsf", h, lp["w_up"])
+        return _wein("bsf,fd->bsd", g * u, lp["w_down"])
+    u = jax.nn.relu(_wein("bsd,df->bsf", h, lp["w_up"]))
+    return _wein("bsf,fd->bsd", u, lp["w_down"])
 
 
 def t5_encode(
@@ -239,7 +240,7 @@ def t5_decode(
         head = jnp.swapaxes(params["embed"], 0, 1)
     else:
         head = params["lm_head"]
-    return jnp.einsum("btd,dv->btv", x, head).astype(jnp.float32)
+    return _wein("btd,dv->btv", x, head).astype(jnp.float32)
 
 
 def t5_generate(
@@ -315,14 +316,19 @@ def config_from_hf_t5(path: str) -> T5Config:
     )
 
 
-def load_hf_t5(path: str, cfg: T5Config | None = None) -> dict:
+def load_hf_t5(
+    path: str, cfg: T5Config | None = None, *, quant: str = ""
+) -> dict:
     """Load an HF t5/flan-t5 safetensors checkpoint into the t5 pytree.
 
     Same conventions as the decoder loader (``serving/hf_loader``): HF
     linears are [out, in] → transposed to [in, out]; per-layer tensors
     stack along the scan axis; the relative-attention bias tables live
     on block 0 only. ``gated_ffn`` maps wi_0→gate, wi_1→up; plain relu
-    maps wi→up.
+    maps wi→up. ``quant`` ("int8"/"int4") quantizes each projection
+    leaf AS IT LANDS — an 11B flan-t5-xxl must fit at its quantized
+    footprint, never the full bf16 tree (the decoder-loader memory
+    discipline).
     """
     import numpy as np
 
@@ -344,20 +350,29 @@ def load_hf_t5(path: str, cfg: T5Config | None = None) -> dict:
     # Lazy per-leaf access (the hf_loader memory discipline: the full
     # tree never materializes twice on host).
     src = _TensorSource(path)
+    if quant:
+        from gofr_tpu.ops.quant import _quant_fn
+
+        qleaf = jax.jit(_quant_fn(quant), donate_argnums=(0,))
+    else:
+        qleaf = None
 
     L = cfg.n_layers
 
-    def stack(fmt: str, transpose: bool = True):
+    def stack(fmt: str, transpose: bool = True, quantize: bool = False):
         a = np.stack([np.asarray(src.get(fmt.format(i))) for i in range(L)])
         if transpose:
             a = np.swapaxes(a, -1, -2)
-        return jnp.asarray(a, cfg.dtype)
+        out = jnp.asarray(a, cfg.dtype)
+        if quantize and qleaf is not None:
+            out = qleaf(out)
+        return out
 
     def attn(side: str, layer_idx: int, pre: str) -> dict:
         base = f"{side}.block.{{}}.layer.{layer_idx}."
         kind = "SelfAttention" if layer_idx == 0 else "EncDecAttention"
         return {
-            f"{pre}{w}": stack(base + kind + f".{h}.weight")
+            f"{pre}{w}": stack(base + kind + f".{h}.weight", quantize=True)
             for w, h in (("wq", "q"), ("wk", "k"), ("wv", "v"), ("wo", "o"))
         }
 
@@ -365,13 +380,13 @@ def load_hf_t5(path: str, cfg: T5Config | None = None) -> dict:
         base = f"{side}.block.{{}}.layer.{layer_idx}.DenseReluDense."
         if cfg.gated_ffn:
             return {
-                "w_gate": stack(base + "wi_0.weight"),
-                "w_up": stack(base + "wi_1.weight"),
-                "w_down": stack(base + "wo.weight"),
+                "w_gate": stack(base + "wi_0.weight", quantize=True),
+                "w_up": stack(base + "wi_1.weight", quantize=True),
+                "w_down": stack(base + "wo.weight", quantize=True),
             }
         return {
-            "w_up": stack(base + "wi.weight"),
-            "w_down": stack(base + "wo.weight"),
+            "w_up": stack(base + "wi.weight", quantize=True),
+            "w_down": stack(base + "wo.weight", quantize=True),
         }
 
     enc = {
@@ -408,7 +423,35 @@ def load_hf_t5(path: str, cfg: T5Config | None = None) -> dict:
         ),
     }
     if not cfg.tied_head:
-        params["lm_head"] = jnp.asarray(
-            np.swapaxes(np.asarray(src.get("lm_head.weight")), 0, 1), cfg.dtype
+        head = jnp.asarray(
+            np.swapaxes(np.asarray(src.get("lm_head.weight")), 0, 1),
+            cfg.dtype,
         )
+        params["lm_head"] = qleaf(head) if qleaf is not None else head
     return params
+
+
+def quantize_t5_params(params: dict, mode: str = "int8") -> dict:
+    """Weight-only quantization of a T5 tree's matmul leaves (the
+    sa_/ca_-prefixed projections and the FFN weights in both stacks,
+    plus the untied lm_head). Norms, embeddings, and the relative-bias
+    tables stay bf16 — _QUANT_KEYS is the ONE quantization-policy set
+    shared with the decoder tree."""
+    from gofr_tpu.ops.quant import _QUANT_KEYS, _quant_fn
+
+    quant = _quant_fn(mode)
+
+    def qsub(tree: dict) -> dict:
+        return {
+            k: quant(v)
+            if k.removeprefix("sa_").removeprefix("ca_") in _QUANT_KEYS
+            else v
+            for k, v in tree.items()
+        }
+
+    out = dict(params)
+    out["encoder"] = qsub(params["encoder"])
+    out["decoder"] = qsub(params["decoder"])
+    if "lm_head" in params:
+        out["lm_head"] = quant(params["lm_head"])
+    return out

@@ -615,15 +615,16 @@ class InferenceEngine:
                 if spec.family == "seq2seq":
                     from gofr_tpu.models.t5 import load_hf_t5
 
-                    if quant_cfg or mesh is not None:
-                        # Silently serving unquantized/replicated would
-                        # defeat the operator's explicit memory and
-                        # parallelism settings.
+                    if mesh is not None:
+                        # Silently serving replicated would defeat the
+                        # operator's explicit parallelism settings.
                         raise ValueError(
-                            "TPU_QUANT / TPU_MESH_* are not supported "
-                            "for seq2seq checkpoints yet"
+                            "TPU_MESH_* is not supported for seq2seq "
+                            "checkpoints yet"
                         )
-                    params = load_hf_t5(ckpt, spec.config)
+                    params = load_hf_t5(
+                        ckpt, spec.config, quant=quant_cfg
+                    )
                 else:
                     params = load_hf_llama(
                         ckpt, spec.config, quant=quant_cfg,
@@ -1383,10 +1384,24 @@ class InferenceEngine:
             raise ValueError(
                 f"unsupported quant mode {mode!r} (int8 or int4)"
             )
-        if self.family != "llm":
-            raise ValueError("quantization currently supports llm models only")
+        if self.family not in ("llm", "seq2seq"):
+            raise ValueError(
+                "quantization supports llm and seq2seq models only"
+            )
         if getattr(self, "_running", False):  # __init__ calls this pre-flags
             raise RuntimeError("quantize before starting the engine")
+        if self.family == "seq2seq":
+            if self.mesh is not None:
+                raise ValueError(
+                    "quantized seq2seq does not compose with a mesh yet"
+                )
+            from gofr_tpu.models.t5 import quantize_t5_params
+
+            self.params = self._jax.jit(
+                lambda p: quantize_t5_params(p, mode), donate_argnums=(0,)
+            )(self.params)
+            self.quant = mode
+            return
         from gofr_tpu.ops.quant import quantize_params
 
         # donate: the bf16 tree frees leaf-by-leaf as the int8 tree
