@@ -50,6 +50,18 @@ class InferenceServicer:
         return kw
 
     async def Generate(self, request, context):
+        if self.engine.family == "seq2seq":
+            # T5-style text-to-text rides the same RPC: prompt in,
+            # generated text out (sampling knobs don't apply to the
+            # greedy seq2seq path).
+            text, ids = await self.engine.seq2seq_text(
+                request.get("prompt", "")
+            )
+            return {
+                "text": text,
+                "tokens": len(ids),
+                "finish_reason": "stop",
+            }
         try:
             result = await self.engine.generate(
                 request.get("prompt", ""), **self._gen_kwargs(request, False)
@@ -71,6 +83,21 @@ class InferenceServicer:
     async def GenerateStream(self, request, context):
         from gofr_tpu.serving.stream_text import stream_generation
 
+        if self.engine.family == "seq2seq":
+            # seq2seq generates as one batched program — stream the
+            # whole answer as a single chunk plus the final summary so
+            # streaming clients work unchanged against a T5 engine.
+            text, ids = await self.engine.seq2seq_text(
+                request.get("prompt", "")
+            )
+            yield {"token": ids[0] if ids else 0, "text": text}
+            yield {
+                "done": True,
+                "tokens": len(ids),
+                "ttft_ms": 0.0,
+                "finish_reason": "stop",
+            }
+            return
         try:
             async for ev in stream_generation(
                 self.engine, request.get("prompt", ""),

@@ -51,6 +51,11 @@ class TypedInferenceServicer(_Base):
         import grpc
 
         prompt, kw = self._gen_kwargs(request)
+        if self.engine.family == "seq2seq":
+            text, ids = await self.engine.seq2seq_text(prompt)
+            return pb.GenerateReply(
+                text=text, tokens=len(ids), finish_reason="stop"
+            )
         try:
             result = await self.engine.generate(prompt, **kw)
         except GofrError as exc:
@@ -73,6 +78,15 @@ class TypedInferenceServicer(_Base):
         import grpc
 
         from gofr_tpu.serving.stream_text import stream_generation
+
+        if self.engine.family == "seq2seq":
+            prompt, _ = self._gen_kwargs(request)
+            text, ids = await self.engine.seq2seq_text(prompt)
+            yield pb.TokenChunk(token=ids[0] if ids else 0, text=text)
+            yield pb.TokenChunk(
+                done=True, tokens=len(ids), finish_reason="stop"
+            )
+            return
 
         prompt, kw = self._gen_kwargs(request)
         try:

@@ -2940,6 +2940,16 @@ class InferenceEngine:
     async def seq2seq(self, text) -> list:
         return await asyncio.wrap_future(self._batcher.submit(text))
 
+    async def seq2seq_text(self, text) -> tuple:
+        """(decoded_text, token_ids) — the ONE dispatch-and-decode used
+        by ctx.infer and both gRPC surfaces, so reply shaping can't
+        drift between them."""
+        ids = await self.seq2seq(text)
+        decoded = (
+            self.tokenizer.decode(ids) if self.tokenizer is not None else ""
+        )
+        return decoded, ids
+
     def embed_sync(self, text, timeout: float = 60.0) -> np.ndarray:
         return self._batcher.submit(text).result(timeout=timeout)
 
@@ -2969,11 +2979,7 @@ class InferenceEngine:
             emb = await self.embed(inputs)
             return {"embedding": emb.tolist()}
         if self.family == "seq2seq":
-            ids = await self.seq2seq(inputs)
-            text = (
-                self.tokenizer.decode(ids)
-                if self.tokenizer is not None else ""
-            )
+            text, ids = await self.seq2seq_text(inputs)
             return {"text": text, "token_ids": ids}
         vec = await self.classify(inputs)
         return {"logits": vec.tolist(), "class": int(np.argmax(vec))}

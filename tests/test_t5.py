@@ -326,3 +326,47 @@ def test_t5_int8_quantization(tmp_path):
         assert a == eng.seq2seq_sync([5, 6, 7])
     finally:
         eng.stop_sync()
+
+
+def test_t5_grpc_generate_routes_seq2seq():
+    """Both gRPC Generate surfaces serve seq2seq engines (text in →
+    generated text out) instead of raising the llm-only error."""
+    import asyncio
+
+    from gofr_tpu.grpc.inference import InferenceServicer
+    from gofr_tpu.grpc.inference_typed import TypedInferenceServicer
+    from gofr_tpu.grpc import inference_pb2
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    eng = InferenceEngine("t5-tiny", max_batch=2, tokenizer=ByteTokenizer())
+    eng.start_sync()
+    try:
+        loop = asyncio.new_event_loop()
+        out = loop.run_until_complete(
+            InferenceServicer(eng).Generate({"prompt": "hi there"}, None)
+        )
+        assert out["tokens"] >= 1 and out["finish_reason"] == "stop"
+        req = inference_pb2.GenerateRequest(prompt="hi there")
+        t_out = loop.run_until_complete(
+            TypedInferenceServicer(eng).Generate(req, None)
+        )
+        assert t_out.tokens == out["tokens"]
+        assert t_out.text == out["text"]
+
+        async def drain(agen):
+            return [c async for c in agen]
+
+        chunks = loop.run_until_complete(
+            drain(InferenceServicer(eng).GenerateStream(
+                {"prompt": "hi there"}, None
+            ))
+        )
+        assert chunks[-1]["done"] and chunks[-1]["tokens"] == out["tokens"]
+        assert chunks[0]["text"] == out["text"]
+        t_chunks = loop.run_until_complete(
+            drain(TypedInferenceServicer(eng).GenerateStream(req, None))
+        )
+        assert t_chunks[-1].done and t_chunks[-1].tokens == out["tokens"]
+    finally:
+        eng.stop_sync()
