@@ -3,6 +3,8 @@
 POST /v1/completions        {"prompt": "...", "max_tokens": 32, "stream": true}
 POST /v1/chat/completions   {"messages": [{"role": "user", "content": "hi"}]}
 GET  /v1/models
+POST /v1/files              multipart JSONL upload (purpose=batch)
+POST /v1/batches            offline batch inference over the uploads
 """
 
 import os
@@ -11,12 +13,14 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 from gofr_tpu import App
+from gofr_tpu.serving.openai_batch import add_openai_batch_routes
 from gofr_tpu.serving.openai_compat import add_openai_routes
 
 
 def main() -> App:
     app = App(config_dir=os.path.join(os.path.dirname(__file__), "configs"))
     add_openai_routes(app)
+    add_openai_batch_routes(app)
     return app
 
 
