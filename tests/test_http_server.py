@@ -279,3 +279,34 @@ def test_debug_endpoints_on_metrics_port(app_harness):
     )
     assert status == 200
     assert json.loads(body) == {}
+
+
+def test_multipart_binary_byte_fidelity():
+    """The multipart parser strips exactly the delimiter CRLFs: file
+    data containing interior AND trailing CR/LF bytes round-trips
+    byte-exact (a JSONL upload keeps its trailing newline; a binary
+    blob with \\r\\n sequences is untouched)."""
+    from gofr_tpu.http.proto import RawRequest
+    from gofr_tpu.http.request import Request
+
+    payload = b"\r\nbinary\r\nwith\nnewlines\r\n\r\n"
+    boundary = "bb7"
+    body = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="purpose"\r\n\r\nbatch\r\n'
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="file"; '
+        f'filename="blob.bin"\r\n'
+        f"Content-Type: application/octet-stream\r\n\r\n"
+    ).encode() + payload + f"\r\n--{boundary}--\r\n".encode()
+    req = Request(RawRequest(
+        method="POST", target="/up", version="HTTP/1.1",
+        headers={
+            "content-type": f"multipart/form-data; boundary={boundary}"
+        },
+        body=body,
+    ))
+    bound = req.bind({})
+    assert bound["purpose"] == "batch"
+    assert bound["file"].data == payload
+    assert bound["file"].filename == "blob.bin"
