@@ -90,6 +90,17 @@ class ErrorServiceUnavailable(GofrError):
         super().__init__(msg)
 
 
+class ErrorPayloadTooLarge(GofrError):
+    """413 — an uploaded payload exceeds a configured store limit."""
+
+    status_code = 413
+
+    def __init__(self, what: str, size: int, limit: int) -> None:
+        super().__init__(
+            f"{what} of {size} bytes exceeds the limit of {limit} bytes"
+        )
+
+
 class ErrorPromptTooLong(GofrError):
     """413 — prompt exceeds the engine's serveable context window. A
     serving framework must surface this, not silently truncate (truncation

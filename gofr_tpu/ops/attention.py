@@ -65,6 +65,22 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _effective_window(window: int, k_cache: jnp.ndarray, block_table) -> int:
+    """0 when the sliding window cannot bind within the cache capacity.
+
+    Contiguous caches are [b, KV, max_len, hd] (capacity = shape[2]); a
+    paged pool is [n_blocks, KV, block, hd] where shape[2] is the BLOCK
+    axis — capacity is the table's row length × block.
+    """
+    if not window:
+        return 0
+    if block_table is None:
+        capacity = k_cache.shape[2]
+    else:
+        capacity = block_table.shape[1] * k_cache.shape[2]
+    return 0 if window >= capacity else window
+
+
 def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     """[b, s, kv_heads, hd] → [b, s, kv_heads*n_rep, hd] (GQA broadcast)."""
     if n_rep == 1:
@@ -197,8 +213,11 @@ def decode_attention(
     """
     if (k_new is None) != (v_new is None):
         raise ValueError("pass k_new and v_new together")
-    if window and window >= k_cache.shape[2]:
-        window = 0  # cannot bind within max_len: keep the kernel path
+    # A window that cannot bind is dropped to keep the kernel path; a
+    # binding window on a paged pool survives (shape[2] there is the
+    # BLOCK axis, not capacity) and takes the dense paged_view path,
+    # where positions are global again and the mask applies exactly.
+    window = _effective_window(window, k_cache, block_table)
     if window:
         kernel = False
     if kernel is None:
@@ -400,8 +419,7 @@ def cache_chunk_attention(
     (the CPU/tests fallback). Rows with t >= lens[p] return 0.
     kernel: None → auto (pallas on TPU).
     """
-    if window and window >= k_cache.shape[2] and block_table is None:
-        window = 0  # cannot bind within max_len: keep the kernel path
+    window = _effective_window(window, k_cache, block_table)
     if window:
         kernel = False
     if kernel is None:

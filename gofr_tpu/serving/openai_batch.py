@@ -32,12 +32,31 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from gofr_tpu.errors import ErrorEntityNotFound, ErrorInvalidParam
+from gofr_tpu.errors import (
+    ErrorEntityNotFound,
+    ErrorInvalidParam,
+    ErrorPayloadTooLarge,
+)
 from gofr_tpu.http.proto import RawRequest
 from gofr_tpu.http.responder import File as FileResponse, Raw
 
 _ENDPOINTS = ("/v1/chat/completions", "/v1/completions", "/v1/embeddings")
 _MAX_CONCURRENCY = 32
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+        if v <= 0:
+            raise ValueError
+        return v
+    except ValueError:
+        raise ValueError(f"{name} must be a positive integer, got {raw!r}") from None
 
 
 @dataclass
@@ -104,19 +123,80 @@ class _Batch:
 
 
 class BatchStore:
-    """In-memory files + batches + the batch runner."""
+    """In-memory files + batches + the batch runner.
+
+    Bounded: a long-lived replica must not let the Files surface exhaust
+    host memory — per-file bytes (``TPU_BATCH_MAX_FILE_BYTES``, default
+    100 MB, matching the multipart zip guard) and total store bytes
+    (``TPU_BATCH_STORE_BYTES``, default 1 GB) are enforced with 413s,
+    and batches terminal for longer than ``TPU_BATCH_RETENTION_S``
+    (default 24 h) are evicted together with their input/output files on
+    the next store mutation.
+    """
 
     def __init__(self, app) -> None:
         self._app = app
         self.files: dict[str, _StoredFile] = {}
         self.batches: dict[str, _Batch] = {}
+        self.max_file_bytes = _env_int(
+            "TPU_BATCH_MAX_FILE_BYTES", 100 * 1024 * 1024
+        )
+        self.max_store_bytes = _env_int(
+            "TPU_BATCH_STORE_BYTES", 1024 * 1024 * 1024
+        )
+        self.retention_s = _env_int("TPU_BATCH_RETENTION_S", 24 * 3600)
         # Strong refs to runner tasks: asyncio keeps only weak ones, and
         # a GC'd runner would strand its batch in 'in_progress'.
         self._tasks: set = set()
 
     # -- files -----------------------------------------------------------
 
-    def add_file(self, filename: str, purpose: str, content: bytes) -> dict:
+    def _evict_expired(self) -> None:
+        """Drop batches terminal past retention, plus their files."""
+        cutoff = int(time.time()) - self.retention_s
+        for bid, b in list(self.batches.items()):
+            done_at = b.completed_at or b.cancelled_at
+            if b.status == "failed":
+                done_at = done_at or b.created_at
+            if done_at is None or done_at > cutoff:
+                continue
+            del self.batches[bid]
+            for fid in (b.input_file_id, b.output_file_id, b.error_file_id):
+                if fid:
+                    self.files.pop(fid, None)
+        # Orphan uploads (never attached to a batch, or whose batch is
+        # gone) age out too, or they would accumulate forever.
+        live = {
+            fid
+            for b in self.batches.values()
+            for fid in (b.input_file_id, b.output_file_id, b.error_file_id)
+            if fid
+        }
+        for fid, f in list(self.files.items()):
+            if fid not in live and f.created_at <= cutoff:
+                del self.files[fid]
+
+    def store_bytes(self) -> int:
+        return sum(len(f.content) for f in self.files.values())
+
+    def add_file(
+        self, filename: str, purpose: str, content: bytes,
+        internal: bool = False,
+    ) -> dict:
+        self._evict_expired()
+        if not internal:
+            # Runner-produced output files bypass the caps: failing a
+            # finished batch over quota would lose paid-for results —
+            # retention eviction bounds them instead.
+            if len(content) > self.max_file_bytes:
+                raise ErrorPayloadTooLarge(
+                    "file", len(content), self.max_file_bytes
+                )
+            if self.store_bytes() + len(content) > self.max_store_bytes:
+                raise ErrorPayloadTooLarge(
+                    "file store", self.store_bytes() + len(content),
+                    self.max_store_bytes,
+                )
         fid = f"file-{uuid.uuid4().hex[:24]}"
         self.files[fid] = _StoredFile(
             fid, filename, purpose, content, int(time.time())
@@ -260,12 +340,12 @@ class BatchStore:
         if out_lines:
             batch.output_file_id = self.add_file(
                 f"{batch.id}_output.jsonl", "batch_output",
-                ("\n".join(out_lines) + "\n").encode(),
+                ("\n".join(out_lines) + "\n").encode(), internal=True,
             )["id"]
         if err_lines:
             batch.error_file_id = self.add_file(
                 f"{batch.id}_errors.jsonl", "batch_output",
-                ("\n".join(err_lines) + "\n").encode(),
+                ("\n".join(err_lines) + "\n").encode(), internal=True,
             )["id"]
         if batch._cancel:
             batch.status = "cancelled"

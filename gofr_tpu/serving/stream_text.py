@@ -91,3 +91,31 @@ async def stream_generation(
             # Abnormal exit — cancel so the engine stops decoding for a
             # consumer that is gone (no-op on a completed future).
             req.future.cancel()
+
+
+async def stream_seq2seq(engine, prompt, tokenizer) -> AsyncIterator[dict]:
+    """Stepped seq2seq streaming, shared by both gRPC surfaces (the same
+    one-owner discipline as ``stream_generation`` — the chunking/ttft/
+    decode logic must not drift between the JSON and typed servicers).
+
+    Yields ``{"type": "piece", "token", "text"}`` per engine chunk, then
+    ``{"type": "done", "tokens", "ttft_ms", "finish_reason"}``. Pieces
+    use cumulative decode so multi-byte text never splits mid-chunk.
+    """
+    t0 = time.time()
+    all_ids: list[int] = []
+    printed = ""
+    ttft_ms = 0.0
+    async for toks in engine.seq2seq_stream(prompt):
+        if not all_ids:
+            ttft_ms = round((time.time() - t0) * 1e3, 2)
+        all_ids.extend(toks)
+        decoded = tokenizer.decode(all_ids) if tokenizer is not None else ""
+        piece, printed = decoded[len(printed):], decoded
+        yield {"type": "piece", "token": toks[0], "text": piece}
+    yield {
+        "type": "done",
+        "tokens": len(all_ids),
+        "ttft_ms": ttft_ms,
+        "finish_reason": "stop",
+    }

@@ -88,6 +88,30 @@ def main() -> None:
     print(f"phase engine-done pid={pid}", flush=True)
     result["engine_tokens"] = [int(t) for t in r.token_ids]
 
+    # DCN × ICI composition (r4 VERDICT next #10): dp OVER processes ×
+    # tp WITHIN each process — the topology a real multi-host pod
+    # serves. Params and the KV cache shard over tp inside each host
+    # (those collectives ride ICI) and replicate over the dp axis that
+    # spans the DCN boundary; one SPMD program covers the pod.
+    mesh2_devs = np.array([
+        sorted(by_proc[p], key=lambda d: d.id)[:2] for p in sorted(by_proc)
+    ])  # [dp = processes, tp = local devices]
+    mesh2 = Mesh(mesh2_devs, ("dp", "tp"))
+    multihost_utils.sync_global_devices("engine2-init")
+    print(f"phase engine2-init pid={pid}", flush=True)
+    engine2 = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), mesh=mesh2, seed=0,
+    )
+    engine2.start_sync()
+    r2 = engine2.generate_sync(
+        "dcn serving smoke", max_new_tokens=16, temperature=0.0,
+        stop_on_eos=False, timeout=180,
+    )
+    engine2.stop_sync()
+    print(f"phase engine2-done pid={pid}", flush=True)
+    result["engine_dp_tp_tokens"] = [int(t) for t in r2.token_ids]
+
     done_file = os.path.join(tmpdir, "peer_done")
     if pid == 0:
         import asyncio

@@ -97,3 +97,10 @@ def test_two_process_dcn_runtime_and_service_hop():
     finally:
         ref.stop_sync()
     assert toks0 == [int(t) for t in base.token_ids], (toks0, base.token_ids)
+
+    # dp-over-processes × tp-within-process (DCN × ICI composed): same
+    # SPMD-consistency + math-unchanged contract for the pod topology.
+    dp0 = results[0]["engine_dp_tp_tokens"]
+    dp1 = results[1]["engine_dp_tp_tokens"]
+    assert dp0 == dp1 and len(dp0) == 16, (dp0, dp1)
+    assert dp0 == [int(t) for t in base.token_ids], (dp0, base.token_ids)

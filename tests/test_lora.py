@@ -445,6 +445,97 @@ def test_adapter_churn_under_load():
         eng.stop_sync()
 
 
+def test_reload_fails_inflight_instead_of_mixing():
+    """Overwriting a slot that live requests still route to must FAIL
+    those requests — one completion must never mix tokens from two
+    adapters (same-name reload), and a request queued across a reload
+    fails at admission instead of running under the wrong weights."""
+    import time as _time
+
+    a1, a2 = _rand_adapter(31), _rand_adapter(32)
+    eng = _engine()
+    try:
+        eng.load_lora("tuned", a1)
+        req = eng.submit_generate(
+            "hello", max_new_tokens=100, temperature=0.0,
+            stop_on_eos=False, adapter="tuned",
+        )
+        deadline = _time.time() + 60
+        while not req.token_ids and _time.time() < deadline:
+            _time.sleep(0.002)
+        assert req.token_ids, "request never started decoding"
+        eng.load_lora("tuned", a2)
+        with pytest.raises(RuntimeError, match="overwritten"):
+            req.future.result(timeout=120)
+        # The reloaded adapter serves fresh requests with the NEW weights.
+        got = _gen(eng, "hello", adapter="tuned")
+        ref = InferenceEngine(
+            "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+            tokenizer=ByteTokenizer(),
+            params=_merged_params(eng.params, a2),
+        )
+        ref.start_sync()
+        try:
+            assert got == _gen(ref, "hello")
+        finally:
+            ref.stop_sync()
+
+        # Queued across a reload: fill every slot with long base runs so
+        # the adapter request cannot be admitted before the reload lands.
+        blockers = [
+            eng.submit_generate(
+                "hold", max_new_tokens=100, temperature=0.0,
+                stop_on_eos=False,
+            )
+            for _ in range(4)
+        ]
+        queued = eng.submit_generate(
+            "hello", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False, adapter="tuned",
+        )
+        eng.load_lora("tuned", a1)
+        with pytest.raises(RuntimeError, match="queued|overwritten"):
+            queued.future.result(timeout=120)
+        for b in blockers:
+            b.future.result(timeout=120)
+    finally:
+        eng.stop_sync()
+
+
+def test_fresh_load_prefers_idle_slot():
+    """A fresh load after an unload picks the free slot with no live
+    traffic, so requests finishing against base (documented unload
+    semantics) are not silently switched onto the new adapter.
+
+    White-box: the engine is never STARTED and the draining request is
+    pinned into a slot directly — racing a real generation against
+    unload_lora is timing-dependent (on a fast run the request finishes
+    first and slot 1 is legitimately reused)."""
+    from gofr_tpu.serving.types import _ActiveSeq, _GenRequest
+
+    eng = InferenceEngine(
+        "llama-tiny-f32", n_slots=4, max_len=128, window_k=4,
+        tokenizer=ByteTokenizer(), lora_slots=2, lora_rank=4,
+    )
+    eng.load_lora("old", _rand_adapter(41))
+    assert eng._lora_names["old"] == 1
+    req = _GenRequest(
+        prompt_ids=[1, 2], max_new_tokens=8, temperature=0.0,
+        stop_on_eos=False, aid=1, lora_gen=eng._lora_gen[1],
+    )
+    eng._slots[0] = _ActiveSeq(request=req, last_token=-1)
+    eng.unload_lora("old")  # in-flight finishes on base (documented)
+    eng.load_lora("new", _rand_adapter(42))
+    assert eng._lora_names["new"] == 2  # slot 1 still draining
+    assert not req.future.done()  # the draining request was untouched
+    # Forced reuse: with slot 2 also taken, a load MUST take slot 1 and
+    # fail its draining request rather than mix weight sets.
+    eng.load_lora("third", _rand_adapter(43))
+    assert eng._lora_names["third"] == 1
+    with pytest.raises(RuntimeError, match="overwritten"):
+        req.future.result(timeout=5)
+
+
 def test_engine_without_lora_rejects():
     eng = InferenceEngine(
         "llama-tiny-f32", n_slots=2, max_len=64,

@@ -399,3 +399,61 @@ def test_batch_forwards_auth_headers():
     finally:
         asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_store_caps_and_retention(batch_app, monkeypatch):
+    """The in-memory store is bounded: oversize uploads 413, a full
+    store 413s further uploads, and terminal batches past retention are
+    evicted together with their files (ADVICE r4: an exposed /v1/files
+    must not let clients exhaust host memory)."""
+    store = batch_app.batch_store
+    # The module-scoped app accumulates files from earlier tests; this
+    # test's quotas are tiny, so start from a clean store.
+    store.files.clear()
+    store.batches.clear()
+    monkeypatch.setattr(store, "max_file_bytes", 64)
+    monkeypatch.setattr(store, "max_store_bytes", 160)
+
+    st, err = _upload(batch_app, b"x" * 65)
+    assert st == 413, err
+    st, meta1 = _upload(batch_app, b"y" * 60)
+    assert st == 200
+    st, meta2 = _upload(batch_app, b"y" * 60)
+    assert st == 200
+    st, err = _upload(batch_app, b"y" * 60)  # 180 > 160 total
+    assert st == 413, err
+    # Deleting frees quota.
+    st, _ = _call(batch_app, "DELETE", f"/v1/files/{meta1['id']}")
+    assert st == 200
+    st, meta3 = _upload(batch_app, b"y" * 60)
+    assert st == 200
+    for m in (meta2, meta3):
+        _call(batch_app, "DELETE", f"/v1/files/{m['id']}")
+
+    # Retention: a completed batch + its files vanish once its terminal
+    # timestamp ages past the window; fresh files survive.
+    monkeypatch.setattr(store, "max_file_bytes", 4096)
+    monkeypatch.setattr(store, "max_store_bytes", 65536)
+    line = json.dumps({
+        "custom_id": "r", "method": "POST", "url": "/v1/completions",
+        "body": {"prompt": "hi", "max_tokens": 2, "temperature": 0},
+    }).encode()
+    st, meta = _upload(batch_app, line)
+    assert st == 200
+    st, batch = _call(batch_app, "POST", "/v1/batches", {
+        "input_file_id": meta["id"], "endpoint": "/v1/completions",
+    })
+    assert st == 200
+    done = _wait_batch(batch_app, batch["id"])
+    assert done["status"] == "completed" and done["output_file_id"]
+    # Age the batch out and trigger eviction via the next mutation.
+    store.batches[batch["id"]].completed_at -= store.retention_s + 10
+    for f in store.files.values():
+        f.created_at -= store.retention_s + 10
+    st, fresh = _upload(batch_app, b"fresh")
+    assert st == 200
+    assert batch["id"] not in store.batches
+    assert meta["id"] not in store.files
+    assert done["output_file_id"] not in store.files
+    assert fresh["id"] in store.files  # the trigger upload survives
+    _call(batch_app, "DELETE", f"/v1/files/{fresh['id']}")

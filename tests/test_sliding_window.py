@@ -109,11 +109,14 @@ def test_engine_swa_matches_full_forward_rollout():
     ))
     prompt = [ord(c) for c in "sliding windows"]  # 15 tokens > window 8
     want = _rollout_reference(params, SWA_CFG, prompt, 12)
-    for spec_tokens in (0, 2):
+    # kv_block=8 makes the paged pool's block axis equal the window — the
+    # shape that used to zero the window in decode_attention (the pool's
+    # shape[2] is the BLOCK axis, not capacity) and attend beyond it.
+    for spec_tokens, kv_block in ((0, 0), (2, 0), (0, 8)):
         eng = InferenceEngine(
             "swa-test", n_slots=2, max_len=128, window_k=4,
             prefill_chunk=16, tokenizer=ByteTokenizer(), params=params,
-            spec_tokens=spec_tokens,
+            spec_tokens=spec_tokens, kv_block=kv_block,
         )
         eng.start_sync()
         try:
@@ -123,7 +126,7 @@ def test_engine_swa_matches_full_forward_rollout():
             ).token_ids
         finally:
             eng.stop_sync()
-        assert got == want, f"spec_tokens={spec_tokens}"
+        assert got == want, f"spec_tokens={spec_tokens} kv_block={kv_block}"
 
 
 def test_engine_swa_mega_parity():

@@ -363,10 +363,63 @@ def test_t5_grpc_generate_routes_seq2seq():
             ))
         )
         assert chunks[-1]["done"] and chunks[-1]["tokens"] == out["tokens"]
-        assert chunks[0]["text"] == out["text"]
+        # Stepped decode: pieces CONCATENATE to the unary text.
+        assert "".join(c["text"] for c in chunks[:-1]) == out["text"]
         t_chunks = loop.run_until_complete(
             drain(TypedInferenceServicer(eng).GenerateStream(req, None))
         )
         assert t_chunks[-1].done and t_chunks[-1].tokens == out["tokens"]
+        assert "".join(c.text for c in t_chunks[:-1]) == out["text"]
+    finally:
+        eng.stop_sync()
+
+
+def test_t5_stream_is_stepped(monkeypatch):
+    """A streaming seq2seq reply must arrive in MULTIPLE content chunks
+    for a multi-token answer (r4 VERDICT weak #7: a streaming API that
+    buffers the whole answer isn't streaming), token-identical to the
+    one-shot batched program, on both gRPC surfaces."""
+    import asyncio
+
+    from gofr_tpu.grpc import inference_pb2
+    from gofr_tpu.grpc.inference import InferenceServicer
+    from gofr_tpu.grpc.inference_typed import TypedInferenceServicer
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    monkeypatch.setenv("TPU_SEQ2SEQ_CHUNK", "2")
+    eng = InferenceEngine("t5-tiny", max_batch=2, tokenizer=ByteTokenizer())
+    eng.start_sync()
+    try:
+        solo = eng.seq2seq_sync("translate this text")
+        streamed = [
+            t
+            for ch in eng.seq2seq_stream_blocking("translate this text")
+            for t in ch
+        ]
+        assert streamed == solo  # stepped path == one-shot program
+        assert len(solo) >= 3, "answer too short to exercise chunking"
+
+        async def drain(agen):
+            return [c async for c in agen]
+
+        loop = asyncio.new_event_loop()
+        want_text = eng.tokenizer.decode(solo)
+        chunks = loop.run_until_complete(
+            drain(InferenceServicer(eng).GenerateStream(
+                {"prompt": "translate this text"}, None
+            ))
+        )
+        content, final = chunks[:-1], chunks[-1]
+        assert len(content) >= 2, "stepped stream must emit ≥2 chunks"
+        assert "".join(c["text"] for c in content) == want_text
+        assert final["done"] and final["tokens"] == len(solo)
+        req = inference_pb2.GenerateRequest(prompt="translate this text")
+        t_chunks = loop.run_until_complete(
+            drain(TypedInferenceServicer(eng).GenerateStream(req, None))
+        )
+        assert len(t_chunks[:-1]) >= 2
+        assert "".join(c.text for c in t_chunks[:-1]) == want_text
+        assert t_chunks[-1].done and t_chunks[-1].tokens == len(solo)
     finally:
         eng.stop_sync()
