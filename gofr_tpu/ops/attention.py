@@ -188,7 +188,8 @@ def decode_attention(
     """Single-token decode attention against per-slot caches.
 
     window: sliding-window (Mistral) — the query attends only the last
-    ``window`` positions including itself; 0 = full. Dense path only.
+    ``window`` positions including itself; 0 = full. Both paths honor
+    it (the kernel masks in-kernel and skips out-of-window blocks).
     q: [b, n_heads, hd] (one query per sequence);
     k_cache, v_cache: [b, n_kv_heads, max_len, hd] (heads-major — the
     TPU-native cache layout, see ``ops/kv_cache.py``);
@@ -213,13 +214,12 @@ def decode_attention(
     """
     if (k_new is None) != (v_new is None):
         raise ValueError("pass k_new and v_new together")
-    # A window that cannot bind is dropped to keep the kernel path; a
-    # binding window on a paged pool survives (shape[2] there is the
-    # BLOCK axis, not capacity) and takes the dense paged_view path,
-    # where positions are global again and the mask applies exactly.
+    # A window that cannot bind is dropped (capacity-aware: a paged
+    # pool's shape[2] is the BLOCK axis, not capacity). A BINDING window
+    # keeps the kernel path — flash_decode masks it in-kernel and skips
+    # whole blocks below the window (O(window) HBM reads, vs the dense
+    # paged fallback's per-step full gather).
     window = _effective_window(window, k_cache, block_table)
-    if window:
-        kernel = False
     if kernel is None:
         kernel = _flash_decode_enabled()
         if (
@@ -227,6 +227,7 @@ def decode_attention(
             and _FLASH_DECODE_ENV == ""
             and _FLASH_ENV in ("", "auto")
             and block_table is None
+            and not window
         ):
             # Measured auto heuristic (BASELINE.md round 3): at short
             # max_len ONE fused dense op beats the kernel's grid of tiny
@@ -234,7 +235,8 @@ def decode_attention(
             # 2421 vs 1931 tok/s); length-skipping only pays once the
             # full-length reads the dense path can't skip get big. The
             # paged pool always takes the kernel — its dense fallback
-            # must materialize a gather first.
+            # must materialize a gather first — and so does a binding
+            # window (the kernel reads only the window's blocks).
             kernel = k_cache.shape[2] > 2048
     if kernel:
         from gofr_tpu.ops.pallas import flash_decode
@@ -242,7 +244,8 @@ def decode_attention(
         return flash_decode(
             q, k_cache, v_cache, lengths, k_new=k_new, v_new=v_new,
             k_scale=k_scale, v_scale=v_scale, block_table=block_table,
-            scale=scale, block_k=_DECODE_BLOCK_K, interpret=_interpret(),
+            scale=scale, block_k=_DECODE_BLOCK_K, window=window,
+            interpret=_interpret(),
         )
     if block_table is not None:
         # Paged pool + dense fallback: gather each row's blocks into a

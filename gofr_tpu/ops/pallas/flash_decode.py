@@ -32,12 +32,31 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _clamp_blk(ik, length, block_k):
-    """kv block index clamped to the slot's last valid block."""
-    return jnp.minimum(ik, jnp.maximum(0, (length - 1) // block_k))
+def _window_lo(length, window, has_new):
+    """First key position inside the sliding window (0 = unwindowed).
+
+    The query sits at ``length`` (split path — the new token) or
+    ``length - 1`` (already-written convention); valid keys are in
+    ``(q_pos - window, q_pos]``.
+    """
+    q_pos = length if has_new else length - 1
+    return jnp.maximum(0, q_pos - window + 1)
 
 
-def _kernel(*refs, scale, block_k, quant, has_new, paged):
+def _clamp_blk(ik, length, block_k, window=0, has_new=False):
+    """kv block index clamped to the slot's VISIBLE range: at most the
+    last valid block, and (windowed) at least the first block the
+    sliding window reaches — out-of-range grid steps then re-"fetch" a
+    visible block, which the pallas pipeline elides (same index → no new
+    DMA), so skipped blocks cost no HBM bandwidth on either side."""
+    hi = jnp.maximum(0, (length - 1) // block_k)
+    if window:
+        lo = _window_lo(length, window, has_new) // block_k
+        return jnp.clip(ik, jnp.minimum(lo, hi), hi)
+    return jnp.minimum(ik, hi)
+
+
+def _kernel(*refs, scale, block_k, quant, has_new, paged, window):
     """Grid: (b, n_kv, kv_blocks); kv blocks innermost, state in scratch.
 
     quant (static): int8 cache mode — two extra scale refs follow v_ref
@@ -83,8 +102,15 @@ def _kernel(*refs, scale, block_k, quant, has_new, paged):
 
     col0 = ik * block_k
     last_vis = jnp.maximum(0, (length - 1) // block_k)
+    # Sliding window (static): keys below lo_pos are invisible; whole
+    # blocks below it skip their body (their DMAs were already elided by
+    # the index-map clamp).
+    lo_pos = _window_lo(length, window, has_new) if window else 0
+    visible = col0 < length
+    if window:
+        visible &= col0 + block_k > lo_pos
 
-    @pl.when(col0 < length)
+    @pl.when(visible)
     def _body():
         q = q_ref[0, 0]      # [rep, hd]
         k = k_ref[0, 0]      # [block_k, hd]
@@ -103,6 +129,8 @@ def _kernel(*refs, scale, block_k, quant, has_new, paged):
 
         cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (rep, block_k), 1)
         mask = cols < length
+        if window:
+            mask &= cols >= lo_pos
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]  # [rep, 128] (value replicated over lanes)
@@ -152,7 +180,7 @@ def _kernel(*refs, scale, block_k, quant, has_new, paged):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret")
+    jax.jit, static_argnames=("scale", "block_k", "window", "interpret")
 )
 def flash_decode(
     q: jnp.ndarray,
@@ -167,6 +195,7 @@ def flash_decode(
     block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Same contract as ``ops.attention.decode_attention``:
@@ -184,7 +213,14 @@ def flash_decode(
     and the table maps each row's logical kv block onto a pool block —
     indexing happens in the BlockSpec index_maps, so the pool streams
     straight from HBM with no per-step gather. ``block_k`` is the pool's
-    block size in that mode. Returns [b, n_heads, hd].
+    block size in that mode.
+
+    window (static): sliding-window attention — the query attends only
+    keys in ``(q_pos - window, q_pos]`` (``ops.attention`` convention);
+    0 = full. Masked in-kernel, and blocks wholly below the window skip
+    both their body and their DMA (the index-map clamp re-fetches a
+    visible block, which the pipeline elides) — windowed decode reads
+    O(window), not O(length), from HBM. Returns [b, n_heads, hd].
     """
     b, n_heads, hd = q.shape
     paged = block_table is not None
@@ -222,19 +258,25 @@ def flash_decode(
     # top: the clamped LOGICAL block resolves to a pool block id.
     if paged:
         def kv_idx(ib, ig, ik, lens, bt):
-            return (bt[ib, _clamp_blk(ik, lens[ib], block_k)], ig, 0, 0)
+            blk = _clamp_blk(ik, lens[ib], block_k, window, has_new)
+            return (bt[ib, blk], ig, 0, 0)
 
         def scale_idx(ib, ig, ik, lens, bt):
-            return (bt[ib, _clamp_blk(ik, lens[ib], block_k)], ig, 0, 0)
+            blk = _clamp_blk(ik, lens[ib], block_k, window, has_new)
+            return (bt[ib, blk], ig, 0, 0)
 
         def row_idx(ib, ig, ik, lens, bt):
             return (ib, ig, 0, 0)
     else:
         def kv_idx(ib, ig, ik, lens):
-            return (ib, ig, _clamp_blk(ik, lens[ib], block_k), 0)
+            return (
+                ib, ig, _clamp_blk(ik, lens[ib], block_k, window, has_new), 0
+            )
 
         def scale_idx(ib, ig, ik, lens):
-            return (ib, ig, 0, _clamp_blk(ik, lens[ib], block_k))
+            return (
+                ib, ig, 0, _clamp_blk(ik, lens[ib], block_k, window, has_new)
+            )
 
         def row_idx(ib, ig, ik, lens):
             return (ib, ig, 0, 0)
@@ -278,7 +320,7 @@ def flash_decode(
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, block_k=block_k, quant=quant,
-            has_new=has_new, paged=paged,
+            has_new=has_new, paged=paged, window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, n_kv, n_rep, hd), q.dtype),

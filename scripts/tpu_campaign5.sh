@@ -66,3 +66,9 @@ run r4-1b-4k-pd8 BENCH_MODEL=llama-1b BENCH_MAX_LEN=4096 BENCH_SLOTS=16 BENCH_RE
 # 9. Multi-LoRA serving overhead: 4 rank-16 adapters round-robin vs base.
 run r4-1b-lora4 BENCH_MODEL=llama-1b BENCH_LORA=4 BENCH_MEGA=0
 run r4-1b-lora4-mega8 BENCH_MODEL=llama-1b BENCH_LORA=4 BENCH_MEGA=8
+# 10. (r5) Sliding-window serving at mistral geometry: the windowed
+#     flash-decode path (in-kernel window mask + block skip, O(window)
+#     HBM reads) vs the dense full-cache read. int8 weights + int8 KV
+#     keep 7B + 8×8k cache inside one v5e.
+run r5-mistral-8k BENCH_MODEL=mistral-7b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_QUANT=int8 BENCH_KV_QUANT=int8 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=8
+run r5-mistral-8k-dense BENCH_MODEL=mistral-7b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_QUANT=int8 BENCH_KV_QUANT=int8 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=8 GOFR_TPU_FLASH_DECODE=0
