@@ -423,8 +423,6 @@ def cache_chunk_attention(
     kernel: None → auto (pallas on TPU).
     """
     window = _effective_window(window, k_cache, block_table)
-    if window:
-        kernel = False
     if kernel is None:
         kernel = _flash_enabled()
     if kernel:
@@ -433,7 +431,7 @@ def cache_chunk_attention(
         return flash_cache_attention(
             q, k_cache, v_cache, slots, starts, lens, k_scale=k_scale,
             v_scale=v_scale, block_table=block_table, scale=scale,
-            interpret=_interpret(),
+            window=window, interpret=_interpret(),
         )
     pre_gathered = False
     if block_table is not None:

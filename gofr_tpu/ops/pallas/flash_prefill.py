@@ -34,11 +34,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _clamp_blk(ik, ctx_len, block_k):
-    return jnp.minimum(ik, jnp.maximum(0, (ctx_len - 1) // block_k))
+def _clamp_blk(ik, ctx_len, block_k, start=None, window=0):
+    """kv block index clamped to the row's VISIBLE range. Windowed: the
+    loosest lower bound over the chunk is the FIRST token's (global pos
+    ``start``), so blocks wholly below ``start - window + 1`` re-fetch a
+    visible block (DMA elided); exact per-token masking happens in the
+    body."""
+    hi = jnp.maximum(0, (ctx_len - 1) // block_k)
+    if window:
+        lo = jnp.maximum(0, start - window + 1) // block_k
+        return jnp.clip(ik, jnp.minimum(lo, hi), hi)
+    return jnp.minimum(ik, hi)
 
 
-def _kernel(*refs, scale, rep, block_k, quant, paged):
+def _kernel(*refs, scale, rep, block_k, quant, paged, window):
     """Grid: (P, n_kv, kv_blocks); kv innermost (scratch carries state).
 
     quant (static): int8 cache mode — k/v scale refs follow v_ref
@@ -72,8 +81,14 @@ def _kernel(*refs, scale, rep, block_k, quant, paged):
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     last_vis = jnp.clip((ctx_len - 1) // block_k, 0, n_k - 1)
+    visible = ik <= last_vis
+    if window:
+        # Loosest chunk-wide lower bound (first token's window edge);
+        # per-token exactness is in the mask below.
+        lo_pos = jnp.maximum(0, start - window + 1)
+        visible &= ik * block_k + block_k > lo_pos
 
-    @pl.when(ik <= last_vis)
+    @pl.when(visible)
     def _body():
         q = q_ref[0, 0]  # [c*rep, hd]
         k = k_ref[0, 0]  # [block_k, hd]
@@ -98,6 +113,9 @@ def _kernel(*refs, scale, rep, block_k, quant, paged):
         # Causal vs the GLOBAL position start+t; rows past the row's own
         # chunk length are padding queries (fully masked → guarded 0 out).
         mask = jnp.logical_and(cols <= start + t, t < clen)
+        if window:
+            # Sliding window: keys must sit in (q_pos - window, q_pos].
+            mask = jnp.logical_and(mask, cols > start + t - window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]
@@ -123,7 +141,7 @@ def _kernel(*refs, scale, rep, block_k, quant, paged):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "block_k", "interpret")
+    jax.jit, static_argnames=("scale", "block_k", "window", "interpret")
 )
 def flash_cache_attention(
     q: jnp.ndarray,
@@ -138,9 +156,15 @@ def flash_cache_attention(
     block_table: jnp.ndarray | None = None,
     scale: float | None = None,
     block_k: int = 256,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Chunk attention against the slot cache.
+
+    window (static): sliding-window attention — each query attends only
+    keys in ``(start+t - window, start+t]``; 0 = full. Masked in-kernel;
+    blocks wholly below the chunk's loosest window edge skip their body
+    and their DMA.
 
     q: [P, c, n_heads, hd] — chunk queries (RoPE'd at positions
     starts[p]+t); k_cache, v_cache: [S, n_kv, max_len, hd] with the chunk's
@@ -181,10 +205,10 @@ def flash_cache_attention(
 
     if paged:
         def kv_idx(ip, ig, ik, slots, starts, lens, bt, bk=block_k):
-            return (
-                bt[slots[ip], _clamp_blk(ik, starts[ip] + lens[ip], bk)],
-                ig, 0, 0,
+            blk = _clamp_blk(
+                ik, starts[ip] + lens[ip], bk, starts[ip], window
             )
+            return (bt[slots[ip], blk], ig, 0, 0)
 
         # Paged scale planes index exactly like K/V (pool block, head).
         scale_idx = kv_idx
@@ -193,16 +217,16 @@ def flash_cache_attention(
             return (ip, ig, 0, 0)
     else:
         def kv_idx(ip, ig, ik, slots, starts, lens, bk=block_k):
-            return (
-                slots[ip], ig,
-                _clamp_blk(ik, starts[ip] + lens[ip], bk), 0,
+            blk = _clamp_blk(
+                ik, starts[ip] + lens[ip], bk, starts[ip], window
             )
+            return (slots[ip], ig, blk, 0)
 
         def scale_idx(ip, ig, ik, slots, starts, lens, bk=block_k):
-            return (
-                slots[ip], ig, 0,
-                _clamp_blk(ik, starts[ip] + lens[ip], bk),
+            blk = _clamp_blk(
+                ik, starts[ip] + lens[ip], bk, starts[ip], window
             )
+            return (slots[ip], ig, 0, blk)
 
         def row_idx(ip, ig, ik, slots, starts, lens):
             return (ip, ig, 0, 0)
@@ -238,7 +262,7 @@ def flash_cache_attention(
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, rep=rep, block_k=block_k, quant=quant,
-            paged=paged,
+            paged=paged, window=window,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((P, n_kv, c * rep, hd), q.dtype),

@@ -423,3 +423,57 @@ def test_windowed_paged_flash_decode_matches_dense():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_windowed_flash_cache_attention_matches_dense(paged):
+    """Windowed chunked prefill in-kernel == dense windowed math, with
+    starts straddling the window boundary (contiguous + paged)."""
+    from gofr_tpu.ops.attention import cache_chunk_attention
+    from gofr_tpu.ops.kv_cache import paged_view
+    from gofr_tpu.ops.pallas import flash_cache_attention
+
+    P, c, n_heads, n_kv, hd, w = 3, 8, 4, 2, 32, 48
+    key = jax.random.PRNGKey(23)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (P, c, n_heads, hd))
+    slots_arr = jnp.array([0, 3, 1], dtype=jnp.int32)
+    starts = jnp.array([0, 100, 37], dtype=jnp.int32)
+    lens = jnp.array([8, 8, 5], dtype=jnp.int32)
+    if paged:
+        S, bs, mb = 4, 64, 4
+        n_blocks = 1 + S * mb
+        pool_k = jax.random.normal(kk, (n_blocks, n_kv, bs, hd))
+        pool_v = jax.random.normal(kv_, (n_blocks, n_kv, bs, hd))
+        perm = jax.random.permutation(
+            jax.random.PRNGKey(6), n_blocks - 1
+        ) + 1
+        table = perm.reshape(S, mb).astype(jnp.int32)
+        vk, vv, _, _ = paged_view(table, pool_k, pool_v, slots_arr)
+        want = cache_chunk_attention(
+            q, vk, vv, jnp.arange(P), starts, lens, window=w, kernel=False,
+        )
+        got = flash_cache_attention(
+            q, pool_k, pool_v, slots_arr, starts, lens, block_table=table,
+            window=w, interpret=True,
+        )
+    else:
+        S, max_len = 4, 256
+        k_cache = jax.random.normal(kk, (S, n_kv, max_len, hd))
+        v_cache = jax.random.normal(kv_, (S, n_kv, max_len, hd))
+        want = cache_chunk_attention(
+            q, k_cache, v_cache, slots_arr, starts, lens, window=w,
+            kernel=False,
+        )
+        got = flash_cache_attention(
+            q, k_cache, v_cache, slots_arr, starts, lens, block_k=64,
+            window=w, interpret=True,
+        )
+        # The window must bind for the rows past position w.
+        full = cache_chunk_attention(
+            q, k_cache, v_cache, slots_arr, starts, lens, kernel=False,
+        )
+        assert not np.allclose(np.asarray(full), np.asarray(want), atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
