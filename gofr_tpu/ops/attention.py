@@ -107,8 +107,8 @@ def attention(
 
     window: sliding-window attention (Mistral) — each query attends only
     the last ``window`` keys (positions in (q_pos-window, q_pos]); 0 =
-    full. Takes the dense path (the flash kernels don't skip interior
-    blocks yet; the masking is exact either way).
+    full. Honored on both paths (the kernel masks in-kernel and skips
+    kv blocks wholly below the window).
     q: [b, s_q, n_heads, hd]; k, v: [b, s_kv, n_kv_heads, hd].
     mask: optional [b, s_q, s_kv] additive-validity bool mask (True = attend).
     lengths: optional [b] valid key-prefix lengths (right-padded batches) —
@@ -120,9 +120,9 @@ def attention(
     if mask is not None and lengths is not None:
         raise ValueError("pass either mask or lengths, not both")
     if window and window >= k.shape[1]:
-        window = 0  # cannot bind: plain causal, keep the kernel path
-    if window:
-        kernel = False
+        window = 0  # cannot bind: plain causal
+    if window and not causal:
+        raise ValueError("window requires causal attention")
     if kernel is None:
         kernel = _flash_enabled() and mask is None
     if kernel and mask is None:
@@ -132,9 +132,9 @@ def attention(
 
             return flash_attention(
                 q, k, v, lengths, causal=causal, scale=scale,
-                interpret=_interpret(),
+                window=window, interpret=_interpret(),
             )
-        return _flash_attention_ad(q, k, v, causal, scale)
+        return _flash_attention_ad(q, k, v, causal, scale, window)
     b, s_q, n_heads, hd = q.shape
     s_kv, n_kv = k.shape[1], k.shape[2]
     n_rep = n_heads // n_kv
@@ -483,30 +483,33 @@ def cache_chunk_attention(
     return out.reshape(P, c, n_heads, hd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_ad(q, k, v, causal, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_ad(q, k, v, causal, scale, window=0):
     """Flash forward, dense-recompute backward.
 
     pallas_call has no reverse-mode rule, so the VJP re-derives gradients
     from the dense formulation — training memory matches the dense path
-    while inference (no grad) gets the O(s) kernel.
+    while inference (no grad) gets the O(s) kernel. ``window`` threads
+    through both directions (windowed-model training stays exact).
     """
     from gofr_tpu.ops.pallas import flash_attention
 
     return flash_attention(
-        q, k, v, causal=causal, scale=scale, interpret=_interpret()
+        q, k, v, causal=causal, scale=scale, window=window,
+        interpret=_interpret(),
     )
 
 
-def _flash_ad_fwd(q, k, v, causal, scale):
-    return _flash_attention_ad(q, k, v, causal, scale), (q, k, v)
+def _flash_ad_fwd(q, k, v, causal, scale, window=0):
+    return _flash_attention_ad(q, k, v, causal, scale, window), (q, k, v)
 
 
-def _flash_ad_bwd(causal, scale, res, g):
+def _flash_ad_bwd(causal, scale, window, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q, k, v: attention(
-            q, k, v, causal=causal, scale=scale, kernel=False
+            q, k, v, causal=causal, scale=scale, kernel=False,
+            window=window,
         ),
         q, k, v,
     )

@@ -26,7 +26,7 @@ NEG_INF = -1e30
 
 
 def _kernel(*refs, scale, causal, s_q, s_kv, block_q, block_k, offset,
-            has_lengths):
+            has_lengths, window):
     """Grid: (b, n_heads, q_blocks, kv_blocks); kv innermost.
 
     With ``has_lengths`` a per-batch valid-length vector rides in SMEM
@@ -71,6 +71,13 @@ def _kernel(*refs, scale, causal, s_q, s_kv, block_q, block_k, offset,
             jnp.minimum(last_vis, (length - 1) // block_k), 0, n_k - 1
         )
     visible = ik <= last_vis
+    if window:
+        # Sliding window (causal-only): the q block's FIRST row bounds
+        # the loosest visible key; blocks wholly below it are skipped
+        # (their loads are predicated out with the compute, like the
+        # above-diagonal causal blocks).
+        lo_pos = jnp.maximum(0, row0 + offset - window + 1)
+        visible &= col0 + block_k > lo_pos
 
     @pl.when(visible)
     def _body():
@@ -92,6 +99,8 @@ def _kernel(*refs, scale, causal, s_q, s_kv, block_q, block_k, offset,
         mask = cols < (length if has_lengths else s_kv)  # invalid keys
         if causal:
             mask = jnp.logical_and(mask, cols <= rows + offset)
+        if window:
+            mask = jnp.logical_and(mask, cols > rows + offset - window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]  # [block_q, 128] (value replicated over lanes)
@@ -138,7 +147,9 @@ def _clamp_blk(ik, length, block_k):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=(
+        "causal", "scale", "block_q", "block_k", "window", "interpret"
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,
@@ -150,6 +161,7 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = 128,
     block_k: int = 128,
+    window: int = 0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Flash attention. Same contract as ``ops.attention.attention``:
@@ -158,8 +170,15 @@ def flash_attention(
     causal offset so the last query row attends to all keys when s_kv > s_q.
     lengths: optional [b] int32 valid key-prefix lengths (right-padded
     batches — the serving-prefill case): keys beyond a row's length are
-    masked and their kv blocks skipped. Returns [b, s_q, n_heads, hd].
+    masked and their kv blocks skipped.
+    window (static, causal-only): sliding-window attention — each query
+    attends keys in ``(q_pos - window, q_pos]``; 0 = full. Masked
+    in-kernel; kv blocks wholly below a q block's window edge are
+    skipped like above-diagonal causal blocks. Returns
+    [b, s_q, n_heads, hd].
     """
+    if window and not causal:
+        raise ValueError("window requires causal attention")
     b, s_q, n_heads, hd = q.shape
     s_kv, n_kv = k.shape[1], k.shape[2]
     n_rep = n_heads // n_kv
@@ -180,7 +199,7 @@ def flash_attention(
         _kernel,
         scale=scale, causal=causal, s_q=s_q, s_kv=s_kv,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
-        has_lengths=lengths is not None,
+        has_lengths=lengths is not None, window=window,
     )
     out_shape = jax.ShapeDtypeStruct((b, n_heads, sq_p, hd), q.dtype)
     scratch_shapes = [

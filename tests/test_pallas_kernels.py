@@ -477,3 +477,92 @@ def test_windowed_flash_cache_attention_matches_dense(paged):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
     )
+
+
+def test_windowed_flash_attention_matches_dense():
+    """Windowed full-sequence kernel == dense windowed math: suffix
+    queries (s_kv > s_q offset), ragged lengths, and the differentiable
+    wrapper's dense-recompute backward."""
+    from gofr_tpu.ops.attention import attention
+
+    b, s_kv, s_q, n_heads, n_kv, hd, w = 2, 192, 192, 4, 2, 32, 48
+    key = jax.random.PRNGKey(31)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s_q, n_heads, hd))
+    k = jax.random.normal(kk, (b, s_kv, n_kv, hd))
+    v = jax.random.normal(kv_, (b, s_kv, n_kv, hd))
+
+    want = attention(q, k, v, causal=True, window=w, kernel=False)
+    got = flash_attention(
+        q, k, v, causal=True, window=w, block_q=64, block_k=64,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    full = attention(q, k, v, causal=True, kernel=False)
+    assert not np.allclose(np.asarray(full), np.asarray(want), atol=1e-3)
+
+    # Suffix-query case: the causal offset composes with the window.
+    qs = q[:, -64:]
+    want_s = attention(qs, k, v, causal=True, window=w, kernel=False)
+    got_s = flash_attention(
+        qs, k, v, causal=True, window=w, block_q=64, block_k=64,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_s), np.asarray(want_s), atol=2e-5, rtol=2e-5
+    )
+
+    # Ragged lengths (serving prefill shape). Rows at positions past a
+    # batch's valid length can have ZERO visible keys once the window
+    # excludes the valid prefix — dense then emits uniform-softmax junk
+    # while the kernel emits its guarded 0; serving reads neither, so
+    # compare only the valid rows.
+    lens = jnp.array([50, 192], dtype=jnp.int32)
+    want_l = np.asarray(attention(
+        q, k, v, causal=True, window=w, lengths=lens, kernel=False
+    ))
+    got_l = np.asarray(flash_attention(
+        q, k, v, lens, causal=True, window=w, block_q=64, block_k=64,
+        interpret=True,
+    ))
+    for bi, ln in enumerate([50, 192]):
+        np.testing.assert_allclose(
+            got_l[bi, :ln], want_l[bi, :ln], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_windowed_flash_attention_grad(monkeypatch):
+    """Windowed kernel forward + dense-recompute backward == dense grad
+    (windowed-model training path)."""
+    import importlib
+
+    att = importlib.import_module("gofr_tpu.ops.attention")
+    monkeypatch.setattr(att, "_FLASH_ENV", "1")
+    b, s, n_heads, n_kv, hd, w = 1, 64, 4, 2, 32, 16
+    key = jax.random.PRNGKey(33)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, n_heads, hd))
+    k = jax.random.normal(kk, (b, s, n_kv, hd))
+    v = jax.random.normal(kv_, (b, s, n_kv, hd))
+
+    got = att.attention(q, k, v, causal=True, window=w)
+    want = att.attention(q, k, v, causal=True, window=w, kernel=False)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+    def loss_kernel(q):
+        return jnp.sum(att.attention(q, k, v, causal=True, window=w) ** 2)
+
+    def loss_dense(q):
+        return jnp.sum(
+            att.attention(q, k, v, causal=True, window=w, kernel=False) ** 2
+        )
+
+    gk = jax.grad(loss_kernel)(q)
+    gd = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(
+        np.asarray(gk), np.asarray(gd), atol=1e-4, rtol=1e-4
+    )
