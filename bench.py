@@ -179,6 +179,10 @@ def _decode_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
         ).astype(jnp.float32)
         ks, vs = rep8(ksc), rep8(vsc)
     lens = jnp.full((S,), T // 2, jnp.int32)  # typical half-full slots
+    # Windowed models (mistral): measure the attention the engine
+    # actually serves — the kernel skips out-of-window blocks, the dense
+    # path can't, so the A/B verdict differs from the unwindowed one.
+    window = getattr(cfg, "sliding_window", 0) or 0
     L = cfg.n_layers
     m1, m2 = L, 9 * L  # differenced trip counts (both amortize dispatch)
     for name, kern in (("kernel", True), ("dense", False)):
@@ -187,7 +191,8 @@ def _decode_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
             def chained(q, k, v, le, sk, sv, m, kn=kern):
                 def body(_, qc):
                     return decode_attention(
-                        qc, k, v, le, k_scale=sk, v_scale=sv, kernel=kn
+                        qc, k, v, le, k_scale=sk, v_scale=sv, kernel=kn,
+                        window=window,
                     )
 
                 return jax.lax.fori_loop(0, m, body, q)
@@ -210,9 +215,10 @@ def _decode_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
                 times[m] = (time.perf_counter() - t_ab) / reps
             per = (times[m2] - times[m1]) / (m2 - m1) * 1e3
             const = times[m1] * 1e3 - per * m1
-            log(f"profile: decode-attn[{name}] ({kv_quant or 'bf16'} kv) "
-                f"{per:.4f} ms/layer in-graph → ~{per * L:.2f} ms/step "
-                f"attn total (per-dispatch const ≈{const:.1f} ms, "
+            wtag = f" window={window}" if window else ""
+            log(f"profile: decode-attn[{name}] ({kv_quant or 'bf16'} kv"
+                f"{wtag}) {per:.4f} ms/layer in-graph → ~{per * L:.2f} "
+                f"ms/step attn total (per-dispatch const ≈{const:.1f} ms, "
                 f"cancelled)")
         except Exception as exc:  # noqa: BLE001 — A/B is advisory
             log(f"profile: decode-attn[{name}] probe failed: {exc}")
