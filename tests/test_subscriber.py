@@ -1,0 +1,156 @@
+"""SubscriptionManager semantics (reference ``subscriber.go:27-84``):
+commit ONLY on handler success, panic recovery logs-and-continues,
+broker read errors back off instead of hot-looping, sync and async
+handlers both run, and stop() cancels the loops cleanly. The example
+tests cover the happy path through a real broker; these pin the error
+paths with a scripted fake."""
+
+from __future__ import annotations
+
+import asyncio
+
+from gofr_tpu.subscriber import SubscriptionManager
+from gofr_tpu.testutil.mock_logger import MockLogger
+
+
+class FakeMsg:
+    def __init__(self, topic: str, data: bytes = b"x") -> None:
+        self.topic = topic
+        self.data = data
+        self.committed = 0
+
+    def commit(self) -> None:
+        self.committed += 1
+
+
+class FakeSubscriber:
+    """Returns scripted items per subscribe() call: a FakeMsg, None
+    (poll timeout), or an Exception (raised)."""
+
+    def __init__(self, script: list) -> None:
+        self.script = list(script)
+
+    def subscribe(self, topic: str, timeout: float):
+        if not self.script:
+            return None
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class FakeContainer:
+    def __init__(self, sub) -> None:
+        self._sub = sub
+        self.logger = MockLogger()
+
+    def get_subscriber(self):
+        return self._sub
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _drive(manager, until, timeout=10.0):
+    manager.start()
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not until():
+        if asyncio.get_running_loop().time() > deadline:
+            await manager.stop()
+            raise AssertionError("condition never reached")
+        await asyncio.sleep(0.01)
+    await manager.stop()
+
+
+def test_commit_only_on_success_sync_and_async():
+    ok1, ok2 = FakeMsg("t"), FakeMsg("t")
+    rejected = FakeMsg("t")
+    sub = FakeSubscriber([ok1, rejected, None, ok2])
+    container = FakeContainer(sub)
+    manager = SubscriptionManager(container)
+    seen = []
+
+    async def handler(ctx):
+        seen.append(ctx.request)
+        if ctx.request is rejected:
+            return False  # handler failure → must NOT commit
+        return True
+
+    manager.register("t", handler)
+    assert manager.topics == ["t"]
+    _run(_drive(manager, lambda: ok2.committed))
+    assert ok1.committed == 1 and ok2.committed == 1
+    assert rejected.committed == 0
+    assert seen == [ok1, rejected, ok2]
+
+    # Sync handler path (runs in the executor).
+    ok3 = FakeMsg("t")
+    sub.script.append(ok3)
+    manager2 = SubscriptionManager(container)
+    manager2.register("t", lambda ctx: True)
+    _run(_drive(manager2, lambda: ok3.committed))
+    assert ok3.committed == 1
+
+
+def test_handler_panic_recovers_without_commit():
+    boom, ok = FakeMsg("t"), FakeMsg("t")
+    container = FakeContainer(FakeSubscriber([boom, ok]))
+    manager = SubscriptionManager(container)
+
+    async def handler(ctx):
+        if ctx.request is boom:
+            raise RuntimeError("handler exploded")
+        return True
+
+    manager.register("t", handler)
+    _run(_drive(manager, lambda: ok.committed))
+    assert boom.committed == 0  # panic → no commit
+    logs = [r for r in container.logger.logs if "panicked" in str(r)]
+    assert logs, container.logger.logs
+
+
+def test_broker_error_backs_off_and_continues():
+    ok = FakeMsg("t")
+    container = FakeContainer(
+        FakeSubscriber([ConnectionError("broker away"), ok])
+    )
+    manager = SubscriptionManager(container)
+    manager.register("t", lambda ctx: True)
+    _run(_drive(manager, lambda: ok.committed))
+    assert ok.committed == 1  # loop survived the read error
+    logs = [
+        r for r in container.logger.logs
+        if "error while reading" in str(r)
+    ]
+    assert logs
+
+
+def test_no_subscriber_configured_waits_then_stops():
+    container = FakeContainer(None)
+    container.get_subscriber = lambda: None
+    manager = SubscriptionManager(container)
+    manager.register("t", lambda ctx: True)
+
+    async def scenario():
+        manager.start()
+        await asyncio.sleep(0.05)  # loop idles on the None subscriber
+        await manager.stop()  # must cancel cleanly, not hang
+
+    _run(scenario())
+    assert manager._tasks == []
+
+
+def test_none_error_commits():
+    """A handler returning None (the common bare-return) counts as
+    success — reference handlers rarely return anything."""
+    ok = FakeMsg("t")
+    container = FakeContainer(FakeSubscriber([ok]))
+    manager = SubscriptionManager(container)
+    manager.register("t", lambda ctx: None)
+    _run(_drive(manager, lambda: ok.committed))
+    assert ok.committed == 1
