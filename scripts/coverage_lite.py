@@ -92,6 +92,22 @@ def main() -> int:
     for pkg, (g, w) in sorted(by_pkg.items()):
         print(f"{pkg:42s} {g:5d}/{w:5d}  {100 * g / max(w, 1):5.1f}%",
               file=sys.stderr)
+    dump = os.environ.get("COVERAGE_LITE_DUMP", "")
+    if dump:
+        missing = {}
+        for root, _, files in os.walk(PKG):
+            if "__pycache__" in root:
+                continue
+            for name in files:
+                if not name.endswith(".py") or name.endswith(OMIT):
+                    continue
+                path = os.path.join(root, name)
+                want = possible_lines(path)
+                got = {ln for f, ln in hit if f == path}
+                rel = os.path.relpath(path, REPO)
+                missing[rel] = sorted(want - got)
+        with open(dump, "w", encoding="utf-8") as f:
+            json.dump(missing, f)
     total_g = sum(g for g, _ in per_file.values())
     total_w = sum(w for _, w in per_file.values())
     print(json.dumps({
