@@ -1,0 +1,151 @@
+"""Wire-level HTTP/1.1 robustness (the layer Go's net/http gives the
+reference for free — ``http/proto.py`` implements it natively, so its
+limits and error statuses need pinning against raw sockets: http.client
+cannot send malformed requests)."""
+
+from __future__ import annotations
+
+import json
+import socket
+
+import pytest
+
+from tests.test_http_server import AppHarness, make_app
+
+
+@pytest.fixture(scope="module")
+def wire_app():
+    app = make_app()
+
+    @app.post("/echo")
+    def echo(ctx):
+        return {"len": len(ctx.request.body or b"")}
+
+    @app.get("/hello")
+    def hello(ctx):
+        return "hi"
+
+    with AppHarness(app) as harness:
+        yield harness
+
+
+def _raw(harness, payload: bytes, recv_all=True) -> bytes:
+    s = socket.create_connection(
+        ("127.0.0.1", harness.app.http_port), timeout=10
+    )
+    try:
+        s.sendall(payload)
+        out = b""
+        s.settimeout(10)
+        while True:
+            try:
+                chunk = s.recv(65536)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            out += chunk
+            if not recv_all and b"\r\n\r\n" in out:
+                break
+        return out
+    finally:
+        s.close()
+
+
+def _status(resp: bytes) -> int:
+    return int(resp.split(b" ", 2)[1])
+
+
+def test_malformed_request_line_400(wire_app):
+    assert _status(_raw(wire_app, b"GARBAGE\r\n\r\n")) == 400
+
+
+def test_unsupported_version_505(wire_app):
+    assert _status(_raw(wire_app, b"GET /hello HTTP/2.0\r\n\r\n")) == 505
+
+
+def test_http10_is_accepted_and_closes_by_default(wire_app):
+    resp = _raw(wire_app, b"GET /hello HTTP/1.0\r\n\r\n")
+    assert _status(resp) == 200
+    # HTTP/1.0 without keep-alive → server closes (Connection: close).
+    assert b"Connection: close" in resp
+
+
+def test_header_line_too_long_431(wire_app):
+    big = b"x-big: " + b"a" * 9000
+    resp = _raw(wire_app, b"GET /hello HTTP/1.1\r\n" + big + b"\r\n\r\n")
+    assert _status(resp) == 431
+
+
+def test_too_many_headers_431(wire_app):
+    headers = b"".join(b"x-h%d: v\r\n" % i for i in range(150))
+    resp = _raw(wire_app, b"GET /hello HTTP/1.1\r\n" + headers + b"\r\n")
+    assert _status(resp) == 431
+
+
+def test_malformed_header_400(wire_app):
+    resp = _raw(wire_app, b"GET /hello HTTP/1.1\r\nno-colon-here\r\n\r\n")
+    assert _status(resp) == 400
+
+
+def test_bad_content_length_400(wire_app):
+    resp = _raw(
+        wire_app,
+        b"POST /echo HTTP/1.1\r\ncontent-length: nope\r\n\r\n",
+    )
+    assert _status(resp) == 400
+    resp = _raw(
+        wire_app,
+        b"POST /echo HTTP/1.1\r\ncontent-length: -5\r\n\r\n",
+    )
+    assert _status(resp) == 400
+
+
+def test_oversized_content_length_413(wire_app):
+    resp = _raw(
+        wire_app,
+        b"POST /echo HTTP/1.1\r\ncontent-length: 99999999999\r\n\r\n",
+    )
+    assert _status(resp) == 413
+
+
+def test_chunked_body_roundtrip_with_trailers(wire_app):
+    body = (
+        b"POST /echo HTTP/1.1\r\n"
+        b"transfer-encoding: chunked\r\n"
+        b"content-type: application/json\r\n\r\n"
+        b"5\r\nhello\r\n"
+        b"6\r\n world\r\n"
+        b"0\r\n"
+        b"x-trailer: ignored\r\n"
+        b"\r\n"
+    )
+    resp = _raw(wire_app, body)
+    assert _status(resp) == 201  # POST envelope status
+    payload = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+    assert payload["data"]["len"] == len(b"hello world")
+
+
+def test_bad_chunk_size_400(wire_app):
+    body = (
+        b"POST /echo HTTP/1.1\r\n"
+        b"transfer-encoding: chunked\r\n\r\n"
+        b"zz\r\nhello\r\n0\r\n\r\n"
+    )
+    assert _status(_raw(wire_app, body)) == 400
+
+
+def test_repeated_headers_comma_join(wire_app):
+    app = wire_app.app
+
+    @app.get("/hdr")
+    def hdr(ctx):
+        return {"via": ctx.request.headers.get("x-multi", "")}
+
+    resp = _raw(
+        wire_app,
+        b"GET /hdr HTTP/1.1\r\nx-multi: a\r\nx-multi: b\r\n\r\n",
+    )
+    assert _status(resp) == 200
+    payload = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+    assert payload["data"]["via"] == "a, b"
