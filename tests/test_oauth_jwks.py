@@ -1,0 +1,158 @@
+"""RS256 OAuth end to end (reference ``oauth.go:53-194``): a real JWKS
+endpoint served in-proc, `App.enable_oauth` wiring, RS256 signature
+verification, and every rejection path (bad signature, unknown kid,
+expired token, unsupported alg, malformed token). The HS256 shared-secret
+path is covered in test_parity_misc; this pins the production RSA path
+the JWKSProvider exists for."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+cryptography = pytest.importorskip("cryptography")
+
+from cryptography.hazmat.primitives.asymmetric import padding, rsa  # noqa: E402
+from cryptography.hazmat.primitives import hashes  # noqa: E402
+
+from tests.test_http_server import AppHarness, make_app  # noqa: E402
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _int_b64url(n: int) -> str:
+    return _b64url(n.to_bytes((n.bit_length() + 7) // 8, "big"))
+
+
+@pytest.fixture(scope="module")
+def rsa_key():
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+@pytest.fixture(scope="module")
+def jwks_server(rsa_key):
+    pub = rsa_key.public_key().public_numbers()
+    jwks = {
+        "keys": [
+            {"kty": "oct", "kid": "sym"},  # non-RSA: must be skipped
+            {"kty": "RSA", "kid": "bad", "n": "!!!", "e": "AQAB"},  # bad jwk
+            {
+                "kty": "RSA",
+                "kid": "test-key",
+                "n": _int_b64url(pub.n),
+                "e": _int_b64url(pub.e),
+            },
+        ]
+    }
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(jwks).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}/jwks.json"
+    srv.shutdown()
+
+
+def _jwt(rsa_key, kid="test-key", alg="RS256", exp=None, claims=None):
+    header = {"alg": alg, "kid": kid}
+    payload = {"sub": "user-1", **(claims or {})}
+    if exp is not None:
+        payload["exp"] = exp
+    h = _b64url(json.dumps(header).encode())
+    p = _b64url(json.dumps(payload).encode())
+    sig = rsa_key.sign(
+        f"{h}.{p}".encode(), padding.PKCS1v15(), hashes.SHA256()
+    )
+    return f"{h}.{p}.{_b64url(sig)}"
+
+
+@pytest.fixture(scope="module")
+def oauth_app(jwks_server):
+    app = make_app()
+
+    @app.get("/claims")
+    def claims(ctx):
+        return {"sub": ctx.get("JWTClaims")["sub"]}
+
+    app.enable_oauth(jwks_server, refresh_interval_s=3600.0)
+    with AppHarness(app) as harness:
+        yield harness
+
+
+def _get(harness, token):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    return harness.request("GET", "/claims", headers=headers)
+
+
+def test_valid_rs256_token_passes_claims(oauth_app, rsa_key):
+    status, _, body = _get(oauth_app, _jwt(rsa_key))
+    assert status == 200
+    assert json.loads(body)["data"]["sub"] == "user-1"
+
+
+def test_missing_and_malformed_tokens_401(oauth_app, rsa_key):
+    status, _, body = _get(oauth_app, None)
+    assert status == 401 and b"missing" in body
+    status, _, body = _get(oauth_app, "not.a.jwt")
+    assert status == 401 and b"malformed" in body
+
+
+def test_unknown_kid_401(oauth_app, rsa_key):
+    status, _, body = _get(oauth_app, _jwt(rsa_key, kid="nope"))
+    assert status == 401 and b"unknown key id" in body
+
+
+def test_tampered_signature_401(oauth_app, rsa_key):
+    token = _jwt(rsa_key)
+    h, p, s = token.split(".")
+    forged = json.loads(base64.urlsafe_b64decode(p + "=="))
+    forged["sub"] = "attacker"
+    tampered = f"{h}.{_b64url(json.dumps(forged).encode())}.{s}"
+    status, _, body = _get(oauth_app, tampered)
+    assert status == 401 and b"invalid signature" in body
+
+
+def test_expired_token_401(oauth_app, rsa_key):
+    status, _, body = _get(oauth_app, _jwt(rsa_key, exp=time.time() - 60))
+    assert status == 401 and b"expired" in body
+    status, _, _ = _get(oauth_app, _jwt(rsa_key, exp=time.time() + 3600))
+    assert status == 200
+
+
+def test_unsupported_alg_401(oauth_app, rsa_key):
+    status, _, body = _get(oauth_app, _jwt(rsa_key, alg="none"))
+    assert status == 401 and b"unsupported alg" in body
+
+
+def test_health_probe_exempt(oauth_app):
+    status, _, _ = oauth_app.request("GET", "/.well-known/alive")
+    assert status == 200
+
+
+def test_provider_survives_dead_endpoint(rsa_key):
+    from gofr_tpu.http.middleware import JWKSProvider
+    from gofr_tpu.testutil.mock_logger import MockLogger
+
+    logger = MockLogger()
+    provider = JWKSProvider(
+        "http://127.0.0.1:1/nope", refresh_interval_s=3600.0, logger=logger
+    )
+    provider.refresh()  # must not raise
+    assert provider.key("anything") is None
+    provider.stop()
