@@ -42,14 +42,28 @@ while true; do
       fi
       PYTHONPATH=/root/.axon_site:/root/repo timeout 600 \
         python scripts/tpu_probe.py llama-1b 32 1024 2>&1 | grep "probe:"
+      if bash -c '. scripts/campaign_lib.sh; for f in campaign/*.json; do
+            n=$(basename "$f" .json); already_measured "$n" || exit 1
+          done'; then
+        echo "full ladder measured; watcher done at $(date)"
+        exit 0
+      fi
+      # Ladder incomplete (some configs degraded/failed): keep watching —
+      # a later window can fill them (every run() skips measured rows).
+      sleep 300
+      continue
     else
       echo "short window (${remaining}s): mini harvest — mega A/B first"
       mini r4-1b BENCH_MODEL=llama-1b BENCH_MEGA=0
       mini r4-1b-mega8 BENCH_MODEL=llama-1b BENCH_MEGA=8
       mini r4-8b-kv8-mega8 BENCH_MODEL=llama-3-8b BENCH_SLOTS=32 BENCH_REQUESTS=64 BENCH_KV_QUANT=int8 BENCH_MEGA=8
       mini r4-1b-int4 BENCH_MODEL=llama-1b BENCH_QUANT=int4
+      mini r5-mistral-8k BENCH_MODEL=mistral-7b BENCH_MAX_LEN=8192 BENCH_SLOTS=8 BENCH_REQUESTS=16 BENCH_QUANT=int8 BENCH_KV_QUANT=int8 BENCH_NEW_TOKENS=64 BENCH_PREFILL_DEPTH=8 BENCH_MEGA=8
+      # A short window that completed the mini set may be followed by a
+      # longer one — keep watching until the deadline.
+      sleep 300
+      continue
     fi
-    exit 0
   fi
   echo "relay down at $(date)"
   sleep 300
