@@ -224,6 +224,77 @@ def _decode_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
             log(f"profile: decode-attn[{name}] probe failed: {exc}")
 
 
+def _prefill_attn_ab(engine, n_slots: int, kv_quant: str) -> None:
+    """In-graph chunked-prefill attention A/B (kernel vs dense), same
+    dispatch-cancelling differencing as ``_decode_attn_ab``. Answers
+    whether the chunk kernel's length-skipping beats one fused dense op
+    at the serving chunk shape (TTFT attribution)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gofr_tpu.ops.attention import cache_chunk_attention
+    from gofr_tpu.ops.kv_cache import quantize_kv
+
+    cfg = engine.cfg
+    S, T, c = n_slots, engine.max_len, engine.prefill_chunk
+    P = engine.prefill_batch
+    key = jax.random.PRNGKey(1)
+    q = jax.random.normal(key, (P, c, cfg.n_heads, cfg.head_dim), jnp.bfloat16)
+    kc = jax.random.normal(
+        key, (S, cfg.n_kv_heads, T, cfg.head_dim), jnp.bfloat16
+    )
+    vc = kc + 1
+    ks = vs = None
+    if kv_quant:
+        kc, ksc = quantize_kv(kc)
+        vc, vsc = quantize_kv(vc)
+        rep8 = lambda s: jnp.broadcast_to(  # noqa: E731
+            s[:, :, None, :], (S, cfg.n_kv_heads, 8, T)
+        ).astype(jnp.float32)
+        ks, vs = rep8(ksc), rep8(vsc)
+    slots = jnp.arange(P, dtype=jnp.int32) % S
+    starts = jnp.full((P,), T // 2, jnp.int32)  # mid-prompt chunk
+    lens = jnp.full((P,), c, jnp.int32)
+    window = getattr(cfg, "sliding_window", 0) or 0
+    L = cfg.n_layers
+    m1, m2 = L, 9 * L
+    for name, kern in (("kernel", True), ("dense", False)):
+        try:
+
+            def chained(q, k, v, sl, st, ln, sk, sv, m, kn=kern):
+                def body(_, qc):
+                    return cache_chunk_attention(
+                        qc, k, v, sl, st, ln, k_scale=sk, v_scale=sv,
+                        kernel=kn, window=window,
+                    )
+
+                return jax.lax.fori_loop(0, m, body, q)
+
+            fn = jax.jit(chained, donate_argnums=(0,))
+            times = {}
+            for m in (m1, m2):
+                md = jnp.int32(m)
+                jax.block_until_ready(
+                    fn(jnp.array(q), kc, vc, slots, starts, lens, ks, vs, md)
+                )
+                reps, out = 3, None
+                t_ab = time.perf_counter()
+                for _ in range(reps):
+                    out = fn(
+                        jnp.array(q), kc, vc, slots, starts, lens, ks, vs,
+                        md,
+                    )
+                jax.block_until_ready(out)
+                times[m] = (time.perf_counter() - t_ab) / reps
+            per = (times[m2] - times[m1]) / (m2 - m1) * 1e3
+            wtag = f" window={window}" if window else ""
+            log(f"profile: prefill-attn[{name}] ({P}x{c} chunk, "
+                f"{kv_quant or 'bf16'} kv{wtag}) {per:.4f} ms/layer "
+                f"in-graph → ~{per * L:.2f} ms/chunk attn total")
+        except Exception as exc:  # noqa: BLE001 — A/B is advisory
+            log(f"profile: prefill-attn[{name}] probe failed: {exc}")
+
+
 _STAGE = ["start", time.time()]
 
 
@@ -388,6 +459,7 @@ def main() -> None:
     # probe tensors (GB-scale at 8B/8k shapes) free before the measured run.
     if on_tpu:
         _decode_attn_ab(engine, n_slots, kv_quant)
+        _prefill_attn_ab(engine, n_slots, kv_quant)
     log(f"profile in {time.time() - t0:.1f}s")
 
     # Warmup: compile the real prefill bucket + steady-state decode path.
