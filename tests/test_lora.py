@@ -799,3 +799,56 @@ def test_openai_surface_routes_adapters():
     finally:
         asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=30)
         loop.call_soon_threadsafe(loop.stop)
+
+
+def test_boot_time_adapters_from_config(tmp_path):
+    """TPU_LORA_ADAPTERS=name=path[,name2=p2] loads PEFT checkpoints at
+    engine boot (the from_config seam); malformed entries fail loudly."""
+    from safetensors.numpy import save_file
+
+    from gofr_tpu.config import MockConfig
+
+    rng = np.random.default_rng(9)
+    tensors = {}
+    for t, mod in (("wq", "q_proj"), ("wv", "v_proj")):
+        d_in, d_out = lora_dims(CFG, t)
+        for i in range(CFG.n_layers):
+            tensors[
+                f"base_model.model.model.layers.{i}.self_attn.{mod}"
+                f".lora_A.weight"
+            ] = rng.standard_normal((4, d_in)).astype(np.float32) * 0.5
+            tensors[
+                f"base_model.model.model.layers.{i}.self_attn.{mod}"
+                f".lora_B.weight"
+            ] = rng.standard_normal((d_out, 4)).astype(np.float32) * 0.5
+    (tmp_path / "adapter_config.json").write_text(json.dumps({
+        "r": 4, "lora_alpha": 4.0,
+        "target_modules": ["q_proj", "v_proj"],
+    }))
+    save_file(tensors, str(tmp_path / "adapter_model.safetensors"))
+
+    cfg = {
+        "TPU_MODEL": "llama-tiny-f32", "TPU_KV_SLOTS": "2",
+        "TPU_MAX_LEN": "128", "TPU_LORA_SLOTS": "2", "TPU_LORA_RANK": "4",
+        "TPU_LORA_ADAPTERS": f"boot={tmp_path}",
+    }
+    eng = InferenceEngine.from_config(MockConfig(cfg))
+    assert eng.lora_names() == ["boot"]
+    eng.start_sync()
+    try:
+        base = eng.generate_sync(
+            "hi", max_new_tokens=6, temperature=0.0, stop_on_eos=False,
+            timeout=120,
+        ).token_ids
+        tuned = eng.generate_sync(
+            "hi", max_new_tokens=6, temperature=0.0, stop_on_eos=False,
+            timeout=120, adapter="boot",
+        ).token_ids
+        assert tuned != base  # the boot adapter actually loaded weights
+    finally:
+        eng.stop_sync()
+
+    with pytest.raises(ValueError, match="name=path"):
+        InferenceEngine.from_config(MockConfig({
+            **cfg, "TPU_LORA_ADAPTERS": "not-an-assignment",
+        }))
