@@ -164,3 +164,55 @@ def test_registered_service_in_container_health(upstream):
     assert health["details"]["service:upstream"]["status"] == "UP"
     svc = app.container.get_http_service("upstream")
     assert svc.get("/data").json()["data"]["value"] == 42
+
+
+def test_oauth2_client_credentials_token_flow(upstream):
+    """OAuthConfig performs the client-credentials grant against a real
+    token endpoint, injects Bearer tokens, caches until near expiry,
+    and re-fetches once expired (reference service auth decorators)."""
+    import time as _time
+
+    from gofr_tpu.service import OAuthConfig
+
+    tokens = {"issued": 0}
+    app = upstream.app
+
+    @app.post("/token")
+    def token(ctx):
+        body = ctx.request.form() if hasattr(ctx.request, "form") else {}
+        tokens["issued"] += 1
+        tokens["last_grant"] = dict(body or {})
+        from gofr_tpu.http.response import Raw
+
+        return Raw({
+            "access_token": f"tok-{tokens['issued']}",
+            "expires_in": 31,  # cache refreshes 30s before expiry → ~1s
+        })
+
+    svc = new_http_service(
+        upstream.address, None, None,
+        OAuthConfig(
+            token_url=f"{upstream.address}/token",
+            client_id="cid", client_secret="sec", scopes=("a", "b"),
+        ),
+    )
+    got = svc.get("/echo-headers").json()["data"]
+    assert got["auth"] == "Bearer tok-1"
+    got = svc.get("/echo-headers").json()["data"]
+    assert got["auth"] == "Bearer tok-1"  # cached, not re-fetched
+    assert tokens["issued"] == 1
+    _time.sleep(1.2)  # past expiry-30s → refresh
+    got = svc.get("/echo-headers").json()["data"]
+    assert got["auth"] == "Bearer tok-2"
+    assert tokens["issued"] == 2
+
+
+def test_retry_on_connection_error():
+    """The retry loop's CONNECTION-error branch: a dead upstream raises
+    after max_retries+1 attempts instead of hanging or succeeding."""
+    svc = new_http_service(
+        "http://127.0.0.1:1", None, None,
+        RetryConfig(max_retries=2, backoff_s=0.01),
+    )
+    with pytest.raises(Exception):
+        svc.get("/data")
