@@ -143,3 +143,64 @@ def test_remote_level_logger_uses_instrumented_client():
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_remote_level_background_loop_and_failure_paths():
+    """The poller's BACKGROUND thread hot-swaps the level on its
+    interval; a dead endpoint or empty payload never kills the loop or
+    changes the level; start() without a URL is a no-op; stop() ends
+    the thread (reference dynamicLevelLogger.go:23-106)."""
+    import http.server
+    import threading
+    import time as _time
+
+    from gofr_tpu.logging import RemoteLevelLogger
+
+    payload = {"data": [
+        {"serviceName": "t", "logLevel": {"LOG_LEVEL": "ERROR"}}
+    ]}
+
+    class LevelHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), LevelHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        log, _, _ = make_logger(Level.INFO)
+        rl = RemoteLevelLogger(
+            log, f"http://127.0.0.1:{srv.server_address[1]}/level",
+            interval_s=0.05,
+        )
+        rl.start()
+        deadline = _time.time() + 10
+        while log.level != Level.ERROR and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert log.level == Level.ERROR  # hot-swapped by the thread
+        # Empty data → keep the current level, keep polling.
+        payload["data"] = []
+        _time.sleep(0.2)
+        assert log.level == Level.ERROR
+        rl.stop()
+
+        # Dead endpoint: fetch must swallow the error, not raise.
+        log2, _, _ = make_logger(Level.INFO)
+        dead = RemoteLevelLogger(log2, "http://127.0.0.1:1/level")
+        dead.fetch_and_update()
+        assert log2.level == Level.INFO
+        dead.stop()
+
+        # No URL configured → start() is a no-op (no thread).
+        log3, _, _ = make_logger(Level.INFO)
+        off = RemoteLevelLogger(log3, "")
+        off.start()
+        assert off._thread is None
+    finally:
+        srv.shutdown()
