@@ -152,6 +152,15 @@ class InProcMQTTBroker:
 
     def close(self) -> None:
         self._closed = True
+        # shutdown() BEFORE close(): the accept thread is blocked inside
+        # accept(), and closing the fd alone leaves the kernel socket
+        # alive (still in LISTEN) until that syscall returns — which is
+        # never without a new connection. shutdown wakes it, so the port
+        # actually frees and a same-port restart can bind.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:

@@ -318,3 +318,58 @@ def test_google_without_driver_raises_clear_error():
 
     with pytest.raises(PubSubBackendUnavailable, match="google-cloud-pubsub"):
         new_google_from_config(MockConfig({}))
+
+
+def test_mqtt_reconnect_replays_subscriptions():
+    """A dropped broker connection must self-heal: the client re-dials
+    with backoff, replays its SUBSCRIBEs, and deliveries resume —
+    pinned by killing the broker and restarting one on the SAME port
+    (mqtt.py:_reconnect, the path nothing exercised)."""
+    import random
+    import time as _time
+
+    # A port BELOW the ephemeral range: the client's reconnect loop
+    # dials the freed port continuously, and against an ephemeral port
+    # the kernel can self-connect (source==dest), holding the port and
+    # blocking the broker's rebind forever.
+    b1 = None
+    for _ in range(20):
+        try:
+            b1 = InProcMQTTBroker(port=random.randint(20000, 28000))
+            break
+        except OSError:
+            continue
+    assert b1 is not None, "no free low port found"
+    port = b1.port
+    sub = MQTTClient(host=b1.host, port=port, client_id="rc-sub")
+    pub = None
+    try:
+        assert sub.subscribe("orders", timeout=0.05) is None  # lazy sub
+        b1.close()  # drop every connection
+        # Rebind the same port (the old listener can linger briefly).
+        b2 = None
+        deadline = _time.time() + 10
+        while b2 is None:
+            try:
+                b2 = InProcMQTTBroker(port=port)
+            except OSError:
+                if _time.time() > deadline:
+                    raise
+                _time.sleep(0.2)
+        try:
+            # Reconnect + SUBSCRIBE replay happen with backoff; publish
+            # retries until the subscription is live again.
+            pub = MQTTClient(host=b2.host, port=port, client_id="rc-pub")
+            msg = None
+            deadline = _time.time() + 20
+            while msg is None and _time.time() < deadline:
+                pub.publish("orders", b"after-reconnect")
+                msg = sub.subscribe("orders", timeout=1.0)
+            assert msg is not None, "no delivery after broker restart"
+            assert msg.value == b"after-reconnect"
+        finally:
+            b2.close()
+    finally:
+        sub.close()
+        if pub is not None:
+            pub.close()
