@@ -310,3 +310,36 @@ def test_multipart_binary_byte_fidelity():
     assert bound["purpose"] == "batch"
     assert bound["file"].data == payload
     assert bound["file"].filename == "blob.bin"
+
+
+def test_shutdown_drain_timeout_closes_stragglers():
+    """A handler that outlives the drain window is forcibly closed and
+    the timeout is logged — shutdown must never hang on one slow
+    request (SURVEY §7 hard-part 5)."""
+    import concurrent.futures
+
+    app = make_app()
+
+    @app.get("/stuck")
+    async def stuck(ctx):
+        await asyncio.sleep(30)
+        return "never"
+
+    harness = AppHarness(app)
+    harness.__enter__()
+    try:
+        harness.app._http_server.drain_timeout_s = 0.3
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            fut = pool.submit(harness.request, "GET", "/stuck")
+            time.sleep(0.2)  # in-flight now
+            t0 = time.time()
+            asyncio.run_coroutine_threadsafe(
+                harness.app.stop(), harness._loop
+            ).result(timeout=15)
+            assert time.time() - t0 < 10  # did not wait the full 30s
+            with pytest.raises(Exception):
+                fut.result(timeout=15)  # connection was reset, not served
+    finally:
+        harness._loop.call_soon_threadsafe(harness._loop.stop)
+        harness._thread.join(timeout=5)
+        harness._loop.close()
