@@ -1,0 +1,47 @@
+"""graftlint — TPU-correctness static analysis (net-new subsystem).
+
+The reference framework's credibility rests on correctness tooling
+(generated mocks, race-detector CI). A JAX serving stack has a class of
+bugs ordinary linters never catch — tracer leaks, silent host↔device
+syncs, recompilation hazards, blocking calls on the batcher hot path —
+and they are exactly the bugs that cost the most on real TPU hardware.
+graftlint is an AST-based rule engine purpose-built for this codebase:
+
+* ``GL001`` host→device sync on hot paths (``.item()``, ``float()``/
+  ``int()``/``np.asarray()`` on device arrays in ``serving/``/``ops/``);
+* ``GL002`` Python branching on tracer values inside jitted functions;
+* ``GL003`` recompilation hazards (mutable static args, shape-derived
+  cache keys);
+* ``GL004`` blocking calls inside ``async def`` or the batcher/
+  scheduler/engine hot path;
+* ``GL005`` lock-discipline drift (shared attributes written both under
+  and outside a lock) in the threaded serving core;
+* ``GL006`` broad exception handlers that silently swallow errors in
+  request paths.
+
+Run it as ``python -m gofr_tpu.analysis [paths]``; suppress a finding
+in place with ``# graftlint: disable=GL001`` and record pre-existing
+debt in the committed baseline (``--write-baseline`` /
+``--check-baseline``). See ``docs/advanced-guide/static-analysis.md``.
+"""
+
+from gofr_tpu.analysis.core import (
+    Baseline,
+    FileContext,
+    Finding,
+    LintConfig,
+    Rule,
+    run_paths,
+)
+from gofr_tpu.analysis.rules import ALL_RULES, default_rules
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "FileContext",
+    "Finding",
+    "LintConfig",
+    "Rule",
+    "default_rules",
+    "run_paths",
+]

@@ -1,0 +1,8 @@
+"""``python -m gofr_tpu.analysis`` entrypoint."""
+
+import sys
+
+from gofr_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""graftlint CLI — ``python -m gofr_tpu.analysis``.
+
+Exit codes: 0 clean (relative to the baseline), 1 findings or baseline
+drift, 2 usage error. ``--write-baseline`` records the current findings
+as accepted debt; ``--check-baseline`` additionally fails when the
+baseline holds entries that no longer occur (paid-off debt must be
+removed from the ledger so it can never mask a regression on the same
+line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from gofr_tpu.analysis.core import (
+    Baseline,
+    config_from_pyproject,
+    run_paths,
+)
+from gofr_tpu.analysis.rules import default_rules
+
+DEFAULT_BASELINE = "graftlint-baseline.json"
+
+
+def _find_repo_root(start: str) -> str:
+    """Nearest ancestor holding pyproject.toml (config + baseline home)."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m gofr_tpu.analysis",
+        description="graftlint: TPU-correctness static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["gofr_tpu"],
+        help="files or directories to analyze (default: gofr_tpu)",
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--ignore", default="",
+        help="comma-separated rule ids to skip",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: <repo root>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="record current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--check-baseline", action="store_true",
+        help="also fail when baseline entries no longer occur (drift)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the registered rules and exit",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    root = _find_repo_root(
+        args.paths[0] if args.paths and os.path.exists(args.paths[0]) else "."
+    )
+    config = config_from_pyproject(os.path.join(root, "pyproject.toml"))
+    if args.select:
+        config.select = {r.strip() for r in args.select.split(",") if r.strip()}
+    if args.ignore:
+        config.disable |= {
+            r.strip() for r in args.ignore.split(",") if r.strip()
+        }
+
+    rules = default_rules(config)
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.rule_id}  {rule.name}: {rule.rationale}")
+        return 0
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = run_paths(args.paths, rules=rules, config=config, root=root)
+    active_ids = {r.rule_id for r in rules if config.wants(r.rule_id)}
+    scoped = bool(args.select or args.ignore)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        new_baseline = Baseline.from_findings(findings)
+        if scoped:
+            # A scoped run never saw the other rules' findings; keep
+            # their recorded debt instead of silently deleting it.
+            old = Baseline.load(baseline_path)
+            for fp, entry in old.entries.items():
+                if entry.get("rule") not in active_ids:
+                    new_baseline.entries[fp] = entry
+        new_baseline.write(baseline_path)
+        print(
+            f"graftlint: wrote {len(new_baseline.entries)} finding(s) "
+            f"to {baseline_path}"
+        )
+        return 0
+
+    stale: list[str] = []
+    if args.no_baseline:
+        new = findings
+    else:
+        baseline = Baseline.load(baseline_path)
+        new, stale = baseline.apply(
+            findings, active_rules=active_ids if scoped else None
+        )
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule_id, "path": f.path, "line": f.line,
+                        "col": f.col + 1, "message": f.message,
+                    }
+                    for f in new
+                ],
+                "stale_baseline_entries": stale if args.check_baseline else [],
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+
+    failed = bool(new)
+    if args.check_baseline and stale:
+        failed = True
+        if args.format == "text":
+            print(
+                f"graftlint: {len(stale)} baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} no longer occur — "
+                "regenerate with --write-baseline",
+                file=sys.stderr,
+            )
+    if failed and args.format == "text" and new:
+        print(
+            f"graftlint: {len(new)} new finding(s) "
+            "(suppress in place with `# graftlint: disable=RULE` "
+            "or accept with --write-baseline)",
+            file=sys.stderr,
+        )
+    return 1 if failed else 0

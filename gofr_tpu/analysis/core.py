@@ -1,0 +1,412 @@
+"""graftlint rule engine: findings, suppressions, config, baseline.
+
+Design mirrors the shape of production linters (ruff/pylint) at ~1/100th
+the size: a :class:`Rule` walks one file's AST and yields
+:class:`Finding`\\ s; the runner parses each file once, applies inline
+suppressions and the committed baseline, and reports what is left.
+
+Fingerprints (the baseline keys) hash the *content* of the flagged line,
+not its number, so unrelated edits above a finding do not invalidate the
+baseline — the same trick ruff's ``--add-noqa``-free baselines and
+Pylint's ``--recursive`` caches use.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional, Sequence
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)"
+)
+
+_DEFAULT_EXCLUDES = (
+    "*_pb2.py",
+    "*_pb2_grpc.py",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule_id: str
+    path: str  # repo-relative, posix separators
+    line: int  # 1-based
+    col: int  # 0-based
+    message: str
+    code_line: str = ""  # stripped source of ``line`` (fingerprint input)
+
+    def fingerprint(self, occurrence: int = 0) -> str:
+        """Stable identity for the baseline: file + rule + line *content*
+        (+ disambiguating occurrence index for identical lines), so the
+        baseline survives edits that merely shift line numbers."""
+        raw = f"{self.path}|{self.rule_id}|{self.code_line}|{occurrence}"
+        return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col + 1}: "
+            f"{self.rule_id} {self.message}"
+        )
+
+
+@dataclass
+class FileContext:
+    """Everything a rule may need about the file under analysis."""
+
+    path: str  # repo-relative, posix separators
+    source: str
+    lines: list[str]
+    # line number -> set of suppressed rule ids ("*" suppresses all)
+    suppressions: dict[int, set[str]]
+    # absolute filesystem path (lets rules resolve sibling files, e.g.
+    # GL005's cross-file mixin analysis)
+    abs_path: str = ""
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        ids = self.suppressions.get(line)
+        return bool(ids) and ("*" in ids or rule_id in ids)
+
+
+class Rule:
+    """Base class for graftlint rules.
+
+    Subclasses set ``rule_id``/``name``/``rationale`` and implement
+    :meth:`check`. ``applies_to`` scopes a rule to parts of the tree
+    (hot-path rules only fire where the cost is real)."""
+
+    rule_id: str = "GL000"
+    name: str = "unnamed"
+    rationale: str = ""
+
+    def applies_to(self, path: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: FileContext, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        code = ctx.lines[line - 1].strip() if 0 < line <= len(ctx.lines) else ""
+        return Finding(
+            rule_id=self.rule_id,
+            path=ctx.path,
+            line=line,
+            col=col,
+            message=message,
+            code_line=code,
+        )
+
+
+@dataclass
+class LintConfig:
+    """Runtime configuration (CLI flags layered over ``[tool.graftlint]``
+    in ``pyproject.toml``)."""
+
+    select: Optional[set[str]] = None  # None = all registered rules
+    disable: set[str] = field(default_factory=set)
+    exclude: tuple[str, ...] = _DEFAULT_EXCLUDES
+    # Rules that only matter where device dispatch happens:
+    hot_path_dirs: tuple[str, ...] = ("serving", "ops")
+    hot_path_files: tuple[str, ...] = (
+        "serving/batcher.py",
+        "serving/scheduler.py",
+        "serving/engine.py",
+    )
+    request_path_dirs: tuple[str, ...] = ("serving", "ops", "grpc")
+
+    def wants(self, rule_id: str) -> bool:
+        if rule_id in self.disable:
+            return False
+        return self.select is None or rule_id in self.select
+
+
+def load_pyproject_config(pyproject_path: str) -> dict:
+    """Read ``[tool.graftlint]`` from pyproject.toml.
+
+    Uses :mod:`tomllib` on 3.11+; on older interpreters falls back to a
+    minimal section scan (our keys are flat ``name = <literal>`` lines,
+    a subset shared by TOML and Python literal syntax)."""
+    try:
+        with open(pyproject_path, "rb") as fp:
+            raw = fp.read()
+    except OSError:
+        return {}
+    try:
+        import tomllib  # Python 3.11+
+    except ImportError:
+        tomllib = None  # type: ignore[assignment]
+    if tomllib is not None:
+        try:
+            data = tomllib.loads(raw.decode("utf-8"))
+        except tomllib.TOMLDecodeError:
+            # A broken pyproject must not crash the linter; the ruff/
+            # mypy steps of the gate will report it far more legibly.
+            return {}
+        tool = data.get("tool", {}).get("graftlint", {})
+        return dict(tool) if isinstance(tool, dict) else {}
+    out: dict = {}
+    in_section = False
+    key: Optional[str] = None
+    buffer = ""
+    key_re = re.compile(r"^[A-Za-z0-9_-]+\s*=")
+    for line in raw.decode("utf-8").splitlines():
+        stripped = line.strip()
+        if stripped.startswith("["):
+            in_section = stripped == "[tool.graftlint]"
+            key, buffer = None, ""
+            continue
+        if not in_section or not stripped or stripped.startswith("#"):
+            continue
+        if key is None or key_re.match(stripped):
+            # A fresh `name = value` line also abandons any stuck
+            # accumulation from an unparseable previous value.
+            if "=" not in stripped:
+                continue
+            key, _, buffer = stripped.partition("=")
+            key = key.strip()
+        else:
+            # A value (e.g. a list) may span lines; keep accumulating
+            # until it parses as a literal.
+            buffer += " " + stripped
+        try:
+            out[key] = ast.literal_eval(_toml_scalars(buffer.strip()))
+            key, buffer = None, ""
+        except (ValueError, SyntaxError):
+            continue
+    return out
+
+
+def _toml_scalars(value: str) -> str:
+    """Map bare TOML booleans onto Python literals for the fallback
+    parser (the only TOML/Python-literal divergence our flat keys use)."""
+    return {"true": "True", "false": "False"}.get(value, value)
+
+
+def config_from_pyproject(pyproject_path: str) -> LintConfig:
+    raw = load_pyproject_config(pyproject_path)
+    cfg = LintConfig()
+    if "disable" in raw:
+        cfg.disable = {str(r) for r in raw["disable"]}
+    if "exclude" in raw:
+        cfg.exclude = _DEFAULT_EXCLUDES + tuple(str(g) for g in raw["exclude"])
+    if "hot-path-dirs" in raw:
+        cfg.hot_path_dirs = tuple(str(d) for d in raw["hot-path-dirs"])
+    if "hot-path-files" in raw:
+        cfg.hot_path_files = tuple(str(f) for f in raw["hot-path-files"])
+    if "request-path-dirs" in raw:
+        cfg.request_path_dirs = tuple(str(d) for d in raw["request-path-dirs"])
+    return cfg
+
+
+def parse_suppressions(lines: Sequence[str]) -> dict[int, set[str]]:
+    """``# graftlint: disable=GL001[,GL004]`` suppresses those rules on
+    its own line; ``disable-next-line=`` suppresses them on the line
+    after (for statements whose trailing comment space is taken)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        target = i + 1 if m.group(1) == "disable-next-line" else i
+        ids = {part.strip() for part in m.group(2).split(",") if part.strip()}
+        out.setdefault(target, set()).update(ids)
+    return out
+
+
+def _excluded(path: str, patterns: Iterable[str]) -> bool:
+    name = os.path.basename(path)
+    return any(
+        fnmatch.fnmatch(name, pat) or fnmatch.fnmatch(path, pat)
+        for pat in patterns
+    )
+
+
+def iter_python_files(
+    paths: Sequence[str], exclude: Iterable[str] = (),
+    root: Optional[str] = None,
+) -> Iterator[str]:
+    """Yield .py files under ``paths`` (files directly, dirs walked)."""
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and not _excluded(_posix(p, root), exclude):
+                yield p
+            continue
+        for base, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in ("__pycache__", ".git") and not _excluded(d, exclude)
+            )
+            for fname in sorted(files):
+                full = os.path.join(base, fname)
+                if fname.endswith(".py") and not _excluded(
+                    _posix(full, root), exclude
+                ):
+                    yield full
+
+
+def _posix(path: str, root: Optional[str] = None) -> str:
+    """Repo-root-relative posix path: finding paths (and therefore
+    baseline fingerprints) must not depend on the invocation CWD."""
+    rel = os.path.relpath(path, root or os.getcwd())
+    return rel.replace(os.sep, "/")
+
+
+def analyze_file(
+    path: str, rules: Sequence[Rule], config: LintConfig,
+    root: Optional[str] = None,
+) -> list[Finding]:
+    rel = _posix(path, root)
+    try:
+        with open(path, "r", encoding="utf-8") as fp:
+            source = fp.read()
+    except (OSError, UnicodeDecodeError):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                rule_id="GL000",
+                path=rel,
+                line=exc.lineno or 1,
+                col=(exc.offset or 1) - 1,
+                message=f"syntax error: {exc.msg}",
+                code_line="",
+            )
+        ]
+    lines = source.splitlines()
+    ctx = FileContext(
+        path=rel,
+        source=source,
+        lines=lines,
+        suppressions=parse_suppressions(lines),
+        abs_path=os.path.abspath(path),
+    )
+    findings: list[Finding] = []
+    for rule in rules:
+        if not config.wants(rule.rule_id) or not rule.applies_to(rel):
+            continue
+        for f in rule.check(tree, ctx):
+            if not ctx.suppressed(f.rule_id, f.line):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return findings
+
+
+def run_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[Rule]] = None,
+    config: Optional[LintConfig] = None,
+    root: Optional[str] = None,
+) -> list[Finding]:
+    """Analyze every Python file under ``paths`` with ``rules``.
+
+    ``root`` anchors the reported (and fingerprinted) paths; pass the
+    repo root so baselines match regardless of the invocation CWD."""
+    from gofr_tpu.analysis.rules import default_rules
+
+    config = config or LintConfig()
+    rules = list(rules) if rules is not None else default_rules(config)
+    out: list[Finding] = []
+    for path in iter_python_files(paths, config.exclude, root):
+        out.extend(analyze_file(path, rules, config, root))
+    return out
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+
+def fingerprint_findings(findings: Sequence[Finding]) -> dict[str, Finding]:
+    """Fingerprint each finding, disambiguating identical lines by their
+    occurrence order within (path, rule, content)."""
+    counts: dict[tuple[str, str, str], int] = {}
+    out: dict[str, Finding] = {}
+    for f in findings:
+        key = (f.path, f.rule_id, f.code_line)
+        n = counts.get(key, 0)
+        counts[key] = n + 1
+        out[f.fingerprint(n)] = f
+    return out
+
+
+class Baseline:
+    """The committed ledger of accepted pre-existing findings.
+
+    A finding whose fingerprint is in the baseline is *known debt* and
+    does not fail the run; a baseline entry with no live finding is
+    *drift* (the debt was paid — ``--check-baseline`` demands the file
+    be regenerated so it can never grow stale)."""
+
+    VERSION = 1
+
+    def __init__(self, entries: Optional[dict[str, dict]] = None) -> None:
+        self.entries: dict[str, dict] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, "r", encoding="utf-8") as fp:
+                data = json.load(fp)
+        except (OSError, ValueError):
+            return cls()
+        if not isinstance(data, dict) or data.get("version") != cls.VERSION:
+            return cls()
+        entries = data.get("findings", {})
+        return cls(entries if isinstance(entries, dict) else {})
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding]) -> "Baseline":
+        entries = {
+            fp: {
+                "rule": f.rule_id,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "code": f.code_line,
+            }
+            for fp, f in fingerprint_findings(findings).items()
+        }
+        return cls(entries)
+
+    def write(self, path: str) -> None:
+        payload = {
+            "version": self.VERSION,
+            "findings": {k: self.entries[k] for k in sorted(self.entries)},
+        }
+        with open(path, "w", encoding="utf-8") as fp:
+            json.dump(payload, fp, indent=2, sort_keys=False)
+            fp.write("\n")
+
+    def apply(
+        self,
+        findings: Sequence[Finding],
+        active_rules: Optional[set[str]] = None,
+    ) -> tuple[list[Finding], list[str]]:
+        """Split ``findings`` into (new, stale-fingerprints).
+
+        ``active_rules`` limits staleness to entries of rules that
+        actually ran — a ``--select GL001`` run produces no GL006
+        findings, and that absence must not count as paid-off debt."""
+        live = fingerprint_findings(findings)
+        new = [f for fp, f in live.items() if fp not in self.entries]
+        stale = [
+            fp for fp, entry in self.entries.items()
+            if fp not in live
+            and (active_rules is None or entry.get("rule") in active_rules)
+        ]
+        new.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+        return new, sorted(stale)

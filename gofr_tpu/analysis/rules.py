@@ -1,0 +1,758 @@
+"""The graftlint rule set (GL001–GL006).
+
+Each rule encodes one class of TPU-serving bug that generic linters
+cannot see because it is a *semantic* property of the jax programming
+model, not a syntax smell. The heuristics are deliberately conservative:
+a rule should only fire where a human reviewer would at least pause —
+anything intentional gets an inline ``# graftlint: disable=RULE`` with
+its justification, which doubles as documentation at the call site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Sequence
+
+from gofr_tpu.analysis.core import FileContext, Finding, LintConfig, Rule
+
+# ----------------------------------------------------------------------
+# shared AST helpers
+# ----------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jax.jit(...)``/``pjit(...)``/``partial(jax.jit, ...)`` Call
+    carrying static-arg kwargs, if ``node`` is a jit wrapper expression."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func) or ""
+    short = name.rsplit(".", 1)[-1]
+    if short in ("jit", "pjit"):
+        return node
+    if short == "partial" and node.args:
+        inner = dotted_name(node.args[0]) or ""
+        if inner.rsplit(".", 1)[-1] in ("jit", "pjit"):
+            return node
+    return None
+
+
+def is_jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        name = dotted_name(dec) or ""
+        if name.rsplit(".", 1)[-1] in ("jit", "pjit"):
+            return True
+        if _jit_call(dec) is not None:
+            return True
+    return False
+
+
+def jit_static_names(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> set[str]:
+    """Parameter names declared static via static_argnums/static_argnames
+    on the function's jit decorator (constant specs only)."""
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for dec in fn.decorator_list:
+        call = _jit_call(dec)
+        if call is None:
+            continue
+        for kw in call.keywords:
+            value = _const_value(kw.value)
+            if kw.arg == "static_argnums" and value is not None:
+                nums = value if isinstance(value, (tuple, list)) else (value,)
+                for n in nums:
+                    if isinstance(n, int) and 0 <= n < len(params):
+                        static.add(params[n])
+            elif kw.arg == "static_argnames" and value is not None:
+                names = value if isinstance(value, (tuple, list)) else (value,)
+                static.update(str(n) for n in names)
+    return static
+
+
+def _const_value(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+
+
+def _contains_shape_attr(node: ast.AST) -> bool:
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr == "shape"
+        for sub in ast.walk(node)
+    )
+
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+# ----------------------------------------------------------------------
+# GL001 — host↔device sync on the hot path
+# ----------------------------------------------------------------------
+
+
+class HostDeviceSyncRule(Rule):
+    """``.item()`` / ``float()`` / ``int()`` / ``np.asarray()`` on a
+    device array forces a blocking device→host transfer. On the decode
+    hot path one stray sync serializes the pipelined windows and costs a
+    full host↔device RTT (~66 ms on a network-attached relay) per call.
+
+    Device values are recognized by this codebase's ``*_dev`` naming
+    convention (the engine's device-resident planes) plus names assigned
+    from ``jnp.*``/``jax.device_put`` expressions in the same scope.
+    """
+
+    rule_id = "GL001"
+    name = "host-device-sync"
+    rationale = (
+        "blocking device→host syncs on the dispatch path serialize the "
+        "window pipeline; fetch asynchronously or keep the value on device"
+    )
+
+    def __init__(self, hot_path_dirs: Sequence[str] = ("serving", "ops")) -> None:
+        self._dirs = tuple(hot_path_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        return any(f"/{d}/" in f"/{path}" for d in self._dirs)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        device_names = self._infer_device_names(tree)
+
+        def is_device(node: ast.AST) -> bool:
+            while isinstance(node, ast.Subscript):
+                node = node.value
+            name = dotted_name(node)
+            if name is None:
+                return False
+            leaf = name.rsplit(".", 1)[-1]
+            return leaf.endswith("_dev") or leaf in device_names
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # x.item() — always a sync.
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and not node.args
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "`.item()` blocks on a device→host transfer; fetch via "
+                    "an async copy (`copy_to_host_async`) or batch the read",
+                )
+                continue
+            fname = dotted_name(node.func) or ""
+            leaf = fname.rsplit(".", 1)[-1]
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if fname in ("float", "int", "bool") and is_device(arg):
+                yield self.finding(
+                    ctx, node,
+                    f"`{fname}()` on a device array is a blocking "
+                    "device→host sync on the hot path",
+                )
+            elif leaf in ("asarray", "array") and fname.split(".")[0] in (
+                "np", "numpy", "onp"
+            ) and is_device(arg):
+                yield self.finding(
+                    ctx, node,
+                    f"`{fname}()` on a device array blocks until the "
+                    "transfer completes; overlap it with "
+                    "`copy_to_host_async` + `is_ready` instead",
+                )
+
+    @staticmethod
+    def _infer_device_names(tree: ast.Module) -> set[str]:
+        """Names assigned from obviously-device-producing expressions."""
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            src = dotted_name(node.value.func) or ""
+            root, leaf = src.split(".")[0], src.rsplit(".", 1)[-1]
+            if root in ("jnp", "jax") or leaf in ("device_put",):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        return out
+
+
+# ----------------------------------------------------------------------
+# GL002 — Python branching on tracer values inside jit
+# ----------------------------------------------------------------------
+
+
+class TracerBranchRule(Rule):
+    """Inside a ``@jax.jit`` function the array arguments are tracers:
+    ``if x > 0:`` raises ``TracerBoolConversionError`` at trace time (or
+    silently bakes one branch in if the value is concrete on the first
+    call). Data-dependent control flow belongs in ``lax.cond`` /
+    ``lax.while_loop`` / ``jnp.where``.
+
+    Shape/dtype reads (``x.shape``, ``x.ndim``, ``len(x)``) are static
+    under tracing and never flagged; parameters named in
+    ``static_argnums``/``static_argnames`` are exempt.
+    """
+
+    rule_id = "GL002"
+    name = "tracer-branch"
+    rationale = (
+        "Python `if`/`while` on a traced value either crashes at trace "
+        "time or freezes one branch into the compiled program"
+    )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and is_jit_decorated(node):
+                yield from self._check_fn(node, ctx)
+
+    def _check_fn(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef, ctx: FileContext
+    ) -> Iterator[Finding]:
+        static = jit_static_names(fn)
+        tainted = {
+            a.arg
+            for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+            if a.arg not in static and a.arg not in ("self", "cls")
+        }
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign):
+                # One-pass taint propagation through simple assignments.
+                if self._expr_tainted(stmt.value, tainted):
+                    for tgt in stmt.targets:
+                        for name in ast.walk(tgt):
+                            if isinstance(name, ast.Name):
+                                tainted.add(name.id)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                if self._expr_tainted(stmt.test, tainted):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    yield self.finding(
+                        ctx, stmt.test,
+                        f"Python `{kind}` on a traced value inside "
+                        f"`{fn.name}` (jitted); use `lax.cond`/"
+                        "`lax.while_loop`/`jnp.where`, or declare the "
+                        "argument static",
+                    )
+
+    def _expr_tainted(self, expr: ast.AST, tainted: set[str]) -> bool:
+        """Does ``expr``'s *runtime value* depend on a tracer?
+
+        Attribute reads of static metadata (``.shape``, ``.dtype``, …)
+        and ``len()``/``isinstance()`` calls launder the taint — they
+        are Python-level constants under tracing."""
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops
+        ):
+            # `x is None` / `x is not None` are Python identity checks —
+            # resolved at trace time, never a tracer bool.
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self._expr_tainted(expr.value, tainted)
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func) or ""
+            if name in ("len", "isinstance", "getattr", "hasattr", "type"):
+                return False
+            parts: list[ast.AST] = list(expr.args) + [
+                kw.value for kw in expr.keywords
+            ]
+            if isinstance(expr.func, ast.Attribute):
+                # x.sum() on a tracer yields a tracer.
+                parts.append(expr.func.value)
+            return any(self._expr_tainted(p, tainted) for p in parts)
+        return any(
+            self._expr_tainted(child, tainted)
+            for child in ast.iter_child_nodes(expr)
+        )
+
+
+# ----------------------------------------------------------------------
+# GL003 — recompilation hazards
+# ----------------------------------------------------------------------
+
+
+class RecompilationHazardRule(Rule):
+    """Every distinct static-arg value (and every unhashable one) is a
+    fresh XLA compile; on TPU a recompile is seconds of wall clock in
+    the serving path. Flags:
+
+    * mutable literals (list/dict/set) passed in a static position of a
+      module-local ``jax.jit(fn, static_arg...)`` wrapper — unhashable,
+      crashes at call time;
+    * dict/cache keys or subscripts built from ``.shape`` f-strings —
+      the signature of a hand-rolled compile cache keyed on shapes,
+      which grows without bound under bucketed padding drift.
+    """
+
+    rule_id = "GL003"
+    name = "recompilation-hazard"
+    rationale = (
+        "unhashable/mutable static args fail or recompile per call; "
+        "shape-keyed caches churn compiles under padding drift"
+    )
+
+    _MUTABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp, ast.GeneratorExp)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        jitted = self._collect_jit_wrappers(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(node, jitted, ctx)
+                continue
+            # d[f"{x.shape}"] / {x.shape: ...} — shape-keyed cache.
+            if isinstance(node, ast.Subscript) and self._shape_key(node.slice):
+                yield self.finding(
+                    ctx, node,
+                    "subscript keyed on a `.shape`-derived value: a "
+                    "hand-rolled compile cache keyed on shapes recompiles "
+                    "per padding bucket; key on the bucket id instead",
+                )
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None and self._shape_key(key):
+                        yield self.finding(
+                            ctx, key,
+                            "dict key built from `.shape`: shape-keyed "
+                            "caches churn compiles; key on the padded "
+                            "bucket instead",
+                        )
+
+    def _check_call(
+        self,
+        node: ast.Call,
+        jitted: dict[str, tuple[set[int], set[str]]],
+        ctx: FileContext,
+    ) -> Iterator[Finding]:
+        name = dotted_name(node.func)
+        if name is None or name not in jitted:
+            return
+        static_nums, static_names = jitted[name]
+        for i, arg in enumerate(node.args):
+            if i in static_nums and isinstance(arg, self._MUTABLE):
+                yield self.finding(
+                    ctx, arg,
+                    f"mutable literal passed as static arg {i} of jitted "
+                    f"`{name}`: unhashable static args raise at call "
+                    "time — pass a tuple or mark the arg non-static",
+                )
+        for kw in node.keywords:
+            if kw.arg in static_names and isinstance(kw.value, self._MUTABLE):
+                yield self.finding(
+                    ctx, kw.value,
+                    f"mutable literal passed as static kwarg "
+                    f"`{kw.arg}` of jitted `{name}`",
+                )
+
+    @staticmethod
+    def _shape_key(node: ast.AST) -> bool:
+        if isinstance(node, ast.JoinedStr):
+            return any(
+                _contains_shape_attr(v.value)
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+            )
+        return isinstance(node, ast.Attribute) and node.attr == "shape"
+
+    @staticmethod
+    def _collect_jit_wrappers(
+        tree: ast.Module,
+    ) -> dict[str, tuple[set[int], set[str]]]:
+        """``g = jax.jit(f, static_argnums=(1,))`` → {"g": ({1}, set())}."""
+        out: dict[str, tuple[set[int], set[str]]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = _jit_call(node.value)
+            if call is None:
+                continue
+            nums: set[int] = set()
+            names: set[str] = set()
+            for kw in call.keywords:
+                value = _const_value(kw.value)
+                if value is None:
+                    continue
+                seq = value if isinstance(value, (tuple, list)) else (value,)
+                if kw.arg == "static_argnums":
+                    nums.update(int(v) for v in seq if isinstance(v, int))
+                elif kw.arg == "static_argnames":
+                    names.update(str(v) for v in seq)
+            if not nums and not names:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = (nums, names)
+        return out
+
+
+# ----------------------------------------------------------------------
+# GL004 — blocking calls in async / hot-path code
+# ----------------------------------------------------------------------
+
+
+class BlockingCallRule(Rule):
+    """``time.sleep`` (and friends) inside an ``async def`` stalls the
+    whole event loop; inside the batcher/scheduler/engine hot path it
+    turns an event wait into a latency floor — a 50 ms poll loop is
+    50 ms of p50 added to every drain. Waits belong on
+    ``threading.Event``/``Condition`` (or ``asyncio.sleep`` in async
+    code) where a state change wakes the waiter immediately.
+    """
+
+    rule_id = "GL004"
+    name = "blocking-call"
+    rationale = (
+        "blocking sleeps/IO stall the event loop or add poll-interval "
+        "latency to the batch hot path; wait on events/conditions"
+    )
+
+    _BLOCKING = {
+        "time.sleep": "blocks the thread",
+        "os.system": "synchronous subprocess",
+        "subprocess.run": "synchronous subprocess",
+        "subprocess.call": "synchronous subprocess",
+        "subprocess.check_call": "synchronous subprocess",
+        "subprocess.check_output": "synchronous subprocess",
+        "subprocess.Popen": "spawns a process (fork latency)",
+        "requests.get": "synchronous HTTP",
+        "requests.post": "synchronous HTTP",
+        "urllib.request.urlopen": "synchronous HTTP",
+        "socket.create_connection": "synchronous connect",
+    }
+
+    def __init__(
+        self,
+        hot_path_files: Sequence[str] = (
+            "serving/batcher.py",
+            "serving/scheduler.py",
+            "serving/engine.py",
+        ),
+    ) -> None:
+        self._hot_files = tuple(hot_path_files)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        hot_file = any(ctx.path.endswith(f) for f in self._hot_files)
+        # Collect the line spans of async defs so sync helpers nested in
+        # them are covered too.
+        async_spans = [
+            (n.lineno, n.end_lineno or n.lineno)
+            for n in ast.walk(tree)
+            if isinstance(n, ast.AsyncFunctionDef)
+        ]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            desc = self._BLOCKING.get(name)
+            if desc is None:
+                continue
+            in_async = any(
+                lo <= node.lineno <= hi for lo, hi in async_spans
+            )
+            if in_async:
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}` ({desc}) inside an `async def` stalls the "
+                    "event loop; use the asyncio equivalent or "
+                    "`run_in_executor`",
+                )
+            elif hot_file and name == "time.sleep":
+                yield self.finding(
+                    ctx, node,
+                    "`time.sleep` on the batcher/scheduler hot path adds "
+                    "its full poll interval to tail latency; wait on a "
+                    "`threading.Event`/`Condition` instead",
+                )
+
+
+# ----------------------------------------------------------------------
+# GL005 — lock discipline over shared mutable state
+# ----------------------------------------------------------------------
+
+
+class LockDisciplineRule(Rule):
+    """If a class protects an attribute with a lock *somewhere*, every
+    write to that attribute outside ``__init__`` must hold the lock —
+    mixed discipline is how torn reads ship. Attributes written at least
+    once inside ``with self.<lock>:`` are 'guarded'; any other write to
+    them outside a with-lock block is flagged. (The race-detector-CI
+    spirit of the reference framework, approximated statically.)
+
+    The hot-path files compose ONE runtime object (mixins over
+    ``InferenceEngine``), so guarded-attribute knowledge is unioned
+    across all of them — a write in ``scheduler.py`` is checked against
+    locks taken in ``engine.py`` and vice versa; a per-class analysis
+    would be blind across exactly the seam it was written for.
+    """
+
+    rule_id = "GL005"
+    name = "lock-discipline"
+    rationale = (
+        "an attribute written both under and outside a lock has no "
+        "consistent happens-before edge; hold the lock everywhere"
+    )
+
+    def __init__(
+        self,
+        hot_path_files: Sequence[str] = (
+            "serving/batcher.py",
+            "serving/scheduler.py",
+            "serving/engine.py",
+        ),
+    ) -> None:
+        self._hot_files = tuple(hot_path_files)
+        self._sibling_guarded: dict[str, set[str]] = {}
+
+    def applies_to(self, path: str) -> bool:
+        return any(path.endswith(f) for f in self._hot_files)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        composed = self._composed_guarded(tree, ctx)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(node, ctx, composed)
+
+    def _composed_guarded(
+        self, tree: ast.Module, ctx: FileContext
+    ) -> set[str]:
+        """Locked-write attributes across the whole composed object:
+        every class in this file plus every class in the sibling
+        hot-path files (parsed once per run)."""
+        guarded: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                guarded |= self._class_writes(node)[0]
+        abs_path = ctx.abs_path
+        suffix = next(
+            (f for f in self._hot_files if ctx.path.endswith(f)), None
+        )
+        if abs_path and suffix and abs_path.endswith(suffix):
+            base = abs_path[: -len(suffix)]
+            for sib in self._hot_files:
+                if sib == suffix:
+                    continue
+                sib_path = base + sib
+                if sib_path not in self._sibling_guarded:
+                    self._sibling_guarded[sib_path] = (
+                        self._guarded_in_file(sib_path)
+                    )
+                guarded |= self._sibling_guarded[sib_path]
+        return guarded
+
+    def _guarded_in_file(self, path: str) -> set[str]:
+        try:
+            with open(path, "r", encoding="utf-8") as fp:
+                tree = ast.parse(fp.read())
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            return set()
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                out |= self._class_writes(node)[0]
+        return out
+
+    def _class_writes(
+        self, cls: ast.ClassDef
+    ) -> tuple[set[str], list[tuple[str, ast.AST]]]:
+        """(locked-write attrs, unlocked writes) for one class body,
+        skipping ``__init__`` (construction precedes sharing)."""
+        guarded: set[str] = set()
+        unlocked: list[tuple[str, ast.AST]] = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            locked_spans = self._lock_spans(method)
+            for stmt in ast.walk(method):
+                attr = self._self_attr_write(stmt)
+                if attr is None:
+                    continue
+                line = stmt.lineno
+                if any(lo <= line <= hi for lo, hi in locked_spans):
+                    guarded.add(attr)
+                else:
+                    unlocked.append((attr, stmt))
+        return guarded, unlocked
+
+    def _check_class(
+        self, cls: ast.ClassDef, ctx: FileContext, composed: set[str]
+    ) -> Iterator[Finding]:
+        _, unlocked = self._class_writes(cls)
+        for attr, stmt in unlocked:
+            if attr in composed:
+                yield self.finding(
+                    ctx, stmt,
+                    f"`self.{attr}` is written under a lock elsewhere in "
+                    "the composed serving core but not here; hold the "
+                    "same lock (or document why this write cannot race)",
+                )
+
+    @staticmethod
+    def _lock_spans(
+        method: ast.FunctionDef | ast.AsyncFunctionDef,
+    ) -> list[tuple[int, int]]:
+        spans = []
+        for node in ast.walk(method):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                name = dotted_name(item.context_expr) or ""
+                if isinstance(item.context_expr, ast.Call):
+                    name = dotted_name(item.context_expr.func) or ""
+                if "lock" in name.lower():
+                    spans.append((node.lineno, node.end_lineno or node.lineno))
+                    break
+        return spans
+
+    @staticmethod
+    def _self_attr_write(stmt: ast.AST) -> Optional[str]:
+        """`self.x = ...` / `self.x += ...` (plain flags, not containers:
+        `self._slots[i] = ...` mutates through a reference the scheduler
+        thread owns — a different discipline, out of scope here)."""
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                return tgt.attr
+        return None
+
+
+# ----------------------------------------------------------------------
+# GL006 — swallowed exceptions in request paths
+# ----------------------------------------------------------------------
+
+
+class ExceptionSwallowRule(Rule):
+    """A bare/overbroad `except` that neither logs, re-raises, nor
+    records the error swallows jax's rich failure modes
+    (``XlaRuntimeError``, OOM, donation errors) exactly where the caller
+    most needs them — a request silently returns garbage instead of a
+    500. Handlers that log, raise, or set an exception on a future are
+    fine; ``pass``-bodies must narrow the exception type or carry a
+    suppression with their justification.
+    """
+
+    rule_id = "GL006"
+    name = "swallowed-exception"
+    rationale = (
+        "broad except+pass hides XlaRuntimeError/OOM from request "
+        "callers; narrow the type, log, or re-raise"
+    )
+
+    _BROAD = {"Exception", "BaseException"}
+
+    def __init__(
+        self, request_path_dirs: Sequence[str] = ("serving", "ops", "grpc")
+    ) -> None:
+        self._dirs = tuple(request_path_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        return any(f"/{d}/" in f"/{path}" for d in self._dirs)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    ctx, node,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "too; name the exception type",
+                )
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if not self._swallows(node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"broad `except {ast.unparse(node.type)}` whose body "
+                "neither logs, re-raises, nor records the error would "
+                "swallow jax runtime failures in the request path",
+            )
+
+    def _is_broad(self, type_node: ast.AST) -> bool:
+        names = []
+        if isinstance(type_node, ast.Tuple):
+            names = [dotted_name(e) or "" for e in type_node.elts]
+        else:
+            names = [dotted_name(type_node) or ""]
+        return any(n.rsplit(".", 1)[-1] in self._BROAD for n in names)
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        """True when the body is a pure no-op (pass/continue/break, a
+        constant expression, or a bare/constant return) — a handler that
+        assigns a fallback, logs, raises, or records the error is
+        *handling*, not swallowing."""
+        for stmt in handler.body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant
+            ):
+                continue
+            if isinstance(stmt, ast.Return) and (
+                stmt.value is None or isinstance(stmt.value, ast.Constant)
+            ):
+                continue
+            return False
+        return True
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+
+ALL_RULES = (
+    HostDeviceSyncRule,
+    TracerBranchRule,
+    RecompilationHazardRule,
+    BlockingCallRule,
+    LockDisciplineRule,
+    ExceptionSwallowRule,
+)
+
+
+def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
+    config = config or LintConfig()
+    return [
+        HostDeviceSyncRule(config.hot_path_dirs),
+        TracerBranchRule(),
+        RecompilationHazardRule(),
+        BlockingCallRule(config.hot_path_files),
+        LockDisciplineRule(config.hot_path_files),
+        ExceptionSwallowRule(config.request_path_dirs),
+    ]

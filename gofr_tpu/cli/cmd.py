@@ -9,7 +9,6 @@ stderr with exit code (``cmd/responder.go:8-19``).
 
 from __future__ import annotations
 
-import dataclasses
 import json
 import re
 import sys

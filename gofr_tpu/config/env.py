@@ -15,7 +15,7 @@ Behavioral contract (from the reference, re-implemented from scratch):
 from __future__ import annotations
 
 import os
-from typing import Mapping, Optional, Protocol
+from typing import Any, Mapping, Optional, Protocol
 
 
 class Config(Protocol):
@@ -57,7 +57,7 @@ def _parse_dotenv(path: str) -> dict[str, str]:
 class EnvLoader:
     """Loads dotenv files into ``os.environ`` and reads keys from it."""
 
-    def __init__(self, config_dir: str, logger=None) -> None:
+    def __init__(self, config_dir: str, logger: Any = None) -> None:
         self._dir = config_dir
         self._logger = logger
         self._read()
@@ -95,10 +95,12 @@ class EnvLoader:
 
     def get_or_default(self, key: str, default: str) -> str:
         val = os.environ.get(key)
-        return val if val not in (None, "") else default
+        if val is None or val == "":
+            return default
+        return val
 
 
-def new_env_file(config_dir: str, logger=None) -> EnvLoader:
+def new_env_file(config_dir: str, logger: Any = None) -> EnvLoader:
     """Factory mirroring the reference's ``config.NewEnvFile`` (``config/godotenv.go:25``)."""
     return EnvLoader(config_dir, logger)
 
@@ -114,4 +116,6 @@ class MockConfig:
 
     def get_or_default(self, key: str, default: str) -> str:
         val = self._values.get(key)
-        return val if val not in (None, "") else default
+        if val is None or val == "":
+            return default
+        return val

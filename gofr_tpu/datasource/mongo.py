@@ -12,7 +12,7 @@ joins the aggregate ``/.well-known/health`` report.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Protocol, runtime_checkable
+from typing import Any, Protocol, runtime_checkable
 
 
 @runtime_checkable

@@ -10,7 +10,6 @@ expiry, MULTI/EXEC, INFO).
 from __future__ import annotations
 
 import fnmatch
-import socket
 import socketserver
 import threading
 import time

@@ -18,7 +18,6 @@ Service ``gofr.tpu.Inference`` with JSON messages:
 from __future__ import annotations
 
 import json
-from typing import Optional
 
 import grpc
 import numpy as np

@@ -10,7 +10,6 @@ so both can share :9000.
 from __future__ import annotations
 
 import json
-import time
 
 import numpy as np
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import inspect
-from typing import Any, Callable
+from typing import Callable
 
 from gofr_tpu.context import Context
 from gofr_tpu.http.proto import RawRequest, Response

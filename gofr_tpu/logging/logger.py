@@ -23,7 +23,6 @@ import time
 from typing import Any, Optional, Protocol, TextIO, runtime_checkable
 
 from gofr_tpu.logging.level import Level, level_from_string
-from gofr_tpu.version import FRAMEWORK_VERSION
 
 
 @runtime_checkable
@@ -184,10 +183,8 @@ def new_logger(level: Level = Level.INFO, **kw: Any) -> Logger:
     return Logger(level=level, **kw)
 
 
-def new_logger_from_env(config=None) -> Logger:
+def new_logger_from_env(config: Any = None) -> Logger:
     """Build a logger from ``LOG_LEVEL`` (reference ``container/container.go:66``)."""
-    import os
-
     raw = config.get("LOG_LEVEL") if config is not None else os.environ.get("LOG_LEVEL")
     return Logger(level=level_from_string(raw))
 

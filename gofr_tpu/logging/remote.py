@@ -16,6 +16,7 @@ visible to the same observability stack as user traffic.
 from __future__ import annotations
 
 import threading
+from typing import Any
 
 from gofr_tpu.logging.level import level_from_string
 from gofr_tpu.logging.logger import Logger
@@ -25,13 +26,14 @@ class RemoteLevelLogger:
     """Wraps a :class:`Logger` and keeps its level in sync with a remote URL."""
 
     def __init__(
-        self, logger: Logger, url: str, interval_s: float = 15.0, metrics=None
+        self, logger: Logger, url: str, interval_s: float = 15.0,
+        metrics: Any = None,
     ) -> None:
         self.logger = logger
         self._url = url
         self._interval = interval_s
         self._metrics = metrics
-        self._service = None
+        self._service: Any = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -70,8 +70,11 @@ def render_prometheus(manager: Manager, app_name: str = "gofr-tpu-app") -> str:
                     le = 'le="' + str(bound) + '"'
                     out.append(f"{inst.name}_bucket{_fmt_labels(key, le)} {cumulative}\n")
                 cumulative += counts[-1]
+                # NB: hoisted out of the f-string — a backslash inside an
+                # f-string expression is a SyntaxError before Python 3.12.
+                le_inf = 'le="+Inf"'
                 out.append(
-                    f"{inst.name}_bucket{_fmt_labels(key, 'le=\"+Inf\"')} {cumulative}\n"
+                    f"{inst.name}_bucket{_fmt_labels(key, le_inf)} {cumulative}\n"
                 )
                 out.append(f"{inst.name}_sum{_fmt_labels(key)} {total}\n")
                 out.append(f"{inst.name}_count{_fmt_labels(key)} {count}\n")

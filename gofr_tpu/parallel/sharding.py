@@ -9,7 +9,6 @@ all-reduces/all-gathers over ICI.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax

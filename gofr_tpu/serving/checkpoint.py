@@ -10,7 +10,7 @@ restore so multi-chip params round-trip without gathering to one host.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any
 
 
 def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:

@@ -39,8 +39,7 @@ from gofr_tpu.serving.lora_runtime import LoRARuntimeMixin
 from gofr_tpu.serving.modalities import ModalityMixin
 from gofr_tpu.serving.programs import LLMProgramsMixin
 from gofr_tpu.serving.scheduler import SchedulerMixin
-from gofr_tpu.serving.types import (  # noqa: F401 — public re-exports
-    _PREFILL_BUCKETS,
+from gofr_tpu.serving.types import (
     _ActiveSeq,
     _GenRequest,
     _PrefillState,
@@ -182,6 +181,12 @@ class InferenceEngine(
         # request can never be enqueued after the drain has already run.
         self._submit_lock = threading.Lock()
         self._drained = False
+        # Set by the scheduler when it publishes "verifiably idle" and on
+        # exit; the graceful drain clears it (under the submit lock)
+        # before waiting, so a stale set from an earlier idle period
+        # cannot satisfy a new drain. It is a drain wake-up only — while
+        # the engine is busy it may still be set from before.
+        self._idle_evt = threading.Event()
 
         if self.family == "llm":
             from gofr_tpu.ops.kv_cache import KVCache
@@ -744,10 +749,16 @@ class InferenceEngine(
             # would permanently reject submissions on the restarted engine.
             self._sched.join(timeout=10)
             self._sched = None
-        self._running = True
-        self._drained = False
-        self._draining = False
-        self._fatal = None
+        # Flag resets hold the submit lock: _enqueue and the scheduler's
+        # drain read these under it, and a half-visible reset (e.g.
+        # _draining=False seen before _drained=False) would let a
+        # submission slip into a queue the old drain already failed.
+        with self._submit_lock:
+            self._running = True
+            self._drained = False
+            self._draining = False
+            self._fatal = None
+            self._idle_evt.clear()
         if self.family == "llm":
             self._sched = threading.Thread(
                 target=self._scheduler_loop, name="tpu-scheduler", daemon=True
@@ -773,16 +784,27 @@ class InferenceEngine(
             with self._submit_lock:
                 self._draining = True
                 self._sched_idle = False
+                self._idle_evt.clear()
             deadline = time.monotonic() + drain_s
             while time.monotonic() < deadline:
                 # Only the scheduler may declare the engine idle (it does
                 # so under the submit lock after verifying every queue and
-                # slot is empty) — polling the structures from here would
-                # race requests in transit between them.
-                if self._sched_idle or not self._running:
+                # slot is empty, then sets the idle event) — polling the
+                # structures from here would race requests in transit
+                # between them. The event wait (vs the old 50 ms sleep
+                # poll) returns the moment the scheduler publishes idle
+                # or dies, so drains end as soon as the work does.
+                # (_drained/_fatal also break: the scheduler's exit path
+                # sets _running=False before the event today, but the
+                # drain must not depend on that ordering.)
+                if (
+                    self._sched_idle or not self._running
+                    or self._drained or self._fatal is not None
+                ):
                     break
-                time.sleep(0.05)
-        self._running = False
+                self._idle_evt.wait(timeout=deadline - time.monotonic())
+        with self._submit_lock:
+            self._running = False
         if self.family == "llm":
             self._work.set()
             if self._sched is not None:
@@ -1070,6 +1092,9 @@ class InferenceEngine(
                     "bytes_in_use": stats.get("bytes_in_use"),
                     "bytes_limit": stats.get("bytes_limit"),
                 }
-        except Exception:  # noqa: BLE001 — not all backends report memory
-            pass
+        except Exception as exc:  # noqa: BLE001
+            # Not all backends report memory; surface why rather than
+            # dropping the gauge silently.
+            if self._logger is not None:
+                self._logger.debugf("memory_stats unavailable: %s", exc)
         return {"status": "UP" if self._running else "DOWN", "details": details}

@@ -28,7 +28,7 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import Any, Optional
+from typing import Any
 
 
 def is_hf_checkpoint(path: str) -> bool:

@@ -186,7 +186,10 @@ class ModalityMixin:
                 self.params, buf, done, enc, lengths,
                 jnp.asarray(start, jnp.int32),
             )
-            toks = np.asarray(
+            # Designed sync point: each chunk's tokens must reach the host
+            # to detect EOS before deciding whether to dispatch the next
+            # chunk — the seq2seq loop is host-driven by construction.
+            toks = np.asarray(  # graftlint: disable=GL001
                 buf[0, start + 1 : start + 1 + chunk]
             ).tolist()
             fresh, hit_eos = [], False

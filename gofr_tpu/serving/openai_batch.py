@@ -30,7 +30,7 @@ import json
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Optional
 
 from gofr_tpu.errors import (
     ErrorEntityNotFound,

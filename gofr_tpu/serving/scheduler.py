@@ -7,14 +7,13 @@ from __future__ import annotations
 
 import queue
 import time
+from concurrent.futures import InvalidStateError
 
 import numpy as np
 
-from gofr_tpu.serving.batcher import pad_bucket
 from gofr_tpu.serving.types import (
     _ActiveSeq,
     _PrefillState,
-    _PREFILL_BUCKETS,
     GenerationResult,
 )
 
@@ -63,10 +62,12 @@ class SchedulerMixin:
                         with self._submit_lock:
                             if self._pending.empty() and not self._wait_kv:
                                 self._sched_idle = True
+                                self._idle_evt.set()
                         self._work.wait(timeout=0.02)
                         self._work.clear()
                     continue
-                self._sched_idle = False
+                with self._submit_lock:
+                    self._sched_idle = False
                 # Dispatch only while some active slot still has budget
                 # beyond what in-flight windows already cover — a wave of
                 # same-length requests otherwise ends with `depth` pure-
@@ -88,9 +89,13 @@ class SchedulerMixin:
         except BaseException as exc:  # noqa: BLE001 — must not strand futures
             # A scheduler crash (e.g. a kernel that fails to compile on this
             # hardware) must fail every caller, not hang them until timeout.
+            # The flag writes hold the submit lock like every other writer:
+            # _enqueue's fatal/running checks must never see a half-
+            # published death.
             error = exc
-            self._fatal = exc
-            self._running = False
+            with self._submit_lock:
+                self._fatal = exc
+                self._running = False
             if self._logger is not None:
                 self._logger.errorf("engine scheduler died: %s", exc)
         # Drain: fail queued requests AND active slots so no awaiting caller
@@ -105,7 +110,7 @@ class SchedulerMixin:
             try:
                 if not req.future.done():
                     req.future.set_exception(reason)
-            except Exception:  # noqa: BLE001 — cancelled concurrently
+            except InvalidStateError:  # cancelled concurrently
                 pass
             req.stream.put(None)
 
@@ -116,8 +121,8 @@ class SchedulerMixin:
         while inflight:
             emitted = inflight.popleft()[0]
             try:
-                np.asarray(emitted)
-            except Exception:  # noqa: BLE001 — device may already be down
+                np.asarray(emitted)  # graftlint: disable=GL001 — shutdown barrier, not a hot-path sync
+            except Exception:  # graftlint: disable=GL006 — device may already be down; any failure here means the fetch is moot
                 pass
         with self._submit_lock:
             self._drained = True
@@ -138,6 +143,9 @@ class SchedulerMixin:
         while self._wait_kv:
             _fail(self._wait_kv.popleft())
         self._prefill_emits.clear()
+        # Wake any graceful drain blocked on the idle event: whether this
+        # exit was clean or fatal, there is nothing left to wait for.
+        self._idle_evt.set()
 
     # ------------------------------------------------------------------
     # paged-KV block allocator (host side; kv_block > 0 only)
@@ -548,12 +556,14 @@ class SchedulerMixin:
                     continue
             except AttributeError:  # fake/CPU backends: always ready
                 pass
-            tok = int(np.asarray(first_dev)[row])
-            lp = float(np.asarray(lp_dev)[row])
+            # The transfer already landed (is_ready above) and was started
+            # asynchronously at dispatch — these reads are copies, not syncs.
+            tok = int(np.asarray(first_dev)[row])  # graftlint: disable=GL001
+            lp = float(np.asarray(lp_dev)[row])  # graftlint: disable=GL001
             top = None
             if self.top_logprobs and req.top_logprobs:
-                ti = np.asarray(ftopi_dev)[row]
-                tl = np.asarray(ftopl_dev)[row]
+                ti = np.asarray(ftopi_dev)[row]  # graftlint: disable=GL001
+                tl = np.asarray(ftopl_dev)[row]  # graftlint: disable=GL001
                 top = [
                     (int(ti[j]), float(tl[j]))
                     for j in range(req.top_logprobs)
@@ -769,7 +779,11 @@ class SchedulerMixin:
                 if wrun is not None:
                     self._dispatch_prefill_chunk()
                 self._flush_prefill_emits()
-                time.sleep(0.001)
+                # Device-readiness poll: there is no host-side event to
+                # wait on for an in-flight device computation, and the
+                # 1 ms granularity is what lets prefill emits interleave
+                # with the window fetch. Not a latency-adding sleep.
+                time.sleep(0.001)  # graftlint: disable=GL004
         # Decode: [2, k, S] (mega: [2, m*k, S], first wrun*k valid).
         # Spec: [2, k, S, G+1] + counts [k, S].
         emitted_host = np.asarray(emitted)
@@ -947,6 +961,6 @@ class SchedulerMixin:
                 self._metrics.set_gauge(
                     "app_tpu_hbm_used_bytes", stats["bytes_in_use"], "chip", "0"
                 )
-        except Exception:
+        except Exception:  # graftlint: disable=GL006 — gauge-only path; memory_stats support varies by backend and must never touch token flow
             pass
 

@@ -35,7 +35,7 @@ class GenerationResult:
     finish_reason: str = "stop"
     # Per-token [(token_id, logprob), ...] alternatives when the request
     # asked for top_logprobs (None otherwise).
-    token_top_logprobs: "Optional[list]" = None
+    token_top_logprobs: Optional[list[Optional[list[tuple[int, float]]]]] = None
 
     @property
     def tokens_per_sec(self) -> float:
@@ -93,11 +93,13 @@ class _GenRequest:
     # params → same sampled stream regardless of batch/scheduling).
     seed: int = 0
     # OpenAI logit_bias: {token_id: bias}, at most LOGIT_BIAS_K entries.
-    logit_bias: dict = field(default_factory=dict)
+    logit_bias: dict[int, float] = field(default_factory=dict)
     # OpenAI top_logprobs: alternatives per emitted token (≤ engine's
     # compiled TPU_TOP_LOGPROBS).
     top_logprobs: int = 0
-    token_top_logprobs: list = field(default_factory=list)
+    token_top_logprobs: list[Optional[list[tuple[int, float]]]] = field(
+        default_factory=list
+    )
     # Set by _finished when a stop sequence matched: char offset of the
     # earliest match in the decoded text.
     stop_cut: int = -1

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# The repo lint/type gate, one command locally == the CI `lint` job:
+#   ruff      — pycodestyle/pyflakes/bugbear subset (pyproject.toml),
+#               plus import sorting scoped to the analysis package;
+#   mypy      — scoped strictness (config/logging/serving-types strict,
+#               rest permissive; see [tool.mypy] in pyproject.toml);
+#   graftlint — TPU-correctness rules GL001–GL006 against the committed
+#               baseline (gofr_tpu/analysis; docs/advanced-guide/
+#               static-analysis.md).
+#
+# ruff/mypy are optional locally (skipped with a warning when not
+# installed); graftlint ships with the repo and always runs.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+failed=0
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "== ruff =="
+  ruff check gofr_tpu/ tests/ examples/ bench.py __graft_entry__.py || failed=1
+  ruff check --select I gofr_tpu/analysis tests/test_graftlint.py || failed=1
+else
+  echo "== ruff == SKIPPED (not installed; pip install ruff)"
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+  echo "== mypy (scoped) =="
+  mypy gofr_tpu/config gofr_tpu/logging gofr_tpu/serving/types.py || failed=1
+else
+  echo "== mypy == SKIPPED (not installed; pip install mypy)"
+fi
+
+echo "== graftlint =="
+python -m gofr_tpu.analysis gofr_tpu/ --check-baseline || failed=1
+
+exit "$failed"

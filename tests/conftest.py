@@ -22,10 +22,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices flag; the XLA_FLAGS
+    # host-device-count override above already provides the 8 devices.
+    pass
 
 import socket
-import threading
 
 import pytest
 

@@ -1,6 +1,5 @@
 """Config layer tests (behavioral parity with reference ``config/godotenv_test.go``)."""
 
-import os
 
 from gofr_tpu.config import MockConfig, new_env_file
 

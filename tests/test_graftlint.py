@@ -1,0 +1,524 @@
+"""graftlint rule-by-rule suite: one positive and one negative fixture
+per rule (GL001–GL006), suppression syntax, baseline round-trip/drift,
+CLI exit codes, and the gate that keeps the committed baseline in sync
+with the tree."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from gofr_tpu.analysis.cli import main
+from gofr_tpu.analysis.core import Baseline, LintConfig, run_paths
+
+
+def _lint(tmp_path, rel, source, select=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    config = LintConfig()
+    if select:
+        config.select = set(select)
+    findings = run_paths([str(tmp_path)], config=config)
+    return [f.rule_id for f in findings], findings
+
+
+# ----------------------------------------------------------------------
+# GL001 — host-device sync
+# ----------------------------------------------------------------------
+
+
+def test_gl001_flags_item_and_conversions_on_hot_path(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/hot.py",
+        """
+        import numpy as np
+
+        def emit(tokens_dev, logps_dev):
+            a = tokens_dev.item()
+            b = float(logps_dev)
+            c = np.asarray(tokens_dev)
+            return a, b, c
+        """,
+        select=["GL001"],
+    )
+    assert ids == ["GL001", "GL001", "GL001"]
+    assert "device" in findings[0].message
+
+
+def test_gl001_ignores_cold_paths_and_host_values(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "datasource/cold.py",
+        """
+        def emit(tokens_dev):
+            return float(tokens_dev)
+        """,
+        select=["GL001"],
+    )
+    assert ids == []  # datasource/ is not a hot-path dir
+    ids, _ = _lint(
+        tmp_path, "serving/host.py",
+        """
+        def emit(count):
+            return float(count)  # plain host value, no device naming
+        """,
+        select=["GL001"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL002 — tracer branch in jit
+# ----------------------------------------------------------------------
+
+
+def test_gl002_flags_python_branch_on_tracer(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def relu_bad(x):
+            if x > 0:
+                return x
+            return 0.0
+        """,
+        select=["GL002"],
+    )
+    assert ids == ["GL002"]
+    assert "relu_bad" in findings[0].message
+
+
+def test_gl002_allows_shape_static_and_identity_branches(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def ok(x, k, mask=None):
+            if x.shape[0] > 2:      # shapes are static under trace
+                x = x + 1
+            if mask is not None:    # identity checks are host-level
+                x = x * mask
+            if k > 1:               # declared static
+                x = x * k
+            return x
+        """,
+        select=["GL002"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL003 — recompilation hazards
+# ----------------------------------------------------------------------
+
+
+def test_gl003_flags_mutable_static_arg_and_shape_keys(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        def run(x, opts):
+            return x
+
+        jitted = jax.jit(run, static_argnums=(1,))
+        compiled = {}
+
+        def call(x):
+            compiled[f"{x.shape}"] = 1
+            return jitted(x, [1, 2])
+        """,
+        select=["GL003"],
+    )
+    assert ids == ["GL003", "GL003"]
+
+
+def test_gl003_allows_hashable_static_args(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        def run(x, opts):
+            return x
+
+        jitted = jax.jit(run, static_argnums=(1,))
+
+        def call(x):
+            return jitted(x, (1, 2))
+        """,
+        select=["GL003"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL004 — blocking calls
+# ----------------------------------------------------------------------
+
+
+def test_gl004_flags_sleep_in_async_and_hot_path(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "handlers.py",
+        """
+        import time
+
+        async def handler(ctx):
+            time.sleep(0.1)
+        """,
+        select=["GL004"],
+    )
+    assert ids == ["GL004"]
+    _, findings = _lint(
+        tmp_path, "serving/engine.py",
+        """
+        import time
+
+        def drain(self):
+            time.sleep(0.05)
+        """,
+        select=["GL004"],
+    )
+    hot = [f for f in findings if f.path.endswith("serving/engine.py")]
+    assert [f.rule_id for f in hot] == ["GL004"]
+    assert "hot path" in hot[0].message
+
+
+def test_gl004_allows_async_sleep_and_cold_path_sleep(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "handlers.py",
+        """
+        import asyncio
+        import time
+
+        async def handler(ctx):
+            await asyncio.sleep(0.1)
+
+        def retry_backoff():
+            time.sleep(1.0)  # not async, not a hot-path file
+        """,
+        select=["GL004"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL005 — lock discipline
+# ----------------------------------------------------------------------
+
+
+def test_gl005_flags_unlocked_write_to_guarded_attr(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/engine.py",
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._draining = False
+
+            def stop(self):
+                with self._lock:
+                    self._draining = True
+
+            def restart(self):
+                self._draining = False  # raced against stop()
+        """,
+        select=["GL005"],
+    )
+    assert ids == ["GL005"]
+    assert "_draining" in findings[0].message
+
+
+def test_gl005_sees_across_mixin_classes_and_sibling_files(tmp_path):
+    # The serving core is ONE runtime object composed from mixins across
+    # files: a lock taken in engine.py must guard the same attribute
+    # written from scheduler.py (and from another class in the same file).
+    (tmp_path / "serving").mkdir(parents=True)
+    (tmp_path / "serving" / "engine.py").write_text(textwrap.dedent(
+        """
+        import threading
+
+        class Engine:
+            def stop(self):
+                with self._submit_lock:
+                    self._running = False
+
+        class OtherMixin:
+            def boot(self):
+                self._running = True  # same object, no lock
+        """
+    ))
+    (tmp_path / "serving" / "scheduler.py").write_text(textwrap.dedent(
+        """
+        class SchedulerMixin:
+            def loop(self):
+                self._running = False  # lock lives in engine.py
+        """
+    ))
+    config = LintConfig()
+    config.select = {"GL005"}
+    findings = run_paths([str(tmp_path)], config=config)
+    flagged = sorted(f.path.rsplit("/", 1)[-1] for f in findings)
+    assert flagged == ["engine.py", "scheduler.py"]
+
+
+def test_gl005_allows_consistent_locking(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/engine.py",
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._draining = False
+
+            def stop(self):
+                with self._lock:
+                    self._draining = True
+
+            def restart(self):
+                with self._lock:
+                    self._draining = False
+        """,
+        select=["GL005"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL006 — swallowed exceptions
+# ----------------------------------------------------------------------
+
+
+def test_gl006_flags_broad_silent_except(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/routes.py",
+        """
+        def handle(req):
+            try:
+                return req.run()
+            except Exception:
+                pass
+        """,
+        select=["GL006"],
+    )
+    assert ids == ["GL006"]
+
+
+def test_gl006_allows_narrow_or_handled_excepts(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/routes.py",
+        """
+        def handle(req, log):
+            try:
+                return req.run()
+            except ValueError:
+                pass                      # narrow: fine
+            except Exception as exc:
+                log.errorf("failed: %s", exc)   # handled: fine
+                return None
+
+        def fallback(req):
+            try:
+                return req.fast_path()
+            except Exception:
+                return req.slow_path()    # fallback work: fine
+        """,
+        select=["GL006"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+
+
+def test_inline_suppression_silences_one_rule(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/routes.py",
+        """
+        def handle(req):
+            try:
+                return req.run()
+            except Exception:  # graftlint: disable=GL006 — probe endpoint
+                pass
+        """,
+        select=["GL006"],
+    )
+    assert ids == []
+
+
+def test_disable_next_line_and_unrelated_rule_still_fires(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/hot.py",
+        """
+        def emit(tokens_dev):
+            # graftlint: disable-next-line=GL001
+            a = float(tokens_dev)
+            b = float(tokens_dev)  # graftlint: disable=GL004 (wrong rule)
+            return a, b
+        """,
+        select=["GL001"],
+    )
+    assert ids == ["GL001"]  # only the wrongly-suppressed line fires
+
+
+# ----------------------------------------------------------------------
+# baseline
+# ----------------------------------------------------------------------
+
+_BASELINE_SRC = """
+def handle(req):
+    try:
+        return req.run()
+    except Exception:
+        pass
+"""
+
+
+def test_baseline_roundtrip_and_line_shift_stability(tmp_path):
+    _, findings = _lint(tmp_path, "serving/routes.py", _BASELINE_SRC)
+    baseline = Baseline.from_findings(findings)
+    new, stale = baseline.apply(findings)
+    assert new == [] and stale == []
+    # Insert lines above: fingerprints key on content, not line numbers.
+    shifted = "# a comment\n# another\n" + textwrap.dedent(_BASELINE_SRC)
+    (tmp_path / "serving/routes.py").write_text(shifted)
+    _, findings2 = _lint(tmp_path, "serving/routes.py", shifted)
+    new, stale = baseline.apply(findings2)
+    assert new == [] and stale == []
+
+
+def test_baseline_drift_detection(tmp_path):
+    _, findings = _lint(tmp_path, "serving/routes.py", _BASELINE_SRC)
+    baseline = Baseline.from_findings(findings)
+    # The debt is paid off: the baseline entry must be reported stale.
+    new, stale = baseline.apply([])
+    assert new == [] and len(stale) == 1
+
+
+def test_cli_exit_codes_and_check_baseline(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    target = tmp_path / "serving" / "routes.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(textwrap.dedent(_BASELINE_SRC))
+    # New findings, no baseline yet -> 1.
+    assert main([str(tmp_path)]) == 1
+    assert "GL006" in capsys.readouterr().out
+    # Accept as baseline -> 0, then a clean re-run -> 0.
+    assert main([str(tmp_path), "--write-baseline"]) == 0
+    assert main([str(tmp_path)]) == 0
+    assert main([str(tmp_path), "--check-baseline"]) == 0
+    # Pay off the debt: plain run stays 0, --check-baseline demands a
+    # baseline refresh (exit 1) so stale entries can't mask regressions.
+    target.write_text("def handle(req):\n    return req.run()\n")
+    assert main([str(tmp_path)]) == 0
+    assert main([str(tmp_path), "--check-baseline"]) == 1
+    assert "no longer occur" in capsys.readouterr().err
+    assert main([str(tmp_path), "--write-baseline"]) == 0
+    assert main([str(tmp_path), "--check-baseline"]) == 0
+
+
+def test_pyproject_fallback_parses_multiline_lists(tmp_path):
+    # The 3.10 fallback parser must handle values spanning lines — the
+    # repo's own hot-path-files list does.
+    from gofr_tpu.analysis.core import load_pyproject_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_pyproject_config(os.path.join(repo, "pyproject.toml"))
+    assert cfg.get("hot-path-files") == [
+        "serving/batcher.py", "serving/scheduler.py", "serving/engine.py",
+    ]
+    assert cfg.get("request-path-dirs") == ["serving", "ops", "grpc"]
+
+
+def test_pyproject_fallback_recovers_from_non_literal_values(tmp_path):
+    # TOML booleans parse, and a value the fallback cannot parse must not
+    # wedge the scan and swallow every following key.
+    from gofr_tpu.analysis.core import load_pyproject_config
+
+    pp = tmp_path / "pyproject.toml"
+    pp.write_text(textwrap.dedent(
+        """
+        [tool.graftlint]
+        flag = true
+        weird = 1979-05-27T07:32:00Z
+        exclude = [
+            "a.py",
+            "b.py",
+        ]
+        """
+    ))
+    cfg = load_pyproject_config(str(pp))
+    assert cfg.get("exclude") == ["a.py", "b.py"]
+    # tomllib parses `flag` natively; the 3.10 fallback maps true->True.
+    assert cfg.get("flag") is True
+
+
+def test_baseline_is_cwd_independent(tmp_path, monkeypatch):
+    proj = tmp_path / "proj"
+    (proj / "serving").mkdir(parents=True)
+    (proj / "pyproject.toml").write_text("")  # marks the repo root
+    (proj / "serving" / "routes.py").write_text(textwrap.dedent(_BASELINE_SRC))
+    monkeypatch.chdir(proj)
+    assert main([str(proj), "--write-baseline"]) == 0
+    # Same tree, analyzed from a different CWD: fingerprints must match.
+    elsewhere = tmp_path / "elsewhere"
+    elsewhere.mkdir()
+    monkeypatch.chdir(elsewhere)
+    assert main([str(proj), "--check-baseline"]) == 0
+
+
+def test_scoped_select_does_not_rot_the_baseline(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    target = tmp_path / "serving" / "routes.py"
+    target.parent.mkdir(parents=True)
+    target.write_text(textwrap.dedent(_BASELINE_SRC))  # one GL006 finding
+    assert main([str(tmp_path), "--write-baseline"]) == 0
+    # A GL001-only run produces no GL006 findings; that absence is NOT
+    # paid-off debt, and a scoped rewrite must keep the GL006 entry.
+    assert main([str(tmp_path), "--select", "GL001", "--check-baseline"]) == 0
+    assert main([str(tmp_path), "--select", "GL001", "--write-baseline"]) == 0
+    assert main([str(tmp_path), "--check-baseline"]) == 0
+
+
+def test_cli_list_rules_and_missing_path(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006"):
+        assert rule_id in out
+    assert main(["/nonexistent/path"]) == 2
+
+
+def test_module_entrypoint_runs():
+    proc = subprocess.run(
+        [sys.executable, "-m", "gofr_tpu.analysis", "--list-rules"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0
+    assert "GL001" in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# the repo gate: committed baseline stays in sync with the tree
+# ----------------------------------------------------------------------
+
+
+def test_repo_clean_against_committed_baseline(monkeypatch, capsys):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.chdir(repo)
+    rc = main(["gofr_tpu", "--check-baseline"])
+    captured = capsys.readouterr()
+    assert rc == 0, (
+        "graftlint gate failed — new findings or baseline drift:\n"
+        + captured.out + captured.err
+    )

@@ -8,7 +8,6 @@ one shot (VERDICT r1 #5)."""
 
 from __future__ import annotations
 
-import json
 
 import jax.numpy as jnp
 import numpy as np

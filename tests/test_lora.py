@@ -9,7 +9,6 @@ per-slot gather cannot leak across rows.
 
 from __future__ import annotations
 
-import dataclasses
 import json
 
 import jax

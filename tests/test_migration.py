@@ -6,7 +6,7 @@ import pytest
 
 from gofr_tpu.config import MockConfig
 from gofr_tpu.container import Container
-from gofr_tpu.datasource.redis import MiniRedis, Redis
+from gofr_tpu.datasource.redis import MiniRedis
 from gofr_tpu.logging import Level, Logger
 from gofr_tpu.migration import Migrate, run
 

@@ -12,11 +12,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from gofr_tpu.models.bert import bert_embed, bert_forward, init_bert
+from gofr_tpu.models.bert import bert_embed, init_bert
 from gofr_tpu.models.registry import get_model, list_models
 from gofr_tpu.models.resnet import init_resnet, resnet_forward
 from gofr_tpu.models.transformer import (
-    count_params,
     init_transformer,
     transformer_decode_step,
     transformer_forward,

@@ -7,7 +7,6 @@ import random
 import pytest
 
 from gofr_tpu.serving.native_tokenizer import (
-    BPETokenizer,
     NativeBPE,
     PyBPE,
     build_native,

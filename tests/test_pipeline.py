@@ -9,7 +9,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from gofr_tpu.models.registry import get_model
 from gofr_tpu.parallel import make_mesh, make_train_step, pipeline_layer_fn
