@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL006).
+"""The graftlint rule set (GL001–GL007).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -733,6 +733,178 @@ class ExceptionSwallowRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL007 — donated-buffer reuse after donate_argnums
+# ----------------------------------------------------------------------
+
+
+class DonatedBufferReuseRule(Rule):
+    """``donate_argnums`` tells XLA it may overwrite the argument's
+    buffer in place — after the call, the donated array is INVALID.
+    Reading it again returns a "buffer has been deleted or donated"
+    error at best and silent garbage through an aliased view at worst.
+    The idiomatic pattern rebinds the result to the donated name
+    (``cache = step(cache, ...)``); this rule flags reads of a donated
+    name after a call that did NOT rebind it.
+
+    Recognized donors: module/class-level ``g = jax.jit(f,
+    donate_argnums=...)`` wrappers (including ``self.attr`` targets)
+    and immediately-invoked ``jax.jit(f, donate_argnums=...)(x)``.
+    Reassigning the name between the call and the read clears the
+    taint.
+    """
+
+    rule_id = "GL007"
+    name = "donated-buffer-reuse"
+    rationale = (
+        "donate_argnums invalidates the argument's buffer at the call; "
+        "reading it afterwards crashes or returns garbage — rebind the "
+        "result to the donated name"
+    )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        donors = self._collect_donating_wrappers(tree)
+        for scope in self._scopes(tree):
+            yield from self._check_scope(scope, donors, ctx)
+
+    @staticmethod
+    def _scopes(tree: ast.Module) -> Iterator[ast.AST]:
+        yield tree  # module body is a scope too
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _donate_nums(call: ast.Call) -> set[int]:
+        """donate_argnums of a jit Call (constant specs only)."""
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                value = _const_value(kw.value)
+                if value is None:
+                    return set()
+                seq = value if isinstance(value, (tuple, list)) else (value,)
+                return {int(v) for v in seq if isinstance(v, int)}
+        return set()
+
+    def _collect_donating_wrappers(
+        self, tree: ast.Module
+    ) -> dict[str, set[int]]:
+        """``g = jax.jit(f, donate_argnums=(0,))`` → {"g": {0}} (also
+        ``self._step = ...`` attribute targets)."""
+        out: dict[str, set[int]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            call = _jit_call(node.value)
+            if call is None:
+                continue
+            nums = self._donate_nums(call)
+            if not nums:
+                continue
+            for tgt in node.targets:
+                name = dotted_name(tgt)
+                if name is not None:
+                    out[name] = nums
+        return out
+
+    def _check_scope(
+        self,
+        scope: ast.AST,
+        donors: dict[str, set[int]],
+        ctx: FileContext,
+    ) -> Iterator[Finding]:
+        # One recursive pass over the scope (NOT descending into nested
+        # function/class bodies — separate scopes, separate lifetimes),
+        # carrying the enclosing assignment's targets so `x = g(x)`
+        # counts as a rebind, not a reuse.
+        donations: list[tuple[str, int, int]] = []  # (name, line, col)
+        assigns: dict[str, list[int]] = {}
+        loads: list[tuple[str, ast.AST]] = []
+        # Reads lexically inside a donating call evaluate BEFORE the
+        # donation happens — never flag them.
+        pre_call: set[int] = set()
+
+        def visit(node: ast.AST, targets: list[str]) -> None:
+            if isinstance(node, ast.Assign):
+                names = [
+                    n
+                    for tgt in node.targets
+                    for sub in ast.walk(tgt)
+                    for n in [dotted_name(sub)]
+                    if n is not None
+                ]
+                for n in names:
+                    assigns.setdefault(n, []).append(node.lineno)
+                targets = names
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                n = dotted_name(node.target)
+                if n is not None:
+                    assigns.setdefault(n, []).append(node.lineno)
+                    targets = [n]
+            if isinstance(node, ast.Call):
+                nums: set[int] = set()
+                fname = dotted_name(node.func)
+                if fname is not None and fname in donors:
+                    nums = donors[fname]
+                elif isinstance(node.func, ast.Call):
+                    jit = _jit_call(node.func)
+                    if jit is not None:
+                        nums = self._donate_nums(jit)
+                if nums:
+                    for sub in ast.walk(node):
+                        pre_call.add(id(sub))
+                for i in nums:
+                    if i < len(node.args):
+                        arg = node.args[i]
+                        donated = dotted_name(arg)
+                        if donated is not None and donated not in targets:
+                            donations.append(
+                                (donated, node.lineno, node.col_offset)
+                            )
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                name = dotted_name(node)
+                if name is not None:
+                    loads.append((name, node))
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                visit(child, targets)
+
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            visit(child, [])
+
+        for name, node in loads:
+            if id(node) in pre_call:  # evaluated before the donation
+                continue
+            for donated, call_line, call_col in donations:
+                if name != donated:
+                    continue
+                if (node.lineno, node.col_offset) < (call_line, call_col):
+                    continue
+                # A reassignment between donation and read clears it.
+                if any(
+                    call_line < a <= node.lineno
+                    for a in assigns.get(name, ())
+                ):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}` was donated to a jitted call on line "
+                    f"{call_line} (donate_argnums) — its buffer is gone; "
+                    "rebind the call's result to it or drop the donation",
+                )
+                break
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -743,6 +915,7 @@ ALL_RULES = (
     BlockingCallRule,
     LockDisciplineRule,
     ExceptionSwallowRule,
+    DonatedBufferReuseRule,
 )
 
 
@@ -755,4 +928,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         BlockingCallRule(config.hot_path_files),
         LockDisciplineRule(config.hot_path_files),
         ExceptionSwallowRule(config.request_path_dirs),
+        DonatedBufferReuseRule(),
     ]

@@ -157,6 +157,28 @@ class Container:
         m.new_gauge(
             "app_tpu_kv_blocks_free", "paged KV cache: free pool blocks"
         )
+        # Request-lifecycle resilience (docs/advanced-guide/resilience.md):
+        # shedding, cancellation, deadlines, and the scheduler watchdog.
+        m.new_counter(
+            "app_tpu_requests_shed_total",
+            "submits rejected by admission control (429/504 before a slot)",
+        )
+        m.new_counter(
+            "app_tpu_requests_cancelled_total",
+            "sequences retired mid-decode by cancel/disconnect",
+        )
+        m.new_counter(
+            "app_tpu_deadline_exceeded_total",
+            "sequences retired because their deadline expired",
+        )
+        m.new_counter(
+            "app_tpu_watchdog_trips_total",
+            "scheduler watchdog trips (stalled device step)",
+        )
+        m.new_gauge(
+            "app_http_service_circuit_open",
+            "circuit breaker state per downstream service (1 = open)",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -45,6 +45,22 @@ class Context:
             return raw.ctx_data.get(key, default)
         return default
 
+    # -- request lifecycle (serving/lifecycle.py) -------------------------
+
+    @property
+    def deadline(self):
+        """The request's Deadline (X-Request-Timeout header or gRPC
+        deadline), or None. Handlers pass it to engine submits so
+        expired requests retire mid-decode."""
+        return self.get("deadline")
+
+    @property
+    def cancel_token(self):
+        """The request's CancelToken — tripped by the server when the
+        client disconnects mid-request. Share it with engine submits so
+        abandoned generations free their KV blocks."""
+        return self.get("cancel")
+
     # -- container passthrough --------------------------------------------
 
     @property

@@ -101,6 +101,49 @@ class ErrorPayloadTooLarge(GofrError):
         )
 
 
+class ErrorTooManyRequests(GofrError):
+    """429 — the submit queue is over its token budget (load shedding).
+
+    Carries a ``Retry-After`` estimate derived from the queue's token
+    backlog over the engine's measured throughput; the responder copies
+    ``headers`` onto the wire so well-behaved clients back off instead
+    of hammering an overloaded engine.
+    """
+
+    status_code = 429
+
+    def __init__(self, reason: str, retry_after_s: float = 1.0) -> None:
+        self.retry_after_s = max(1, int(-(-retry_after_s // 1)))  # ceil ≥ 1
+        self.headers = {"Retry-After": str(self.retry_after_s)}
+        super().__init__(
+            f"request shed: {reason}; retry after ~{self.retry_after_s}s"
+        )
+
+
+class ErrorDeadlineExceeded(GofrError):
+    """504 — the request's deadline expired before (or during)
+    generation. Mid-stream, the scheduler retires the sequence and
+    frees its KV blocks; the stream ends with this terminal error."""
+
+    status_code = 504
+
+    def __init__(self, detail: str = "") -> None:
+        msg = "deadline exceeded"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class ErrorRequestCancelled(GofrError):
+    """499 (client closed request) — the caller cancelled or
+    disconnected; the engine retired the sequence mid-decode."""
+
+    status_code = 499
+
+    def __init__(self) -> None:
+        super().__init__("request cancelled by the client")
+
+
 class ErrorPromptTooLong(GofrError):
     """413 — prompt exceeds the engine's serveable context window. A
     serving framework must surface this, not silently truncate (truncation

@@ -1,0 +1,143 @@
+"""Deterministic fault injection for the serving core.
+
+A :class:`FaultInjector` is a registry of *named injection points* —
+the seams the resilience layer must survive — that production code
+fires unconditionally and tests arm selectively:
+
+* ``engine.submit``       — inside the submit path, before enqueue
+* ``engine.tokenize``     — before the tokenizer encodes a prompt
+* ``scheduler.window``    — top of every scheduler loop iteration
+* ``scheduler.device_step`` — before a decode/prefill device dispatch
+
+Unarmed, ``fire`` is one dict read (the serving hot path pays nothing
+measurable). Armed, a point either **raises** the configured exception
+or **runs** a callable — the callable form is how tests simulate a
+stalled device step without sleeping: the action blocks on a
+``threading.Event`` the test controls, so every ordering is explicit.
+
+Determinism rules this module enforces by design:
+
+* no randomness — a fault fires on exact hit counts (``after`` skips,
+  ``times`` bounds), never probabilistically;
+* no timers — "slow" is modeled by test-controlled events, "expired"
+  by injectable clocks (``serving/lifecycle.py``), never ``sleep``.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator, Optional
+
+
+@dataclass
+class _ArmedFault:
+    point: str
+    raises: Optional[BaseException] = None
+    action: Optional[Callable[..., Any]] = None
+    times: Optional[int] = None  # max fires; None = every hit
+    after: int = 0  # skip the first `after` hits
+    hits: int = 0
+    fired: int = 0
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+class FaultInjector:
+    """Thread-safe named-fault registry (one global default below)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._points: dict[str, _ArmedFault] = {}
+
+    # -- arming (test side) --------------------------------------------
+
+    def arm(
+        self,
+        point: str,
+        *,
+        raises: Optional[BaseException] = None,
+        action: Optional[Callable[..., Any]] = None,
+        times: Optional[int] = None,
+        after: int = 0,
+    ) -> _ArmedFault:
+        """Arm ``point``. Exactly one of ``raises``/``action`` must be
+        given. ``times`` bounds total fires; ``after`` skips the first N
+        hits (e.g. fail the *second* window only)."""
+        if (raises is None) == (action is None):
+            raise ValueError("arm() needs exactly one of raises= or action=")
+        fault = _ArmedFault(
+            point=point, raises=raises, action=action, times=times,
+            after=after,
+        )
+        with self._lock:
+            self._points[point] = fault
+        return fault
+
+    def disarm(self, point: str) -> None:
+        with self._lock:
+            self._points.pop(point, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._points.clear()
+
+    @contextmanager
+    def armed(
+        self,
+        point: str,
+        *,
+        raises: Optional[BaseException] = None,
+        action: Optional[Callable[..., Any]] = None,
+        times: Optional[int] = None,
+        after: int = 0,
+    ) -> Iterator[_ArmedFault]:
+        """``with faults.armed("scheduler.device_step", raises=exc): ...``"""
+        fault = self.arm(
+            point, raises=raises, action=action, times=times, after=after
+        )
+        try:
+            yield fault
+        finally:
+            self.disarm(point)
+
+    def fired(self, point: str) -> int:
+        """How many times ``point`` actually fired (0 if never armed)."""
+        with self._lock:
+            fault = self._points.get(point)
+        return fault.fired if fault is not None else 0
+
+    # -- firing (production side) --------------------------------------
+
+    def fire(self, point: str, **ctx: Any) -> Any:
+        """Called at the injection point. No-op unless armed; armed, it
+        raises the configured exception or returns the action's result
+        (the action receives ``ctx`` as keyword arguments)."""
+        if not self._points:  # fast path: nothing armed anywhere
+            return None
+        fault = self._points.get(point)
+        if fault is None:
+            return None
+        with fault.lock:
+            fault.hits += 1
+            if fault.hits <= fault.after:
+                return None
+            if fault.times is not None and fault.fired >= fault.times:
+                return None
+            fault.fired += 1
+        if fault.action is not None:
+            return fault.action(**ctx)
+        assert fault.raises is not None
+        raise fault.raises
+
+
+#: Process-wide default injector: production seams fire on it, tests
+#: arm it (and MUST disarm — use the ``armed`` context manager).
+default_injector = FaultInjector()
+
+fire = default_injector.fire
+armed = default_injector.armed
+arm = default_injector.arm
+disarm = default_injector.disarm
+reset = default_injector.reset
+fired = default_injector.fired

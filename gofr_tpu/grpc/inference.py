@@ -23,7 +23,11 @@ import grpc
 import numpy as np
 
 from gofr_tpu.errors import GofrError
-from gofr_tpu.grpc.server import json_method_handlers
+from gofr_tpu.grpc.server import (
+    deadline_from_context,
+    grpc_status_code,
+    json_method_handlers,
+)
 
 SERVICE = "gofr.tpu.Inference"
 
@@ -33,7 +37,7 @@ class InferenceServicer:
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
 
-    def _gen_kwargs(self, request, stream: bool) -> dict:
+    def _gen_kwargs(self, request, stream: bool, context=None) -> dict:
         from gofr_tpu.serving.stream_text import normalize_stop
 
         kw = dict(
@@ -46,6 +50,15 @@ class InferenceServicer:
             kw["top_p"] = float(request["top_p"])
         if request.get("adapter"):
             kw["adapter"] = str(request["adapter"])
+        # Deadline propagation: an explicit timeout_s field wins, else
+        # the caller's gRPC deadline — either way the engine retires the
+        # sequence mid-decode when it expires (scheduler lifecycle reap).
+        if request.get("timeout_s") is not None:
+            kw["deadline_s"] = float(request["timeout_s"])
+        elif context is not None:
+            remaining = deadline_from_context(context)
+            if remaining is not None:
+                kw["deadline_s"] = remaining
         return kw
 
     async def Generate(self, request, context):
@@ -63,14 +76,11 @@ class InferenceServicer:
             }
         try:
             result = await self.engine.generate(
-                request.get("prompt", ""), **self._gen_kwargs(request, False)
+                request.get("prompt", ""),
+                **self._gen_kwargs(request, False, context),
             )
         except GofrError as exc:
-            code = (
-                grpc.StatusCode.INVALID_ARGUMENT
-                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
-            )
-            await context.abort(code, str(exc))
+            await context.abort(grpc_status_code(exc), str(exc))
         return {
             "text": result.text,
             "tokens": len(result.token_ids),
@@ -106,7 +116,7 @@ class InferenceServicer:
         try:
             async for ev in stream_generation(
                 self.engine, request.get("prompt", ""),
-                self._gen_kwargs(request, True), self.tokenizer,
+                self._gen_kwargs(request, True, context), self.tokenizer,
             ):
                 if ev["type"] == "piece":
                     yield {"token": ev["token"], "text": ev["text"]}
@@ -118,11 +128,7 @@ class InferenceServicer:
                         "finish_reason": ev["finish_reason"],
                     }
         except GofrError as exc:
-            code = (
-                grpc.StatusCode.INVALID_ARGUMENT
-                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
-            )
-            await context.abort(code, str(exc))
+            await context.abort(grpc_status_code(exc), str(exc))
 
     async def Embed(self, request, context):
         emb = await self.engine.embed(request.get("text", ""))

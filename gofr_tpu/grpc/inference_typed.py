@@ -30,7 +30,9 @@ class TypedInferenceServicer(_Base):
         self.engine = engine
         self.tokenizer = tokenizer or engine.tokenizer
 
-    def _gen_kwargs(self, request) -> tuple:
+    def _gen_kwargs(self, request, context=None) -> tuple:
+        from gofr_tpu.grpc.server import deadline_from_context
+
         prompt = (
             list(request.prompt_ids) if request.prompt_ids else request.prompt
         )
@@ -44,12 +46,19 @@ class TypedInferenceServicer(_Base):
             kw["top_p"] = request.top_p
         if request.adapter:
             kw["adapter"] = request.adapter
+        if context is not None:
+            # Caller's gRPC deadline → engine Deadline: when it expires
+            # the scheduler retires the sequence and frees its KV blocks
+            # instead of decoding past an RPC nobody is waiting on.
+            remaining = deadline_from_context(context)
+            if remaining is not None:
+                kw["deadline_s"] = remaining
         return prompt, kw
 
     async def Generate(self, request, context):
-        import grpc
+        from gofr_tpu.grpc.server import grpc_status_code
 
-        prompt, kw = self._gen_kwargs(request)
+        prompt, kw = self._gen_kwargs(request, context)
         if self.engine.family == "seq2seq":
             text, ids = await self.engine.seq2seq_text(prompt)
             return pb.GenerateReply(
@@ -58,11 +67,7 @@ class TypedInferenceServicer(_Base):
         try:
             result = await self.engine.generate(prompt, **kw)
         except GofrError as exc:
-            code = (
-                grpc.StatusCode.INVALID_ARGUMENT
-                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
-            )
-            await context.abort(code, str(exc))
+            await context.abort(grpc_status_code(exc), str(exc))
         return pb.GenerateReply(
             text=result.text,
             tokens=len(result.token_ids),
@@ -76,6 +81,7 @@ class TypedInferenceServicer(_Base):
     async def GenerateStream(self, request, context):
         import grpc
 
+        from gofr_tpu.grpc.server import grpc_status_code
         from gofr_tpu.serving.stream_text import (
             stream_generation,
             stream_seq2seq,
@@ -97,7 +103,7 @@ class TypedInferenceServicer(_Base):
                     )
             return
 
-        prompt, kw = self._gen_kwargs(request)
+        prompt, kw = self._gen_kwargs(request, context)
         try:
             async for ev in stream_generation(
                 self.engine, prompt, kw, self.tokenizer
@@ -112,11 +118,7 @@ class TypedInferenceServicer(_Base):
                         finish_reason=ev["finish_reason"],
                     )
         except GofrError as exc:
-            code = (
-                grpc.StatusCode.INVALID_ARGUMENT
-                if exc.status_code < 500 else grpc.StatusCode.INTERNAL
-            )
-            await context.abort(code, str(exc))
+            await context.abort(grpc_status_code(exc), str(exc))
         except Exception as exc:  # noqa: BLE001 — engine died mid-stream
             await context.abort(grpc.StatusCode.INTERNAL, str(exc))
 

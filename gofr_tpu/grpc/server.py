@@ -18,6 +18,41 @@ import grpc
 from gofr_tpu.tracing import get_tracer
 
 
+def grpc_status_code(exc: BaseException) -> "grpc.StatusCode":
+    """Framework error → gRPC status, honoring the resilience statuses:
+    shed (429) → RESOURCE_EXHAUSTED, deadline (504) → DEADLINE_EXCEEDED,
+    cancelled (499) → CANCELLED, draining (503) → UNAVAILABLE; the rest
+    keep the historical 4xx→INVALID_ARGUMENT / 5xx→INTERNAL split."""
+    status = getattr(exc, "status_code", 500)
+    if status == 429:
+        return grpc.StatusCode.RESOURCE_EXHAUSTED
+    if status == 499:
+        return grpc.StatusCode.CANCELLED
+    if status == 503:
+        return grpc.StatusCode.UNAVAILABLE
+    if status == 504:
+        return grpc.StatusCode.DEADLINE_EXCEEDED
+    if status < 500:
+        return grpc.StatusCode.INVALID_ARGUMENT
+    return grpc.StatusCode.INTERNAL
+
+
+def deadline_from_context(context) -> Optional[float]:
+    """Seconds remaining on the caller's gRPC deadline, or None. The
+    servicers turn this into a ``Deadline`` on engine submits so an
+    expired RPC's sequence retires mid-decode server-side too."""
+    tr = getattr(context, "time_remaining", None)
+    if not callable(tr):
+        return None
+    try:
+        remaining = tr()
+    except Exception:  # graftlint: disable=GL006 — absent/stub deadline APIs mean "no deadline", not an error
+        return None
+    if remaining is None or remaining <= 0:
+        return None
+    return float(remaining)
+
+
 class RPCLog:
     """Structured RPC log (reference ``grpc/log.go:22-28``)."""
 

@@ -52,9 +52,16 @@ class Responder:
     def respond(self, result: Any, error: Optional[BaseException]) -> Response:
         if error is not None:
             status = self.status_from_error(error)
+            headers = {"Content-Type": "application/json"}
+            # Errors may carry wire headers (e.g. Retry-After on a shed
+            # 429 — errors.ErrorTooManyRequests) so well-behaved clients
+            # back off instead of hammering an overloaded engine.
+            extra = getattr(error, "headers", None)
+            if isinstance(extra, dict):
+                headers.update({str(k): str(v) for k, v in extra.items()})
             return Response(
                 status=status,
-                headers={"Content-Type": "application/json"},
+                headers=headers,
                 body=to_json_bytes({"error": {"message": str(error) or "unknown error"}}),
             )
 

@@ -9,7 +9,12 @@ drain-on-shutdown mandatory).
 * per-connection read deadline mirroring the reference's 5s
   ``ReadHeaderTimeout`` (``httpServer.go:27``);
 * keep-alive with pipelined sequential requests;
-* the handler is ``async fn(RawRequest) -> Response``.
+* the handler is ``async fn(RawRequest) -> Response``;
+* request lifecycle: an ``X-Request-Timeout`` header (seconds) becomes a
+  :class:`Deadline` and every request carries a :class:`CancelToken` in
+  ``ctx_data`` — the token trips when the connection dies mid-request,
+  so a generation handler's engine work is retired instead of decoding
+  for a client that is gone (docs/advanced-guide/resilience.md).
 """
 
 from __future__ import annotations
@@ -26,6 +31,7 @@ from gofr_tpu.http.proto import (
     read_request,
     serialize_response,
 )
+from gofr_tpu.serving.lifecycle import CancelToken, Deadline
 
 Handler = Callable[[RawRequest], Awaitable[Response]]
 
@@ -35,6 +41,10 @@ KEEPALIVE_IDLE_TIMEOUT_S = 75.0
 # as they need. Bound them generously instead of inheriting the 5s header
 # budget (which would reset slow uploads mid-stream with no response).
 BODY_READ_TIMEOUT_S = 300.0
+# Per-request deadline header: seconds the client is willing to wait.
+# Parsed here (the transport edge) so every surface — framework routes,
+# OpenAI endpoints, ctx.infer — sees the same Deadline on ctx_data.
+REQUEST_TIMEOUT_HEADER = "x-request-timeout"
 
 
 class HTTPServer:
@@ -154,6 +164,35 @@ class HTTPServer:
                     if raw is None:
                         break
                     first = False
+                    # Request lifecycle: a cancel token every layer below
+                    # can share (the OpenAI routes hand it to the engine),
+                    # tripped when this connection dies mid-request; an
+                    # optional client deadline from X-Request-Timeout.
+                    cancel = CancelToken()
+                    raw.ctx_data["cancel"] = cancel
+                    tmo = raw.headers.get(REQUEST_TIMEOUT_HEADER)
+                    if tmo:
+                        try:
+                            raw.ctx_data["deadline"] = Deadline.after(
+                                float(tmo)
+                            )
+                        except ValueError:
+                            if self._logger is not None:
+                                self._logger.warnf(
+                                    "ignoring malformed %s header: %r",
+                                    REQUEST_TIMEOUT_HEADER, tmo,
+                                )
+                    # Disconnect watch for the handler's whole run (not
+                    # just the response write): a client that vanishes
+                    # mid-generation must trip the cancel token NOW so
+                    # the engine's lifecycle reap frees its KV slot,
+                    # instead of decoding the full budget for nobody.
+                    # Poll-based (at_eof/is_closing) on purpose — reading
+                    # the socket to detect EOF would consume a pipelined
+                    # next request's bytes.
+                    watcher = asyncio.ensure_future(
+                        _watch_disconnect(reader, writer, cancel)
+                    )
                     try:
                         resp = await self._handler(raw)
                     except Exception as exc:  # framework-level last resort
@@ -164,6 +203,8 @@ class HTTPServer:
                             headers={"Content-Type": "application/json"},
                             body=b'{"error":{"message":"Internal Server Error"}}',
                         )
+                    finally:
+                        watcher.cancel()
 
                     keep = raw.keep_alive
                     writer.write(
@@ -212,6 +253,12 @@ class HTTPServer:
                                     pass
                 finally:
                     self._inflight.discard(writer)
+                if not drained:
+                    # The client is gone mid-response: trip the request's
+                    # cancel token so any engine work feeding it retires
+                    # (the stream path's aclose above handles SSE; this
+                    # covers responses that failed to flush).
+                    cancel.cancel()
                 if not drained or not keep:
                     break
         finally:
@@ -220,6 +267,29 @@ class HTTPServer:
                 writer.close()
             except Exception:
                 pass
+
+
+async def _watch_disconnect(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    cancel: CancelToken,
+    poll_s: float = 0.25,
+) -> None:
+    """Trip ``cancel`` when the peer goes away while a handler is
+    running. A FIN surfaces as ``reader.at_eof()`` (the event loop keeps
+    the socket read-registered while the handler awaits), an RST as a
+    closing transport. Half-close clients (shutdown(WR) then read the
+    response) are treated as disconnects — pathological under HTTP/1.1
+    keep-alive. Cancelled by the caller when the handler returns; fast
+    handlers (health, metrics) therefore never reach the first poll, and
+    the interval is sized so 64 concurrent generations cost ~256 timer
+    wakeups/sec, not thousands — disconnect reaping only needs to beat
+    the decode budget, not the millisecond."""
+    while True:
+        if reader.at_eof() or writer.is_closing():
+            cancel.cancel()
+            return
+        await asyncio.sleep(poll_s)
 
 
 async def _safe_drain(writer: asyncio.StreamWriter) -> bool:

@@ -14,6 +14,7 @@ import gc
 import os
 import threading
 import time
+from typing import Iterable
 
 from gofr_tpu.metrics.manager import Counter, Gauge, Histogram, Manager, UpDownCounter
 from gofr_tpu.version import FRAMEWORK_VERSION
@@ -25,7 +26,7 @@ def _escape(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def _fmt_labels(pairs, extra: str = "") -> str:
+def _fmt_labels(pairs: Iterable[tuple[str, str]], extra: str = "") -> str:
     parts = [f'{k}="{_escape(v)}"' for k, v in pairs]
     if extra:
         parts.append(extra)
@@ -44,7 +45,7 @@ def render_prometheus(manager: Manager, app_name: str = "gofr-tpu-app") -> str:
     out: list[str] = []
     # Per-scrape runtime stats (reference metrics/handler.go:21-35).
     gc_counts = gc.get_count()
-    runtime = {
+    runtime: dict[str, float] = {
         "process_threads": threading.active_count(),
         "process_resident_memory_bytes": _rss_bytes(),
         "process_uptime_seconds": time.time() - _START_TIME,

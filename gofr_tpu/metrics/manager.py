@@ -16,13 +16,15 @@ Capability parity with the reference's ``metrics/register.go:15-270``:
 TPU-first deltas: locking is per-instrument so unrelated metrics never
 contend on the request/decode hot path, and the serving engine registers
 per-chip gauges (queue depth, HBM used) on the same registry.
+
+This module is in the strict-mypy scope (pyproject ``[tool.mypy]``).
 """
 
 from __future__ import annotations
 
 import threading
 from bisect import bisect_left
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 _CARDINALITY_WARN_AT = 20
 
@@ -30,8 +32,14 @@ DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 7.5, 10.0,
 )
 
+#: A recorded label set: sorted ((key, value), ...) pairs.
+LabelSet = tuple[tuple[str, str], ...]
 
-def _labelset(labels: tuple) -> tuple[tuple[str, str], ...]:
+#: One histogram series: (per-bucket counts, [sum, count]).
+HistogramSeries = tuple[list[int], list[float]]
+
+
+def _labelset(labels: tuple) -> LabelSet:
     if len(labels) % 2 != 0:
         raise ValueError("labels must be key/value pairs")
     pairs = [(str(labels[i]), str(labels[i + 1])) for i in range(0, len(labels), 2)]
@@ -46,9 +54,9 @@ class _Instrument:
         self.name = name
         self.description = description
         self._lock = threading.Lock()
-        self._series: dict = {}
+        self._series: dict[LabelSet, Any] = {}
 
-    def labelsets(self):
+    def labelsets(self) -> list[LabelSet]:
         with self._lock:
             return list(self._series.keys())
 
@@ -61,7 +69,7 @@ class Counter(_Instrument):
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + value
 
-    def collect(self):
+    def collect(self) -> dict[LabelSet, float]:
         with self._lock:
             return dict(self._series)
 
@@ -78,7 +86,7 @@ class Gauge(_Instrument):
         with self._lock:
             self._series[key] = float(value)
 
-    def collect(self):
+    def collect(self) -> dict[LabelSet, float]:
         with self._lock:
             return dict(self._series)
 
@@ -88,14 +96,15 @@ class Histogram(_Instrument):
 
     def __init__(self, name: str, description: str, buckets: Sequence[float]) -> None:
         super().__init__(name, description)
-        self.buckets = tuple(sorted(buckets))
+        self.buckets: tuple[float, ...] = tuple(sorted(buckets))
 
     def record(self, value: float, labels: tuple) -> None:
         key = _labelset(labels)
         with self._lock:
-            series = self._series.get(key)
+            series: Optional[HistogramSeries] = self._series.get(key)
             if series is None:
-                series = [0] * (len(self.buckets) + 1), [0.0, 0]  # bucket counts, (sum, count)
+                # bucket counts, (sum, count)
+                series = [0] * (len(self.buckets) + 1), [0.0, 0]
                 self._series[key] = series
             counts, agg = series
             # Prometheus `le` is inclusive: first bucket with bound >= value.
@@ -104,7 +113,7 @@ class Histogram(_Instrument):
             agg[0] += value
             agg[1] += 1
 
-    def collect(self):
+    def collect(self) -> dict[LabelSet, tuple[list[int], tuple[float, float]]]:
         with self._lock:
             return {
                 key: ([*counts], (agg[0], agg[1]))
@@ -115,7 +124,7 @@ class Histogram(_Instrument):
 class Manager:
     """Thread-safe instrument registry (reference ``metrics/register.go:15-25``)."""
 
-    def __init__(self, logger=None) -> None:
+    def __init__(self, logger: Any = None) -> None:
         self._logger = logger
         self._lock = threading.Lock()
         self._instruments: dict[str, _Instrument] = {}
@@ -146,7 +155,7 @@ class Manager:
 
     # -- recording (reference register.go:168-247) -----------------------
 
-    def _get(self, name: str, cls) -> Optional[_Instrument]:
+    def _get(self, name: str, cls: type) -> Optional[_Instrument]:
         inst = self._instruments.get(name)
         if inst is None:
             self._log_error(f"metrics {name} is not registered")
@@ -157,9 +166,9 @@ class Manager:
             return None
         return inst
 
-    def increment_counter(self, name: str, *labels) -> None:
+    def increment_counter(self, name: str, *labels: Any) -> None:
         inst = self._get(name, Counter)
-        if inst is None:
+        if not isinstance(inst, Counter):
             return
         try:
             inst.add(1.0, labels)
@@ -168,9 +177,9 @@ class Manager:
             return
         self._check_cardinality(inst)
 
-    def delta_updown_counter(self, name: str, value: float, *labels) -> None:
+    def delta_updown_counter(self, name: str, value: float, *labels: Any) -> None:
         inst = self._get(name, UpDownCounter)
-        if inst is None:
+        if not isinstance(inst, UpDownCounter):
             return
         try:
             inst.add(value, labels)
@@ -179,9 +188,9 @@ class Manager:
             return
         self._check_cardinality(inst)
 
-    def record_histogram(self, name: str, value: float, *labels) -> None:
+    def record_histogram(self, name: str, value: float, *labels: Any) -> None:
         inst = self._get(name, Histogram)
-        if inst is None:
+        if not isinstance(inst, Histogram):
             return
         try:
             inst.record(value, labels)
@@ -190,9 +199,9 @@ class Manager:
             return
         self._check_cardinality(inst)
 
-    def set_gauge(self, name: str, value: float, *labels) -> None:
+    def set_gauge(self, name: str, value: float, *labels: Any) -> None:
         inst = self._get(name, Gauge)
-        if inst is None:
+        if not isinstance(inst, Gauge):
             return
         try:
             inst.set(value, labels)
@@ -225,6 +234,6 @@ class Manager:
             return list(self._instruments.values())
 
 
-def new_metrics_manager(logger=None) -> Manager:
+def new_metrics_manager(logger: Any = None) -> Manager:
     """Reference ``metrics/register.go:49-55``."""
     return Manager(logger=logger)

@@ -466,7 +466,9 @@ def load_hf_t5(
             np.swapaxes(np.asarray(src.get("lm_head.weight")), 0, 1),
             cfg.dtype,
         )
-        params["lm_head"] = qleaf(head) if qleaf is not None else head
+        # Read-before-donate ordering (graftlint GL007): the bare `head`
+        # branch must evaluate before the donating qleaf call.
+        params["lm_head"] = head if qleaf is None else qleaf(head)
     return params
 
 

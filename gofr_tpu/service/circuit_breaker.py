@@ -5,6 +5,12 @@ fast-fail with :class:`CircuitOpenError` and a background ticker probes the
 health endpoint every ``interval`` seconds to auto-close (reference
 ``circuit_breaker.go:57-96,106-118``); a request-path probe also closes the
 circuit when a live call succeeds after recovery.
+
+The probe ticker is a daemon thread that is **stopped by ``close()``** —
+a breaker must not keep probing a service whose client was torn down —
+and breaker state is surfaced as the ``app_http_service_circuit_open``
+gauge (1 = open) labeled by service address, so dashboards see an open
+circuit the moment it opens rather than inferring it from error rates.
 """
 
 from __future__ import annotations
@@ -13,7 +19,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from gofr_tpu.service.wrapper import ServiceWrapper
+from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
 
 class CircuitOpenError(Exception):
@@ -42,6 +48,7 @@ class _CircuitBreakerService(ServiceWrapper):
         self._failures = 0
         self._open = False
         self._opened_at = 0.0
+        self._closed = False  # client torn down; no more tickers
         self._stop = threading.Event()
         self._ticker: threading.Thread | None = None
 
@@ -50,11 +57,23 @@ class _CircuitBreakerService(ServiceWrapper):
         with self._lock:
             return self._open
 
+    def _publish_state(self, open_: bool) -> None:
+        """Breaker state gauge, labeled by the wrapped service address."""
+        base = innermost(self)
+        metrics = getattr(base, "_metrics", None)
+        if metrics is not None:
+            metrics.set_gauge(
+                "app_http_service_circuit_open",
+                1.0 if open_ else 0.0,
+                "service", getattr(base, "address", "unknown"),
+            )
+
     def _record_success(self) -> None:
         with self._lock:
             self._failures = 0
-            if self._open:
-                self._open = False
+            was_open, self._open = self._open, False
+        if was_open:
+            self._publish_state(False)
         self._stop_ticker()
 
     def _record_failure(self) -> None:
@@ -64,17 +83,29 @@ class _CircuitBreakerService(ServiceWrapper):
             if self._failures >= self._threshold and not self._open:
                 self._open = True
                 self._opened_at = time.time()
-                start_ticker = True
+                start_ticker = not self._closed
         if start_ticker:
+            self._publish_state(True)
             self._start_ticker()
 
     def _start_ticker(self) -> None:
-        """Health-probe loop to auto-close (reference ``:106-118``)."""
-        self._stop.clear()
-        self._ticker = threading.Thread(
-            target=self._probe_loop, name="circuit-breaker-probe", daemon=True
-        )
-        self._ticker.start()
+        """Health-probe loop to auto-close (reference ``:106-118``).
+        Daemon: it must never pin the interpreter open, and ``close()``
+        stops it explicitly so it cannot outlive the client either.
+        The ``_closed`` re-check and the stop-clear both hold the lock:
+        a failure racing ``close()`` could otherwise observe
+        ``_closed=False``, lose the lock, and then spawn a ticker whose
+        ``_stop.clear()`` undoes close()'s stop signal — resurrecting
+        exactly the leak close() exists to prevent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._stop.clear()
+            self._ticker = threading.Thread(
+                target=self._probe_loop, name="circuit-breaker-probe",
+                daemon=True,
+            )
+            self._ticker.start()
 
     def _stop_ticker(self) -> None:
         self._stop.set()
@@ -90,6 +121,21 @@ class _CircuitBreakerService(ServiceWrapper):
             return self._inner.health_check().get("status") == "UP"
         except Exception:
             return False
+
+    def close(self) -> None:
+        """Stop the probe ticker with the client (the ticker previously
+        could outlive it, probing a dead address forever), then close
+        the wrapped service."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        ticker = self._ticker
+        if ticker is not None and ticker.is_alive():
+            ticker.join(timeout=5)
+        self._ticker = None
+        inner_close = getattr(self._inner, "close", None)
+        if callable(inner_close):
+            inner_close()
 
     def request(self, method: str, path: str, **kw):
         if self.is_open:

@@ -5,10 +5,11 @@ the per-option files: ``apikey_auth.go``, ``basic_auth.go``, ``oauth.go``,
 from __future__ import annotations
 
 import base64
+import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Mapping, Optional
+from typing import Callable, Mapping, Optional
 
 from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
@@ -134,11 +135,32 @@ class HealthConfig:
 
 @dataclass
 class RetryConfig:
-    """Retry 5xx / connection errors with exponential backoff (net-new;
-    the reference leaves retries to the caller)."""
+    """Retry 5xx / connection errors with JITTERED exponential backoff
+    (net-new; the reference leaves retries to the caller).
+
+    Fixed-delay retries synchronize thundering herds: every client that
+    failed at t₀ retries at exactly t₀+d, re-spiking the service it just
+    knocked over. Each delay is therefore the exponential base
+    ``backoff_s · 2^attempt`` (capped at ``max_backoff_s``) scaled by a
+    uniform draw from ``[1 - jitter, 1 + jitter]`` — clients decorrelate
+    while the expected delay stays the configured schedule. ``rng`` is
+    injectable so tests pin the draw (``docs/advanced-guide/
+    http-communication.md``).
+    """
 
     max_retries: int = 3
     backoff_s: float = 0.1
+    jitter: float = 0.5  # ±50% of the exponential base
+    max_backoff_s: float = 30.0
+    rng: Callable[[], float] = field(default=random.random)
+
+    def delay_s(self, attempt: int) -> float:
+        """The jittered sleep before retry ``attempt + 1`` (attempt is
+        0-based). Bounds: base·(1-jitter) ≤ delay ≤ base·(1+jitter)."""
+        base = min(self.backoff_s * (2 ** attempt), self.max_backoff_s)
+        jitter = min(max(self.jitter, 0.0), 1.0)
+        factor = 1.0 - jitter + 2.0 * jitter * self.rng()
+        return base * factor
 
     def add_option(self, svc):
         cfg = self
@@ -155,7 +177,7 @@ class RetryConfig:
                         last_exc = exc
                         if attempt == cfg.max_retries:
                             raise
-                    time.sleep(cfg.backoff_s * (2**attempt))
+                    time.sleep(cfg.delay_s(attempt))
                 if last_exc is not None:
                     raise last_exc
                 return resp

@@ -8,6 +8,13 @@ power-of-two buckets so XLA reuses a small set of compiled shapes.
 
 Thread-based (device calls block anyway): async callers get a
 ``concurrent.futures.Future`` they can await via ``asyncio.wrap_future``.
+
+Overload: the queue is bounded and a full queue **sheds** — submit
+raises :class:`gofr_tpu.errors.ErrorTooManyRequests`, which the HTTP
+responder maps to 429 + ``Retry-After`` (the LLM engine's submit path
+applies the same policy; docs/advanced-guide/resilience.md).
+
+This module is in the strict-mypy scope (pyproject ``[tool.mypy]``).
 """
 
 from __future__ import annotations
@@ -47,7 +54,7 @@ class DynamicBatcher:
         execute: Callable[[list], list],
         max_batch: int = 8,
         max_wait_s: float = 0.005,
-        metrics=None,
+        metrics: Any = None,
         name: str = "batcher",
         max_queue: int = 1024,
     ) -> None:
@@ -74,9 +81,25 @@ class DynamicBatcher:
             self._thread = None
 
     def submit(self, payload: Any) -> Future:
-        """Enqueue; raises queue.Full on overload (caller maps to 429)."""
+        """Enqueue; a full queue SHEDS with 429 + Retry-After (a bounded
+        queue that 500s on overload trains clients to retry immediately,
+        which is the opposite of what an overloaded batcher needs)."""
         pending = _Pending(payload)
-        self._queue.put_nowait(pending)
+        try:
+            self._queue.put_nowait(pending)
+        except queue.Full:
+            from gofr_tpu.errors import ErrorTooManyRequests
+
+            if self._metrics is not None:
+                self._metrics.increment_counter(
+                    "app_tpu_requests_shed_total",
+                    "model", self._name, "reason", "queue_full",
+                )
+            raise ErrorTooManyRequests(
+                f"{self._name} batch queue full "
+                f"({self._queue.maxsize} pending)",
+                retry_after_s=1.0,
+            ) from None
         if self._metrics is not None:
             self._metrics.set_gauge(
                 "app_tpu_queue_depth", self._queue.qsize(), "batcher", self._name

@@ -32,9 +32,11 @@ from typing import Any, Optional
 
 import numpy as np
 
+from gofr_tpu import faults
 from gofr_tpu.serving.batcher import DynamicBatcher
 from gofr_tpu.serving.tokenizer import tokenizer_from_config
 
+from gofr_tpu.serving.lifecycle import CancelToken, Deadline, coalesce_deadline
 from gofr_tpu.serving.lora_runtime import LoRARuntimeMixin
 from gofr_tpu.serving.modalities import ModalityMixin
 from gofr_tpu.serving.programs import LLMProgramsMixin
@@ -46,6 +48,7 @@ from gofr_tpu.serving.types import (
     GenerationResult,
     LOGIT_BIAS_K,
 )
+from gofr_tpu.serving.watchdog import Watchdog
 
 
 class InferenceEngine(
@@ -84,6 +87,10 @@ class InferenceEngine(
         lora_slots: int = 0,
         lora_rank: int = 16,
         lora_targets: str = "wq,wk,wv,wo",
+        queue_max: int = 1024,
+        queue_max_tokens: int = 0,
+        expected_tps: float = 0.0,
+        watchdog_s: float = 0.0,
         params=None,
         logger=None,
         metrics=None,
@@ -187,6 +194,25 @@ class InferenceEngine(
         # cannot satisfy a new drain. It is a drain wake-up only — while
         # the engine is busy it may still be set from before.
         self._idle_evt = threading.Event()
+        # Admission control: token-budget accounting over the submit
+        # queue (guarded by the submit lock like every other admission
+        # flag) plus a throughput estimate for projected-wait shedding.
+        self.queue_max_tokens = max(0, queue_max_tokens)
+        self._queued_tokens = 0
+        self._expected_tps = max(0.0, expected_tps)
+        self._tps_ewma = 0.0
+        # Watchdog: latched unhealthy reason, reported by health_check
+        # and set (under the submit lock) by the trip callback.
+        self._unhealthy_reason: Optional[str] = None
+        self._watchdog: Optional[Watchdog] = None
+        if watchdog_s > 0:
+            self._watchdog = Watchdog(
+                watchdog_s,
+                on_trip=self._on_watchdog_trip,
+                metrics=metrics,
+                logger=logger,
+                model_name=model_name,
+            )
 
         if self.family == "llm":
             from gofr_tpu.ops.kv_cache import KVCache
@@ -315,7 +341,9 @@ class InferenceEngine(
             from collections import deque as _deque
 
             self._wait_kv: "_deque[_GenRequest]" = _deque()
-            self._pending: "queue.Queue[_GenRequest]" = queue.Queue(maxsize=1024)
+            self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
+                maxsize=max(1, queue_max)
+            )
             self._work = threading.Event()
             self._sched: Optional[threading.Thread] = None
             # Host→device uploads: on a mesh, place as a REPLICATED global
@@ -584,6 +612,18 @@ class InferenceEngine(
             kv_pool_blocks=int(
                 config.get_or_default("TPU_KV_POOL_BLOCKS", "0")
             ),
+            # Request-lifecycle resilience knobs (docs/advanced-guide/
+            # resilience.md): bounded submit queue + token budget,
+            # throughput prior for projected-wait shedding, and the
+            # scheduler watchdog's wall-time bound (0 = disabled).
+            queue_max=int(config.get_or_default("TPU_QUEUE_MAX", "1024")),
+            queue_max_tokens=int(
+                config.get_or_default("TPU_QUEUE_TOKENS", "0")
+            ),
+            expected_tps=float(
+                config.get_or_default("TPU_EXPECTED_TPS", "0")
+            ),
+            watchdog_s=float(config.get_or_default("TPU_WATCHDOG_S", "0")),
             logger=logger,
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
@@ -758,8 +798,13 @@ class InferenceEngine(
             self._drained = False
             self._draining = False
             self._fatal = None
+            self._unhealthy_reason = None
+            self._queued_tokens = 0
             self._idle_evt.clear()
         if self.family == "llm":
+            if self._watchdog is not None:
+                self._watchdog.reset()
+                self._watchdog.start()
             self._sched = threading.Thread(
                 target=self._scheduler_loop, name="tpu-scheduler", daemon=True
             )
@@ -806,6 +851,8 @@ class InferenceEngine(
         with self._submit_lock:
             self._running = False
         if self.family == "llm":
+            if self._watchdog is not None:
+                self._watchdog.stop()
             self._work.set()
             if self._sched is not None:
                 self._sched.join(timeout=10)
@@ -815,6 +862,16 @@ class InferenceEngine(
 
     def close(self) -> None:
         self.stop_sync()
+
+    def _on_watchdog_trip(self, reason: str) -> None:
+        """Watchdog callback: latch unhealthy and start a graceful
+        drain — new submissions get 503 (pointing traffic at healthy
+        replicas) while any work the stalled device eventually finishes
+        still reaches its callers. The flags hold the submit lock like
+        every other writer."""
+        with self._submit_lock:
+            self._unhealthy_reason = reason
+            self._draining = True
 
     # ------------------------------------------------------------------
     # public LLM API
@@ -827,7 +884,46 @@ class InferenceEngine(
         admission-room clamp in _dispatch_prefill_chunk enforces)."""
         return self.max_len - 2 - (self.pipeline_depth + 1) * self.window_k
 
+    def _throughput_tps(self) -> float:
+        """Tokens/sec estimate for projected-wait shedding: the operator
+        prior (TPU_EXPECTED_TPS) wins; otherwise the retirement-path
+        EWMA; 50 tok/s as the cold-start floor so a fresh engine never
+        divides by zero or sheds everything."""
+        if self._expected_tps > 0:
+            return self._expected_tps
+        if self._tps_ewma > 0:
+            return self._tps_ewma
+        return 50.0
+
+    def _projected_wait_s(self, cost_tokens: int) -> float:
+        """Seconds of queue ahead of a request costing ``cost_tokens``
+        (prompt + generation budget), from the queue's token backlog
+        over the throughput estimate. Reads under the submit lock."""
+        return (self._queued_tokens + cost_tokens) / self._throughput_tps()
+
+    def _note_dequeued(self, req: _GenRequest) -> None:
+        """Return a popped request's tokens to the submit budget."""
+        cost = len(req.prompt_ids) + req.max_new_tokens
+        with self._submit_lock:
+            self._queued_tokens = max(0, self._queued_tokens - cost)
+
+    def _shed(self, reason: str, retry_after_s: float) -> None:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_requests_shed_total",
+                "model", self.model_name, "reason", reason,
+            )
+        if self._logger is not None:
+            self._logger.warnf(
+                "shedding request (%s); retry in ~%.0fs",
+                reason, retry_after_s,
+            )
+
     def _enqueue(self, req: _GenRequest) -> None:
+        # Fault seam: a submit-path failure (serialization bug, OOM in
+        # bookkeeping) must reject THIS request, not wedge the engine.
+        faults.fire("engine.submit", engine=self, request=req)
+        cost = len(req.prompt_ids) + req.max_new_tokens
         # Check-and-enqueue under the drain lock: once the scheduler's final
         # drain has run, nothing may land in the queue (it would hang) —
         # and during a GRACEFUL drain nothing may land either (503; the
@@ -838,14 +934,60 @@ class InferenceEngine(
                 from gofr_tpu.errors import ErrorServiceUnavailable
 
                 raise ErrorServiceUnavailable(
-                    "engine draining for shutdown; retry against another "
-                    "replica"
+                    "engine draining for shutdown"
+                    + (
+                        f" (watchdog: {self._unhealthy_reason})"
+                        if self._unhealthy_reason else ""
+                    )
+                    + "; retry against another replica"
                 )
             if self._fatal is not None:
                 raise RuntimeError(f"engine scheduler died: {self._fatal}")
             if not self._running or self._drained:
                 raise RuntimeError("engine not started")
-            self._pending.put_nowait(req)
+            # Load shedding BEFORE admission (Orca/vLLM treat overload as
+            # first-class): a bounded token budget over the submit queue
+            # answers 429 + Retry-After instead of queueing unboundedly,
+            # and a request whose deadline cannot survive the projected
+            # queue wait is rejected NOW — burning a KV slot on a
+            # generation nobody will wait for helps no one.
+            from gofr_tpu.errors import (
+                ErrorDeadlineExceeded,
+                ErrorTooManyRequests,
+            )
+
+            wait_s = self._projected_wait_s(cost)
+            if (
+                self.queue_max_tokens
+                and self._queued_tokens + cost > self.queue_max_tokens
+            ):
+                self._shed("queue_tokens", wait_s)
+                raise ErrorTooManyRequests(
+                    f"submit queue token budget exhausted "
+                    f"({self._queued_tokens} queued + {cost} requested > "
+                    f"{self.queue_max_tokens}; TPU_QUEUE_TOKENS)",
+                    retry_after_s=wait_s,
+                )
+            if req.deadline is not None and (
+                req.deadline.expired()
+                or req.deadline.remaining() <= wait_s
+            ):
+                self._shed("deadline", wait_s)
+                raise ErrorDeadlineExceeded(
+                    f"projected queue wait {wait_s:.2f}s exceeds the "
+                    f"request deadline "
+                    f"({max(req.deadline.remaining(), 0.0):.2f}s left)"
+                )
+            try:
+                self._pending.put_nowait(req)
+            except queue.Full:
+                self._shed("queue_full", wait_s)
+                raise ErrorTooManyRequests(
+                    f"submit queue full ({self._pending.maxsize} requests; "
+                    f"TPU_QUEUE_MAX)",
+                    retry_after_s=wait_s,
+                ) from None
+            self._queued_tokens += cost
             self._sched_idle = False
         self._work.set()
 
@@ -863,6 +1005,9 @@ class InferenceEngine(
         logit_bias: "Optional[dict]" = None,
         top_logprobs: int = 0,
         adapter: str = "",
+        deadline: "Optional[Deadline]" = None,
+        deadline_s: "Optional[float]" = None,
+        cancel: "Optional[CancelToken]" = None,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -951,6 +1096,9 @@ class InferenceEngine(
                     f"logit_bias token ids must be in [0, "
                     f"{self.cfg.vocab_size}) and biases in [-100, 100]"
                 ])
+        # Fault seam: a tokenizer failure (corrupt vocab, bad merges row)
+        # must 500 this request and leave the engine serving.
+        faults.fire("engine.tokenize", prompt=prompt)
         ids = (
             self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         )
@@ -995,7 +1143,12 @@ class InferenceEngine(
             # reloaded/unloaded while this request is queued, admission
             # fails it instead of silently serving different weights.
             lora_gen=self._lora_gen[aid] if aid else 0,
+            deadline=coalesce_deadline(deadline, deadline_s),
         )
+        if cancel is not None:
+            # Share the transport's token (HTTP disconnect, gRPC cancel)
+            # so tripping it retires this sequence mid-decode.
+            req.cancel = cancel
         self._enqueue(req)
         return req
 
@@ -1071,6 +1224,16 @@ class InferenceEngine(
             "devices": [str(d) for d in devices],
             "running": self._running,
         }
+        unhealthy = self._unhealthy_reason
+        if self._watchdog is not None or unhealthy is not None:
+            details["watchdog"] = {
+                "tripped": unhealthy is not None,
+                "reason": unhealthy or "",
+                "bound_s": (
+                    self._watchdog.bound_s
+                    if self._watchdog is not None else 0.0
+                ),
+            }
         if self.family == "llm":
             details["kv_slots"] = {
                 "total": self.n_slots,
@@ -1097,4 +1260,5 @@ class InferenceEngine(
             # dropping the gauge silently.
             if self._logger is not None:
                 self._logger.debugf("memory_stats unavailable: %s", exc)
-        return {"status": "UP" if self._running else "DOWN", "details": details}
+        status = "UP" if self._running and unhealthy is None else "DOWN"
+        return {"status": status, "details": details}

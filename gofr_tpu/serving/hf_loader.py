@@ -283,13 +283,16 @@ def load_hf_llama(
     def to_device(x, quantize: bool, spec=None):
         x = jnp.asarray(x, dtype=dtype)
         if mesh is not None:
-            placed = jax.device_put(x, named_shardings(spec, mesh))
             if quantize and quant:
+                # The placed bf16 leaf is DONATED to the quantizer and
+                # never read again (graftlint GL007 scopes it to this
+                # branch).
+                placed = jax.device_put(x, named_shardings(spec, mesh))
                 return jax.jit(
                     qfn, donate_argnums=(0,),
                     out_shardings=named_shardings(qspec(spec), mesh),
                 )(placed)
-            return placed
+            return jax.device_put(x, named_shardings(spec, mesh))
         if quantize and quant:
             return jax.jit(qfn, donate_argnums=(0,))(jax.device_put(x))
         return jax.device_put(x)

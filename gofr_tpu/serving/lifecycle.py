@@ -1,0 +1,97 @@
+"""Request-lifecycle primitives: deadlines and cancellation tokens.
+
+The serving core's resilience contract (Orca/vLLM treat mid-stream
+eviction as first-class; SURVEY §2.6) needs two small, thread-safe
+objects that travel WITH a request from the edge (HTTP header, gRPC
+deadline) through ``serving/types.py`` into the scheduler loop:
+
+* :class:`Deadline` — an absolute expiry on an injectable monotonic
+  clock. The injectable clock is what makes deadline tests
+  deterministic: a test advances a fake clock instead of sleeping.
+* :class:`CancelToken` — a latch the transport layer trips when the
+  client disconnects (HTTP connection drop, gRPC stream cancel) so the
+  scheduler retires the sequence and frees its KV blocks within one
+  decode window instead of decoding for nobody.
+
+Both are checked by the scheduler's lifecycle reap
+(``scheduler._reap_lifecycle``) once per loop iteration — O(slots)
+host bookkeeping, no device traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Deadline:
+    """An absolute expiry measured on ``clock`` (monotonic seconds).
+
+    Use :meth:`after` for the common "N seconds from now" form. The
+    clock is injectable so tests can drive expiry deterministically.
+    """
+
+    __slots__ = ("expires_at", "_clock")
+
+    def __init__(
+        self,
+        expires_at: float,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.expires_at = expires_at
+        self._clock = clock
+
+    @classmethod
+    def after(
+        cls,
+        seconds: float,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> "Deadline":
+        return cls(clock() + seconds, clock)
+
+    def remaining(self) -> float:
+        """Seconds until expiry (negative once expired)."""
+        return self.expires_at - self._clock()
+
+    def expired(self) -> bool:
+        return self._clock() >= self.expires_at
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+class CancelToken:
+    """A one-way latch: once cancelled, stays cancelled.
+
+    ``threading.Event``-backed so any thread (asyncio transport
+    callback, gRPC cancel handler, test) can trip it and the scheduler
+    thread observes it without locking.
+    """
+
+    __slots__ = ("_evt",)
+
+    def __init__(self) -> None:
+        self._evt = threading.Event()
+
+    def cancel(self) -> None:
+        self._evt.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._evt.is_set()
+
+    def __repr__(self) -> str:
+        return f"CancelToken(cancelled={self.cancelled})"
+
+
+def coalesce_deadline(
+    deadline: Optional[Deadline], deadline_s: Optional[float]
+) -> Optional[Deadline]:
+    """An explicit Deadline wins (it may ride a test clock); otherwise a
+    relative budget becomes one on the real monotonic clock."""
+    if deadline is not None:
+        return deadline
+    if deadline_s is not None:
+        return Deadline.after(float(deadline_s))
+    return None

@@ -163,6 +163,12 @@ def add_openai_routes(
             f"availability"
         )
 
+    def _lifecycle(ctx) -> dict:
+        """Deadline (X-Request-Timeout) + cancel token (disconnect) from
+        the HTTP server, threaded into every engine submit so abandoned
+        or expired requests retire mid-decode and free their KV blocks."""
+        return dict(deadline=ctx.deadline, cancel=ctx.cancel_token)
+
     def _params(body: dict) -> dict:
         # Explicit nulls are legal per the OpenAI spec → fall back to
         # defaults instead of int(None)/float(None) crashes.
@@ -269,7 +275,24 @@ def add_openai_routes(
                     # The engine's retired result is authoritative: its
                     # text is already stop-trimmed, its finish_reason
                     # covers eos/budget/context-window.
-                    result = req.future.result(timeout=30)
+                    try:
+                        result = req.future.result(timeout=30)
+                    except Exception as exc:  # noqa: BLE001 — mapped to a terminal SSE error event below
+                        # Terminal error event: a deadline-exceeded or
+                        # engine-failed stream must END with an explicit
+                        # error, not silently truncate (the 200/SSE
+                        # headers are long gone, so the event stream is
+                        # the only error channel left).
+                        err = {
+                            "error": {
+                                "message": str(exc),
+                                "type": type(exc).__name__,
+                                "code": getattr(exc, "status_code", 500),
+                            }
+                        }
+                        yield f"data: {json.dumps(err)}\n\n"
+                        yield "data: [DONE]\n\n"
+                        return
                     reason = result.finish_reason
                     if (
                         engine.tokenizer is not None
@@ -308,8 +331,10 @@ def add_openai_routes(
             finally:
                 # Client disconnected (GeneratorExit via the server's
                 # aclose), stop sequence hit, or completed: cancel so the
-                # engine frees the KV slot instead of decoding for nobody.
-                req.future.cancel()
+                # engine frees the KV slot instead of decoding for nobody
+                # (cancel_request also trips the shared CancelToken the
+                # scheduler's lifecycle reap watches).
+                req.cancel_request()
 
         return Stream(chunks=events())
 
@@ -347,7 +372,7 @@ def add_openai_routes(
         body = _completion_body(ctx.request.raw.body)
         adapter = _check_model(body, engine)
         prompts = _normalize_prompts(body.get("prompt", ""))
-        params = dict(_params(body), adapter=adapter)
+        params = dict(_params(body), adapter=adapter, **_lifecycle(ctx))
         stop_seqs = _stop_list(body)
         streaming = bool(body.get("stream"))
         n = _n_choices(body, streaming)
@@ -443,7 +468,7 @@ def add_openai_routes(
                 prompt = template(messages)
         else:
             prompt = template(messages)
-        params = dict(_params(body), adapter=adapter)
+        params = dict(_params(body), adapter=adapter, **_lifecycle(ctx))
         stop_seqs = _stop_list(body)
         streaming = bool(body.get("stream"))
         n = _n_choices(body, streaming)

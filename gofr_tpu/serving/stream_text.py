@@ -90,7 +90,9 @@ async def stream_generation(
         if not finished:
             # Abnormal exit — cancel so the engine stops decoding for a
             # consumer that is gone (no-op on a completed future).
-            req.future.cancel()
+            # cancel_request also trips the request's CancelToken, which
+            # the scheduler's lifecycle reap retires within one window.
+            req.cancel_request()
 
 
 async def stream_seq2seq(engine, prompt, tokenizer) -> AsyncIterator[dict]:

@@ -11,6 +11,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Optional
 
+from gofr_tpu.serving.lifecycle import CancelToken, Deadline
+
 
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
@@ -108,6 +110,18 @@ class _GenRequest:
     # whose adapter was reloaded/unloaded in flight must not register).
     aid: int = 0
     lora_gen: int = 0
+    # Lifecycle: the scheduler's per-window reap retires the sequence
+    # (and frees its KV blocks) when the deadline expires or the cancel
+    # token trips — see serving/lifecycle.py and ``cancel_request``.
+    deadline: Optional[Deadline] = None
+    cancel: CancelToken = field(default_factory=CancelToken)
+
+    def cancel_request(self) -> None:
+        """Transport-side cancel (client disconnect / explicit abort):
+        trips the token the scheduler reaps on AND cancels the future so
+        a not-yet-admitted request resolves immediately."""
+        self.cancel.cancel()
+        self.future.cancel()
 
 
 @dataclass

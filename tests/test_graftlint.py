@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL006), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL007), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -338,6 +338,97 @@ def test_gl006_allows_narrow_or_handled_excepts(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL007 — donated-buffer reuse after donate_argnums
+# ----------------------------------------------------------------------
+
+
+def test_gl007_flags_read_after_donation(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        step = jax.jit(run, donate_argnums=(0,))
+
+        def bad(cache, tokens):
+            out = step(cache, tokens)
+            return out, cache.lengths  # donated buffer read back
+        """,
+        select=["GL007"],
+    )
+    assert ids == ["GL007"]
+    assert "donate" in findings[0].message
+
+
+def test_gl007_flags_immediately_invoked_jit_donation(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        def bad(params, quantize):
+            quantized = jax.jit(quantize, donate_argnums=(0,))(params)
+            total = sum(params.values())  # params' buffers are gone
+            return quantized, total
+        """,
+        select=["GL007"],
+    )
+    assert ids == ["GL007"]
+
+
+def test_gl007_allows_rebinding_and_reassignment(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        step = jax.jit(run, donate_argnums=(0,))
+
+        def good_rebind(cache, tokens):
+            cache = step(cache, tokens)   # idiomatic: result rebinds
+            return cache.lengths
+
+        def good_attr(self, tokens):
+            self.cache = step(self.cache, tokens)
+            return self.cache
+
+        def good_reassign(cache, tokens, fresh):
+            out = step(cache, tokens)
+            cache = fresh()               # new binding clears the taint
+            return out, cache
+
+        def good_no_donation(cache, tokens, plain):
+            out = plain(cache, tokens)    # not a donating wrapper
+            return out, cache
+        """,
+        select=["GL007"],
+    )
+    assert ids == []
+
+
+def test_gl007_scopes_do_not_leak(tmp_path):
+    # A donation inside one function must not taint another function's
+    # use of the same variable name; args evaluated as part of the
+    # donating call itself are pre-donation reads.
+    ids, _ = _lint(
+        tmp_path, "mod.py",
+        """
+        import jax
+
+        step = jax.jit(run, donate_argnums=(0,))
+
+        def donates(cache):
+            return step(cache, cache.lengths)  # arg reads: pre-donation
+
+        def unrelated(cache):
+            return cache.lengths
+        """,
+        select=["GL007"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -494,7 +585,9 @@ def test_scoped_select_does_not_rot_the_baseline(tmp_path, monkeypatch):
 def test_cli_list_rules_and_missing_path(capsys):
     assert main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006"):
+    for rule_id in (
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+    ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2
 

@@ -216,3 +216,86 @@ def test_retry_on_connection_error():
     )
     with pytest.raises(Exception):
         svc.get("/data")
+
+
+def test_retry_backoff_jitter_bounds():
+    """Jittered exponential backoff: every delay stays within
+    base·2^attempt scaled by [1 - jitter, 1 + jitter], capped at
+    max_backoff_s — and a pinned rng makes the draw deterministic."""
+    import random as _random
+
+    rng = _random.Random(7)
+    cfg = RetryConfig(
+        max_retries=3, backoff_s=0.1, jitter=0.5, max_backoff_s=0.3,
+        rng=rng.random,
+    )
+    for attempt in range(6):
+        base = min(0.1 * (2 ** attempt), 0.3)
+        for _ in range(50):
+            delay = cfg.delay_s(attempt)
+            assert base * 0.5 <= delay <= base * 1.5, (attempt, delay)
+    # Jitter actually varies the delay (fixed delays synchronize herds).
+    draws = {round(cfg.delay_s(0), 6) for _ in range(20)}
+    assert len(draws) > 1
+    # jitter=0 degrades to the fixed exponential schedule.
+    fixed = RetryConfig(backoff_s=0.1, jitter=0.0, rng=rng.random)
+    assert fixed.delay_s(0) == pytest.approx(0.1)
+    assert fixed.delay_s(2) == pytest.approx(0.4)
+    # Out-of-range jitter configs clamp instead of going negative.
+    weird = RetryConfig(backoff_s=0.1, jitter=5.0, rng=lambda: 0.0)
+    assert weird.delay_s(0) == pytest.approx(0.0)  # clamped to jitter=1
+
+
+def test_circuit_breaker_close_stops_probe_ticker(upstream):
+    """The probe ticker must die with the client — it used to keep
+    probing a dead address forever — and breaker state lands on the
+    app_http_service_circuit_open gauge."""
+    import threading as _threading
+
+    from gofr_tpu.metrics import new_metrics_manager
+
+    metrics = new_metrics_manager()
+    metrics.new_gauge("app_http_service_circuit_open")
+    svc = new_http_service(
+        upstream.address, None, metrics,
+        HealthConfig("/data"),
+        CircuitBreakerConfig(threshold=1, interval_s=0.05),
+    )
+    upstream.state["fail"] = True
+    try:
+        assert svc.get("/data").status_code == 500  # opens the breaker
+        gauge = {
+            i.name: i for i in metrics.instruments()
+        }["app_http_service_circuit_open"].collect()
+        assert list(gauge.values()) == [1.0]
+        ticker = svc._ticker
+        assert ticker is not None and ticker.is_alive()
+        svc.close()
+        assert not any(
+            t.name == "circuit-breaker-probe" and t.is_alive()
+            for t in _threading.enumerate()
+        )
+        assert svc._ticker is None
+    finally:
+        upstream.state["fail"] = False
+
+
+def test_circuit_breaker_recovery_clears_state_gauge(upstream):
+    from gofr_tpu.metrics import new_metrics_manager
+
+    metrics = new_metrics_manager()
+    metrics.new_gauge("app_http_service_circuit_open")
+    svc = new_http_service(
+        upstream.address, None, metrics,
+        HealthConfig("/data"),
+        CircuitBreakerConfig(threshold=1, interval_s=60),
+    )
+    upstream.state["fail"] = True
+    assert svc.get("/data").status_code == 500
+    upstream.state["fail"] = False
+    assert svc.get("/data").status_code == 200  # request-path recovery
+    gauge = {
+        i.name: i for i in metrics.instruments()
+    }["app_http_service_circuit_open"].collect()
+    assert list(gauge.values()) == [0.0]
+    svc.close()
