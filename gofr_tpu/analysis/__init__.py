@@ -17,7 +17,9 @@ graftlint is an AST-based rule engine purpose-built for this codebase:
 * ``GL005`` lock-discipline drift (shared attributes written both under
   and outside a lock) in the threaded serving core;
 * ``GL006`` broad exception handlers that silently swallow errors in
-  request paths.
+  request paths;
+* ``GL007`` donated-buffer reuse after ``donate_argnums``;
+* ``GL008`` ``jnp.asarray``/``jnp.array`` inside ``lax.scan`` bodies.
 
 Run it as ``python -m gofr_tpu.analysis [paths]``; suppress a finding
 in place with ``# graftlint: disable=GL001`` and record pre-existing

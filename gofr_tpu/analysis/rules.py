@@ -905,6 +905,85 @@ class DonatedBufferReuseRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL008 — jnp.asarray / jnp.array inside lax.scan bodies
+# ----------------------------------------------------------------------
+
+
+class ScanBodyAsarrayRule(Rule):
+    """``jnp.asarray`` / ``jnp.array`` inside a ``jax.lax.scan`` body
+    materializes its operand as a fresh constant (or convert op) in the
+    LOOP BODY: the tracer runs the body once, but the embedded constant
+    is baked per-compile and host data re-converts inside the hottest
+    region of the program — on TPU a large baked constant bloats the
+    executable and a per-iteration convert defeats the reason the layer
+    stack was scanned in the first place. Hoist the conversion out of
+    the body (close over a device array, or thread it through the scan
+    carry/xs).
+
+    Recognized bodies: a named function or lambda passed as the first
+    argument (or ``f=`` keyword) of ``lax.scan`` / ``jax.lax.scan``.
+    Factory calls (``scan(make_body(...), ...)``) are out of reach
+    statically and deliberately skipped — conservative by design.
+    """
+
+    rule_id = "GL008"
+    name = "scan-body-asarray"
+    rationale = (
+        "jnp.asarray/jnp.array in a lax.scan body bakes a constant or "
+        "re-converts host data inside the scanned region; hoist it out "
+        "of the body"
+    )
+
+    _CONVERTERS = {
+        "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    }
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        defs: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+        seen: set[int] = set()  # one body scanned twice reports once
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            if fname not in ("lax.scan", "jax.lax.scan"):
+                continue
+            body_expr: Optional[ast.AST] = None
+            if node.args:
+                body_expr = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "f":
+                        body_expr = kw.value
+                        break
+            body: Optional[ast.AST] = None
+            if isinstance(body_expr, ast.Lambda):
+                body = body_expr
+            elif isinstance(body_expr, ast.Name):
+                body = defs.get(body_expr.id)
+            if body is None or id(body) in seen:
+                continue
+            seen.add(id(body))
+            yield from self._check_body(body, ctx)
+
+    def _check_body(self, body: ast.AST, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            if fname in self._CONVERTERS:
+                yield self.finding(
+                    ctx, node,
+                    f"`{fname}` inside a `lax.scan` body bakes a "
+                    "constant / re-converts host data in the scanned "
+                    "region; hoist it out of the body (close over a "
+                    "device array or thread it through the carry)",
+                )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -916,6 +995,7 @@ ALL_RULES = (
     LockDisciplineRule,
     ExceptionSwallowRule,
     DonatedBufferReuseRule,
+    ScanBodyAsarrayRule,
 )
 
 
@@ -929,4 +1009,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         LockDisciplineRule(config.hot_path_files),
         ExceptionSwallowRule(config.request_path_dirs),
         DonatedBufferReuseRule(),
+        ScanBodyAsarrayRule(),
     ]

@@ -175,6 +175,22 @@ class Container:
             "app_tpu_watchdog_trips_total",
             "scheduler watchdog trips (stalled device step)",
         )
+        # Self-healing supervision (serving/supervisor.py): warm engine
+        # restarts, requests carried across them, and the health state
+        # machine (0=SERVING 1=DEGRADED 2=RESTARTING 3=DOWN).
+        m.new_counter(
+            "app_tpu_engine_restarts_total",
+            "supervisor warm restarts after a trip or scheduler crash",
+        )
+        m.new_counter(
+            "app_tpu_requests_replayed_total",
+            "in-flight requests replayed across an engine restart",
+        )
+        m.new_gauge(
+            "app_tpu_engine_state",
+            "engine health state machine "
+            "(0=SERVING 1=DEGRADED 2=RESTARTING 3=DOWN)",
+        )
         m.new_gauge(
             "app_http_service_circuit_open",
             "circuit breaker state per downstream service (1 = open)",

@@ -20,7 +20,11 @@ Determinism rules this module enforces by design:
 * no randomness — a fault fires on exact hit counts (``after`` skips,
   ``times`` bounds), never probabilistically;
 * no timers — "slow" is modeled by test-controlled events, "expired"
-  by injectable clocks (``serving/lifecycle.py``), never ``sleep``.
+  by injectable clocks (``serving/lifecycle.py``), never ``sleep``;
+* re-arming a point replaces its registry entry WITHOUT touching an
+  in-flight action from the previous arming — the chaos suite
+  (``tests/test_supervisor.py``) relies on this to park a thread with a
+  stall, then swap in the raise that kills its next iteration.
 """
 
 from __future__ import annotations

@@ -38,6 +38,7 @@ class InferenceServicer:
         self.tokenizer = tokenizer or engine.tokenizer
 
     def _gen_kwargs(self, request, stream: bool, context=None) -> dict:
+        from gofr_tpu.grpc.server import tenant_from_context
         from gofr_tpu.serving.stream_text import normalize_stop
 
         kw = dict(
@@ -46,6 +47,12 @@ class InferenceServicer:
             stop_on_eos=bool(request.get("stop_on_eos", not stream)),
             stop=normalize_stop(request.get("stop")),
         )
+        if context is not None:
+            # Per-tenant admission quotas (TPU_TENANT_QUEUE_MAX): the
+            # x-tenant-id metadata is the gRPC twin of the HTTP header.
+            tenant = tenant_from_context(context)
+            if tenant:
+                kw["tenant"] = tenant
         if request.get("top_p") is not None:
             kw["top_p"] = float(request["top_p"])
         if request.get("adapter"):

@@ -31,7 +31,10 @@ class TypedInferenceServicer(_Base):
         self.tokenizer = tokenizer or engine.tokenizer
 
     def _gen_kwargs(self, request, context=None) -> tuple:
-        from gofr_tpu.grpc.server import deadline_from_context
+        from gofr_tpu.grpc.server import (
+            deadline_from_context,
+            tenant_from_context,
+        )
 
         prompt = (
             list(request.prompt_ids) if request.prompt_ids else request.prompt
@@ -47,6 +50,11 @@ class TypedInferenceServicer(_Base):
         if request.adapter:
             kw["adapter"] = request.adapter
         if context is not None:
+            # Per-tenant admission quotas (TPU_TENANT_QUEUE_MAX): the
+            # x-tenant-id metadata is the gRPC twin of the HTTP header.
+            tenant = tenant_from_context(context)
+            if tenant:
+                kw["tenant"] = tenant
             # Caller's gRPC deadline → engine Deadline: when it expires
             # the scheduler retires the sequence and frees its KV blocks
             # instead of decoding past an RPC nobody is waiting on.

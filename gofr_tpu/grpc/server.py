@@ -37,6 +37,22 @@ def grpc_status_code(exc: BaseException) -> "grpc.StatusCode":
     return grpc.StatusCode.INTERNAL
 
 
+def tenant_from_context(context) -> str:
+    """The ``x-tenant-id`` invocation-metadata value ("" when absent) —
+    the gRPC twin of the HTTP header feeding per-tenant admission
+    quotas (``TPU_TENANT_QUEUE_MAX``)."""
+    meta = getattr(context, "invocation_metadata", None)
+    if not callable(meta):
+        return ""
+    try:
+        for key, value in meta() or ():
+            if str(key).lower() == "x-tenant-id":
+                return str(value)
+    except Exception:  # graftlint: disable=GL006 — absent/stub metadata APIs mean "untenanted", not an error
+        return ""
+    return ""
+
+
 def deadline_from_context(context) -> Optional[float]:
     """Seconds remaining on the caller's gRPC deadline, or None. The
     servicers turn this into a ``Deadline`` on engine submits so an

@@ -3,6 +3,8 @@
 The graft the reference never had: a JAX/XLA inference backend living in the
 container like any other datasource (``TPU()`` member), a dynamic batcher
 coalescing concurrent requests into padded executions, a slot-based KV cache
-for autoregressive decode, and per-chip observability on the framework
-metrics registry.
+for autoregressive decode, per-chip observability on the framework
+metrics registry, and a self-healing supervision layer
+(``supervisor.py``) that warm-restarts a tripped or crashed engine and
+replays its in-flight requests.
 """

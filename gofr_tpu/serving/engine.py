@@ -36,7 +36,12 @@ from gofr_tpu import faults
 from gofr_tpu.serving.batcher import DynamicBatcher
 from gofr_tpu.serving.tokenizer import tokenizer_from_config
 
-from gofr_tpu.serving.lifecycle import CancelToken, Deadline, coalesce_deadline
+from gofr_tpu.serving.lifecycle import (
+    AggregateThroughput,
+    CancelToken,
+    Deadline,
+    coalesce_deadline,
+)
 from gofr_tpu.serving.lora_runtime import LoRARuntimeMixin
 from gofr_tpu.serving.modalities import ModalityMixin
 from gofr_tpu.serving.programs import LLMProgramsMixin
@@ -89,6 +94,7 @@ class InferenceEngine(
         lora_targets: str = "wq,wk,wv,wo",
         queue_max: int = 1024,
         queue_max_tokens: int = 0,
+        tenant_queue_max: int = 0,
         expected_tps: float = 0.0,
         watchdog_s: float = 0.0,
         params=None,
@@ -188,6 +194,19 @@ class InferenceEngine(
         # request can never be enqueued after the drain has already run.
         self._submit_lock = threading.Lock()
         self._drained = False
+        # Supervision (serving/supervisor.py): the attached supervisor (if
+        # any) owns the restart policy; the scheduler epoch brands each
+        # scheduler thread so one abandoned mid-wedge can never drain or
+        # dispatch against a restarted engine's state; salvaged retryable
+        # requests park in _replay until the supervisor requeues them.
+        self._supervisor: Optional[Any] = None
+        self._epoch = 0
+        self._replay: list[_GenRequest] = []
+        self._restart_pending = False  # supervisor teardown in progress
+        # Health state machine (SERVING → DEGRADED → RESTARTING → DOWN),
+        # surfaced via health_check / both gRPC Health RPCs and the
+        # app_tpu_engine_state gauge. DOWN until start_sync.
+        self._state = "DOWN"
         # Set by the scheduler when it publishes "verifiably idle" and on
         # exit; the graceful drain clears it (under the submit lock)
         # before waiting, so a stale set from an earlier idle period
@@ -197,10 +216,19 @@ class InferenceEngine(
         # Admission control: token-budget accounting over the submit
         # queue (guarded by the submit lock like every other admission
         # flag) plus a throughput estimate for projected-wait shedding.
+        self.queue_max = max(1, queue_max)
         self.queue_max_tokens = max(0, queue_max_tokens)
         self._queued_tokens = 0
         self._expected_tps = max(0.0, expected_tps)
-        self._tps_ewma = 0.0
+        # Sliding-window AGGREGATE tokens/sec across the whole batch —
+        # the shedding denominator. (The previous per-request EWMA
+        # underestimated batched throughput by ~the batch size and shed
+        # correspondingly too eagerly.)
+        self._tput = AggregateThroughput()
+        # Per-tenant admission quota (TPU_TENANT_QUEUE_MAX): queued
+        # request count per X-Tenant-Id, guarded by the submit lock.
+        self.tenant_queue_max = max(0, tenant_queue_max)
+        self._tenant_queued: dict[str, int] = {}
         # Watchdog: latched unhealthy reason, reported by health_check
         # and set (under the submit lock) by the trip callback.
         self._unhealthy_reason: Optional[str] = None
@@ -215,8 +243,6 @@ class InferenceEngine(
             )
 
         if self.family == "llm":
-            from gofr_tpu.ops.kv_cache import KVCache
-
             self.max_len = min(max_len, self.cfg.max_len)
             self.n_slots = n_slots
             self.window_k = max(1, window_k)
@@ -263,9 +289,9 @@ class InferenceEngine(
             # Paged KV (TPU_KV_BLOCK>0): block-pool cache + host allocator
             # — HBM scales with resident tokens, not slots × max_len.
             self.kv_block = max(0, kv_block)
+            self.kv_pool_blocks = kv_pool_blocks
+            self.prefix_slots = max(0, prefix_slots)
             if self.kv_block:
-                from gofr_tpu.ops.kv_cache import PagedKVCache
-
                 if self.max_len % self.kv_block:
                     raise ValueError(
                         f"max_len={self.max_len} must be a multiple of "
@@ -276,75 +302,6 @@ class InferenceEngine(
                         "prefix-KV reuse and the paged cache are mutually "
                         "exclusive (the pool copies slot rows)"
                     )
-                make_cache = lambda: PagedKVCache.create(  # noqa: E731
-                    self.cfg.n_layers, n_slots, self.max_len,
-                    self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
-                    quant=self.kv_quant, block=self.kv_block,
-                    n_blocks=kv_pool_blocks,
-                )
-            else:
-                make_cache = lambda: KVCache.create(  # noqa: E731
-                    self.cfg.n_layers, n_slots, self.max_len,
-                    self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
-                    quant=self.kv_quant,
-                )
-            if mesh is not None:
-                # KV heads shard over tp, the length axis over cp —
-                # same layout prefill and decode.
-                from gofr_tpu.models.transformer import kv_cache_specs
-                from gofr_tpu.parallel.sharding import (
-                    named_shardings,
-                    prune_specs,
-                )
-
-                self.cache = jax.jit(
-                    make_cache,
-                    out_shardings=named_shardings(
-                        prune_specs(
-                            kv_cache_specs(
-                                quantized=bool(self.kv_quant),
-                                paged=bool(self.kv_block),
-                                cp="cp" in mesh.axis_names,
-                            ),
-                            mesh,
-                        ),
-                        mesh,
-                    ),
-                )()
-            else:
-                self.cache = make_cache()
-            if self.kv_block:
-                # Host-side block allocator: block 0 is the parking block
-                # and never handed out; the table mirror uploads (8 KB)
-                # only when an admission/top-up/release dirtied it.
-                self._free_blocks = list(range(1, self.cache.n_blocks))
-                self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
-                self._table_host = np.zeros(
-                    (n_slots, self.max_len // self.kv_block), dtype=np.int32
-                )
-                self._table_dirty = False
-                self._dispatched_tokens = [0] * n_slots
-            # Prefix-KV reuse: shared system prompts prefill once into a
-            # device pool; admission copies rows in (prefix_cache.py).
-            self._prefix_pool = None
-            if prefix_slots > 0:
-                from gofr_tpu.serving.prefix_cache import PrefixPool
-
-                self._prefix_pool = PrefixPool(
-                    prefix_slots, self.cache, mesh=mesh
-                )
-            self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
-            self._prefilling: dict[int, _PrefillState] = {}
-            # (first_dev, first_lp_dev, row, slot, seq) awaiting async fetch.
-            self._prefill_emits: list = []
-            # Paged mode: requests held back waiting for free pool blocks.
-            from collections import deque as _deque
-
-            self._wait_kv: "_deque[_GenRequest]" = _deque()
-            self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
-                maxsize=max(1, queue_max)
-            )
-            self._work = threading.Event()
             self._sched: Optional[threading.Thread] = None
             # Host→device uploads: on a mesh, place as a REPLICATED global
             # array — on a multi-host (DCN) mesh a bare jnp.asarray would
@@ -377,24 +334,6 @@ class InferenceEngine(
                 self._lockstep = (
                     multiproc and jax.default_backend() != "tpu"
                 )
-            self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
-            self._logps_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
-            # Slot state lives ON DEVICE between windows; re-uploaded only
-            # when admissions/retirements change it (dirty flag). Steady-
-            # state decode then dispatches with zero host→device traffic.
-            # Sampling is counter-based (seed, n_sampled) per slot — no
-            # PRNG key threads through device state at all.
-            self._nsteps_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
-            self._seeds_host = np.zeros((n_slots,), dtype=np.int32)
-            self._seeds_dev = self._up(self._seeds_host)
-            self._seeds_dirty = False
-            # Multi-LoRA adapter plane: per-slot adapter index into the
-            # stacked [L, 1+lora_slots, ...] adapter leaves (0 = base).
-            # Allocated unconditionally so every compiled signature is
-            # uniform; without adapter leaves in params the operand is
-            # dead and XLA drops it.
-            self._aids_host = np.zeros((n_slots,), dtype=np.int32)
-            self._aids_dev = self._up(self._aids_host)
             # Host-side default-seed source for requests without one: each
             # unseeded request gets a fresh draw (OpenAI semantics), while
             # an explicit seed reproduces exactly. Single-process engines
@@ -408,40 +347,6 @@ class InferenceEngine(
             self._seed_rng = (
                 _random.Random(seed + 3) if multiproc
                 else _random.Random(os.urandom(16))
-            )
-            self._active_dev = self._up(np.zeros((n_slots,), dtype=bool))
-            self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
-            self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
-            self._greedy_dev = self._up(np.ones((n_slots,), dtype=bool))
-            # Penalties state: per-slot generated-token counts (a [1]-wide
-            # dummy when the feature is compiled out keeps one signature).
-            pv = self.cfg.vocab_size if self.enable_penalties else 1
-            self._pcounts_dev = self._up(
-                np.zeros((n_slots, pv), dtype=np.int32)
-            )
-            self._fpen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
-            self._ppen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
-            self._bidx_host = np.full(
-                (n_slots, LOGIT_BIAS_K), -1, dtype=np.int32
-            )
-            self._bval_host = np.zeros(
-                (n_slots, LOGIT_BIAS_K), dtype=np.float32
-            )
-            self._bidx_dev = self._up(self._bidx_host)
-            self._bval_dev = self._up(self._bval_host)
-            tlk = max(1, self.top_logprobs)
-            self._topi_dev = self._up(
-                np.zeros((n_slots, tlk), dtype=np.int32)
-            )
-            self._topl_dev = self._up(
-                np.zeros((n_slots, tlk), dtype=np.float32)
-            )
-            self._slot_state_dirty = True
-            # Token history per slot (prompt + generated) — the n-gram
-            # draft source; only maintained when speculation is on.
-            self._history_dev = (
-                self._up(np.zeros((n_slots, self.max_len), dtype=np.int32))
-                if self.spec_tokens else None
             )
             # Multi-LoRA serving: merge zeroed stacked adapter leaves
             # into params["layers"] (slot 0 = base; load_lora fills
@@ -486,6 +391,11 @@ class InferenceEngine(
                     **self.params,
                     "layers": {**self.params["layers"], **leaves},
                 }
+            # Per-boot serving state (KV cache, allocator, queues, device
+            # planes) lives in its own method so the supervisor's warm
+            # restart can rebuild it without re-initializing params or
+            # recompiling programs.
+            self._init_llm_serving_state()
             self._build_llm_steps()
         elif self.family == "encoder":
             self.max_len = min(max_len, self.cfg.max_len)
@@ -620,6 +530,9 @@ class InferenceEngine(
             queue_max_tokens=int(
                 config.get_or_default("TPU_QUEUE_TOKENS", "0")
             ),
+            tenant_queue_max=int(
+                config.get_or_default("TPU_TENANT_QUEUE_MAX", "0")
+            ),
             expected_tps=float(
                 config.get_or_default("TPU_EXPECTED_TPS", "0")
             ),
@@ -650,6 +563,23 @@ class InferenceEngine(
                     )
                 name, path = entry.split("=", 1)
                 engine.load_lora(name.strip(), path.strip())
+        # Self-healing (docs/advanced-guide/resilience.md): TPU_RESTART_MAX
+        # > 0 attaches a supervisor that owns the restart policy — watchdog
+        # trips and fatal scheduler exits tear down, back off, warm-restart
+        # and replay retryable requests instead of latching DOWN.
+        restart_max = int(config.get_or_default("TPU_RESTART_MAX", "0"))
+        if restart_max > 0 and engine.family == "llm":
+            from gofr_tpu.serving.supervisor import EngineSupervisor
+
+            EngineSupervisor(
+                engine,
+                max_restarts=restart_max,
+                backoff_s=float(
+                    config.get_or_default("TPU_RESTART_BACKOFF_S", "0.5")
+                ),
+                metrics=metrics,
+                logger=logger,
+            ).start()
         return engine
 
     def _init_llm_quantized(self, seed: int) -> dict:
@@ -705,6 +635,152 @@ class InferenceEngine(
             "final_norm": make("final_norm", shapes["final_norm"]),
             "lm_head": make("lm_head", shapes["lm_head"]),
         }
+
+    def _init_llm_serving_state(self) -> None:
+        """(Re)build every per-boot LLM serving structure: the KV cache
+        (and its paged-pool allocator), the prefix pool, the admission
+        queues, and the device-resident slot-state planes.
+
+        Called from ``__init__`` and again from :meth:`restart_sync` —
+        the supervisor's warm restart. Params and compiled programs are
+        deliberately NOT touched: a restart reuses the already-loaded
+        pytree and the jit caches, so recovery costs cache allocation,
+        not a model load + compile. Everything rebuilt here is either
+        derived state (KV contents are re-prefilled by request replay)
+        or bookkeeping a crashed/abandoned scheduler may have left
+        inconsistent.
+        """
+        jax = self._jax
+        mesh = self.mesh
+        n_slots = self.n_slots
+        from gofr_tpu.ops.kv_cache import KVCache
+
+        if self.kv_block:
+            from gofr_tpu.ops.kv_cache import PagedKVCache
+
+            make_cache = lambda: PagedKVCache.create(  # noqa: E731
+                self.cfg.n_layers, n_slots, self.max_len,
+                self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+                quant=self.kv_quant, block=self.kv_block,
+                n_blocks=self.kv_pool_blocks,
+            )
+        else:
+            make_cache = lambda: KVCache.create(  # noqa: E731
+                self.cfg.n_layers, n_slots, self.max_len,
+                self.cfg.n_kv_heads, self.cfg.head_dim, self.cfg.dtype,
+                quant=self.kv_quant,
+            )
+        if mesh is not None:
+            # KV heads shard over tp, the length axis over cp —
+            # same layout prefill and decode.
+            from gofr_tpu.models.transformer import kv_cache_specs
+            from gofr_tpu.parallel.sharding import (
+                named_shardings,
+                prune_specs,
+            )
+
+            self.cache = jax.jit(
+                make_cache,
+                out_shardings=named_shardings(
+                    prune_specs(
+                        kv_cache_specs(
+                            quantized=bool(self.kv_quant),
+                            paged=bool(self.kv_block),
+                            cp="cp" in mesh.axis_names,
+                        ),
+                        mesh,
+                    ),
+                    mesh,
+                ),
+            )()
+        else:
+            self.cache = make_cache()
+        if self.kv_block:
+            # Host-side block allocator: block 0 is the parking block
+            # and never handed out; the table mirror uploads (8 KB)
+            # only when an admission/top-up/release dirtied it.
+            self._free_blocks = list(range(1, self.cache.n_blocks))
+            self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
+            self._table_host = np.zeros(
+                (n_slots, self.max_len // self.kv_block), dtype=np.int32
+            )
+            self._table_dirty = False
+            self._dispatched_tokens = [0] * n_slots
+        # Prefix-KV reuse: shared system prompts prefill once into a
+        # device pool; admission copies rows in (prefix_cache.py). A
+        # restart builds a FRESH pool — the old rows died with the old
+        # cache, so callers re-register (register_prefix documents this).
+        self._prefix_pool = None
+        if self.prefix_slots > 0:
+            from gofr_tpu.serving.prefix_cache import PrefixPool
+
+            self._prefix_pool = PrefixPool(
+                self.prefix_slots, self.cache, mesh=mesh
+            )
+        self._slots: list[Optional[_ActiveSeq]] = [None] * n_slots
+        self._prefilling: dict[int, _PrefillState] = {}
+        # (first_dev, first_lp_dev, row, slot, seq) awaiting async fetch.
+        self._prefill_emits: list = []
+        # Paged mode: requests held back waiting for free pool blocks.
+        from collections import deque as _deque
+
+        self._wait_kv: "_deque[_GenRequest]" = _deque()
+        self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
+            maxsize=self.queue_max
+        )
+        self._work = threading.Event()
+        self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
+        self._logps_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
+        # Slot state lives ON DEVICE between windows; re-uploaded only
+        # when admissions/retirements change it (dirty flag). Steady-
+        # state decode then dispatches with zero host→device traffic.
+        # Sampling is counter-based (seed, n_sampled) per slot — no
+        # PRNG key threads through device state at all.
+        self._nsteps_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
+        self._seeds_host = np.zeros((n_slots,), dtype=np.int32)
+        self._seeds_dev = self._up(self._seeds_host)
+        self._seeds_dirty = False
+        # Multi-LoRA adapter plane: per-slot adapter index into the
+        # stacked [L, 1+lora_slots, ...] adapter leaves (0 = base).
+        # Allocated unconditionally so every compiled signature is
+        # uniform; without adapter leaves in params the operand is
+        # dead and XLA drops it.
+        self._aids_host = np.zeros((n_slots,), dtype=np.int32)
+        self._aids_dev = self._up(self._aids_host)
+        self._active_dev = self._up(np.zeros((n_slots,), dtype=bool))
+        self._temps_dev = self._up(np.ones((n_slots,), dtype=np.float32))
+        self._topp_dev = self._up(np.ones((n_slots,), dtype=np.float32))
+        self._greedy_dev = self._up(np.ones((n_slots,), dtype=bool))
+        # Penalties state: per-slot generated-token counts (a [1]-wide
+        # dummy when the feature is compiled out keeps one signature).
+        pv = self.cfg.vocab_size if self.enable_penalties else 1
+        self._pcounts_dev = self._up(
+            np.zeros((n_slots, pv), dtype=np.int32)
+        )
+        self._fpen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
+        self._ppen_dev = self._up(np.zeros((n_slots,), dtype=np.float32))
+        self._bidx_host = np.full(
+            (n_slots, LOGIT_BIAS_K), -1, dtype=np.int32
+        )
+        self._bval_host = np.zeros(
+            (n_slots, LOGIT_BIAS_K), dtype=np.float32
+        )
+        self._bidx_dev = self._up(self._bidx_host)
+        self._bval_dev = self._up(self._bval_host)
+        tlk = max(1, self.top_logprobs)
+        self._topi_dev = self._up(
+            np.zeros((n_slots, tlk), dtype=np.int32)
+        )
+        self._topl_dev = self._up(
+            np.zeros((n_slots, tlk), dtype=np.float32)
+        )
+        self._slot_state_dirty = True
+        # Token history per slot (prompt + generated) — the n-gram
+        # draft source; only maintained when speculation is on.
+        self._history_dev = (
+            self._up(np.zeros((n_slots, self.max_len), dtype=np.int32))
+            if self.spec_tokens else None
+        )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -797,10 +873,14 @@ class InferenceEngine(
             self._running = True
             self._drained = False
             self._draining = False
+            self._restart_pending = False
             self._fatal = None
             self._unhealthy_reason = None
             self._queued_tokens = 0
+            self._tenant_queued.clear()
             self._idle_evt.clear()
+        self._tput.reset()
+        self._set_state("SERVING")
         if self.family == "llm":
             if self._watchdog is not None:
                 self._watchdog.reset()
@@ -859,19 +939,123 @@ class InferenceEngine(
                 self._sched = None
         else:
             self._batcher.stop()
+        self._set_state("DOWN")
 
     def close(self) -> None:
+        # An attached supervisor must not resurrect an engine the
+        # operator is closing (and its thread must not leak).
+        sup = self._supervisor
+        if sup is not None:
+            sup.stop()
         self.stop_sync()
+        if sup is not None:
+            # Final sweep: a scheduler crash racing this close may have
+            # parked requests for replay after stop()'s own drain;
+            # nothing will ever requeue them now (idempotent pop-and-
+            # fail under the submit lock).
+            sup.drain_parked()
+
+    # ------------------------------------------------------------------
+    # supervision (serving/supervisor.py)
+    # ------------------------------------------------------------------
+
+    def attach_supervisor(self, supervisor: Any) -> None:
+        """Hand the restart policy to ``supervisor``: watchdog trips and
+        fatal scheduler exits notify it instead of latching DOWN until
+        an operator intervenes, and the scheduler's death drain parks
+        retryable requests for replay instead of failing them."""
+        self._supervisor = supervisor
+
+    def _set_state(self, state: str) -> None:
+        """Health state machine transition (SERVING → DEGRADED →
+        RESTARTING → DOWN), mirrored to the app_tpu_engine_state gauge
+        (0=SERVING 1=DEGRADED 2=RESTARTING 3=DOWN)."""
+        self._state = state
+        if self._metrics is not None:
+            order = {"SERVING": 0, "DEGRADED": 1, "RESTARTING": 2, "DOWN": 3}
+            self._metrics.set_gauge(
+                "app_tpu_engine_state", order.get(state, 3),
+                "model", self.model_name,
+            )
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def restart_sync(self) -> None:
+        """Warm restart (the supervisor's recovery step): rebuild the
+        per-boot serving state — KV cache, paged-pool allocator, queues,
+        device slot planes — and start a fresh scheduler, REUSING the
+        already-loaded params pytree and the compiled programs. A failed
+        device dispatch may have consumed donated buffers (cache, token
+        planes), so everything donated is rebuilt; params are never
+        donated by the serving programs and survive as-is."""
+        if self.family != "llm":
+            self.stop_sync()
+            self.start_sync()
+            return
+        if self._running:
+            self.stop_sync()
+        self._init_llm_serving_state()
+        self.start_sync()
+
+    def requeue_replay(self, req: _GenRequest) -> bool:
+        """Re-admit a salvaged request after a restart, bypassing the
+        admission shedders (it was admitted before the crash; shedding
+        the replay would fail a client the restart exists to save).
+        Returns False when the request stopped being retryable during
+        the restart (cancelled / deadline expired) or the fresh queue is
+        already full — the caller fails it with the terminal error path.
+        """
+        if not req.retryable():
+            return False
+        # Admission-scoped fields reset so the fresh scheduler re-admits
+        # from scratch; prefill_ids() covers the already-emitted tokens.
+        req.effective_prompt_len = 0
+        req.replays += 1
+        req.replayed_tokens = len(req.token_ids)
+        cost = len(req.prompt_ids) + req.max_new_tokens
+        with self._submit_lock:
+            if not self._running or self._drained or self._draining:
+                return False
+            try:
+                self._pending.put_nowait(req)
+            except queue.Full:
+                return False
+            self._queued_tokens += cost
+            if self.tenant_queue_max and req.tenant:
+                self._tenant_queued[req.tenant] = (
+                    self._tenant_queued.get(req.tenant, 0) + 1
+                )
+            self._sched_idle = False
+        self._work.set()
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_requests_replayed_total", "model", self.model_name
+            )
+        if self._logger is not None:
+            self._logger.infof(
+                "replayed request after restart (%d token(s) already "
+                "delivered, %d remaining)",
+                len(req.token_ids), req.max_new_tokens - len(req.token_ids),
+            )
+        return True
 
     def _on_watchdog_trip(self, reason: str) -> None:
         """Watchdog callback: latch unhealthy and start a graceful
         drain — new submissions get 503 (pointing traffic at healthy
         replicas) while any work the stalled device eventually finishes
         still reaches its callers. The flags hold the submit lock like
-        every other writer."""
+        every other writer. With a supervisor attached the trip also
+        requests a restart instead of staying latched until an operator
+        intervenes."""
         with self._submit_lock:
             self._unhealthy_reason = reason
             self._draining = True
+        self._set_state("DEGRADED")
+        sup = self._supervisor
+        if sup is not None:
+            sup.notify_trip(reason)
 
     # ------------------------------------------------------------------
     # public LLM API
@@ -886,13 +1070,17 @@ class InferenceEngine(
 
     def _throughput_tps(self) -> float:
         """Tokens/sec estimate for projected-wait shedding: the operator
-        prior (TPU_EXPECTED_TPS) wins; otherwise the retirement-path
-        EWMA; 50 tok/s as the cold-start floor so a fresh engine never
-        divides by zero or sheds everything."""
+        prior (TPU_EXPECTED_TPS) wins; otherwise the sliding-window
+        AGGREGATE rate across the whole batch (lifecycle.
+        AggregateThroughput — a per-request rate underestimates batched
+        throughput by ~the batch size and sheds too eagerly); 50 tok/s
+        as the cold-start floor so a fresh engine never divides by zero
+        or sheds everything."""
         if self._expected_tps > 0:
             return self._expected_tps
-        if self._tps_ewma > 0:
-            return self._tps_ewma
+        rate = self._tput.rate()
+        if rate > 0:
+            return rate
         return 50.0
 
     def _projected_wait_s(self, cost_tokens: int) -> float:
@@ -902,10 +1090,17 @@ class InferenceEngine(
         return (self._queued_tokens + cost_tokens) / self._throughput_tps()
 
     def _note_dequeued(self, req: _GenRequest) -> None:
-        """Return a popped request's tokens to the submit budget."""
+        """Return a popped request's tokens (and its tenant-quota seat)
+        to the submit budgets."""
         cost = len(req.prompt_ids) + req.max_new_tokens
         with self._submit_lock:
             self._queued_tokens = max(0, self._queued_tokens - cost)
+            if req.tenant and req.tenant in self._tenant_queued:
+                left = self._tenant_queued[req.tenant] - 1
+                if left > 0:
+                    self._tenant_queued[req.tenant] = left
+                else:  # drop empty entries: the dict stays O(live tenants)
+                    del self._tenant_queued[req.tenant]
 
     def _shed(self, reason: str, retry_after_s: float) -> None:
         if self._metrics is not None:
@@ -957,6 +1152,22 @@ class InferenceEngine(
             )
 
             wait_s = self._projected_wait_s(cost)
+            # Per-tenant quota FIRST (TPU_TENANT_QUEUE_MAX): one tenant
+            # flooding the queue is shed on ITS OWN budget before it can
+            # exhaust the global one for everyone else.
+            if (
+                self.tenant_queue_max
+                and req.tenant
+                and self._tenant_queued.get(req.tenant, 0)
+                >= self.tenant_queue_max
+            ):
+                self._shed("tenant_quota", wait_s)
+                raise ErrorTooManyRequests(
+                    f"tenant {req.tenant!r} has "
+                    f"{self._tenant_queued[req.tenant]} queued request(s) "
+                    f"(TPU_TENANT_QUEUE_MAX={self.tenant_queue_max})",
+                    retry_after_s=wait_s,
+                )
             if (
                 self.queue_max_tokens
                 and self._queued_tokens + cost > self.queue_max_tokens
@@ -988,6 +1199,10 @@ class InferenceEngine(
                     retry_after_s=wait_s,
                 ) from None
             self._queued_tokens += cost
+            if self.tenant_queue_max and req.tenant:
+                self._tenant_queued[req.tenant] = (
+                    self._tenant_queued.get(req.tenant, 0) + 1
+                )
             self._sched_idle = False
         self._work.set()
 
@@ -1008,6 +1223,7 @@ class InferenceEngine(
         deadline: "Optional[Deadline]" = None,
         deadline_s: "Optional[float]" = None,
         cancel: "Optional[CancelToken]" = None,
+        tenant: str = "",
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -1144,6 +1360,7 @@ class InferenceEngine(
             # fails it instead of silently serving different weights.
             lora_gen=self._lora_gen[aid] if aid else 0,
             deadline=coalesce_deadline(deadline, deadline_s),
+            tenant=str(tenant or ""),
         )
         if cancel is not None:
             # Share the transport's token (HTTP disconnect, gRPC cancel)
@@ -1223,7 +1440,16 @@ class InferenceEngine(
             "family": self.family,
             "devices": [str(d) for d in devices],
             "running": self._running,
+            # Supervision state machine (serving/supervisor.py):
+            # SERVING → DEGRADED (trip/crash detected) → RESTARTING
+            # (supervisor recovering) → DOWN (stopped or restart budget
+            # exhausted). Inside details so it rides the typed gRPC
+            # HealthReply's details_json too.
+            "state": self._state,
         }
+        sup = self._supervisor
+        if sup is not None:
+            details["supervisor"] = sup.describe()
         unhealthy = self._unhealthy_reason
         if self._watchdog is not None or unhealthy is not None:
             details["watchdog"] = {
@@ -1260,5 +1486,10 @@ class InferenceEngine(
             # dropping the gauge silently.
             if self._logger is not None:
                 self._logger.debugf("memory_stats unavailable: %s", exc)
-        status = "UP" if self._running and unhealthy is None else "DOWN"
-        return {"status": status, "details": details}
+        status = (
+            "UP"
+            if self._running and unhealthy is None
+            and self._state == "SERVING"
+            else "DOWN"
+        )
+        return {"status": status, "state": self._state, "details": details}

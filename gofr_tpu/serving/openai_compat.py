@@ -166,8 +166,14 @@ def add_openai_routes(
     def _lifecycle(ctx) -> dict:
         """Deadline (X-Request-Timeout) + cancel token (disconnect) from
         the HTTP server, threaded into every engine submit so abandoned
-        or expired requests retire mid-decode and free their KV blocks."""
-        return dict(deadline=ctx.deadline, cancel=ctx.cancel_token)
+        or expired requests retire mid-decode and free their KV blocks.
+        X-Tenant-Id rides along for per-tenant admission quotas
+        (TPU_TENANT_QUEUE_MAX)."""
+        header = getattr(ctx, "header", None)
+        tenant = (header("x-tenant-id") if header is not None else "") or ""
+        return dict(
+            deadline=ctx.deadline, cancel=ctx.cancel_token, tenant=tenant,
+        )
 
     def _params(body: dict) -> dict:
         # Explicit nulls are legal per the OpenAI spec → fall back to

@@ -6,6 +6,7 @@ retirement. Mixin methods on InferenceEngine — split from
 from __future__ import annotations
 
 import queue
+import threading
 import time
 from concurrent.futures import InvalidStateError
 
@@ -19,12 +20,41 @@ from gofr_tpu.serving.types import (
     GenerationResult,
 )
 
+# Thread attribute carrying the scheduler epoch the thread was started
+# under (each thread brands only itself, so there is no cross-thread
+# write to race).
+_EPOCH_ATTR = "gofr_sched_epoch"
+
+
+class SchedulerSuperseded(BaseException):
+    """The supervisor restarted the engine around this (previously
+    wedged) scheduler thread: its epoch is stale, so it must exit
+    WITHOUT touching engine state or draining — the supervisor already
+    salvaged or failed every request it owned. BaseException on purpose:
+    nothing between the dispatch seams and the loop may catch it."""
+
 
 class SchedulerMixin:
     """The scheduler thread's entire dataplane-facing loop."""
 
+    def _check_superseded(self) -> None:
+        """Raise :class:`SchedulerSuperseded` when this thread's branded
+        epoch no longer matches the engine's — i.e. the supervisor
+        abandoned this thread mid-wedge and a new scheduler owns the
+        state. Called at the seams where a wedged step would resume."""
+        epoch = getattr(threading.current_thread(), _EPOCH_ATTR, None)
+        if epoch is not None and epoch != self._epoch:
+            raise SchedulerSuperseded
+
     def _scheduler_loop(self) -> None:
         error: BaseException | None = None
+        # Brand this thread with the epoch it was started under: if the
+        # supervisor abandons it (wedged device step) and restarts the
+        # engine, the bumped engine epoch makes every later touch from
+        # this thread raise SchedulerSuperseded instead of corrupting
+        # the new scheduler's state.
+        epoch = self._epoch
+        setattr(threading.current_thread(), _EPOCH_ATTR, epoch)
         # Windows are PIPELINED `pipeline_depth` deep: dispatch window n+D
         # before fetching window n's tokens. The ~66ms host↔device roundtrip
         # (network-attached relay) is latency, not bandwidth — overlapping
@@ -34,7 +64,7 @@ class SchedulerMixin:
 
         inflight: deque = deque()  # _dispatch_window return tuples
         try:
-            while self._running:
+            while self._running and self._epoch == epoch:
                 # Progress heartbeat: the watchdog trips when this loop
                 # stalls (hung device step, wedged relay) for longer than
                 # its wall-time bound. Idle iterations pet every ≤20 ms.
@@ -43,6 +73,7 @@ class SchedulerMixin:
                 # Fault seam: a test's armed action here can stall the
                 # whole loop (watchdog coverage) or fail one iteration.
                 faults.fire("scheduler.window", engine=self)
+                self._check_superseded()
                 # Lifecycle reap: cancelled/disconnected/deadline-expired
                 # sequences retire HERE, once per loop iteration, so a
                 # dead stream's KV blocks free within one decode window.
@@ -93,13 +124,20 @@ class SchedulerMixin:
                 # MORE per window than the guarantee.)
                 wants_more = any_active and any(
                     s is not None
-                    and s.tokens_in_flight <= s.request.max_new_tokens
+                    and s.tokens_in_flight <= s.request.remaining_new_tokens
                     for s in self._slots
                 )
                 if wants_more:
                     inflight.append(self._dispatch_window())
                 while len(inflight) > (self.pipeline_depth if wants_more else 0):
                     self._process_window(*inflight.popleft())
+        except SchedulerSuperseded:
+            # The supervisor restarted the engine around this wedged
+            # thread: a new scheduler owns every structure, and the
+            # supervisor already salvaged/failed this thread's requests.
+            # Exit with NO drain — failing futures here would double-
+            # resolve requests the new scheduler is replaying.
+            return
         except BaseException as exc:  # noqa: BLE001 — must not strand futures
             # A scheduler crash (e.g. a kernel that fails to compile on this
             # hardware) must fail every caller, not hang them until timeout.
@@ -108,8 +146,15 @@ class SchedulerMixin:
             # published death.
             error = exc
             with self._submit_lock:
+                if self._epoch != epoch:
+                    # An abandoned (wedged) thread whose stuck call
+                    # finally RAISED: the engine was restarted around it,
+                    # so these flags belong to the new scheduler — exit
+                    # without touching anything.
+                    return
                 self._fatal = exc
                 self._running = False
+            self._set_state("DEGRADED")
             if self._logger is not None:
                 self._logger.errorf("engine scheduler died: %s", exc)
         # Drain: fail queued requests AND active slots so no awaiting caller
@@ -117,8 +162,27 @@ class SchedulerMixin:
         # lock closes the race where a submitter enqueues between the
         # scheduler's exit and this drain.
         reason: BaseException = error or RuntimeError("engine stopped")
+        # With a supervisor attached and a restart coming (fatal exit, or
+        # a watchdog-trip teardown marked by _restart_pending), RETRYABLE
+        # requests are salvaged for replay instead of failed: their
+        # futures/streams stay open and the supervisor requeues them on
+        # the restarted engine. Non-retryable ones (cancelled, expired,
+        # prefix registrations) fail through the existing terminal path.
+        # A STOPPING supervisor accepts no salvage — nothing would ever
+        # requeue it (a crash racing engine.close() must fail its
+        # requests, not park them forever).
+        sup = self._supervisor
+        salvaging = (
+            sup is not None
+            and not sup.stopping
+            and (error is not None or self._restart_pending)
+        )
+        salvaged: list[_GenRequest] = []
 
         def _fail(req) -> None:
+            if salvaging and req.retryable():
+                salvaged.append(req)
+                return
             # done() + InvalidStateError guard: an async caller may have
             # cancelled the future already.
             try:
@@ -131,7 +195,13 @@ class SchedulerMixin:
         # Block on in-flight windows first: returning from stop with device
         # computations + async host copies still outstanding races
         # interpreter teardown (observed as a runtime-client thread panic
-        # at exit).
+        # at exit). This barrier is also where a WEDGED device leaves the
+        # thread parked — everything after it runs under ONE submit-lock
+        # hold with one epoch check, so the supervisor's abandonment
+        # (epoch bump + salvage, also under the lock) strictly either
+        # precedes this drain (it returns untouched) or follows it (the
+        # salvage sees emptied structures and the already-parked replay
+        # list) — never interleaves into double-salvage or stranding.
         while inflight:
             emitted = inflight.popleft()[0]
             try:
@@ -139,28 +209,39 @@ class SchedulerMixin:
             except Exception:  # graftlint: disable=GL006 — device may already be down; any failure here means the fetch is moot
                 pass
         with self._submit_lock:
+            if self._epoch != epoch:
+                # Superseded at (or while parked in) the barrier: the
+                # supervisor owns every request now.
+                return
             self._drained = True
             self._queued_tokens = 0
+            self._tenant_queued.clear()
             while not self._pending.empty():
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     break
                 _fail(req)
-        for i, seq in enumerate(self._slots):
-            if seq is None:
-                continue
-            _fail(seq.request)
-            self._release_slot(i)
-        for slot, st in list(self._prefilling.items()):
-            _fail(st.request)
-            del self._prefilling[slot]
-        while self._wait_kv:
-            _fail(self._wait_kv.popleft())
-        self._prefill_emits.clear()
+            for i, seq in enumerate(self._slots):
+                if seq is None:
+                    continue
+                _fail(seq.request)
+                self._release_slot(i)
+            for slot, st in list(self._prefilling.items()):
+                _fail(st.request)
+                del self._prefilling[slot]
+            while self._wait_kv:
+                _fail(self._wait_kv.popleft())
+            self._prefill_emits.clear()
+            if salvaged:
+                self._replay.extend(salvaged)
         # Wake any graceful drain blocked on the idle event: whether this
         # exit was clean or fatal, there is nothing left to wait for.
         self._idle_evt.set()
+        # Crash notification LAST: the supervisor may start restarting the
+        # moment it hears, and the salvage above must already be parked.
+        if error is not None and self._supervisor is not None:
+            self._supervisor.notify_crash(error)
 
     # ------------------------------------------------------------------
     # request-lifecycle reap (cancellation + deadlines)
@@ -346,12 +427,36 @@ class SchedulerMixin:
                         ))
                 req.stream.put(None)
                 continue
+            # Replay-aware admission: a request the supervisor carried
+            # across a restart re-prefills prompt + already-delivered
+            # tokens (prefill_ids), so decode resumes at exactly the
+            # next token. Fresh requests: prefill_ids IS the prompt.
+            pids = req.prefill_ids()
+            # Clamp generation budget so pipelined-window overshoot can't
+            # overrun the cache (admission-time guard; see
+            # _dispatch_window). Done BEFORE any block allocation so the
+            # replay-complete early-retire below cannot strand pool
+            # blocks on a slot it never occupies.
+            room = (
+                self.max_len - 1 - len(req.prompt_ids)
+                - (self.pipeline_depth + 1) * self.window_k
+                * (self.spec_tokens + 1)
+            )
+            req.max_new_tokens = max(1, min(req.max_new_tokens, room))
+            if req.replayed_tokens >= req.max_new_tokens:
+                # The clamp (or the original budget) is already covered
+                # by the tokens delivered before the restart: the request
+                # is complete — retire it with the full result instead of
+                # prefilling a slot to generate nothing.
+                seq = _ActiveSeq(request=req, last_token=req.token_ids[-1])
+                self._retire(-1, seq)
+                continue
             if self.kv_block:
                 # A request bigger than the ENTIRE pool can never be
                 # admitted — fail it now instead of deadlocking the
                 # admission queue behind it forever.
                 B = self.kv_block
-                need = (min(len(req.prompt_ids) + 1, self.max_len) + B - 1) // B
+                need = (min(len(pids) + 1, self.max_len) + B - 1) // B
                 if need > self.cache.n_blocks - 1:
                     if not req.future.done():
                         req.future.set_exception(RuntimeError(
@@ -365,19 +470,11 @@ class SchedulerMixin:
                 # top up ahead of dispatch. Pool dry → hold the request
                 # back (retirements will refill the free list).
                 if not self._ensure_blocks(
-                    free[0], len(req.prompt_ids) + 1
+                    free[0], len(pids) + 1
                 ):
                     self._wait_kv.appendleft(req)
                     break
                 self._dispatched_tokens[free[0]] = 0
-            # Clamp generation budget so pipelined-window overshoot can't
-            # overrun the cache (admission-time guard; see _dispatch_window).
-            room = (
-                self.max_len - 1 - len(req.prompt_ids)
-                - (self.pipeline_depth + 1) * self.window_k
-                * (self.spec_tokens + 1)
-            )
-            req.max_new_tokens = max(1, min(req.max_new_tokens, room))
             slot = free.pop(0)
             self._seeds_host[slot] = req.seed
             self._aids_host[slot] = req.aid
@@ -387,12 +484,12 @@ class SchedulerMixin:
                 self._bidx_host[slot, j] = tok
                 self._bval_host[slot, j] = bv
             self._seeds_dirty = True
-            state = _PrefillState(request=req)
+            state = _PrefillState(request=req, ids=pids)
             if self._prefix_pool is not None and not req.prefix_store:
                 # Per-adapter pools: pooled K/V is a function of the
                 # weights that prefilled it, so a request only reuses a
                 # prefix registered under its OWN adapter.
-                idx, plen = self._prefix_pool.lookup(req.prompt_ids, req.aid)
+                idx, plen = self._prefix_pool.lookup(pids, req.aid)
                 if idx >= 0:
                     # Copy pooled KV rows in; prefill only the remainder.
                     # done < len(prompt) always, so the final chunk still
@@ -401,7 +498,7 @@ class SchedulerMixin:
                     self.cache = self._prefix_pool.load(
                         self.cache, idx, slot, plen
                     )
-                    state.done = min(plen, len(req.prompt_ids) - 1)
+                    state.done = min(plen, len(pids) - 1)
                     if self._metrics is not None:
                         self._metrics.increment_counter(
                             "app_tpu_prefix_hits", "model", self.model_name
@@ -412,6 +509,7 @@ class SchedulerMixin:
         # Fault seam: a raise here is a device failure at prefill
         # dispatch — the scheduler's death drain must fail every caller.
         faults.fire("scheduler.device_step", engine=self, kind="prefill")
+        self._check_superseded()
         if self._seeds_dirty:
             # Upload the admission-scoped planes BEFORE any dispatch —
             # the deep multi-chunk branch below reads _aids_dev, so a
@@ -438,9 +536,7 @@ class SchedulerMixin:
             deep = [
                 (slot, st, rem)
                 for slot, st in rows
-                for rem in [
-                    (len(st.request.prompt_ids) - st.done - 1) // c
-                ]
+                for rem in [(len(st.ids) - st.done - 1) // c]
                 if rem >= 2
             ]
             if deep:
@@ -451,7 +547,7 @@ class SchedulerMixin:
                 slots_m = np.zeros((P,), dtype=np.int32)
                 starts_m = np.zeros((P,), dtype=np.int32)
                 for i, (slot, st, _) in enumerate(deep):
-                    ids = st.request.prompt_ids
+                    ids = st.ids
                     for j in range(d):
                         lo = st.done + j * c
                         tokens3[j, i, :] = ids[lo : lo + c]
@@ -467,16 +563,22 @@ class SchedulerMixin:
                     self._up(slots_m), self._up(starts_m),
                     self._up(np.int32(d)),
                 )
+                # Locals-then-commit around the dispatch (same zombie
+                # fence as _dispatch_window): a wedged call that returns
+                # after abandonment must not clobber the new cache.
+                mhist = None
                 if self.spec_tokens:
-                    self.cache, self._history_dev = (
-                        self._prefill_multi_chunk_hist(
-                            *margs, self._history_dev, self._aids_dev
-                        )
+                    mcache, mhist = self._prefill_multi_chunk_hist(
+                        *margs, self._history_dev, self._aids_dev
                     )
                 else:
-                    self.cache = self._prefill_multi_chunk(
+                    mcache = self._prefill_multi_chunk(
                         *margs, self._aids_dev
                     )
+                self._check_superseded()
+                self.cache = mcache
+                if mhist is not None:
+                    self._history_dev = mhist
                 if self._lockstep:
                     self._jax.block_until_ready(self.cache.lengths)
                 for _, st, _ in deep:
@@ -498,7 +600,7 @@ class SchedulerMixin:
         topps = np.ones((P,), dtype=np.float32)
         greedy = np.ones((P,), dtype=bool)
         for i, (slot, st) in enumerate(rows):
-            ids = st.request.prompt_ids
+            ids = st.ids
             chunk = ids[st.done : st.done + c]
             tokens[i, : len(chunk)] = chunk
             slots[i] = slot
@@ -535,21 +637,29 @@ class SchedulerMixin:
         use_bias = any(
             st.request.logit_bias for _, st in rows
         )
+        # Locals-then-commit around the dispatch (zombie fence; see
+        # _dispatch_window).
+        chist = None
         if self.spec_tokens:
-            (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._pcounts_dev, self._nsteps_dev,
-             self._topi_dev, self._topl_dev, ftopi_dev, ftopl_dev,
-             self._history_dev) = (
+            (ccache, ctoks, clps, first_dev,
+             first_lp_dev, cpc, cnst,
+             cti, ctl, ftopi_dev, ftopl_dev, chist) = (
                 self._prefill_chunk_step_hist(
                     *args, self._history_dev, use_bias=use_bias
                 )
             )
         else:
-            (self.cache, self._tokens_dev, self._logps_dev, first_dev,
-             first_lp_dev, self._pcounts_dev, self._nsteps_dev,
-             self._topi_dev, self._topl_dev, ftopi_dev, ftopl_dev) = (
+            (ccache, ctoks, clps, first_dev,
+             first_lp_dev, cpc, cnst,
+             cti, ctl, ftopi_dev, ftopl_dev) = (
                 self._prefill_chunk_step(*args, use_bias=use_bias)
             )
+        self._check_superseded()
+        self.cache, self._tokens_dev, self._logps_dev = ccache, ctoks, clps
+        self._pcounts_dev, self._nsteps_dev = cpc, cnst
+        self._topi_dev, self._topl_dev = cti, ctl
+        if chist is not None:
+            self._history_dev = chist
         if self._lockstep:
             self._jax.block_until_ready(first_dev)
         if self._metrics is not None:
@@ -640,6 +750,7 @@ class SchedulerMixin:
         """
         if not self._prefill_emits:
             return
+        self._check_superseded()
         keep = []
         for entry in self._prefill_emits:
             first_dev, lp_dev, ftopi_dev, ftopl_dev, row, slot, seq = entry
@@ -691,6 +802,7 @@ class SchedulerMixin:
         # Fault seam: a raise models the device failing a decode window;
         # an armed action that blocks models a hung step (watchdog).
         faults.fire("scheduler.device_step", engine=self, kind="decode")
+        self._check_superseded()
         jnp = self._jnp
         if self._slot_state_dirty:
             # Slot composition changed since the last window: re-upload the
@@ -740,7 +852,8 @@ class SchedulerMixin:
                 if seq is not None:
                     remaining_host[i] = max(
                         0,
-                        seq.request.max_new_tokens + 1 - seq.tokens_in_flight,
+                        seq.request.remaining_new_tokens + 1
+                        - seq.tokens_in_flight,
                     )
                     eos_stop_host[i] = seq.request.stop_on_eos
 
@@ -792,9 +905,14 @@ class SchedulerMixin:
         counts = None
         wrun = None
         etops = None
+        # Results land in LOCALS first and commit to self only after a
+        # superseded check: a dispatch that BLOCKED here (wedged relay —
+        # the exact case the supervisor abandons threads over) must not
+        # overwrite the restarted engine's live cache/planes when its
+        # stuck call finally returns.
+        hist = pc = ti = tl = None
         if mega > 1 and self.spec_tokens:
-            (emitted, counts, wrun, self._tokens_dev, self._logps_dev,
-             self.cache, self._nsteps_dev, self._history_dev) = (
+            (emitted, counts, wrun, toks, lps, cache, nst, hist) = (
                 self._mega_spec_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
@@ -806,9 +924,7 @@ class SchedulerMixin:
                 )
             )
         elif mega > 1:
-            (emitted, etops, wrun, self._tokens_dev, self._logps_dev,
-             self.cache, self._nsteps_dev, self._pcounts_dev,
-             self._topi_dev, self._topl_dev) = (
+            (emitted, etops, wrun, toks, lps, cache, nst, pc, ti, tl) = (
                 self._mega_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
@@ -822,8 +938,7 @@ class SchedulerMixin:
                 )
             )
         elif self.spec_tokens:
-            (emitted, counts, self._tokens_dev, self._logps_dev, self.cache,
-             self._nsteps_dev, self._history_dev) = (
+            (emitted, counts, toks, lps, cache, nst, hist) = (
                 self._spec_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
@@ -833,9 +948,7 @@ class SchedulerMixin:
                 )
             )
         else:
-            (emitted, etops, self._tokens_dev, self._logps_dev, self.cache,
-             self._nsteps_dev, self._pcounts_dev, self._topi_dev,
-             self._topl_dev) = (
+            (emitted, etops, toks, lps, cache, nst, pc, ti, tl) = (
                 self._decode_window(
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
@@ -846,6 +959,13 @@ class SchedulerMixin:
                     k=self.window_k, use_bias=use_bias,
                 )
             )
+        self._check_superseded()
+        self._tokens_dev, self._logps_dev = toks, lps
+        self.cache, self._nsteps_dev = cache, nst
+        if hist is not None:
+            self._history_dev = hist
+        if pc is not None:
+            self._pcounts_dev, self._topi_dev, self._topl_dev = pc, ti, tl
         if etops is not None and not any(
             seq is not None and seq.request.top_logprobs
             for seq in self._slots
@@ -888,6 +1008,13 @@ class SchedulerMixin:
         # Decode: [2, k, S] (mega: [2, m*k, S], first wrun*k valid).
         # Spec: [2, k, S, G+1] + counts [k, S].
         emitted_host = np.asarray(emitted)
+        # The fetch above is this loop's other blocking point (a wedged
+        # relay stalls HERE, not only at dispatch): if the supervisor
+        # abandoned this thread while it was stuck, the token block in
+        # hand belongs to the OLD engine — emitting it would duplicate
+        # tokens on replayed streams and release slots/blocks of the
+        # restarted scheduler's allocator.
+        self._check_superseded()
         counts_host = np.asarray(counts) if counts is not None else None
         etops_host = np.asarray(etops) if etops is not None else None
         steps = (
@@ -998,6 +1125,10 @@ class SchedulerMixin:
         seq.request.token_ids.append(tok)
         seq.request.token_logprobs.append(logprob)
         seq.request.stream.put(tok)
+        # Aggregate-throughput sample feeding projected-wait shedding
+        # (engine._throughput_tps): every emission across every slot
+        # counts, so the estimate is the batch's rate, not one stream's.
+        self._tput.note(1)
         if self._metrics is not None:
             self._metrics.increment_counter(
                 "app_tpu_tokens_generated", "model", self.model_name
@@ -1020,7 +1151,14 @@ class SchedulerMixin:
         if len(req.token_ids) >= req.max_new_tokens:
             return True
         prompt_len = req.effective_prompt_len or len(req.prompt_ids)
-        return prompt_len + len(req.token_ids) >= self.max_len - 1
+        # Context-length guard. After a replay, effective_prompt_len
+        # already covers the pre-restart tokens (they were re-prefilled),
+        # so subtract them from the generated count or the sum would
+        # double-count and retire the stream early.
+        return (
+            prompt_len + len(req.token_ids) - req.replayed_tokens
+            >= self.max_len - 1
+        )
 
     def _retire(self, slot: int, seq: _ActiveSeq) -> None:
         req = seq.request
@@ -1061,18 +1199,6 @@ class SchedulerMixin:
         if not req.future.done():
             req.future.set_result(result)
         req.stream.put(None)  # stream sentinel (after the result resolves)
-        # Throughput EWMA feeding projected-wait load shedding (engine.
-        # _projected_wait_s). Per-request decode rate underestimates the
-        # batched aggregate, so the projection sheds conservatively;
-        # operators wanting exact control set TPU_EXPECTED_TPS.
-        if seq.started_at and ids:
-            dur = time.time() - seq.started_at
-            if dur > 0:
-                inst = len(ids) / dur
-                self._tps_ewma = (
-                    inst if self._tps_ewma <= 0
-                    else 0.8 * self._tps_ewma + 0.2 * inst
-                )
 
     def _update_slot_gauges(self) -> None:
         if self._metrics is None:

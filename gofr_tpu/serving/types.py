@@ -65,6 +65,31 @@ class _ActiveSeq:
 
 
 @dataclass
+class ReplayState:
+    """Everything the supervisor needs to seamlessly continue a request
+    on a restarted engine (``_GenRequest.replay_state``): the original
+    prompt, the sampling contract, and the tokens already streamed to
+    the client. The request object itself is requeued (its stream queue
+    and future ARE the client's handles); this snapshot is the
+    retryability decision plus the observability record of what was
+    carried across the restart."""
+
+    prompt_ids: list[int]
+    emitted_ids: list[int]
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    seed: int
+    stop_on_eos: bool
+    stop_texts: list[str]
+
+    @property
+    def remaining_tokens(self) -> int:
+        """Generation budget left after the tokens already delivered."""
+        return max(0, self.max_new_tokens - len(self.emitted_ids))
+
+
+@dataclass
 class _GenRequest:
     prompt_ids: list[int]
     max_new_tokens: int
@@ -115,6 +140,22 @@ class _GenRequest:
     # token trips — see serving/lifecycle.py and ``cancel_request``.
     deadline: Optional[Deadline] = None
     cancel: CancelToken = field(default_factory=CancelToken)
+    # Admission-quota tenant (X-Tenant-Id header / gRPC metadata); ""
+    # means untenanted — only the global budgets apply.
+    tenant: str = ""
+    # Times the supervisor carried this request across an engine restart,
+    # and how many tokens had been delivered at the LAST replay (those
+    # ride inside the re-prefilled context, so window accounting and the
+    # context-length guard must not count them twice).
+    replays: int = 0
+    replayed_tokens: int = 0
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        """Post-replay generation budget: ``max_new_tokens`` counts the
+        client's TOTAL budget, of which ``replayed_tokens`` were already
+        delivered before the restart."""
+        return max(1, self.max_new_tokens - self.replayed_tokens)
 
     def cancel_request(self) -> None:
         """Transport-side cancel (client disconnect / explicit abort):
@@ -123,6 +164,49 @@ class _GenRequest:
         self.cancel.cancel()
         self.future.cancel()
 
+    def prefill_ids(self) -> list[int]:
+        """The token ids admission must prefill: the prompt plus any
+        continuation tokens already delivered before an engine restart.
+        A replayed request re-prefills its full context so the next
+        sampled token is exactly the continuation — no client-visible
+        duplicates and no gaps. Fresh requests have no emitted tokens,
+        so this is their prompt unchanged."""
+        if self.token_ids:
+            return self.prompt_ids + self.token_ids
+        return self.prompt_ids
+
+    def retryable(self) -> bool:
+        """Can this request be carried across an engine restart? False
+        when already resolved, cancelled, past its deadline, or a prefix
+        registration (pool rows died with the engine — the caller must
+        re-register against the new one). The allocation-free predicate
+        form of :meth:`replay_state` — salvage paths evaluate it per
+        request under the submit lock, where copying token lists would
+        hurt."""
+        if self.prefix_store or self.future.done():
+            return False
+        if self.cancel.cancelled:
+            return False
+        if self.deadline is not None and self.deadline.expired():
+            return False
+        return True
+
+    def replay_state(self) -> Optional[ReplayState]:
+        """Snapshot for a seamless post-restart continuation, or None
+        when the request is not :meth:`retryable`."""
+        if not self.retryable():
+            return None
+        return ReplayState(
+            prompt_ids=list(self.prompt_ids),
+            emitted_ids=list(self.token_ids),
+            max_new_tokens=self.max_new_tokens,
+            temperature=self.temperature,
+            top_p=self.top_p,
+            seed=self.seed,
+            stop_on_eos=self.stop_on_eos,
+            stop_texts=list(self.stop_texts),
+        )
+
 
 @dataclass
 class _PrefillState:
@@ -130,4 +214,8 @@ class _PrefillState:
 
     request: _GenRequest
     done: int = 0  # prompt tokens already written to the cache
+    # Admission-time snapshot of ``request.prefill_ids()`` (prompt plus
+    # any replayed continuation) so the per-chunk dispatch loops don't
+    # rebuild the concatenation once per row per iteration.
+    ids: list[int] = field(default_factory=list)
 

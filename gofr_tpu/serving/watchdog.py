@@ -11,7 +11,13 @@ On a trip the watchdog latches unhealthy, bumps
 ``app_tpu_watchdog_trips_total``, opens a tracing span so the stall is
 visible in traces, and invokes ``on_trip`` — the engine's callback
 flips it into draining (new submissions get 503) and the health
-endpoint reports DOWN. The latch clears only on engine restart.
+endpoint reports DOWN; with a supervisor attached
+(``serving/supervisor.py``) the callback also requests an automatic
+restart. The latch clears only on engine restart — manual or
+supervisor-driven; either path runs ``reset()`` + ``start()`` on this
+SAME instance, so the monitor thread (which exits once latched) is
+respawned and the restarted engine is watched from a fresh pet
+baseline.
 
 Determinism: ``check(now=...)`` takes an explicit timestamp, so tests
 trip the watchdog by *stating* a time, not by sleeping through the

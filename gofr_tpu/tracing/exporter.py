@@ -24,10 +24,13 @@ import json
 import queue
 import threading
 import urllib.request
+from typing import Any
+
+from gofr_tpu.tracing.tracer import Span
 
 
 class NoopExporter:
-    def export(self, span, service_name: str) -> None:  # noqa: ARG002
+    def export(self, span: Span, service_name: str) -> None:  # noqa: ARG002
         pass
 
     def shutdown(self) -> None:
@@ -35,10 +38,10 @@ class NoopExporter:
 
 
 class ConsoleExporter:
-    def __init__(self, logger=None) -> None:
+    def __init__(self, logger: Any = None) -> None:
         self._logger = logger
 
-    def export(self, span, service_name: str) -> None:
+    def export(self, span: Span, service_name: str) -> None:
         line = {
             "traceId": span.trace_id,
             "id": span.span_id,
@@ -59,23 +62,32 @@ class _BatchingHTTPExporter:
     ``exporter.go:48-130``). Subclasses define ``_convert`` (span → wire
     dict) and ``_encode`` (batch → request body)."""
 
-    def __init__(self, url: str, logger=None, batch_size: int = 64, flush_interval_s: float = 2.0) -> None:
+    def __init__(
+        self,
+        url: str,
+        logger: Any = None,
+        batch_size: int = 64,
+        flush_interval_s: float = 2.0,
+    ) -> None:
         self._url = url
         self._logger = logger
         self._batch_size = batch_size
         self._interval = flush_interval_s
-        self._queue: queue.Queue = queue.Queue(maxsize=4096)
+        self._queue: "queue.Queue[tuple[Span, str]]" = queue.Queue(
+            maxsize=4096
+        )
         self._stop = threading.Event()
+        self._failed_once = False
         self._thread = threading.Thread(target=self._run, name="trace-exporter", daemon=True)
         self._thread.start()
 
-    def export(self, span, service_name: str) -> None:
+    def export(self, span: Span, service_name: str) -> None:
         try:
             self._queue.put_nowait((span, service_name))
         except queue.Full:
             pass  # drop rather than block the request path
 
-    def _convert(self, span, service_name: str) -> dict:
+    def _convert(self, span: Span, service_name: str) -> dict:
         raise NotImplementedError
 
     def _encode(self, batch: list[dict]) -> bytes:
@@ -112,7 +124,7 @@ class _BatchingHTTPExporter:
                 # First failure at ERROR so a misconfigured sink (wrong
                 # protocol/endpoint → every batch dropped) is visible at
                 # default log level; repeats stay at debug.
-                if not getattr(self, "_failed_once", False):
+                if not self._failed_once:
                     self._failed_once = True
                     self._logger.errorf(
                         "trace export to %s failed (further failures "
@@ -130,8 +142,8 @@ class ZipkinExporter(_BatchingHTTPExporter):
     """Zipkin-JSON HTTP exporter (reference ``exporter.go:58-96`` shape;
     also serves the hosted "gofr" sink, ``exporter.go:22-33``)."""
 
-    def _convert(self, span, service_name: str) -> dict:
-        out = {
+    def _convert(self, span: Span, service_name: str) -> dict:
+        out: dict[str, Any] = {
             "traceId": span.trace_id,
             "id": span.span_id,
             "name": span.name,
@@ -156,11 +168,11 @@ class OTLPExporter(_BatchingHTTPExporter):
 
     _STATUS_CODES = {"OK": 1, "ERROR": 2}
 
-    def _convert(self, span, service_name: str) -> dict:
+    def _convert(self, span: Span, service_name: str) -> dict:
         # Exact end timestamp when the span was properly ended; derive
         # from duration only as a fallback.
         end_ns = span.end_ns or (span.start_ns + span.duration_us * 1000)
-        out = {
+        out: dict[str, Any] = {
             "traceId": span.trace_id,
             "spanId": span.span_id,
             "name": span.name,
@@ -209,7 +221,7 @@ class OTLPExporter(_BatchingHTTPExporter):
         }).encode()
 
 
-def exporter_from_config(config, logger=None):
+def exporter_from_config(config: Any, logger: Any = None) -> Any:
     """Reference ``gofr.go:250-300``: TRACE_EXPORTER + TRACER_URL select the
     sink — zipkin/gofr speak Zipkin JSON, jaeger/otlp speak OTLP/HTTP."""
     name = (config.get_or_default("TRACE_EXPORTER", "") or "").lower()

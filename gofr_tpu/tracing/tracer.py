@@ -13,7 +13,8 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from types import TracebackType
+from typing import Any, Optional
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "gofr_tpu_current_span", default=None
@@ -32,12 +33,12 @@ class Span:
     parent_id: Optional[str] = None
     start_ns: int = 0
     end_ns: Optional[int] = None
-    attributes: dict = field(default_factory=dict)
+    attributes: dict[str, Any] = field(default_factory=dict)
     status: str = "OK"
     _tracer: Optional["Tracer"] = None
-    _token: object = None
+    _token: Optional[contextvars.Token[Optional["Span"]]] = None
 
-    def set_attribute(self, key: str, value) -> None:
+    def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
 
     def set_status(self, status: str) -> None:
@@ -68,7 +69,12 @@ class Span:
     def __enter__(self) -> "Span":
         return self
 
-    def __exit__(self, exc_type, exc, tb) -> None:
+    def __exit__(
+        self,
+        exc_type: Optional[type[BaseException]],
+        exc: Optional[BaseException],
+        tb: Optional[TracebackType],
+    ) -> None:
         if exc is not None:
             self.set_status("ERROR")
             self.set_attribute("error.message", str(exc))
@@ -78,7 +84,9 @@ class Span:
 class Tracer:
     """Creates spans and hands completed ones to an exporter."""
 
-    def __init__(self, service_name: str = "gofr-tpu-app", exporter=None) -> None:
+    def __init__(
+        self, service_name: str = "gofr-tpu-app", exporter: Any = None
+    ) -> None:
         self.service_name = service_name
         self._exporter = exporter
         self._lock = threading.Lock()
@@ -89,7 +97,7 @@ class Tracer:
         parent: Optional[Span] = None,
         trace_id: Optional[str] = None,
         parent_span_id: Optional[str] = None,
-        attributes: Optional[dict] = None,
+        attributes: Optional[dict[str, Any]] = None,
     ) -> Span:
         if parent is None:
             parent = _current_span.get()
@@ -133,7 +141,9 @@ def set_tracer(tracer: Tracer) -> None:
     _global_tracer = tracer
 
 
-def extract_traceparent(headers: dict) -> tuple[Optional[str], Optional[str]]:
+def extract_traceparent(
+    headers: dict[str, str],
+) -> tuple[Optional[str], Optional[str]]:
     """Parse W3C ``traceparent`` → (trace_id, parent_span_id)."""
     tp = headers.get("traceparent", "")
     parts = tp.split("-")
@@ -142,7 +152,9 @@ def extract_traceparent(headers: dict) -> tuple[Optional[str], Optional[str]]:
     return None, None
 
 
-def inject_traceparent(headers: dict, span: Optional[Span] = None) -> dict:
+def inject_traceparent(
+    headers: dict[str, str], span: Optional[Span] = None
+) -> dict[str, str]:
     span = span or current_span()
     if span is not None:
         headers["traceparent"] = span.traceparent()

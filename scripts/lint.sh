@@ -25,7 +25,8 @@ fi
 
 if command -v mypy >/dev/null 2>&1; then
   echo "== mypy (scoped) =="
-  mypy gofr_tpu/config gofr_tpu/logging gofr_tpu/metrics \
+  mypy gofr_tpu/analysis gofr_tpu/config gofr_tpu/logging \
+    gofr_tpu/metrics gofr_tpu/tracing \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
     gofr_tpu/serving/batcher.py || failed=1
 else

@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL007), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL008), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -429,6 +429,66 @@ def test_gl007_scopes_do_not_leak(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL008 — jnp.asarray / jnp.array inside lax.scan bodies
+# ----------------------------------------------------------------------
+
+
+def test_gl008_flags_asarray_in_scan_bodies(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "models/layers.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def forward(x, params, table):
+            def body(carry, layer):
+                bias = jnp.asarray(table)       # baked per body trace
+                return carry + layer + bias, None
+
+            x, _ = jax.lax.scan(body, x, params)
+            y, _ = jax.lax.scan(
+                lambda c, l: (c + jnp.array([1.0]), None), x, params
+            )
+            return x + y
+        """,
+        select=["GL008"],
+    )
+    assert ids == ["GL008", "GL008"]
+    assert "lax.scan" in findings[0].message
+    assert "hoist" in findings[0].message
+
+
+def test_gl008_ignores_conversions_outside_bodies(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "models/layers.py",
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def forward(x, params, table):
+            bias = jnp.asarray(table)           # hoisted: fine
+            def body(carry, layer):
+                return carry + layer + bias, None
+
+            x, _ = jax.lax.scan(body, x, params)
+            return x
+
+        def unrelated(table):
+            # Not a scan body at all.
+            return jnp.array(table)
+
+        def factory_scan(x, params, make_body):
+            # Factory-built bodies are statically out of reach — the
+            # rule must stay quiet rather than guess.
+            x, _ = jax.lax.scan(make_body(1), x, params)
+            return x
+        """,
+        select=["GL008"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -587,6 +647,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        "GL008",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2

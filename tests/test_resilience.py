@@ -37,6 +37,7 @@ from gofr_tpu.errors import (
 from gofr_tpu.metrics import new_metrics_manager
 from gofr_tpu.serving.engine import InferenceEngine
 from gofr_tpu.serving.lifecycle import (
+    AggregateThroughput,
     CancelToken,
     Deadline,
     coalesce_deadline,
@@ -301,6 +302,166 @@ def test_already_expired_deadline_rejected_at_submit(engine):
         engine.submit_generate(
             "late", max_new_tokens=4, temperature=0.0, deadline=dead
         )
+
+
+# ----------------------------------------------------------------------
+# aggregate-throughput estimator (projected-wait shedding denominator)
+# ----------------------------------------------------------------------
+
+
+def test_aggregate_throughput_sliding_window():
+    now = [0.0]
+    tput = AggregateThroughput(window_s=10.0, clock=lambda: now[0])
+    assert tput.rate() == 0.0  # no signal → caller falls back to prior
+    # 4 concurrent streams × 50 tok/s each = 200 tok/s aggregate.
+    for step in range(1, 101):
+        now[0] = step * 0.02  # a window's worth of emissions every 20ms
+        tput.note(4)
+    assert 180.0 <= tput.rate() <= 220.0
+    # Old samples slide out of the window…
+    now[0] += 11.0
+    assert tput.rate() == 0.0
+    # …and reset() forgets history (engine restart).
+    tput.note(4)
+    assert tput.rate() > 0
+    tput.reset()
+    assert tput.rate() == 0.0
+
+
+def test_aggregate_throughput_governs_shed_decisions(engine):
+    """Shed decisions under concurrent load: the old per-request EWMA
+    measured ONE stream (~aggregate/batch) and over-shed by the batch
+    size; the aggregate estimator admits what the engine can actually
+    chew through. Simulated: 4 streams × 50 tok/s each."""
+    now = [0.0]
+    agg = AggregateThroughput(window_s=10.0, clock=lambda: now[0])
+    per_stream_ewma = 50.0  # what the retired-request EWMA converged to
+    for step in range(1, 101):
+        now[0] = step * 0.02
+        agg.note(4)  # all four slots emit each window
+    old_tput, engine._tput = engine._tput, agg
+    old_exp = engine._expected_tps
+    engine._expected_tps = 0.0
+    try:
+        assert engine._throughput_tps() == pytest.approx(agg.rate())
+        # A request needing ~1000 tokens of queue ahead of a 10s
+        # deadline: at the TRUE 200 tok/s it waits ~5s → admit; the
+        # per-request estimate (50 tok/s → 20s) would have shed it.
+        cost = 1000
+        wait_aggregate = engine._projected_wait_s(cost)
+        wait_per_request = cost / per_stream_ewma
+        assert wait_aggregate < 10.0 < wait_per_request
+        req = engine.submit_generate(
+            "admitted under aggregate throughput",
+            max_new_tokens=cost - len(b"admitted under aggregate throughput"),
+            temperature=0.0, stop_on_eos=False, deadline_s=10.0,
+        )
+        # Admitted (no ErrorDeadlineExceeded shed) — cancel it; the
+        # admission decision is the test, not the decode.
+        req.cancel_request()
+        _drain_stream(req)
+    finally:
+        engine._tput = old_tput
+        engine._expected_tps = old_exp
+
+
+# ----------------------------------------------------------------------
+# per-tenant admission quotas (TPU_TENANT_QUEUE_MAX)
+# ----------------------------------------------------------------------
+
+
+def test_tenant_quota_sheds_per_tenant_before_global(engine, metrics):
+    """One tenant's flood sheds on ITS budget (429, reason
+    tenant_quota) while other tenants and untenanted requests keep
+    being admitted under the same global queue."""
+    inst = {
+        i.name: i for i in metrics.instruments()
+    }["app_tpu_requests_shed_total"]
+
+    def tenant_shed_total() -> float:
+        return sum(
+            v for k, v in inst.collect().items()
+            if ("reason", "tenant_quota") in k
+        )
+
+    before = tenant_shed_total()
+    gate_in, gate_out = threading.Event(), threading.Event()
+
+    def stall(**kw):
+        gate_in.set()
+        gate_out.wait(timeout=60)
+
+    old_max = engine.tenant_queue_max
+    engine.tenant_queue_max = 2
+    reqs = []
+    try:
+        with faults.armed("scheduler.window", action=stall, times=1):
+            assert gate_in.wait(30)  # queue cannot drain while parked
+            for _ in range(2):
+                reqs.append(engine.submit_generate(
+                    "tenant a", max_new_tokens=4, temperature=0.0,
+                    stop_on_eos=False, tenant="acme",
+                ))
+            # Third same-tenant submit: shed on the TENANT budget…
+            with pytest.raises(ErrorTooManyRequests) as exc:
+                engine.submit_generate(
+                    "tenant a again", max_new_tokens=4, temperature=0.0,
+                    tenant="acme",
+                )
+            assert "acme" in str(exc.value)
+            assert exc.value.status_code == 429
+            assert int(exc.value.headers["Retry-After"]) >= 1
+            # …while another tenant and an untenanted caller still fit.
+            reqs.append(engine.submit_generate(
+                "tenant b", max_new_tokens=4, temperature=0.0,
+                stop_on_eos=False, tenant="globex",
+            ))
+            reqs.append(engine.submit_generate(
+                "no tenant", max_new_tokens=4, temperature=0.0,
+                stop_on_eos=False,
+            ))
+            gate_out.set()
+        for req in reqs:
+            req.future.result(timeout=120)
+        assert tenant_shed_total() == before + 1
+        # Quota seats return on dequeue: the tenant can submit again.
+        done = engine.submit_generate(
+            "tenant a after drain", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False, tenant="acme",
+        )
+        done.future.result(timeout=120)
+        assert engine._tenant_queued == {}
+    finally:
+        engine.tenant_queue_max = old_max
+
+
+def test_tenant_rides_http_header_and_grpc_metadata(engine):
+    """The engine-facing tenant key comes from X-Tenant-Id (HTTP) and
+    x-tenant-id invocation metadata (gRPC) — both transports feed the
+    same submit kwarg."""
+    from gofr_tpu.grpc.server import tenant_from_context
+
+    class _Ctx:
+        def invocation_metadata(self):
+            return (("user-agent", "t"), ("x-tenant-id", "acme"))
+
+    assert tenant_from_context(_Ctx()) == "acme"
+
+    class _NoMeta:
+        pass
+
+    assert tenant_from_context(_NoMeta()) == ""
+
+    from gofr_tpu.context import Context
+    from gofr_tpu.http.proto import RawRequest
+    from gofr_tpu.http.request import Request
+
+    raw = RawRequest(
+        method="POST", target="/v1/completions", version="HTTP/1.1",
+        headers={"x-tenant-id": "globex"}, body=b"{}",
+    )
+    ctx = Context(Request(raw), container=None)
+    assert ctx.header("x-tenant-id") == "globex"
 
 
 # ----------------------------------------------------------------------
