@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL007).
+"""The graftlint rule set (GL001–GL009).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -984,6 +984,182 @@ class ScanBodyAsarrayRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL009 — per-request jit-cache growth
+# ----------------------------------------------------------------------
+
+
+class JitCacheGrowthRule(Rule):
+    """A hand-rolled compile cache keyed on per-request values grows
+    without bound: every distinct prompt length / shape / tensor size
+    adds ANOTHER compiled executable that is never evicted, and on TPU
+    each entry is seconds of compile time plus resident program memory.
+    Two signatures are flagged:
+
+    * ``functools.lru_cache`` / ``functools.cache`` on a callable whose
+      body builds a jitted program, when the cache key can grow per
+      request — an unbounded decorator (``cache`` or
+      ``lru_cache(maxsize=None)``), a shape/length-named parameter, or
+      a method (``self`` in the key also pins every engine instance
+      alive);
+    * dict-cached jit builders — ``cache[seq_len] = jax.jit(...)``
+      (or ``.setdefault``) where the key is a shape/length-derived
+      value.
+
+    The fix is the codebase's bucketed-padding idiom: compile one
+    fixed-shape program per PADDING BUCKET (a small closed set) and pad
+    requests into it, instead of one program per observed request
+    shape. GL003 flags ``.shape``-f-string keys; this rule catches the
+    lru_cache/method and bare length-key forms it cannot see.
+    """
+
+    rule_id = "GL009"
+    name = "jit-cache-growth"
+    rationale = (
+        "shape-keyed lru_cache/dict caches of jitted programs compile "
+        "and retain one executable per observed request shape; key on a "
+        "closed set of padding buckets instead"
+    )
+
+    _SHAPE_HINTS = ("shape", "len", "length", "size", "tokens", "dim")
+
+    @classmethod
+    def _shapeish(cls, name: str) -> bool:
+        lowered = name.lower()
+        return any(hint in lowered for hint in cls._SHAPE_HINTS)
+
+    @staticmethod
+    def _cache_decorator(dec: ast.AST) -> Optional[tuple[str, bool]]:
+        """(decorator name, unbounded?) for lru_cache/cache decorators."""
+        call = dec if isinstance(dec, ast.Call) else None
+        target = call.func if call is not None else dec
+        name = dotted_name(target) or ""
+        short = name.rsplit(".", 1)[-1]
+        if short == "cache":
+            return name, True
+        if short != "lru_cache":
+            return None
+        if call is None:
+            return name, False  # bare @lru_cache: default maxsize=128
+        for kw in call.keywords:
+            if kw.arg == "maxsize":
+                value = _const_value(kw.value)
+                return name, value is None
+        if call.args:
+            return name, _const_value(call.args[0]) is None
+        return name, False
+
+    @staticmethod
+    def _builds_jit(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _jit_call(node) is not None:
+                return True
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_cached_fn(node, ctx)
+            elif isinstance(node, ast.Assign):
+                yield from self._check_dict_cache(
+                    node.targets, node.value, ctx
+                )
+            elif isinstance(node, ast.Call):
+                yield from self._check_setdefault(node, ctx)
+
+    def _check_cached_fn(
+        self, fn: ast.FunctionDef | ast.AsyncFunctionDef, ctx: FileContext
+    ) -> Iterator[Finding]:
+        cached = None
+        for dec in fn.decorator_list:
+            cached = self._cache_decorator(dec)
+            if cached is not None:
+                break
+        if cached is None or not self._builds_jit(fn):
+            return
+        dec_name, unbounded = cached
+        params = [
+            a.arg for a in fn.args.posonlyargs + fn.args.args
+            + fn.args.kwonlyargs
+        ]
+        is_method = bool(params) and params[0] in ("self", "cls")
+        shape_params = [p for p in params if self._shapeish(p)]
+        if not (unbounded or is_method or shape_params):
+            return  # bounded cache over a closed key set: the fix itself
+        if unbounded:
+            why = f"`@{dec_name}` is unbounded"
+        elif is_method:
+            why = (
+                f"`@{dec_name}` on a method keys on `{params[0]}` too — "
+                "the cache pins every instance AND grows per shape"
+            )
+        else:
+            why = (
+                f"key includes per-request value(s) "
+                f"{', '.join(repr(p) for p in shape_params)}"
+            )
+        yield self.finding(
+            ctx, fn,
+            f"`{fn.name}` builds a jitted program under `@{dec_name}` "
+            f"and {why}: the compile cache grows per request — key on a "
+            "closed set of padding buckets (bounded maxsize, "
+            "module-level function)",
+        )
+
+    def _check_dict_cache(
+        self, targets: list[ast.AST], value: ast.AST, ctx: FileContext
+    ) -> Iterator[Finding]:
+        if not isinstance(value, ast.Call) or _jit_call(value) is None:
+            return
+        for tgt in targets:
+            if isinstance(tgt, ast.Subscript) and self._growing_key(
+                tgt.slice
+            ):
+                yield self.finding(
+                    ctx, tgt,
+                    "jitted program stored under a shape/length-derived "
+                    "dict key: the cache compiles and retains one "
+                    "executable per observed request shape; key on a "
+                    "padding bucket instead",
+                )
+
+    def _check_setdefault(
+        self, node: ast.Call, ctx: FileContext
+    ) -> Iterator[Finding]:
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault"
+            and len(node.args) >= 2
+        ):
+            return
+        if _jit_call(node.args[1]) is not None and self._growing_key(
+            node.args[0]
+        ):
+            yield self.finding(
+                ctx, node,
+                "jitted program `setdefault`-cached under a shape/"
+                "length-derived key grows the compile cache per request; "
+                "key on a padding bucket instead",
+            )
+
+    def _growing_key(self, key: ast.AST) -> bool:
+        """A key expression that can take unboundedly many per-request
+        values: a shape attribute, a shape/length-named name, or a
+        tuple/f-string containing one."""
+        for sub in ast.walk(key):
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                return True
+            if isinstance(sub, ast.Name) and self._shapeish(sub.id):
+                return True
+            if isinstance(sub, ast.Attribute) and self._shapeish(sub.attr):
+                return True
+            if isinstance(sub, ast.Call):
+                fname = dotted_name(sub.func) or ""
+                if fname == "len":
+                    return True
+        return False
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -996,6 +1172,7 @@ ALL_RULES = (
     ExceptionSwallowRule,
     DonatedBufferReuseRule,
     ScanBodyAsarrayRule,
+    JitCacheGrowthRule,
 )
 
 
@@ -1010,4 +1187,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         ExceptionSwallowRule(config.request_path_dirs),
         DonatedBufferReuseRule(),
         ScanBodyAsarrayRule(),
+        JitCacheGrowthRule(),
     ]

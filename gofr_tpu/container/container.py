@@ -195,6 +195,26 @@ class Container:
             "app_http_service_circuit_open",
             "circuit breaker state per downstream service (1 = open)",
         )
+        # Replica-tier failover (service/replica_pool.py): per-replica
+        # routing state, mid-stream failovers, probe failures, hedges.
+        m.new_gauge(
+            "app_tpu_replica_state",
+            "per-replica routing state "
+            "(0=SERVING 1=DEGRADED 2=RESTARTING 3=DOWN/demoted)",
+        )
+        m.new_counter(
+            "app_tpu_failovers_total",
+            "in-flight requests adopted by a sibling replica after a "
+            "replica died",
+        )
+        m.new_counter(
+            "app_tpu_probe_failures_total",
+            "synthetic health probes failed (replica demoted from routing)",
+        )
+        m.new_counter(
+            "app_tpu_hedged_requests_total",
+            "unary requests hedged or retried on a second replica",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

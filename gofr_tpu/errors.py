@@ -144,6 +144,23 @@ class ErrorRequestCancelled(GofrError):
         super().__init__("request cancelled by the client")
 
 
+class ErrorNoHealthyReplica(GofrError):
+    """502 — the replica pool could not place the request on ANY
+    backend: every replica is DOWN/RESTARTING, demoted by a failed
+    probe, or rejected the submit. 502 (bad gateway) rather than 503 on
+    purpose: a single replica's drain answers 503 (retry THIS address
+    later), while 502 says the routing tier itself found no healthy
+    upstream — load balancers and clients treat the two differently."""
+
+    status_code = 502
+
+    def __init__(self, detail: str = "") -> None:
+        msg = "no healthy replica available"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
 class ErrorPromptTooLong(GofrError):
     """413 — prompt exceeds the engine's serveable context window. A
     serving framework must surface this, not silently truncate (truncation

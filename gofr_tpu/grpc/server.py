@@ -21,14 +21,15 @@ from gofr_tpu.tracing import get_tracer
 def grpc_status_code(exc: BaseException) -> "grpc.StatusCode":
     """Framework error → gRPC status, honoring the resilience statuses:
     shed (429) → RESOURCE_EXHAUSTED, deadline (504) → DEADLINE_EXCEEDED,
-    cancelled (499) → CANCELLED, draining (503) → UNAVAILABLE; the rest
+    cancelled (499) → CANCELLED, draining (503) and replica-pool
+    exhaustion (502, ErrorNoHealthyReplica) → UNAVAILABLE; the rest
     keep the historical 4xx→INVALID_ARGUMENT / 5xx→INTERNAL split."""
     status = getattr(exc, "status_code", 500)
     if status == 429:
         return grpc.StatusCode.RESOURCE_EXHAUSTED
     if status == 499:
         return grpc.StatusCode.CANCELLED
-    if status == 503:
+    if status in (502, 503):
         return grpc.StatusCode.UNAVAILABLE
     if status == 504:
         return grpc.StatusCode.DEADLINE_EXCEEDED

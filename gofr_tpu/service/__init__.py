@@ -19,6 +19,12 @@ from gofr_tpu.service.options import (
     OAuthConfig,
     RetryConfig,
 )
+from gofr_tpu.service.replica_pool import (
+    EngineReplica,
+    HTTPReplica,
+    Replica,
+    ReplicaPool,
+)
 
 __all__ = [
     "HTTPService",
@@ -32,4 +38,8 @@ __all__ = [
     "DefaultHeaders",
     "HealthConfig",
     "RetryConfig",
+    "Replica",
+    "EngineReplica",
+    "HTTPReplica",
+    "ReplicaPool",
 ]

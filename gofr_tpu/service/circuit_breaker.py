@@ -76,6 +76,16 @@ class _CircuitBreakerService(ServiceWrapper):
             self._publish_state(False)
         self._stop_ticker()
 
+    def note_probe_success(self) -> None:
+        """An out-of-band synthetic probe (replica pool's active prober)
+        succeeded against the wrapped service: it demonstrably serves
+        REAL traffic again, which is stronger evidence than the health
+        ticker's liveness poll. Half-open the breaker NOW — reset the
+        failure count and let requests flow — instead of making callers
+        wait out the remainder of the probe interval on a replica that
+        already returned to SERVING. No-op on a closed breaker."""
+        self._record_success()
+
     def _record_failure(self) -> None:
         start_ticker = False
         with self._lock:

@@ -1,0 +1,942 @@
+"""Replica-tier failover: health-aware routing around DOWN engines.
+
+PR 3 made a SINGLE engine self-healing — but a replica that exhausts
+``TPU_RESTART_MAX`` still lands DOWN and takes its traffic with it. This
+module is the layer above: a :class:`ReplicaPool` fronts N inference
+backends (in-process :class:`~gofr_tpu.serving.engine.InferenceEngine`
+replicas and/or remote ``HTTPService`` endpoints) and makes the POOL the
+availability boundary, the way vLLM/Pathways-style deployments treat
+the router rather than the engine as the unit that must never die.
+
+What the pool owns:
+
+* **Health-aware routing** — every submit picks the least-loaded
+  replica among SERVING ones (round-robin tie-break so equal-load
+  replicas share traffic), spills to DEGRADED when nothing is SERVING,
+  and never routes to RESTARTING/DOWN or probe-demoted replicas. With
+  no routable replica at all, submits fail fast with
+  :class:`~gofr_tpu.errors.ErrorNoHealthyReplica` (502 — the routing
+  tier found no upstream) instead of queueing into a dead engine.
+* **Mid-stream failover** — each in-proc replica gets a *handoff*: when
+  an engine's supervisor gives up (crash loop → DOWN) or a scheduler
+  dies unsupervised, still-retryable requests are offered to the pool,
+  which requeues the SAME request object on a sibling replica via
+  ``engine.requeue_replay``. The client's stream queue and future carry
+  over; admission re-prefills prompt + already-delivered tokens and the
+  sampling-counter offset restores the seeded sample path, so the SSE
+  stream continues byte-identically — no 5xx, no duplicate tokens.
+* **Hedged unary retries** — :meth:`ReplicaPool.generate_sync` (and the
+  async ``generate``) races a second replica when the primary is slow
+  (jittered ``TPU_HEDGE_DELAY_S``) or retries when it fails fast; both
+  spend from a token-bucket :class:`~gofr_tpu.serving.lifecycle.
+  HedgeBudget` (``TPU_HEDGE_BUDGET``) so hedging can never double load
+  on an already-slow tier, and are deadline-aware. Per-replica circuit
+  breakers stay where they are — an open breaker's fast-fail is simply
+  one more signal the router reroutes on, not a second breaker.
+* **Active probing** — a jittered-interval prober issues one cheap
+  synthetic generation per replica (``engine.synthetic_probe``: one
+  greedy token through the full dataplane). A failed probe demotes the
+  replica (routed around even if it still CLAIMS SERVING) and asks its
+  supervisor to restart — recovery on evidence, not just on crash. A
+  DOWN replica is revived and **re-admitted only after a passing
+  probe**; a passing probe also resets the supervisor's crash-loop
+  counter and half-opens a stuck circuit breaker.
+
+Observability: ``app_tpu_replica_state`` (0=SERVING 1=DEGRADED
+2=RESTARTING 3=DOWN per replica), ``app_tpu_failovers_total``,
+``app_tpu_probe_failures_total``, ``app_tpu_hedged_requests_total``.
+
+Determinism contract (the chaos suite, ``tests/test_replica_pool.py``):
+clock/rng are injectable, the prober thread is optional (tests call
+``probe_once()``), and nothing here sleeps on the request path.
+
+Cross-replica replay only produces *byte-identical* continuations when
+sibling replicas share params and the engine seed (the same
+``TPU_SEED``); with distinct seeds the continuation is still a valid
+sample path, just a different one.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import random
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+from gofr_tpu.errors import (
+    ErrorDeadlineExceeded,
+    ErrorNoHealthyReplica,
+    ErrorTooManyRequests,
+)
+from gofr_tpu.serving.lifecycle import Deadline, HedgeBudget
+
+#: Gauge encoding shared with app_tpu_engine_state.
+_STATE_ORDER = {"SERVING": 0, "DEGRADED": 1, "RESTARTING": 2, "DOWN": 3}
+
+#: Statuses a sibling replica may retry/hedge: per-replica overload or
+#: failure. 4xx validation errors and 504 (the CALLER's deadline) are
+#: the same on every replica and never rerouted.
+_REROUTE_STATUSES = frozenset((429, 500, 502, 503))
+
+
+def _is_reroutable(exc: BaseException) -> bool:
+    return int(getattr(exc, "status_code", 500)) in _REROUTE_STATUSES
+
+
+class Replica:
+    """One pool member. Subclasses bind a concrete backend."""
+
+    #: Streaming + request adoption need an in-process engine.
+    supports_stream = False
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        # Latched by a failed synthetic probe; cleared ONLY by a passing
+        # one. While set, the router treats the replica as DOWN no
+        # matter what its own state machine claims.
+        self.probe_failed = False
+
+    # -- routing surface ------------------------------------------------
+
+    def state(self) -> str:
+        raise NotImplementedError
+
+    def load(self) -> int:
+        """Outstanding work (queue + live); the least-loaded heuristic."""
+        raise NotImplementedError
+
+    def submit(self, prompt: Any, **kw: Any) -> Any:
+        """Submit a generation; returns a ``_GenRequest``-shaped handle
+        (``.future``, ``.stream``, ``.cancel_request()``)."""
+        raise NotImplementedError
+
+    def adopt(self, req: Any) -> bool:
+        """Continue a salvaged request from a dying sibling (stream and
+        future intact). False when this backend cannot."""
+        return False
+
+    # -- probe surface ----------------------------------------------------
+
+    def probe(self, timeout_s: float) -> tuple[str, str]:
+        """One synthetic end-to-end check → ``(verdict, reason)`` with
+        verdict ``"pass"`` (healthy), ``"busy"`` (overloaded — shedding
+        or congested, which is a HEALTHY engine doing its job, never
+        grounds for demotion or a restart), or ``"fail"`` (broken)."""
+        raise NotImplementedError
+
+    def revive(self, probe_timeout_s: float = 5.0) -> bool:
+        """Attempt to bring a DOWN backend back for probation."""
+        return False
+
+    def note_probe_success(self) -> None:
+        """Propagate a passing probe (supervisor counter reset, breaker
+        half-open, ...)."""
+
+    def notify_probe_failure(self, reason: str) -> None:
+        """Propagate a failing probe (supervisor restart request)."""
+
+    def describe(self) -> dict:
+        return {
+            "state": self.state(),
+            "probe_failed": self.probe_failed,
+            "load": self.load(),
+            "supports_stream": self.supports_stream,
+        }
+
+    def close(self) -> None:
+        pass
+
+
+class EngineReplica(Replica):
+    """An in-process :class:`InferenceEngine` (plus its supervisor)."""
+
+    supports_stream = True
+
+    def __init__(self, name: str, engine: Any) -> None:
+        super().__init__(name)
+        self.engine = engine
+
+    def state(self) -> str:
+        return str(self.engine.state)
+
+    def load(self) -> int:
+        eng = self.engine
+        if getattr(eng, "family", "llm") != "llm":
+            return 0
+        # Lock-free host reads — a one-iteration-stale count is fine for
+        # a routing heuristic.
+        queued = eng._pending.qsize() + len(eng._wait_kv)
+        live = sum(1 for s in eng._slots if s is not None)
+        return queued + live + len(eng._prefilling)
+
+    def submit(self, prompt: Any, **kw: Any) -> Any:
+        return self.engine.submit_generate(prompt, **kw)
+
+    def adopt(self, req: Any) -> bool:
+        return bool(self.engine.requeue_replay(req))
+
+    def probe(self, timeout_s: float) -> tuple[str, str]:
+        from gofr_tpu.errors import (
+            ErrorDeadlineExceeded,
+            ErrorTooManyRequests,
+        )
+
+        try:
+            self.engine.synthetic_probe(timeout_s=timeout_s)
+            return "pass", ""
+        except (ErrorTooManyRequests, ErrorDeadlineExceeded) as exc:
+            # Admission SHED the probe: overload, not breakage — a
+            # replica answering 429s is exactly what load shedding is
+            # for, and demoting/restarting it would cascade the load
+            # onto its siblings until the whole pool restarts.
+            return "busy", f"{type(exc).__name__}: {exc}"
+        except cf.TimeoutError as exc:
+            if self.load() > 1:
+                # The probe queued behind real work: congested, not
+                # dead. A wedged scheduler is the watchdog's job.
+                return "busy", f"probe timed out behind {self.load()} waiting"
+            return "fail", f"probe timed out on an idle engine: {exc}"
+        except Exception as exc:  # noqa: BLE001 — ANY other failure demotes the replica
+            return "fail", f"{type(exc).__name__}: {exc}"
+
+    def revive(self, probe_timeout_s: float = 5.0) -> bool:
+        sup = getattr(self.engine, "_supervisor", None)
+        if sup is not None:
+            return bool(sup.revive())
+        try:
+            self.engine.restart_sync()
+            return True
+        except Exception:  # noqa: BLE001 — a failed revive keeps the replica DOWN
+            return False
+
+    def note_probe_success(self) -> None:
+        sup = getattr(self.engine, "_supervisor", None)
+        if sup is not None:
+            sup.note_probe_success()
+
+    def notify_probe_failure(self, reason: str) -> None:
+        sup = getattr(self.engine, "_supervisor", None)
+        if sup is not None:
+            sup.notify_probe_failure(reason)
+
+    def close(self) -> None:
+        self.engine.set_replica_handoff(None)
+        self.engine.close()
+
+
+class HTTPReplica(Replica):
+    """A remote replica behind the service tier: unary generations via
+    its OpenAI-compatible endpoint, liveness via ``/.well-known/health``.
+
+    Compose the service with :class:`CircuitBreakerConfig`/auth options
+    at construction — the pool does not duplicate the breaker, it
+    reroutes on its fast-fails and half-opens it on passing probes.
+    Streams and request adoption stay on in-proc replicas: a remote
+    engine's stream cannot adopt another replica's live queue handle.
+    """
+
+    supports_stream = False
+
+    def __init__(
+        self,
+        name: str,
+        service: Any,
+        *,
+        generate_path: str = "v1/completions",
+    ) -> None:
+        super().__init__(name)
+        self.service = service
+        self.generate_path = generate_path
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._state = "SERVING"
+
+    def state(self) -> str:
+        return self._state
+
+    def load(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def submit(self, prompt: Any, **kw: Any) -> Any:
+        from gofr_tpu.serving.types import _GenRequest
+
+        req = _GenRequest(
+            prompt_ids=list(prompt) if not isinstance(prompt, str) else [],
+            max_new_tokens=int(kw.get("max_new_tokens", 128)),
+            temperature=float(kw.get("temperature", 0.0)),
+            stop_on_eos=bool(kw.get("stop_on_eos", True)),
+        )
+        deadline = kw.get("deadline")
+        with self._lock:
+            self._inflight += 1
+        worker = threading.Thread(
+            target=self._run_unary,
+            args=(req, prompt, kw, deadline),
+            name=f"http-replica-{self.name}",
+            daemon=True,
+        )
+        worker.start()
+        return req
+
+    def _run_unary(
+        self, req: Any, prompt: Any, kw: dict, deadline: Optional[Deadline]
+    ) -> None:
+        from gofr_tpu.errors import ErrorServiceUnavailable
+        from gofr_tpu.serving.types import GenerationResult
+
+        start = time.monotonic()
+        try:
+            body: dict[str, Any] = {
+                "prompt": prompt,
+                "max_tokens": int(kw.get("max_new_tokens", 128)),
+                "temperature": float(kw.get("temperature", 0.0)),
+                "stream": False,
+            }
+            # Forward the FULL sampling contract: a remote replica that
+            # silently dropped logit_bias/penalties/adapter would serve
+            # differently-sampled (or base-model) output with a 200.
+            for src, dst in (
+                ("top_p", "top_p"), ("stop", "stop"), ("seed", "seed"),
+                ("logit_bias", "logit_bias"),
+                ("frequency_penalty", "frequency_penalty"),
+                ("presence_penalty", "presence_penalty"),
+                ("top_logprobs", "top_logprobs"),
+                # A loaded LoRA adapter's name IS a model on the OpenAI
+                # surface (this repo's own openai_compat convention).
+                ("adapter", "model"),
+            ):
+                if kw.get(src):
+                    body[dst] = kw[src]
+            headers: dict[str, str] = {}
+            if deadline is not None:
+                headers["X-Request-Timeout"] = str(
+                    max(deadline.remaining(), 0.001)
+                )
+            if kw.get("tenant"):
+                headers["X-Tenant-Id"] = str(kw["tenant"])
+            resp = self.service.post(
+                self.generate_path, json=body, headers=headers
+            )
+            if resp.status_code >= 400:
+                if resp.status_code == 429:
+                    raise ErrorTooManyRequests(
+                        f"replica {self.name} shed the request",
+                        retry_after_s=float(
+                            resp.get_header("Retry-After") or 1.0
+                        ),
+                    )
+                if resp.status_code >= 500:
+                    raise ErrorServiceUnavailable(
+                        f"replica {self.name} answered {resp.status_code}"
+                    )
+                # Request-shaped 4xx (400/404/413/...): surface the
+                # UPSTREAM's status untouched — the request would fail
+                # identically on every replica, so it must not become a
+                # reroutable 503 and bounce around the pool.
+                from gofr_tpu.errors import GofrError
+
+                exc = GofrError(
+                    f"replica {self.name} answered {resp.status_code}: "
+                    f"{resp.body[:200].decode(errors='replace')}"
+                )
+                exc.status_code = resp.status_code
+                raise exc
+            data = resp.json()
+            if isinstance(data, dict) and "choices" not in data:
+                data = data.get("data", data)  # unwrap gofr envelopes
+            choice = (data.get("choices") or [{}])[0]
+            usage = data.get("usage") or {}
+            result = GenerationResult(
+                text=str(choice.get("text", "")),
+                token_ids=[],
+                prompt_tokens=int(usage.get("prompt_tokens", 0)),
+                ttft_s=0.0,
+                duration_s=time.monotonic() - start,
+                finish_reason=str(choice.get("finish_reason", "stop")),
+            )
+            if not req.future.done():
+                req.future.set_result(result)
+        except Exception as exc:  # noqa: BLE001 — every failure must reach the caller
+            try:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            except Exception:  # noqa: BLE001 — future cancelled concurrently
+                pass
+        finally:
+            with self._lock:
+                self._inflight -= 1
+            req.stream.put(None)
+
+    def probe(self, timeout_s: float) -> tuple[str, str]:
+        try:
+            health = self.service.health_check()
+        except Exception as exc:  # noqa: BLE001 — unreachable == failed probe
+            health = {"status": "DOWN", "details": {"error": str(exc)}}
+        if health.get("status") == "UP":
+            self._state = "SERVING"
+            return "pass", ""
+        self._state = "DOWN"
+        return "fail", str(health.get("details", {}).get("error", "DOWN"))
+
+    def revive(self, probe_timeout_s: float = 5.0) -> bool:
+        verdict, _ = self.probe(timeout_s=probe_timeout_s)
+        return verdict == "pass"
+
+    def note_probe_success(self) -> None:
+        # Half-open a stuck breaker anywhere in the option chain: the
+        # probe proved the address serves again (circuit_breaker.py).
+        svc = self.service
+        while svc is not None:
+            hook = getattr(svc, "note_probe_success", None)
+            if callable(hook):
+                hook()
+            svc = getattr(svc, "_inner", None)
+
+    def close(self) -> None:
+        close = getattr(self.service, "close", None)
+        if callable(close):
+            close()
+
+
+class ReplicaPool:
+    """Engine-shaped facade over N replicas (drop-in for
+    ``container.tpu``: the OpenAI routes and both gRPC servicers serve
+    through it unchanged)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Replica],
+        *,
+        # Hedge only requests slower than a typical healthy completion:
+        # multi-token generations run seconds, and a sub-second default
+        # would hedge nearly EVERY request on a healthy pool.
+        hedge_delay_s: float = 2.0,
+        hedge_budget: Optional[HedgeBudget] = None,
+        probe_interval_s: float = 30.0,
+        probe_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+        metrics: Any = None,
+        logger: Any = None,
+    ) -> None:
+        if not replicas:
+            raise ValueError("a replica pool needs at least one replica")
+        self._replicas = list(replicas)
+        self.hedge_delay_s = max(0.0, float(hedge_delay_s))
+        self.hedge_budget = (
+            hedge_budget if hedge_budget is not None
+            else HedgeBudget(clock=clock)
+        )
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random()
+        self._metrics = metrics
+        self._logger = logger
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+        self._probe_stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self._primary_engine = next(
+            (r.engine for r in self._replicas
+             if isinstance(r, EngineReplica)),
+            None,
+        )
+        # Mid-stream failover: each in-proc engine offers the pool its
+        # otherwise-terminal retryable requests (engine.try_handoff →
+        # here → sibling.adopt == requeue_replay).
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                replica.engine.set_replica_handoff(
+                    self._make_handoff(replica)
+                )
+
+    # -- engine facade ----------------------------------------------------
+
+    @property
+    def family(self) -> str:
+        eng = self._primary_engine
+        return str(eng.family) if eng is not None else "llm"
+
+    @property
+    def model_name(self) -> str:
+        eng = self._primary_engine
+        if eng is not None:
+            return str(eng.model_name)
+        return self._replicas[0].name
+
+    @property
+    def tokenizer(self) -> Any:
+        eng = self._primary_engine
+        return eng.tokenizer if eng is not None else None
+
+    @property
+    def replicas(self) -> list[Replica]:
+        return list(self._replicas)
+
+    def __getattr__(self, name: str) -> Any:
+        # Everything the pool does not reinterpret (lora_names,
+        # max_prompt_tokens, embed, register_prefix, ...) delegates to
+        # the primary in-proc engine — the pool is an ENGINE-shaped
+        # object to its callers. (Only reached for attributes not
+        # defined on the pool itself.)
+        eng = self.__dict__.get("_primary_engine")
+        if eng is not None and not name.startswith("__"):
+            return getattr(eng, name)
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self.start_sync()
+
+    def start_sync(self) -> None:
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                replica.engine.start_sync()
+        self.start_prober()
+
+    async def stop(self, drain_s: float = 0.0) -> None:
+        self.stop_prober()
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                # Detach the handoff FIRST: a pool-wide shutdown must
+                # terminate in-flight work, not migrate it replica to
+                # replica (re-decoding delivered prefixes and emitting
+                # phantom failover metrics during a routine deploy).
+                replica.engine.set_replica_handoff(None)
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                replica.engine.stop_sync(drain_s)
+
+    def close(self) -> None:
+        self.stop_prober()
+        for replica in self._replicas:
+            try:
+                replica.close()
+            except Exception as exc:  # noqa: BLE001 — close every replica regardless
+                if self._logger is not None:
+                    self._logger.errorf(
+                        "replica %s close failed: %s", replica.name, exc
+                    )
+
+    # -- routing ----------------------------------------------------------
+
+    def pick(
+        self,
+        exclude: Iterable[Replica] = (),
+        *,
+        require_stream: bool = False,
+    ) -> Replica:
+        """Least-loaded routable replica: SERVING first, spill to
+        DEGRADED, never RESTARTING/DOWN or probe-demoted. Round-robin
+        rotation breaks load ties so equal replicas share traffic.
+        ``require_stream`` restricts to stream-capable (in-proc)
+        backends — a unary-only HTTPReplica handed a streaming request
+        would answer a 200 SSE with zero tokens, which is worse than an
+        honest 502."""
+        excluded = {id(r) for r in exclude}
+
+        def routable(states: tuple[str, ...]) -> list[Replica]:
+            return [
+                r for r in self._replicas
+                if id(r) not in excluded
+                and not r.probe_failed
+                and (r.supports_stream or not require_stream)
+                and r.state() in states
+            ]
+
+        candidates = routable(("SERVING",)) or routable(("DEGRADED",))
+        if candidates:
+            with self._rr_lock:
+                start = self._rr % len(candidates)
+                self._rr += 1
+            rotated = candidates[start:] + candidates[:start]
+            return min(rotated, key=lambda r: r.load())
+        raise ErrorNoHealthyReplica(
+            f"{len(self._replicas)} replica(s), none "
+            + ("stream-capable and " if require_stream else "")
+            + "SERVING or DEGRADED"
+        )
+
+    def _submit_routed(
+        self,
+        prompt: Any,
+        kw: dict,
+        tried: list[Replica],
+        *,
+        require_stream: bool,
+    ) -> tuple[Replica, Any]:
+        """Submit with failover across replicas: per-replica overload or
+        failure (429/5xx, open breaker) reroutes to the next candidate;
+        request-shaped errors (400/413/...) raise immediately — they
+        would fail identically everywhere."""
+        last: Optional[BaseException] = None
+        while True:
+            try:
+                replica = self.pick(
+                    exclude=tried, require_stream=require_stream
+                )
+            except ErrorNoHealthyReplica:
+                if isinstance(last, ErrorTooManyRequests):
+                    raise last from None  # keep the 429 + Retry-After
+                if last is not None:
+                    raise ErrorNoHealthyReplica(str(last)) from last
+                raise
+            tried.append(replica)
+            try:
+                return replica, replica.submit(prompt, **kw)
+            except Exception as exc:
+                if not _is_reroutable(exc):
+                    raise
+                last = exc
+                if self._logger is not None:
+                    self._logger.warnf(
+                        "replica %s rejected a submit (%s); rerouting",
+                        replica.name, exc,
+                    )
+
+    def submit_generate(self, prompt: Any, **kw: Any) -> Any:
+        """Route one generation. The returned handle's STREAM must work
+        (callers can't say whether they will iterate it), so only
+        stream-capable in-proc replicas qualify; unary-only HTTPReplicas
+        serve through :meth:`generate_sync`/:meth:`generate` instead.
+        Mid-stream replica loss is handled by the handoff path, not
+        here."""
+        _, req = self._submit_routed(prompt, kw, [], require_stream=True)
+        return req
+
+    # -- unary with hedged retries ---------------------------------------
+
+    def _hedge_delay(self, deadline: Optional[Deadline]) -> float:
+        """Jittered hedge trigger, clamped under the caller's deadline."""
+        delay = self.hedge_delay_s * (0.75 + 0.5 * self._rng.random())
+        if deadline is not None:
+            delay = min(delay, max(deadline.remaining(), 0.0))
+        return delay
+
+    def should_hedge(self, deadline: Optional[Deadline]) -> bool:
+        """Deadline-aware, budgeted second-attempt decision (latency
+        hedges AND fast-fail retries): never hedge work whose deadline
+        already passed, and never without budget — an exhausted bucket
+        means the tier is slow EVERYWHERE and doubling load would dig
+        the hole deeper."""
+        if deadline is not None and deadline.remaining() <= 0:
+            return False
+        return self.hedge_budget.try_acquire()
+
+    def _count_hedge(self, kind: str) -> None:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_hedged_requests_total", "kind", kind
+            )
+
+    def generate_sync(
+        self, prompt: Any, timeout: float = 300.0, **kw: Any
+    ) -> Any:
+        """Unary generation with bounded hedged retries: a slow primary
+        is raced by one budgeted hedge on a different replica (first
+        success wins, the loser is cancelled); a fast-failing primary is
+        retried once on a sibling. Composes with per-replica circuit
+        breakers — their fast-fails are reroute signals here."""
+        deadline = kw.get("deadline")
+        tried: list[Replica] = []
+        _, req = self._submit_routed(prompt, kw, tried, require_stream=False)
+        live = [req]
+        primary_exc: Optional[BaseException] = None
+        try:
+            return req.future.result(timeout=self._hedge_delay(deadline))
+        except cf.TimeoutError:
+            pass  # primary slow → consider a latency hedge below
+        except cf.CancelledError:
+            live, primary_exc = [], ErrorNoHealthyReplica("request cancelled")
+        except Exception as exc:
+            if not _is_reroutable(exc):
+                raise
+            live, primary_exc = [], exc  # primary failed fast → retry
+        # Hedges AND fast-fail retries both spend from the SAME bucket:
+        # under tier-wide overload, unbudgeted retries would double load
+        # exactly when every replica is already failing. The sibling
+        # check comes FIRST (short-circuit) so a pool with no routable
+        # second replica never burns tokens it cannot use — draining the
+        # bucket on impossible hedges would starve real ones the moment
+        # a sibling recovers.
+        if self._routable_sibling_exists(tried) and self.should_hedge(
+            deadline
+        ):
+            try:
+                _, second = self._submit_routed(
+                    prompt, kw, tried, require_stream=False
+                )
+            except Exception as exc:  # noqa: BLE001 — ride the primary if no sibling
+                if not live:
+                    raise (primary_exc or exc)
+            else:
+                live.append(second)
+                self._count_hedge(
+                    "retry" if primary_exc is not None else "hedge"
+                )
+        elif not live:
+            # Primary failed with no budgeted/routable second attempt:
+            # fail honestly rather than amplify the overload.
+            assert primary_exc is not None
+            raise primary_exc
+        return self._first_result(live, timeout, primary_exc)
+
+    def _routable_sibling_exists(self, tried: list[Replica]) -> bool:
+        excluded = {id(r) for r in tried}
+        return any(
+            id(r) not in excluded
+            and not r.probe_failed
+            and r.state() in ("SERVING", "DEGRADED")
+            for r in self._replicas
+        )
+
+    def _first_result(
+        self,
+        reqs: list[Any],
+        timeout: float,
+        last_exc: Optional[BaseException],
+    ) -> Any:
+        """First successful attempt wins; losers are cancelled so no
+        replica decodes for a caller that already has its answer."""
+        end = time.monotonic() + timeout
+        pending = list(reqs)
+        while pending:
+            by_future = {r.future: r for r in pending}
+            done, _ = cf.wait(
+                list(by_future),
+                timeout=max(0.0, end - time.monotonic()),
+                return_when=cf.FIRST_COMPLETED,
+            )
+            if not done:
+                for r in pending:
+                    r.cancel_request()
+                raise ErrorDeadlineExceeded(
+                    f"no replica answered within {timeout:.1f}s"
+                )
+            for future in done:
+                pending.remove(by_future[future])
+                try:
+                    result = future.result()
+                except Exception as exc:  # noqa: BLE001 — keep racing the others
+                    last_exc = exc
+                    continue
+                for loser in pending:
+                    loser.cancel_request()
+                return result
+        raise last_exc if last_exc is not None else ErrorNoHealthyReplica()
+
+    async def generate(self, prompt: Any, **kw: Any) -> Any:
+        import asyncio
+        from functools import partial
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, partial(self.generate_sync, prompt, **kw)
+        )
+
+    async def generate_stream(self, prompt: Any, **kw: Any):
+        """Async iterator over token ids (engine-API parity); replica
+        loss mid-stream is healed by the handoff path underneath."""
+        import asyncio
+
+        req = self.submit_generate(prompt, **kw)
+        loop = asyncio.get_running_loop()
+        while True:
+            tok = await loop.run_in_executor(None, req.stream.get)
+            if tok is None:
+                return
+            yield tok
+
+    # -- mid-stream failover (engine handoff target) ----------------------
+
+    def _make_handoff(self, source: EngineReplica) -> Callable[[Any], bool]:
+        def handoff(req: Any) -> bool:
+            return self._failover(req, source)
+
+        return handoff
+
+    def _failover(self, req: Any, source: Replica) -> bool:
+        """Adopt a salvaged request from a dying replica onto a healthy
+        sibling. True = requeued (stream/future intact); False = the
+        caller fails it through its terminal path."""
+        tried: list[Replica] = [source]
+        for _ in range(len(self._replicas)):
+            try:
+                # Adoption continues a live STREAM handle: in-proc only.
+                replica = self.pick(exclude=tried, require_stream=True)
+            except ErrorNoHealthyReplica:
+                return False
+            tried.append(replica)
+            if not replica.adopt(req):
+                continue
+            if self._metrics is not None:
+                self._metrics.increment_counter(
+                    "app_tpu_failovers_total",
+                    "from", source.name, "to", replica.name,
+                )
+            if self._logger is not None:
+                self._logger.infof(
+                    "failover: request moved %s → %s (%d token(s) already "
+                    "delivered)",
+                    source.name, replica.name, len(req.token_ids),
+                )
+            return True
+        return False
+
+    # -- active probing ---------------------------------------------------
+
+    def probe_once(self) -> dict[str, str]:
+        """One synthetic-probe sweep (the prober thread's body; tests
+        call it directly — no thread, no sleeps). Per replica:
+
+        * RESTARTING — its supervisor is mid-recovery; leave it alone.
+        * DOWN — demote, attempt a revive, then probe; only a PASSING
+          probe re-admits it.
+        * SERVING/DEGRADED — probe; a failure demotes it and requests a
+          supervisor restart (restart on evidence, not just on crash).
+        """
+        results: dict[str, str] = {}
+        for replica in self._replicas:
+            state = replica.state()
+            if state == "RESTARTING":
+                results[replica.name] = "restarting"
+            elif state == "DOWN":
+                # Probation: only a PASSING probe re-admits a revived
+                # replica (a merely-busy one stays out until it proves
+                # the dataplane end to end).
+                replica.probe_failed = True
+                results[replica.name] = (
+                    self._probe_replica(replica)
+                    if replica.revive(self.probe_timeout_s) else "down"
+                )
+            else:
+                results[replica.name] = self._probe_replica(replica)
+            self._publish_state(replica)
+        return results
+
+    def _probe_replica(self, replica: Replica) -> str:
+        verdict, reason = replica.probe(self.probe_timeout_s)
+        if verdict == "pass":
+            if replica.probe_failed and self._logger is not None:
+                self._logger.infof(
+                    "probe: replica %s passed; re-admitted to routing",
+                    replica.name,
+                )
+            replica.probe_failed = False
+            replica.note_probe_success()
+            return "pass"
+        if verdict == "busy":
+            # Overload is NOT failure: the replica is shedding/congested
+            # under load, which demotion or a restart would only push
+            # onto its siblings (restart cascade). Routing status stays
+            # exactly as it was — a demoted replica still needs a clean
+            # pass to come back.
+            if self._logger is not None:
+                self._logger.infof(
+                    "probe: replica %s busy (%s); no action", replica.name,
+                    reason,
+                )
+            return f"busy: {reason}"
+        replica.probe_failed = True
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_probe_failures_total", "replica", replica.name
+            )
+        if self._logger is not None:
+            self._logger.errorf(
+                "probe: replica %s failed (%s); demoted from routing",
+                replica.name, reason,
+            )
+        replica.notify_probe_failure(reason)
+        return f"fail: {reason}"
+
+    def start_prober(self) -> "ReplicaPool":
+        if self.probe_interval_s <= 0:
+            return self
+        if self._probe_thread is not None and self._probe_thread.is_alive():
+            return self
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="tpu-replica-prober", daemon=True
+        )
+        self._probe_thread.start()
+        return self
+
+    def stop_prober(self) -> None:
+        self._probe_stop.set()
+        thread = self._probe_thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+        self._probe_thread = None
+
+    def _probe_loop(self) -> None:
+        while True:
+            # Jittered interval: a fleet of pools must not probe (or
+            # restart) in lockstep.
+            delay = self.probe_interval_s * (0.5 + self._rng.random())
+            if self._probe_stop.wait(delay):
+                return
+            try:
+                self.probe_once()
+            except Exception as exc:  # noqa: BLE001 — the prober must survive
+                if self._logger is not None:
+                    self._logger.errorf("replica probe sweep failed: %s", exc)
+
+    # -- health -----------------------------------------------------------
+
+    def _publish_state(self, replica: Replica) -> None:
+        if self._metrics is None:
+            return
+        value = (
+            _STATE_ORDER["DOWN"] if replica.probe_failed
+            else _STATE_ORDER.get(replica.state(), 3)
+        )
+        self._metrics.set_gauge(
+            "app_tpu_replica_state", value, "replica", replica.name
+        )
+
+    @property
+    def state(self) -> str:
+        """Pool-level state machine: SERVING while ANY replica serves —
+        single-replica loss is the pool's job to absorb."""
+        states = [
+            "DOWN" if r.probe_failed else r.state() for r in self._replicas
+        ]
+        if "SERVING" in states:
+            return "SERVING"
+        if "DEGRADED" in states or "RESTARTING" in states:
+            return "DEGRADED"
+        return "DOWN"
+
+    def health_check(self) -> dict:
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            detail = replica.describe()
+            if isinstance(replica, EngineReplica):
+                sup = getattr(replica.engine, "_supervisor", None)
+                if sup is not None:
+                    detail["supervisor"] = sup.describe()
+            replicas[replica.name] = detail
+            self._publish_state(replica)
+        pool_state = self.state
+        serving = sum(
+            1 for r in self._replicas
+            if not r.probe_failed and r.state() == "SERVING"
+        )
+        return {
+            "status": "UP" if pool_state == "SERVING" else "DOWN",
+            "state": pool_state,
+            "details": {
+                "model": self.model_name,
+                "family": self.family,
+                "replicas": replicas,
+                "serving": serving,
+                "total": len(self._replicas),
+                "hedge_budget": round(self.hedge_budget.available(), 3),
+            },
+        }

@@ -18,6 +18,23 @@ def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
     from gofr_tpu.serving.engine import InferenceEngine
 
     try:
+        # Replica tier (docs/advanced-guide/resilience.md): TPU_REPLICAS
+        # > 1 and/or TPU_REPLICA_ADDRS front the engine(s) with a
+        # health-aware failover router — container.tpu becomes the POOL
+        # (engine-shaped facade), so every serving surface routes
+        # through it unchanged.
+        n_replicas = int(config.get_or_default("TPU_REPLICAS", "1"))
+        remote_addrs = [
+            a.strip()
+            for a in config.get_or_default(
+                "TPU_REPLICA_ADDRS", ""
+            ).split(",")
+            if a.strip()
+        ]
+        if n_replicas > 1 or remote_addrs:
+            return _new_tpu_pool_from_config(
+                config, max(1, n_replicas), remote_addrs, logger, metrics
+            )
         engine = InferenceEngine.from_config(config, logger=logger, metrics=metrics)
         if logger is not None:
             logger.infof("TPU backend initialised with model %s", model)
@@ -26,6 +43,66 @@ def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
         if logger is not None:
             logger.errorf("could not initialise TPU backend: %s", exc)
         return None
+
+
+def _new_tpu_pool_from_config(
+    config, n_replicas: int, remote_addrs: list, logger, metrics
+):
+    """Build the replica pool: N in-process engines (each with its own
+    supervisor when TPU_RESTART_MAX is set) plus one HTTPReplica per
+    remote address, fronted by a ReplicaPool with the probe/hedge knobs
+    (TPU_PROBE_INTERVAL_S / TPU_PROBE_TIMEOUT_S / TPU_HEDGE_DELAY_S /
+    TPU_HEDGE_BUDGET). In-proc replicas share the same config — same
+    params and engine seed — so cross-replica replay continues streams
+    byte-identically."""
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.lifecycle import HedgeBudget
+    from gofr_tpu.service import new_http_service
+    from gofr_tpu.service.replica_pool import (
+        EngineReplica,
+        HTTPReplica,
+        ReplicaPool,
+    )
+
+    replicas = []
+    for i in range(n_replicas):
+        engine = InferenceEngine.from_config(
+            config, logger=logger, metrics=metrics
+        )
+        replicas.append(EngineReplica(f"engine-{i}", engine))
+    for addr in remote_addrs:
+        replicas.append(
+            HTTPReplica(
+                addr,
+                new_http_service(addr, logger, metrics),
+            )
+        )
+    pool = ReplicaPool(
+        replicas,
+        hedge_delay_s=float(
+            config.get_or_default("TPU_HEDGE_DELAY_S", "2.0")
+        ),
+        hedge_budget=HedgeBudget(
+            burst=float(config.get_or_default("TPU_HEDGE_BUDGET", "8")),
+            rate_per_s=float(
+                config.get_or_default("TPU_HEDGE_RATE_PER_S", "2")
+            ),
+        ),
+        probe_interval_s=float(
+            config.get_or_default("TPU_PROBE_INTERVAL_S", "30")
+        ),
+        probe_timeout_s=float(
+            config.get_or_default("TPU_PROBE_TIMEOUT_S", "30")
+        ),
+        metrics=metrics,
+        logger=logger,
+    )
+    if logger is not None:
+        logger.infof(
+            "TPU replica pool initialised: %d in-proc engine(s), %d "
+            "remote replica(s)", n_replicas, len(remote_addrs),
+        )
+    return pool
 
 
 def new_tpu_embed_from_config(

@@ -97,6 +97,7 @@ class InferenceEngine(
         tenant_queue_max: int = 0,
         expected_tps: float = 0.0,
         watchdog_s: float = 0.0,
+        replay_exact: bool = True,
         params=None,
         logger=None,
         metrics=None,
@@ -203,6 +204,20 @@ class InferenceEngine(
         self._epoch = 0
         self._replay: list[_GenRequest] = []
         self._restart_pending = False  # supervisor teardown in progress
+        # Replica-tier failover (service/replica_pool.py): when this
+        # engine is one replica of a pool, the pool installs a handoff —
+        # terminal failure paths offer still-retryable requests to it
+        # (the pool requeues them on another replica) before failing
+        # them. None outside a pool: failures stay terminal.
+        self._handoff: Optional[Any] = None
+        # Sampled-stream replay policy (TPU_REPLAY_EXACT): True (default)
+        # regenerates the delivered prefix through the decode path —
+        # byte-identical continuation at the cost of re-decoding it;
+        # False re-prefills prompt + delivered tokens and restores the
+        # sampling COUNTER (the noff plane) — one prefill pass, same
+        # sample path, but prefill-kernel bf16 K/V rounding may flip a
+        # later token. Greedy replays always take the fast path.
+        self.replay_exact = bool(replay_exact)
         # Health state machine (SERVING → DEGRADED → RESTARTING → DOWN),
         # surfaced via health_check / both gRPC Health RPCs and the
         # app_tpu_engine_state gauge. DOWN until start_sync.
@@ -537,6 +552,9 @@ class InferenceEngine(
                 config.get_or_default("TPU_EXPECTED_TPS", "0")
             ),
             watchdog_s=float(config.get_or_default("TPU_WATCHDOG_S", "0")),
+            replay_exact=config.get_or_default(
+                "TPU_REPLAY_EXACT", "true"
+            ).lower() in ("1", "true", "yes"),
             logger=logger,
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
@@ -739,6 +757,13 @@ class InferenceEngine(
         self._nsteps_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
         self._seeds_host = np.zeros((n_slots,), dtype=np.int32)
         self._seeds_dev = self._up(self._seeds_host)
+        # Per-slot sampling-counter OFFSET at admission: 0 for fresh
+        # requests; a replayed request's delivered-token count, so its
+        # counter-based sample path continues where the crashed engine
+        # left off (seeded-sampling replay continuity). Uploaded with
+        # the seeds plane under the same dirty flag.
+        self._noff_host = np.zeros((n_slots,), dtype=np.int32)
+        self._noff_dev = self._up(self._noff_host)
         self._seeds_dirty = False
         # Multi-LoRA adapter plane: per-slot adapter index into the
         # stacked [L, 1+lora_slots, ...] adapter leaves (0 = base).
@@ -966,6 +991,58 @@ class InferenceEngine(
         retryable requests for replay instead of failing them."""
         self._supervisor = supervisor
 
+    def set_replica_handoff(self, handoff: Optional[Any]) -> None:
+        """Install a replica-pool handoff: ``handoff(req) -> bool`` is
+        offered every still-retryable request this engine would
+        otherwise fail terminally (crash-loop DOWN, scheduler death with
+        no supervisor). True means the pool adopted it — requeued on
+        another replica via :meth:`requeue_replay`, stream and future
+        intact — so the client never sees this replica die."""
+        self._handoff = handoff
+
+    def try_handoff(self, req: _GenRequest) -> bool:
+        """Offer one request to the attached replica-pool handoff.
+        False when no handoff is installed, the request is no longer
+        retryable, or the pool could not place it (the caller then runs
+        its normal terminal error path). Adapter-bound requests are
+        never handed off (LoRA slot ids are per-engine, so a sibling
+        would serve different weights), and neither are replica-pinned
+        ones (synthetic probes must measure THIS replica)."""
+        handoff = self._handoff
+        if (
+            handoff is None or req.aid or req.pin_replica
+            or not req.retryable()
+        ):
+            return False
+        try:
+            return bool(handoff(req))
+        except Exception as exc:  # noqa: BLE001 — handoff must not mask the drain
+            if self._logger is not None:
+                self._logger.errorf("replica handoff failed: %s", exc)
+            return False
+
+    def synthetic_probe(self, timeout_s: float = 30.0) -> Any:
+        """Active health probe: ONE cheap greedy token through the full
+        submit → prefill → decode → retire path. Raises (or times out)
+        when the serving dataplane is broken in any way a real request
+        would observe — the replica pool's prober demotes the replica
+        and asks the supervisor to restart on that evidence, and a DOWN
+        replica is re-admitted only after this passes."""
+        if self.family != "llm":
+            return self.health_check()
+        # Pinned to THIS engine: a probe the pool fails over to a
+        # healthy sibling would report a dead replica as alive.
+        req = self.submit_generate(
+            [1], max_new_tokens=1, temperature=0.0, stop_on_eos=False,
+            pin_replica=True,
+        )
+        try:
+            return req.future.result(timeout=timeout_s)
+        finally:
+            # A timed-out probe must not decode forever in a live slot.
+            if not req.future.done():
+                req.cancel_request()
+
     def _set_state(self, state: str) -> None:
         """Health state machine transition (SERVING → DEGRADED →
         RESTARTING → DOWN), mirrored to the app_tpu_engine_state gauge
@@ -1010,10 +1087,33 @@ class InferenceEngine(
         if not req.retryable():
             return False
         # Admission-scoped fields reset so the fresh scheduler re-admits
-        # from scratch; prefill_ids() covers the already-emitted tokens.
+        # from scratch.
         req.effective_prompt_len = 0
         req.replays += 1
-        req.replayed_tokens = len(req.token_ids)
+        if req.temperature > 0 and self.replay_exact:
+            # SAMPLED stream → EXACT replay (TPU_REPLAY_EXACT, default):
+            # regenerate the delivered prefix from the prompt through
+            # the decode path (counter restarts at 0 and
+            # deterministically re-walks the same sample path; the
+            # scheduler swallows the re-generated prefix). Re-prefilling
+            # the delivered tokens would write their K/V through the
+            # prefill kernel, whose bf16 rounding differs from the
+            # original decode writes by enough to flip a later sampled
+            # token.
+            req.replay_skip = len(req.token_ids)
+            req.replayed_tokens = 0
+        else:
+            # FAST replay: re-prefill prompt + delivered tokens
+            # (prefill_ids) in one pass and resume at the next position;
+            # the sampling-counter offset plane restores the PRNG step
+            # (ReplayState.n_sampled) so a sampled stream continues on
+            # the SAME counter path. Greedy streams always take this
+            # path (argmax is robust to the prefill/decode kernel
+            # rounding); sampled streams take it under
+            # TPU_REPLAY_EXACT=false, trading possible bf16-rounding
+            # token flips for not re-decoding a long delivered prefix.
+            req.replay_skip = 0
+            req.replayed_tokens = len(req.token_ids)
         cost = len(req.prompt_ids) + req.max_new_tokens
         with self._submit_lock:
             if not self._running or self._drained or self._draining:
@@ -1036,8 +1136,9 @@ class InferenceEngine(
         if self._logger is not None:
             self._logger.infof(
                 "replayed request after restart (%d token(s) already "
-                "delivered, %d remaining)",
+                "delivered, %d remaining, mode=%s)",
                 len(req.token_ids), req.max_new_tokens - len(req.token_ids),
+                "regenerate" if req.replay_skip else "re-prefill",
             )
         return True
 
@@ -1224,6 +1325,7 @@ class InferenceEngine(
         deadline_s: "Optional[float]" = None,
         cancel: "Optional[CancelToken]" = None,
         tenant: str = "",
+        pin_replica: bool = False,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -1361,6 +1463,7 @@ class InferenceEngine(
             lora_gen=self._lora_gen[aid] if aid else 0,
             deadline=coalesce_deadline(deadline, deadline_s),
             tenant=str(tenant or ""),
+            pin_replica=pin_replica,
         )
         if cancel is not None:
             # Share the transport's token (HTTP disconnect, gRPC cancel)

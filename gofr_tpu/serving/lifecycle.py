@@ -165,6 +165,60 @@ class AggregateThroughput:
             self._total -= n
 
 
+class HedgeBudget:
+    """Token-bucket budget for hedged/retried requests (replica pool).
+
+    Unbounded hedging doubles load exactly when the tier is already
+    slow — the classic retry-storm amplifier. This bucket caps extra
+    attempts: it starts full at ``burst`` tokens and refills at
+    ``rate_per_s``; every hedge or failover retry must
+    :meth:`try_acquire` a token first, and a drained bucket means the
+    request simply waits on its primary attempt instead of multiplying.
+
+    Deterministic by construction (this module's contract): the clock is
+    injectable and refill is computed, never slept for. Thread-safe —
+    acquired from request threads and the pool's prober alike.
+    """
+
+    def __init__(
+        self,
+        burst: float = 8.0,
+        rate_per_s: float = 2.0,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.burst = max(0.0, float(burst))
+        self.rate_per_s = max(0.0, float(rate_per_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._last = clock()
+
+    def _refill(self, now: float) -> None:
+        # Callers hold self._lock.
+        if now > self._last:
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate_per_s
+            )
+        self._last = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        """Take ``n`` tokens if available; False (no partial take, no
+        blocking) when the budget is exhausted."""
+        with self._lock:
+            self._refill(self._clock())
+            if self._tokens < n:
+                return False
+            self._tokens -= n
+            return True
+
+    def available(self) -> float:
+        """Current token balance (after refill) — observability only."""
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
+
+
 def coalesce_deadline(
     deadline: Optional[Deadline], deadline_s: Optional[float]
 ) -> Optional[Deadline]:

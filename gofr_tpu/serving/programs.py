@@ -142,7 +142,7 @@ class LLMProgramsMixin:
         def _prefill_core(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, aids, use_bias,
+            nsteps, bidx, bval, topi, topl, aids, noff, use_bias,
         ):
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
@@ -157,7 +157,12 @@ class LLMProgramsMixin:
                 params, tokens, cache, slots, starts, lens, cfg,
                 dense_attn=dense_attn, aids=aids[slots],
             )
-            sub = row_keys(seeds[slots], jnp.zeros_like(slots))
+            # Sample at the slot's counter OFFSET (noff): 0 for fresh
+            # admissions, the delivered-token count for replayed requests
+            # — so a non-greedy stream carried across a restart continues
+            # on the same counter-based sample path (seeded-sampling
+            # replay continuity).
+            sub = row_keys(seeds[slots], noff[slots])
             first, first_lp, ftopi, ftopl = sample(
                 logits, sub, temps, greedy, topps,
                 bias=(bidx[slots], bval[slots]) if use_bias else None,
@@ -179,9 +184,9 @@ class LLMProgramsMixin:
                 pcounts = pcounts.at[
                     jnp.arange(S), all_tokens
                 ].add(has.astype(jnp.int32))
-            # The first token was sampled with n=0; the slot's next sample
-            # uses n=1.
-            nsteps = jnp.where(has, 1, nsteps)
+            # The finalize token was sampled with n=noff; the slot's next
+            # sample uses n=noff+1 (fresh requests: 0 then 1).
+            nsteps = jnp.where(has, noff + 1, nsteps)
             if top_lp_k:
                 topi = jnp.where(has[:, None], ftopi[idx], topi)
                 topl = jnp.where(has[:, None], ftopl[idx], topl)
@@ -253,13 +258,14 @@ class LLMProgramsMixin:
             )
 
         @partial(
-            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19, 21),
+            jax.jit, donate_argnums=(1, 12, 13, 14, 15, 18, 19, 22),
             static_argnames=("use_bias",),
         )
         def prefill_chunk_step_hist(
             params, cache, tokens, slots, starts, lens, finalize, row_valid,
             temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, aids, history, use_bias=False,
+            nsteps, bidx, bval, topi, topl, aids, noff, history,
+            use_bias=False,
         ):
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
@@ -267,7 +273,7 @@ class LLMProgramsMixin:
                 params, cache, tokens, slots, starts, lens, finalize,
                 row_valid, temps, greedy, topps, seeds, all_tokens,
                 all_logps, pcounts, nsteps, bidx, bval, topi, topl, aids,
-                use_bias,
+                noff, use_bias,
             )
             c = tokens.shape[1]
             hpos = jnp.clip(
@@ -641,7 +647,7 @@ class LLMProgramsMixin:
                     self._seeds_dev, self._tokens_dev, self._logps_dev,
                     self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
                     self._bval_dev, self._topi_dev, self._topl_dev,
-                    self._aids_dev,
+                    self._aids_dev, self._noff_dev,
                     use_bias=False,
                 )
             )

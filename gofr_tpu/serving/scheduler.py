@@ -179,10 +179,9 @@ class SchedulerMixin:
         )
         salvaged: list[_GenRequest] = []
 
-        def _fail(req) -> None:
-            if salvaging and req.retryable():
-                salvaged.append(req)
-                return
+        handoff_after: list[_GenRequest] = []
+
+        def _terminal(req) -> None:
             # done() + InvalidStateError guard: an async caller may have
             # cancelled the future already.
             try:
@@ -191,6 +190,29 @@ class SchedulerMixin:
             except InvalidStateError:  # cancelled concurrently
                 pass
             req.stream.put(None)
+
+        def _fail(req) -> None:
+            if salvaging and req.retryable():
+                salvaged.append(req)
+                return
+            # Replica-pool handoff: with no supervisor to replay locally
+            # (or a stopping one), a still-retryable request can instead
+            # continue on a SIBLING replica — the pool requeues it with
+            # its stream/future intact. Deferred past the submit-lock
+            # release below: adoption takes the SIBLING engine's submit
+            # lock, and two replicas draining into each other under
+            # their own locks would deadlock. Only unplaceable requests
+            # get the terminal error.
+            if (
+                not salvaging
+                and self._handoff is not None
+                and not req.aid
+                and not req.pin_replica
+                and req.retryable()
+            ):
+                handoff_after.append(req)
+                return
+            _terminal(req)
 
         # Block on in-flight windows first: returning from stop with device
         # computations + async host copies still outstanding races
@@ -235,6 +257,10 @@ class SchedulerMixin:
             self._prefill_emits.clear()
             if salvaged:
                 self._replay.extend(salvaged)
+        # Handoffs run with the submit lock RELEASED (see _fail above).
+        for req in handoff_after:
+            if not self.try_handoff(req):
+                _terminal(req)
         # Wake any graceful drain blocked on the idle event: whether this
         # exit was clean or fatal, there is nothing left to wait for.
         self._idle_evt.set()
@@ -477,6 +503,11 @@ class SchedulerMixin:
                 self._dispatched_tokens[free[0]] = 0
             slot = free.pop(0)
             self._seeds_host[slot] = req.seed
+            # Sampling-counter offset: a replayed request resumes its
+            # counter-based sample path at the delivered-token count
+            # (fresh requests start at 0), so non-greedy streams carried
+            # across a restart continue byte-identically.
+            self._noff_host[slot] = req.replayed_tokens
             self._aids_host[slot] = req.aid
             self._bidx_host[slot, :] = -1
             self._bval_host[slot, :] = 0.0
@@ -516,6 +547,7 @@ class SchedulerMixin:
             # flush only on the single-chunk path would prefill a long
             # prompt with the slot's PREVIOUS occupant's adapter.
             self._seeds_dev = self._up(self._seeds_host)
+            self._noff_dev = self._up(self._noff_host)
             self._bidx_dev = self._up(self._bidx_host)
             self._bval_dev = self._up(self._bval_host)
             self._aids_dev = self._up(self._aids_host)
@@ -630,7 +662,7 @@ class SchedulerMixin:
             self._seeds_dev, self._tokens_dev, self._logps_dev,
             self._pcounts_dev, self._nsteps_dev, self._bidx_dev,
             self._bval_dev, self._topi_dev, self._topl_dev,
-            self._aids_dev,
+            self._aids_dev, self._noff_dev,
         )
         # Static compile choice: the no-bias program has no bias scatter
         # at all (each variant compiles once, then caches).
@@ -1120,6 +1152,28 @@ class SchedulerMixin:
 
     def _emit_token(self, seq: _ActiveSeq, tok: int, logprob: float,
                     top=None) -> None:
+        req = seq.request
+        if req.replay_skip > 0:
+            # Exact-replay regeneration phase: this token was already
+            # delivered to the client before the restart — swallow the
+            # re-generated copy instead of duplicating it on the
+            # stream. The walk is deterministic (counter-based
+            # sampling), so a mismatch means the replay landed on a
+            # different engine seed/params — log it, the stream stays
+            # consistent with what was already delivered.
+            idx = len(req.token_ids) - req.replay_skip
+            if (
+                self._logger is not None
+                and 0 <= idx < len(req.token_ids)
+                and req.token_ids[idx] != tok
+            ):
+                self._logger.warnf(
+                    "exact replay diverged at position %d (%d != %d); "
+                    "do the pool's replicas share TPU_SEED?",
+                    idx, tok, req.token_ids[idx],
+                )
+            req.replay_skip -= 1
+            return
         if seq.request.top_logprobs:
             seq.request.token_top_logprobs.append(top)
         seq.request.token_ids.append(tok)

@@ -190,6 +190,56 @@ class EngineSupervisor:
         requests into the engine's replay list) and died."""
         self._request_recovery(f"scheduler crash: {exc}")
 
+    def notify_probe_failure(self, reason: str) -> None:
+        """A synthetic health probe failed against a replica that still
+        CLAIMS to be serving (replica pool's active prober): the serving
+        dataplane is broken in a way no crash or watchdog trip caught —
+        treat it as a detected failure and restart, instead of waiting
+        for a real request to wedge. Degrades first so health endpoints
+        and the pool's router stop sending traffic immediately."""
+        self._engine._set_state("DEGRADED")
+        self._request_recovery(f"probe: {reason}")
+
+    def note_probe_success(self) -> None:
+        """A synthetic probe PASSED (pool prober): the engine provably
+        serves end to end, so the crash-loop window closes — the
+        consecutive-failure counter resets and the next failure starts a
+        fresh restart budget rather than landing straight in DOWN."""
+        self._consecutive = 0
+        self._last_recovered_at = self._clock()
+
+    def revive(self) -> bool:
+        """Bring a DOWN engine back for probation (probe-driven
+        re-admission): restart it with a FRESH crash-loop budget. The
+        caller (the pool's prober) must follow with a passing synthetic
+        probe before routing traffic again — revive restores the
+        machinery, the probe earns re-admission. Returns False when the
+        supervisor is stopping or the restart itself fails (the engine
+        stays DOWN)."""
+        with self._lock:
+            if self._stopping:
+                return False
+        try:
+            self._engine.restart_sync()
+        except Exception as exc:  # noqa: BLE001 — a failed revive must report, not raise
+            if self._logger is not None:
+                self._logger.errorf(
+                    "supervisor: revive failed; engine stays DOWN: %s", exc
+                )
+            try:
+                self._engine.stop_sync()
+            except Exception:  # graftlint: disable=GL006 — best-effort rollback; the revive failure above is already logged
+                pass
+            return False
+        self._consecutive = 0
+        self._last_recovered_at = self._clock()
+        if self._logger is not None:
+            self._logger.infof(
+                "supervisor: engine revived from DOWN (probe-driven); "
+                "restart budget reset"
+            )
+        return True
+
     def _request_recovery(self, reason: str) -> None:
         with self._lock:
             if self._stopping:
@@ -408,6 +458,10 @@ class EngineSupervisor:
                 with eng._submit_lock:
                     eng._replay.append(req)
                 continue
+            # A request the fresh queue could not take (full) may still
+            # continue on a sibling replica before failing terminally.
+            if eng.try_handoff(req):
+                continue
             dropped += 1
             self._fail_request(req)
         return replayed, dropped
@@ -473,4 +527,11 @@ class EngineSupervisor:
         with eng._submit_lock:
             reqs, eng._replay = list(eng._replay), []
         for req in reqs:
+            # Replica-tier failover: a still-retryable request this
+            # replica can no longer serve continues on a SIBLING replica
+            # when a pool handoff is installed — the client's stream and
+            # future carry over; only unplaceable requests get the
+            # crash-loop terminal error.
+            if eng.try_handoff(req):
+                continue
             self._fail_request(req, exc)

@@ -82,6 +82,15 @@ class ReplayState:
     seed: int
     stop_on_eos: bool
     stop_texts: list[str]
+    # Sampling counter at snapshot time (the observability record, like
+    # the rest of this snapshot): the first generated token is sampled
+    # with counter 0, so after E delivered tokens the next draw must use
+    # counter E. The RUNTIME restore flows through the request object —
+    # ``requeue_replay`` sets ``replayed_tokens`` (== this value on the
+    # fast replay path) and admission mirrors it into the per-slot
+    # sample-offset plane — so a non-greedy replayed stream continues on
+    # the same sample path instead of restarting at step 0.
+    n_sampled: int = 0
 
     @property
     def remaining_tokens(self) -> int:
@@ -149,6 +158,20 @@ class _GenRequest:
     # context-length guard must not count them twice).
     replays: int = 0
     replayed_tokens: int = 0
+    # Pinned to the engine it was submitted to: never handed off to a
+    # sibling replica. Synthetic health probes set this — a probe that a
+    # HEALTHY sibling completes would report the dead replica as alive.
+    pin_replica: bool = False
+    # EXACT (regeneration) replay, used for sampled streams: the engine
+    # re-generates the delivered prefix from the prompt through the
+    # decode path (counter-based sampling makes the walk bit-identical)
+    # and the scheduler swallows this many re-generated tokens instead
+    # of duplicating them on the client stream. Re-prefilling the
+    # delivered tokens instead (the greedy replay path) writes their
+    # K/V through the prefill kernel, which differs from the original
+    # decode-written K/V by bf16 rounding — enough to flip a sampled
+    # token, though never a greedy argmax.
+    replay_skip: int = 0
 
     @property
     def remaining_new_tokens(self) -> int:
@@ -167,11 +190,15 @@ class _GenRequest:
     def prefill_ids(self) -> list[int]:
         """The token ids admission must prefill: the prompt plus any
         continuation tokens already delivered before an engine restart.
-        A replayed request re-prefills its full context so the next
-        sampled token is exactly the continuation — no client-visible
-        duplicates and no gaps. Fresh requests have no emitted tokens,
-        so this is their prompt unchanged."""
-        if self.token_ids:
+        A greedy replayed request re-prefills its full context so the
+        next token is exactly the continuation — no client-visible
+        duplicates and no gaps. An EXACT (regeneration) replay
+        (``replay_skip`` > 0) prefills the prompt only: the delivered
+        tokens re-generate through the decode path so their K/V — and
+        therefore every later sampled token — is bit-identical. Fresh
+        requests have no emitted tokens, so this is their prompt
+        unchanged."""
+        if self.token_ids and not self.replay_skip:
             return self.prompt_ids + self.token_ids
         return self.prompt_ids
 
@@ -205,6 +232,9 @@ class _GenRequest:
             seed=self.seed,
             stop_on_eos=self.stop_on_eos,
             stop_texts=list(self.stop_texts),
+            # Counter-based sampling consumes exactly one step per
+            # emitted token, so the delivered count IS the PRNG step.
+            n_sampled=len(self.token_ids),
         )
 
 

@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 
 class Watchdog:
@@ -41,8 +41,8 @@ class Watchdog:
         *,
         clock: Callable[[], float] = time.monotonic,
         on_trip: Optional[Callable[[str], None]] = None,
-        metrics=None,
-        logger=None,
+        metrics: Any = None,
+        logger: Any = None,
         model_name: str = "",
         check_interval_s: Optional[float] = None,
     ) -> None:

@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/serving-types strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL007 against the committed
+#   graftlint — TPU-correctness rules GL001–GL009 against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
 #
@@ -26,9 +26,10 @@ fi
 if command -v mypy >/dev/null 2>&1; then
   echo "== mypy (scoped) =="
   mypy gofr_tpu/analysis gofr_tpu/config gofr_tpu/logging \
-    gofr_tpu/metrics gofr_tpu/tracing \
+    gofr_tpu/metrics gofr_tpu/tracing gofr_tpu/faults \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
-    gofr_tpu/serving/batcher.py || failed=1
+    gofr_tpu/serving/batcher.py gofr_tpu/serving/supervisor.py \
+    gofr_tpu/serving/watchdog.py || failed=1
 else
   echo "== mypy == SKIPPED (not installed; pip install mypy)"
 fi

@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL008), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL009), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -489,6 +489,71 @@ def test_gl008_ignores_conversions_outside_bodies(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL009 — per-request jit-cache growth
+# ----------------------------------------------------------------------
+
+
+def test_gl009_flags_shape_keyed_lru_cache_and_dict_cached_jit(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/progs.py",
+        """
+        import functools
+        from functools import lru_cache
+
+        import jax
+
+        class Engine:
+            @lru_cache(maxsize=128)
+            def _program(self, seq_len):
+                # Method + per-request key: one executable per observed
+                # prompt length, and the cache pins self forever.
+                return jax.jit(lambda x: x * seq_len)
+
+            def warm(self, prompt_len):
+                self._cache[prompt_len] = jax.jit(lambda x: x)
+                self._cache.setdefault(prompt_len, jax.jit(lambda x: x))
+
+        @functools.cache
+        def build_step(n_tokens):
+            # Unbounded decorator around a jit builder.
+            return jax.jit(lambda x: x[:n_tokens])
+        """,
+        select=["GL009"],
+    )
+    assert ids == ["GL009", "GL009", "GL009", "GL009"]
+    assert "padding bucket" in findings[0].message
+
+
+def test_gl009_ignores_bounded_bucketed_caches(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/progs.py",
+        """
+        from functools import lru_cache
+
+        import jax
+
+        @lru_cache(maxsize=8)
+        def program_for_bucket(bucket):
+            # Module-level, bounded, keyed on a CLOSED bucket set — the
+            # fix the rule recommends.
+            return jax.jit(lambda x: x + bucket)
+
+        @lru_cache
+        def expensive_lookup(seq_len):
+            # Shape-ish key but no jit built: not a compile cache.
+            return seq_len * 2
+
+        PROGS = {}
+
+        def warm(bucket):
+            PROGS[bucket] = jax.jit(lambda x: x)  # bucket id key: fine
+        """,
+        select=["GL009"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -647,7 +712,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008",
+        "GL008", "GL009",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2

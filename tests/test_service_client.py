@@ -280,6 +280,60 @@ def test_circuit_breaker_close_stops_probe_ticker(upstream):
         upstream.state["fail"] = False
 
 
+def test_circuit_breaker_half_opens_on_probe_success(upstream):
+    """Regression (replica-pool composition): a breaker stuck open on a
+    replica that has RETURNED to serving must half-open on the next
+    successful synthetic probe (``note_probe_success``) instead of
+    waiting out the full probe interval — with no traffic and a long
+    ticker, the old behavior kept a healthy replica dark for minutes."""
+    svc = new_http_service(
+        upstream.address, None, None,
+        HealthConfig("/data"),
+        # interval_s huge: the background ticker can never be the thing
+        # that closes the circuit inside this test.
+        CircuitBreakerConfig(threshold=1, interval_s=3600),
+    )
+    upstream.state["fail"] = True
+    assert svc.get("/data").status_code == 500  # opens the breaker
+    assert svc.is_open
+    with pytest.raises(CircuitOpenError):
+        svc.get("/data")
+    # The upstream recovers, but NO requests arrive to trigger the
+    # request-path probe: without the hook the circuit stays open until
+    # the 1-hour ticker fires.
+    upstream.state["fail"] = False
+    assert svc.is_open
+    svc.note_probe_success()  # the pool's synthetic probe passed
+    assert not svc.is_open
+    assert svc.get("/data").status_code == 200
+    svc.close()
+
+
+def test_replica_probe_half_opens_breaker_through_option_chain(upstream):
+    """The pool reaches the breaker through however many option
+    wrappers compose the service: HTTPReplica.note_probe_success walks
+    the ``_inner`` chain."""
+    from gofr_tpu.service.replica_pool import HTTPReplica
+
+    svc = new_http_service(
+        upstream.address, None, None,
+        HealthConfig("/data"),
+        CircuitBreakerConfig(threshold=1, interval_s=3600),
+        DefaultHeaders({"X-Custom": "wrapped"}),  # breaker is now inner
+    )
+    upstream.state["fail"] = True
+    assert svc.get("/data").status_code == 500
+    breaker = svc._inner  # the DefaultHeaders wrapper wraps the breaker
+    assert breaker.is_open
+    upstream.state["fail"] = False
+    replica = HTTPReplica("r0", svc)
+    verdict, _ = replica.probe(timeout_s=5.0)
+    assert verdict == "pass"
+    replica.note_probe_success()
+    assert not breaker.is_open
+    svc.close()
+
+
 def test_circuit_breaker_recovery_clears_state_gauge(upstream):
     from gofr_tpu.metrics import new_metrics_manager
 
