@@ -19,7 +19,11 @@ graftlint is an AST-based rule engine purpose-built for this codebase:
 * ``GL006`` broad exception handlers that silently swallow errors in
   request paths;
 * ``GL007`` donated-buffer reuse after ``donate_argnums``;
-* ``GL008`` ``jnp.asarray``/``jnp.array`` inside ``lax.scan`` bodies.
+* ``GL008`` ``jnp.asarray``/``jnp.array`` inside ``lax.scan`` bodies;
+* ``GL009`` per-request jit-cache growth (shape-keyed lru_cache/dict
+  caches of jit builders);
+* ``GL010`` repeated host pulls (``np.asarray``/``jax.device_get``) of
+  the same device value inside a loop body.
 
 Run it as ``python -m gofr_tpu.analysis [paths]``; suppress a finding
 in place with ``# graftlint: disable=GL001`` and record pre-existing

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL009).
+"""The graftlint rule set (GL001–GL010).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1160,6 +1160,137 @@ class JitCacheGrowthRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL010 — repeated host pull of the same device value in a loop
+# ----------------------------------------------------------------------
+
+
+class RepeatedHostPullRule(Rule):
+    """``np.asarray(x)`` / ``jax.device_get(x)`` re-materializes the
+    ENTIRE device array on the host every call. Doing it repeatedly for
+    the same value inside one loop body — the typical shape is indexing
+    one row per iteration, ``np.asarray(x_dev)[row]`` — pays the full
+    device→host copy once per iteration for data that does not change
+    across iterations. (The scheduler's prefill-emit loop did exactly
+    this: every emitting row re-pulled the whole fetched block, per row,
+    per window.) The fix is one hoisted host copy before (or memoized
+    across) the loop, indexed per iteration.
+
+    Conservative by design: only *literally identical* name/attribute
+    arguments count, a rebind of the argument anywhere in the loop body
+    disqualifies it (each iteration may legitimately pull a different
+    array under the same name), and nested function bodies are skipped
+    (a closure is not executed per iteration by the loop itself).
+    ``jnp.asarray`` is the host→device direction and is GL008's
+    business, not this rule's.
+    """
+
+    rule_id = "GL010"
+    name = "repeated-host-pull"
+    rationale = (
+        "pulling the same device value to host more than once in a loop "
+        "re-copies the full array per iteration; hoist one host copy "
+        "before the loop and index it"
+    )
+
+    @staticmethod
+    def _pull_arg(node: ast.AST) -> Optional[str]:
+        """The pulled value's dotted name for ``np.asarray(x)`` /
+        ``numpy.asarray(x)`` / ``jax.device_get(x)`` calls; None for
+        anything else (including ``jnp.asarray`` — that is an upload)."""
+        if not isinstance(node, ast.Call) or not node.args:
+            return None
+        fname = dotted_name(node.func) or ""
+        short = fname.rsplit(".", 1)[-1]
+        if short == "asarray":
+            if fname.rsplit(".", 1)[0] not in ("np", "numpy"):
+                return None
+        elif short != "device_get":
+            return None
+        return dotted_name(node.args[0])
+
+    @staticmethod
+    def _loop_walk(loop: ast.AST) -> Iterator[ast.AST]:
+        """Every node lexically inside the loop's body/orelse, skipping
+        nested function/lambda bodies (not run per iteration by this
+        loop) but descending into nested loops/ifs/withs."""
+        stack = list(getattr(loop, "body", [])) + list(
+            getattr(loop, "orelse", [])
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _rebound_disqualifies(arg: str, rebound: set[str]) -> bool:
+        """A pull of ``arg`` is disqualified when any rebound target is
+        a dotted prefix of it (rebinding ``self``/``self.buf`` changes
+        what ``self.buf.x`` resolves to) or vice versa (storing through
+        ``self.buf.x`` may mutate the object ``self.buf`` holds)."""
+        parts = arg.split(".")
+        prefixes = {".".join(parts[: i + 1]) for i in range(len(parts))}
+        if prefixes & rebound:
+            return True
+        return any(r.startswith(arg + ".") for r in rebound)
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        seen: set[tuple[int, str]] = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            rebound: set[str] = set()
+            if isinstance(loop, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(loop.target):
+                    if isinstance(t, ast.Name):
+                        rebound.add(t.id)
+            pulls: dict[str, list[ast.Call]] = {}
+            for node in self._loop_walk(loop):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    rebound.add(node.id)
+                elif isinstance(
+                    node, (ast.Attribute, ast.Subscript)
+                ) and isinstance(node.ctx, (ast.Store, ast.Del)):
+                    # self.buf = ... / self.buf[i] = ... inside the loop:
+                    # pulls of self.buf (or anything reached through it)
+                    # may see a different array each iteration, same as
+                    # a bare-name rebind.
+                    target = (
+                        node if isinstance(node, ast.Attribute)
+                        else node.value
+                    )
+                    dn = dotted_name(target)
+                    if dn:
+                        rebound.add(dn)
+                arg = self._pull_arg(node)
+                if arg is not None:
+                    pulls.setdefault(arg, []).append(node)  # type: ignore[arg-type]
+            for arg, calls in pulls.items():
+                if len(calls) < 2:
+                    continue
+                if self._rebound_disqualifies(arg, rebound):
+                    continue  # per-iteration value: each pull differs
+                calls.sort(key=lambda c: (c.lineno, c.col_offset))
+                anchor = calls[1]
+                key = (anchor.lineno, arg)
+                if key in seen:  # nested loops see the same pair twice
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, anchor,
+                    f"`{arg}` is pulled to host {len(calls)} times in "
+                    f"this loop — each call copies the full device "
+                    f"array; hoist one host copy before the loop and "
+                    f"index it per iteration",
+                )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1173,6 +1304,7 @@ ALL_RULES = (
     DonatedBufferReuseRule,
     ScanBodyAsarrayRule,
     JitCacheGrowthRule,
+    RepeatedHostPullRule,
 )
 
 
@@ -1188,4 +1320,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         DonatedBufferReuseRule(),
         ScanBodyAsarrayRule(),
         JitCacheGrowthRule(),
+        RepeatedHostPullRule(),
     ]

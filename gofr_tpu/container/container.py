@@ -157,6 +157,23 @@ class Container:
         m.new_gauge(
             "app_tpu_kv_blocks_free", "paged KV cache: free pool blocks"
         )
+        # Automatic block-level prefix caching (TPU_AUTO_PREFIX;
+        # docs/advanced-guide/prefix-caching.md): radix-index lookups at
+        # admission, prompt tokens served by aliased cached blocks
+        # instead of re-prefill, and the index's resident block count.
+        m.new_counter(
+            "app_tpu_prefix_lookup_total",
+            "radix prefix-cache lookups at admission (result=hit|miss)",
+        )
+        m.new_counter(
+            "app_tpu_prefix_hit_tokens_total",
+            "prompt tokens admission-aliased from cached KV blocks "
+            "(prefill skipped)",
+        )
+        m.new_gauge(
+            "app_tpu_prefix_cached_blocks",
+            "KV blocks currently held by the radix prefix index",
+        )
         # Request-lifecycle resilience (docs/advanced-guide/resilience.md):
         # shedding, cancellation, deadlines, and the scheduler watchdog.
         m.new_counter(

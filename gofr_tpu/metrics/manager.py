@@ -177,6 +177,20 @@ class Manager:
             return
         self._check_cardinality(inst)
 
+    def add_counter(self, name: str, value: float, *labels: Any) -> None:
+        """Increment a counter by ``value`` (>0) — token-denominated
+        counters (e.g. prefix hit tokens) add per-request amounts in one
+        call instead of N increments."""
+        inst = self._get(name, Counter)
+        if not isinstance(inst, Counter):
+            return
+        try:
+            inst.add(float(value), labels)
+        except ValueError as exc:
+            self._log_error(f"metrics {name}: {exc}")
+            return
+        self._check_cardinality(inst)
+
     def delta_updown_counter(self, name: str, value: float, *labels: Any) -> None:
         inst = self._get(name, UpDownCounter)
         if not isinstance(inst, UpDownCounter):

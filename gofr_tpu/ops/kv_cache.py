@@ -27,8 +27,10 @@ updated buffers which XLA aliases in place when the jitted step donates them
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 
@@ -190,6 +192,101 @@ class PagedKVCache(NamedTuple):
         if self.k_s is not None:
             total += self.k_s.size * self.k_s.dtype.itemsize * 2
         return int(total)
+
+
+class BlockAllocator:
+    """Host-side refcounted allocator over the paged pool's physical
+    blocks (block 0 is the reserved parking block and never handed out).
+
+    The original paged allocator was a bare free list: every block
+    belonged to exactly one slot and retirement returned it. Automatic
+    prefix caching (serving/radix_cache.py) shares fully-filled prompt
+    blocks across requests by block-table aliasing, so ownership becomes
+    counted: a block's refcount is the number of live slot tables that
+    reference it plus one if the radix index holds it. A block returns
+    to the free list exactly when its refcount reaches zero.
+
+    Thread safety: admission/retirement mutate from the scheduler
+    thread, but ``RadixPrefixIndex.purge_aid`` decrefs from whichever
+    thread calls ``load_lora``/``unload_lora``, so the count/free-list
+    transitions hold an internal lock (host bookkeeping — contention is
+    nil next to a device dispatch).
+    """
+
+    def __init__(self, n_blocks: int) -> None:
+        import threading
+
+        self.n_blocks = int(n_blocks)
+        self._lock = threading.Lock()
+        # Pop from the end → highest ids hand out first (the original
+        # free-list order; tests and the soak script watch its length).
+        self._free: list[int] = list(range(1, self.n_blocks))
+        self._refs: list[int] = [0] * self.n_blocks
+
+    @property
+    def free_blocks(self) -> list[int]:
+        """Free-list view (length == free blocks). Treat as read-only."""
+        return self._free
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, bid: int) -> int:
+        return self._refs[bid]
+
+    def alloc(self) -> Optional[int]:
+        """One free block with refcount 1, or None when the pool is dry
+        (callers may evict unreferenced radix-cached blocks and retry).
+        """
+        with self._lock:
+            if not self._free:
+                return None
+            bid = self._free.pop()
+            self._refs[bid] = 1
+            return bid
+
+    def incref(self, bid: int) -> int:
+        """Add a reference (block-table aliasing / radix adoption)."""
+        with self._lock:
+            if self._refs[bid] <= 0:
+                raise ValueError(f"incref of free block {bid}")
+            self._refs[bid] += 1
+            return self._refs[bid]
+
+    def decref(self, bid: int) -> bool:
+        """Drop one reference; True when this freed the block (refcount
+        hit zero and it returned to the free list)."""
+        with self._lock:
+            if self._refs[bid] <= 0:
+                raise ValueError(f"decref of free block {bid}")
+            self._refs[bid] -= 1
+            if self._refs[bid] == 0:
+                self._free.append(bid)
+                return True
+            return False
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def paged_copy_block(cache: "PagedKVCache", src, dst) -> "PagedKVCache":
+    """Copy one physical block pool→pool across every layer (K, V and
+    the int8 scale planes when present) — the copy-on-write step behind
+    zero-copy prefix sharing: when a cached prefix covers a slot's
+    ENTIRE prompt, the finalize chunk still re-writes the last prompt
+    position, so the boundary block is duplicated first and the slot's
+    table points at the private copy. ``src``/``dst`` are traced int32
+    scalars, so this is ONE fixed-shape compile per cache geometry; the
+    donated pool aliases in place."""
+    new = cache._replace(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]),
+    )
+    if cache.k_s is not None:
+        new = new._replace(
+            k_s=cache.k_s.at[:, dst].set(cache.k_s[:, src]),
+            v_s=cache.v_s.at[:, dst].set(cache.v_s[:, src]),
+        )
+    return new
 
 
 def paged_view(block_table, layer_k, layer_v, rows, layer_ks=None,

@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
+from typing import Any
 
 from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
@@ -33,14 +34,14 @@ class CircuitBreakerConfig:
     threshold: int = 5
     interval_s: float = 10.0
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> "_CircuitBreakerService":
         return _CircuitBreakerService(svc, self.threshold, self.interval_s)
 
 
 class _CircuitBreakerService(ServiceWrapper):
     """Wraps an HTTPService; delegates everything else."""
 
-    def __init__(self, inner, threshold: int, interval_s: float) -> None:
+    def __init__(self, inner: Any, threshold: int, interval_s: float) -> None:
         super().__init__(inner)
         self._threshold = threshold
         self._interval = interval_s
@@ -147,7 +148,7 @@ class _CircuitBreakerService(ServiceWrapper):
         if callable(inner_close):
             inner_close()
 
-    def request(self, method: str, path: str, **kw):
+    def request(self, method: str, path: str, **kw: Any) -> Any:
         if self.is_open:
             # Recovery probe on the request path (reference :149-156).
             if self._healthy():

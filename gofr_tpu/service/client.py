@@ -47,7 +47,7 @@ class ServiceLog:
             "response_time": self.duration, "trace_id": self.trace_id,
         }
 
-    def pretty_print(self, fp) -> None:
+    def pretty_print(self, fp: Any) -> None:
         fp.write(
             f"\x1b[38;5;8mSVC\x1b[0m {self.duration:>8}µs {self.status} "
             f"{self.method} {self.url}\n"
@@ -57,7 +57,7 @@ class ServiceLog:
 class HTTPService:
     """Concrete client; options wrap/extend it (``AddOption`` pattern)."""
 
-    def __init__(self, address: str, logger=None, metrics=None, timeout: float = 30.0) -> None:
+    def __init__(self, address: str, logger: Any = None, metrics: Any = None, timeout: float = 30.0) -> None:
         self.address = address.rstrip("/")
         self._logger = logger
         self._metrics = metrics
@@ -117,19 +117,19 @@ class HTTPService:
 
     # -- verb helpers (reference service/new.go:89-133) --------------------
 
-    def get(self, path: str, params=None, headers=None) -> Response:
+    def get(self, path: str, params: Any = None, headers: Any = None) -> Response:
         return self.request("GET", path, params=params, headers=headers)
 
-    def post(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+    def post(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Response:
         return self.request("POST", path, params=params, body=body, json=json, headers=headers)
 
-    def put(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+    def put(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Response:
         return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
 
-    def patch(self, path: str, params=None, body=None, json=None, headers=None) -> Response:
+    def patch(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Response:
         return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
 
-    def delete(self, path: str, params=None, body=None, headers=None) -> Response:
+    def delete(self, path: str, params: Any = None, body: Any = None, headers: Any = None) -> Response:
         return self.request("DELETE", path, params=params, body=body, headers=headers)
 
     # -- health (reference service/health.go) ------------------------------
@@ -150,7 +150,9 @@ class HTTPService:
         self._client.close()
 
 
-def new_http_service(address: str, logger=None, metrics=None, *options) -> HTTPService:
+def new_http_service(
+    address: str, logger: Any = None, metrics: Any = None, *options: Any
+) -> HTTPService:
     """Factory folding option decorators (reference ``service/new.go:68-87``)."""
     svc = HTTPService(address, logger=logger, metrics=metrics)
     for option in options:

@@ -9,7 +9,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Mapping, Optional
+from typing import Any, Callable, Mapping, Optional
 
 from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
@@ -20,7 +20,7 @@ class _HeaderInjector(ServiceWrapper):
     def _headers(self) -> dict:
         return {}
 
-    def request(self, method: str, path: str, *, headers=None, **kw):
+    def request(self, method: str, path: str, *, headers: Any = None, **kw: Any) -> Any:
         merged = {**self._headers(), **(headers or {})}
         return self._inner.request(method, path, headers=merged, **kw)
 
@@ -31,7 +31,7 @@ class APIKeyConfig:
 
     api_key: str
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         cfg = self
 
         class _Svc(_HeaderInjector):
@@ -48,7 +48,7 @@ class BasicAuthConfig:
     username: str
     password: str
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         token = base64.b64encode(
             f"{self.username}:{self.password}".encode()
         ).decode()
@@ -95,7 +95,7 @@ class OAuthConfig:
             self._cache["expiry"] = time.time() + float(payload.get("expires_in", 3600))
             return self._cache["token"]
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         cfg = self
 
         class _Svc(_HeaderInjector):
@@ -109,7 +109,7 @@ class OAuthConfig:
 class DefaultHeaders:
     headers: Mapping[str, str]
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         cfg = self
 
         class _Svc(_HeaderInjector):
@@ -125,7 +125,7 @@ class HealthConfig:
 
     endpoint: str = ".well-known/alive"
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         # health_check() runs on the base client regardless of wrapping
         # order, so the override must land on the innermost service — not
         # on whatever wrapper happens to be outermost.
@@ -162,11 +162,11 @@ class RetryConfig:
         factor = 1.0 - jitter + 2.0 * jitter * self.rng()
         return base * factor
 
-    def add_option(self, svc):
+    def add_option(self, svc: Any) -> Any:
         cfg = self
 
         class _Svc(_HeaderInjector):
-            def request(self, method: str, path: str, **kw):
+            def request(self, method: str, path: str, **kw: Any) -> Any:
                 last_exc: Optional[Exception] = None
                 for attempt in range(cfg.max_retries + 1):
                     try:

@@ -106,6 +106,14 @@ class Replica:
         """Outstanding work (queue + live); the least-loaded heuristic."""
         raise NotImplementedError
 
+    def throughput(self) -> float:
+        """Measured tokens/sec (sliding window), 0.0 when unknown — the
+        weighted router divides outstanding work by this to estimate
+        completion time. Replicas without a signal (cold engines, unary
+        HTTP backends) share a common floor, which degrades weighted
+        routing to the plain least-loaded pick."""
+        return 0.0
+
     def submit(self, prompt: Any, **kw: Any) -> Any:
         """Submit a generation; returns a ``_GenRequest``-shaped handle
         (``.future``, ``.stream``, ``.cancel_request()``)."""
@@ -169,6 +177,18 @@ class EngineReplica(Replica):
         queued = eng._pending.qsize() + len(eng._wait_kv)
         live = sum(1 for s in eng._slots if s is not None)
         return queued + live + len(eng._prefilling)
+
+    def throughput(self) -> float:
+        # The engine's sliding-window AGGREGATE tokens/sec — the same
+        # lifecycle.AggregateThroughput estimate its own projected-wait
+        # shedder divides by. 0.0 while cold (no emissions in window).
+        tput = getattr(self.engine, "_tput", None)
+        if tput is None:
+            return 0.0
+        try:
+            return float(tput.rate())
+        except Exception:  # noqa: BLE001 — heuristic only, never break routing
+            return 0.0
 
     def submit(self, prompt: Any, **kw: Any) -> Any:
         return self.engine.submit_generate(prompt, **kw)
@@ -416,6 +436,7 @@ class ReplicaPool:
         hedge_budget: Optional[HedgeBudget] = None,
         probe_interval_s: float = 30.0,
         probe_timeout_s: float = 30.0,
+        weighted: bool = True,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
         metrics: Any = None,
@@ -424,6 +445,13 @@ class ReplicaPool:
         if not replicas:
             raise ValueError("a replica pool needs at least one replica")
         self._replicas = list(replicas)
+        # Weighted routing (TPU_ROUTE_WEIGHTED, default on): pick by
+        # least ESTIMATED COMPLETION TIME — outstanding work over the
+        # replica's measured tokens/sec — instead of raw queue length,
+        # so a replica decoding 2× faster absorbs ~2× the traffic.
+        # Replicas with no throughput signal share a common default, in
+        # which case the pick degrades to exactly the least-loaded one.
+        self.weighted = bool(weighted)
         self.hedge_delay_s = max(0.0, float(hedge_delay_s))
         self.hedge_budget = (
             hedge_budget if hedge_budget is not None
@@ -538,7 +566,12 @@ class ReplicaPool:
         ``require_stream`` restricts to stream-capable (in-proc)
         backends — a unary-only HTTPReplica handed a streaming request
         would answer a 200 SSE with zero tokens, which is worse than an
-        honest 502."""
+        honest 502.
+
+        Weighted mode ranks by estimated completion time instead:
+        ``(load + 1) / measured tokens/sec`` — the ROADMAP follow-up to
+        queue-length routing; with no throughput signal anywhere it
+        collapses to the same least-loaded pick."""
         excluded = {id(r) for r in exclude}
 
         def routable(states: tuple[str, ...]) -> list[Replica]:
@@ -556,12 +589,35 @@ class ReplicaPool:
                 start = self._rr % len(candidates)
                 self._rr += 1
             rotated = candidates[start:] + candidates[:start]
-            return min(rotated, key=lambda r: r.load())
+            if not self.weighted:
+                return min(rotated, key=lambda r: r.load())
+            return min(rotated, key=self._completion_score(rotated))
         raise ErrorNoHealthyReplica(
             f"{len(self._replicas)} replica(s), none "
             + ("stream-capable and " if require_stream else "")
             + "SERVING or DEGRADED"
         )
+
+    @staticmethod
+    def _completion_score(
+        candidates: Sequence[Replica],
+    ) -> Callable[[Replica], float]:
+        """Least-estimated-completion-time key: outstanding work (+1
+        for the request being placed) over measured tokens/sec.
+        Replicas without a signal (cold, unary HTTP) are assumed as
+        fast as the FASTEST measured sibling — a cold replica is
+        usually an idle one, and penalizing it would starve it of the
+        traffic that would produce its first measurement. All-unknown
+        → every rate equal → ordering identical to least-loaded."""
+        rates = {id(r): max(0.0, r.throughput()) for r in candidates}
+        known = [v for v in rates.values() if v > 0.0]
+        default = max(known) if known else 1.0
+
+        def score(r: Replica) -> float:
+            rate = rates.get(id(r), 0.0) or default
+            return (r.load() + 1) / rate
+
+        return score
 
     def _submit_routed(
         self,
@@ -739,7 +795,9 @@ class ReplicaPool:
             None, partial(self.generate_sync, prompt, **kw)
         )
 
-    async def generate_stream(self, prompt: Any, **kw: Any):
+    async def generate_stream(
+        self, prompt: Any, **kw: Any
+    ) -> Any:
         """Async iterator over token ids (engine-API parity); replica
         loss mid-stream is healed by the handoff path underneath."""
         import asyncio

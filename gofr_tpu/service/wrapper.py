@@ -8,8 +8,10 @@ override point intercepts all traffic.
 
 from __future__ import annotations
 
+from typing import Any
 
-def innermost(svc):
+
+def innermost(svc: Any) -> Any:
     """Walk the ``_inner`` chain to the base HTTPService."""
     while hasattr(svc, "_inner"):
         svc = svc._inner
@@ -19,26 +21,26 @@ def innermost(svc):
 class ServiceWrapper:
     """Decorator base: wraps a service, delegates everything else."""
 
-    def __init__(self, inner) -> None:
+    def __init__(self, inner: Any) -> None:
         self._inner = inner
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         return getattr(self._inner, name)
 
-    def request(self, method: str, path: str, **kw):
+    def request(self, method: str, path: str, **kw: Any) -> Any:
         return self._inner.request(method, path, **kw)
 
-    def get(self, path, params=None, headers=None):
+    def get(self, path: str, params: Any = None, headers: Any = None) -> Any:
         return self.request("GET", path, params=params, headers=headers)
 
-    def post(self, path, params=None, body=None, json=None, headers=None):
+    def post(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Any:
         return self.request("POST", path, params=params, body=body, json=json, headers=headers)
 
-    def put(self, path, params=None, body=None, json=None, headers=None):
+    def put(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Any:
         return self.request("PUT", path, params=params, body=body, json=json, headers=headers)
 
-    def patch(self, path, params=None, body=None, json=None, headers=None):
+    def patch(self, path: str, params: Any = None, body: Any = None, json: Any = None, headers: Any = None) -> Any:
         return self.request("PATCH", path, params=params, body=body, json=json, headers=headers)
 
-    def delete(self, path, params=None, body=None, headers=None):
+    def delete(self, path: str, params: Any = None, body: Any = None, headers: Any = None) -> Any:
         return self.request("DELETE", path, params=params, body=body, headers=headers)

@@ -94,6 +94,11 @@ def _new_tpu_pool_from_config(
         probe_timeout_s=float(
             config.get_or_default("TPU_PROBE_TIMEOUT_S", "30")
         ),
+        # Weighted routing: least-estimated-completion-time over the
+        # per-replica measured tokens/sec; false = raw queue length.
+        weighted=config.get_or_default(
+            "TPU_ROUTE_WEIGHTED", "true"
+        ).lower() in ("1", "true", "yes"),
         metrics=metrics,
         logger=logger,
     )

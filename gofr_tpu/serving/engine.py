@@ -85,6 +85,8 @@ class InferenceEngine(
         spec_tokens: int = 0,
         kv_block: int = 0,
         kv_pool_blocks: int = 0,
+        auto_prefix: bool = False,
+        prefix_cache_blocks: int = 0,
         mesh=None,
         quant: str = "",
         kv_quant: str = "",
@@ -306,6 +308,21 @@ class InferenceEngine(
             self.kv_block = max(0, kv_block)
             self.kv_pool_blocks = kv_pool_blocks
             self.prefix_slots = max(0, prefix_slots)
+            # Automatic block-level prefix caching (TPU_AUTO_PREFIX):
+            # retired prompts' full KV blocks stay indexed in a radix
+            # trie and later requests admission-alias them into their
+            # block table — zero-copy hits, refcounted sharing, COW'd
+            # boundary (serving/radix_cache.py + docs/advanced-guide/
+            # prefix-caching.md). Paged-cache only: sharing IS table
+            # aliasing.
+            self.auto_prefix = bool(auto_prefix)
+            self.prefix_cache_blocks = max(0, prefix_cache_blocks)
+            if self.auto_prefix and not self.kv_block:
+                raise ValueError(
+                    "TPU_AUTO_PREFIX requires the paged KV cache "
+                    "(TPU_KV_BLOCK > 0): prefix hits alias pool blocks "
+                    "through the block table"
+                )
             if self.kv_block:
                 if self.max_len % self.kv_block:
                     raise ValueError(
@@ -315,8 +332,17 @@ class InferenceEngine(
                 if prefix_slots > 0:
                     raise ValueError(
                         "prefix-KV reuse and the paged cache are mutually "
-                        "exclusive (the pool copies slot rows)"
+                        "exclusive (the pool copies slot rows; use "
+                        "TPU_AUTO_PREFIX for paged prefix sharing)"
                     )
+            # Prefix-cache observability counters (host-side mirrors of
+            # app_tpu_prefix_{lookup,hit_tokens}_total so bench/tests
+            # read them without a metrics manager). Cumulative across
+            # warm restarts — the INDEX resets with the cache planes,
+            # these do not.
+            self._prefix_lookups = 0
+            self._prefix_hit_tokens = 0
+            self._prefill_chunk_steps = 0
             self._sched: Optional[threading.Thread] = None
             # Host→device uploads: on a mesh, place as a REPLICATED global
             # array — on a multi-host (DCN) mesh a bare jnp.asarray would
@@ -537,6 +563,13 @@ class InferenceEngine(
             kv_pool_blocks=int(
                 config.get_or_default("TPU_KV_POOL_BLOCKS", "0")
             ),
+            # Automatic block-level prefix caching (needs TPU_KV_BLOCK).
+            auto_prefix=config.get_or_default(
+                "TPU_AUTO_PREFIX", "false"
+            ).lower() in ("1", "true", "yes"),
+            prefix_cache_blocks=int(
+                config.get_or_default("TPU_PREFIX_CACHE_BLOCKS", "0")
+            ),
             # Request-lifecycle resilience knobs (docs/advanced-guide/
             # resilience.md): bounded submit queue + token budget,
             # throughput prior for projected-wait shedding, and the
@@ -713,17 +746,34 @@ class InferenceEngine(
             )()
         else:
             self.cache = make_cache()
+        self._radix = None
         if self.kv_block:
-            # Host-side block allocator: block 0 is the parking block
-            # and never handed out; the table mirror uploads (8 KB)
-            # only when an admission/top-up/release dirtied it.
-            self._free_blocks = list(range(1, self.cache.n_blocks))
+            # Host-side REFCOUNTED block allocator (ops/kv_cache.py):
+            # block 0 is the parking block and never handed out; the
+            # table mirror uploads (8 KB) only when an admission/top-up/
+            # release dirtied it. Refcounts exist for the automatic
+            # prefix cache — aliased blocks are shared by many tables.
+            from gofr_tpu.ops.kv_cache import BlockAllocator
+
+            self._allocator = BlockAllocator(self.cache.n_blocks)
             self._slot_blocks: list[list[int]] = [[] for _ in range(n_slots)]
             self._table_host = np.zeros(
                 (n_slots, self.max_len // self.kv_block), dtype=np.int32
             )
             self._table_dirty = False
             self._dispatched_tokens = [0] * n_slots
+            if self.auto_prefix:
+                # The radix index maps token content to PHYSICAL pool
+                # blocks, so it is rebuilt WITH the cache planes: after
+                # a supervisor warm restart the old blocks' contents are
+                # gone and replayed requests re-prefill through normal
+                # admission, re-warming the index as they retire.
+                from gofr_tpu.serving.radix_cache import RadixPrefixIndex
+
+                self._radix = RadixPrefixIndex(
+                    self.kv_block, self._allocator,
+                    max_blocks=self.prefix_cache_blocks,
+                )
         # Prefix-KV reuse: shared system prompts prefill once into a
         # device pool; admission copies rows in (prefix_cache.py). A
         # restart builds a FRESH pool — the old rows died with the old
@@ -1163,6 +1213,14 @@ class InferenceEngine(
     # ------------------------------------------------------------------
 
     @property
+    def _free_blocks(self) -> list:
+        """Free-list view of the paged allocator (kept as the historical
+        attribute name — tests and scripts/soak.py watch its length).
+        Read-only: all mutation goes through the refcounted
+        ``BlockAllocator``."""
+        return self._allocator.free_blocks
+
+    @property
     def max_prompt_tokens(self) -> int:
         """Longest admissible prompt: one generated token plus pipelined-
         window overshoot must still fit in max_len (the same invariant the
@@ -1577,6 +1635,12 @@ class InferenceEngine(
                     "total": self.cache.n_blocks - 1,  # block 0 parks
                     "free": len(self._free_blocks),
                 }
+                if self._radix is not None:
+                    details["prefix_cache"] = {
+                        "cached_blocks": self._radix.n_cached_blocks,
+                        "lookups": self._prefix_lookups,
+                        "hit_tokens": self._prefix_hit_tokens,
+                    }
         try:
             stats = devices[0].memory_stats()
             if stats:

@@ -110,6 +110,14 @@ class LoRARuntimeMixin:
         )
         if self._prefix_pool is not None:
             self._prefix_pool.purge_aid(idx)
+        radix = getattr(self, "_radix", None)
+        if radix is not None:
+            # Same staleness rule for the automatic prefix cache: radix
+            # entries hold K/V prefilled under the slot's previous
+            # weights. Blocks still aliased by live tables survive until
+            # those slots release (their requests fail above).
+            radix.purge_aid(idx)
+            self._publish_prefix_gauge()
         layers = dict(self.params["layers"])
         # Zero the WHOLE slot first: a reload with fewer targets than the
         # previous version must not leave the old version's deltas live.
@@ -155,6 +163,10 @@ class LoRARuntimeMixin:
             # The adapter slot id may be reused by a later load; pooled
             # prefixes prefilled under it are stale the moment it frees.
             self._prefix_pool.purge_aid(idx)
+        radix = getattr(self, "_radix", None)
+        if radix is not None:
+            radix.purge_aid(idx)
+            self._publish_prefix_gauge()
         layers = dict(self.params["layers"])
         for t in self._lora_targets:
             for suffix in ("_lora_a", "_lora_b"):

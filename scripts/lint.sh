@@ -2,9 +2,9 @@
 # The repo lint/type gate, one command locally == the CI `lint` job:
 #   ruff      — pycodestyle/pyflakes/bugbear subset (pyproject.toml),
 #               plus import sorting scoped to the analysis package;
-#   mypy      — scoped strictness (config/logging/serving-types strict,
+#   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL009 against the committed
+#   graftlint — TPU-correctness rules GL001–GL010 against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
 #
@@ -27,9 +27,10 @@ if command -v mypy >/dev/null 2>&1; then
   echo "== mypy (scoped) =="
   mypy gofr_tpu/analysis gofr_tpu/config gofr_tpu/logging \
     gofr_tpu/metrics gofr_tpu/tracing gofr_tpu/faults \
+    gofr_tpu/service \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
     gofr_tpu/serving/batcher.py gofr_tpu/serving/supervisor.py \
-    gofr_tpu/serving/watchdog.py || failed=1
+    gofr_tpu/serving/watchdog.py gofr_tpu/serving/scheduler.py || failed=1
 else
   echo "== mypy == SKIPPED (not installed; pip install mypy)"
 fi

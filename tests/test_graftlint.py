@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL009), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL010), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -554,6 +554,79 @@ def test_gl009_ignores_bounded_bucketed_caches(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL010 — repeated host pull of the same device value in a loop
+# ----------------------------------------------------------------------
+
+
+def test_gl010_flags_repeated_pull_of_same_value_in_loop(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/emit.py",
+        """
+        import jax
+        import numpy as np
+
+        def emit(rows, first_dev, lp_dev):
+            out = []
+            for row in rows:
+                tok = int(np.asarray(first_dev)[row])
+                lp = float(np.asarray(first_dev)[row])
+                out.append((tok, lp))
+            return out
+
+        def fetch(rows, planes_dev):
+            while rows:
+                row = rows.pop()
+                a = jax.device_get(planes_dev)[row]
+                b = jax.device_get(planes_dev)[row + 1]
+        """,
+        select=["GL010"],
+    )
+    assert ids == ["GL010", "GL010"]
+    assert "hoist one host copy" in findings[0].message
+
+
+def test_gl010_ignores_hoisted_rebound_and_closure_pulls(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/emit.py",
+        """
+        import numpy as np
+
+        def emit(rows, first_dev):
+            first = np.asarray(first_dev)  # hoisted: the fix
+            return [int(first[row]) for row in rows]
+
+        def drain(inflight):
+            while inflight:
+                emitted = inflight.popleft()[0]
+                a = np.asarray(emitted)  # rebound per iteration
+                b = np.asarray(emitted)  # same iteration's value: fine
+                del a, b
+
+        def lazy(rows, x_dev):
+            for row in rows:
+                # Closure bodies are not per-iteration work of THIS loop.
+                pull = lambda: np.asarray(x_dev) + np.asarray(x_dev)
+            return pull
+
+        def upload(rows, table):
+            import jax.numpy as jnp
+            for row in rows:
+                a = jnp.asarray(table)  # host->device: GL008's business
+                b = jnp.asarray(table)
+
+        class Drainer:
+            def drain(self):
+                while self.queue:
+                    a = np.asarray(self.emitted)
+                    self.emitted = self.fetch()  # attribute rebound:
+                    b = np.asarray(self.emitted)  # a different array
+        """,
+        select=["GL010"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -712,7 +785,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009",
+        "GL008", "GL009", "GL010",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2

@@ -1,0 +1,659 @@
+"""Automatic block-level prefix caching (ISSUE 5 acceptance gate).
+
+Three layers, all deterministic on CPU:
+
+* host units — the refcounted ``BlockAllocator`` and the
+  ``RadixPrefixIndex`` (longest-prefix walk, insert adoption semantics,
+  LRU eviction of unreferenced leaves, adapter purge);
+* a fuzz-style churn test that interleaves admit/retire/evict/grow/
+  restart against a host-side model of the scheduler's exact aliasing
+  and COW logic, asserting after every step that each block is either
+  free or accounted for by exactly its referencing tables + the index
+  — and that a slot never writes a block with refcount > 1;
+* engine integration — a warm repeated-prefix request admission-aliases
+  cached blocks (``app_tpu_prefix_hit_tokens_total`` mirror > 0),
+  dispatches STRICTLY fewer prefill chunk steps than the cold run, and
+  emits a byte-identical stream; whole-prompt hits exercise the COW
+  boundary; pool pressure evicts cached blocks instead of starving
+  requests; LoRA unload purges the adapter's subtree; and a supervisor
+  warm restart rebuilds a fresh index while replaying byte-identically.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from gofr_tpu import faults
+from gofr_tpu.metrics import new_metrics_manager
+from gofr_tpu.ops.kv_cache import BlockAllocator
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.radix_cache import RadixPrefixIndex
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+
+def _metrics_manager():
+    m = new_metrics_manager()
+    for name in (
+        "app_tpu_prefix_lookup_total", "app_tpu_prefix_hit_tokens_total",
+        "app_tpu_tokens_generated", "app_tpu_requests_shed_total",
+        "app_tpu_requests_cancelled_total", "app_tpu_deadline_exceeded_total",
+    ):
+        m.new_counter(name)
+    for name in (
+        "app_tpu_prefix_cached_blocks", "app_tpu_kv_blocks_free",
+        "app_tpu_kv_slots_in_use", "app_tpu_queue_depth",
+        "app_tpu_hbm_used_bytes", "app_tpu_engine_state",
+        "app_tpu_lora_adapters",
+    ):
+        m.new_gauge(name)
+    for name in ("app_tpu_infer_latency", "app_tpu_batch_size"):
+        m.new_histogram(name)
+    return m
+
+
+def _counter_total(metrics, name, **labels):
+    inst = {i.name: i for i in metrics.instruments()}[name]
+    total = 0.0
+    for key, value in inst.collect().items():
+        if all((k, str(v)) in key for k, v in labels.items()):
+            total += value
+    return total
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    yield
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# host units: allocator
+# ----------------------------------------------------------------------
+
+
+def test_allocator_refcount_lifecycle():
+    alloc = BlockAllocator(5)  # blocks 1..4 usable; 0 parks
+    assert alloc.n_free == 4
+    a = alloc.alloc()
+    assert a is not None and alloc.refcount(a) == 1
+    assert alloc.incref(a) == 2
+    assert alloc.decref(a) is False  # still referenced
+    assert alloc.decref(a) is True  # refcount 0 → freed
+    assert alloc.n_free == 4
+    # Double-free / touch-free are programming errors, loudly.
+    with pytest.raises(ValueError):
+        alloc.decref(a)
+    with pytest.raises(ValueError):
+        alloc.incref(a)
+    # Exhaustion returns None (no exception: callers defer or evict).
+    got = [alloc.alloc() for _ in range(4)]
+    assert None not in got and alloc.alloc() is None
+
+
+# ----------------------------------------------------------------------
+# host units: radix index
+# ----------------------------------------------------------------------
+
+
+def _fill(alloc: BlockAllocator, n: int) -> list[int]:
+    out = []
+    for _ in range(n):
+        bid = alloc.alloc()
+        assert bid is not None
+        out.append(bid)
+    return out
+
+
+def _release(alloc: BlockAllocator, blocks: list[int]) -> None:
+    """Drop the references a ``lookup`` returned holding (tests that
+    only probe the index must not leak them into refcount asserts)."""
+    for bid in blocks:
+        alloc.decref(bid)
+
+
+def test_radix_longest_prefix_walk_and_adoption():
+    alloc = BlockAllocator(17)
+    idx = RadixPrefixIndex(4, alloc)
+    ids = [1, 2, 3, 4, 5, 6, 7, 8, 9]  # 2 full blocks + tail
+    row = _fill(alloc, 2)
+    flags = idx.insert(ids, row, aid=0)
+    assert flags == [True, True]  # both references adopted
+    assert idx.n_cached_blocks == 2
+
+    # Full two-block match; the tail never matches (not a full block).
+    blocks, matched = idx.lookup(ids + [9, 9, 9], aid=0)
+    assert blocks == row and matched == 8
+    # lookup returns holding one reference per block (index + ours).
+    assert all(alloc.refcount(b) == 2 for b in blocks)
+    _release(alloc, blocks)
+    # Diverging second block → only the first matches.
+    blocks, matched = idx.lookup([1, 2, 3, 4, 9, 9, 9, 9], aid=0)
+    assert blocks == row[:1] and matched == 4
+    _release(alloc, blocks)
+    # Under three tokens of prefix — no full block — no match.
+    assert idx.lookup([1, 2, 3], aid=0) == ([], 0)
+    # Different adapter slot: blind to aid 0's entries.
+    assert idx.lookup(ids, aid=1) == ([], 0)
+
+    # Re-inserting the same content does NOT adopt (incumbent block
+    # wins); the caller keeps — and here releases — its own refs.
+    row2 = _fill(alloc, 2)
+    flags = idx.insert(ids, row2, aid=0)
+    assert flags == [False, False]
+    for bid in row2:
+        alloc.decref(bid)
+    assert idx.n_cached_blocks == 2
+    blocks, _ = idx.lookup(ids, aid=0)
+    assert blocks == row
+    _release(alloc, blocks)
+
+
+def test_radix_lru_eviction_unreferenced_leaves_only():
+    alloc = BlockAllocator(33)
+    idx = RadixPrefixIndex(2, alloc)
+    # Two chains under one root: [1,2]->[3,4] and [5,6].
+    chain_a = _fill(alloc, 2)
+    idx.insert([1, 2, 3, 4], chain_a, aid=0)
+    chain_b = _fill(alloc, 1)
+    idx.insert([5, 6], chain_b, aid=0)
+    free0 = alloc.n_free
+
+    # A lookup refreshes [1,2]'s chain; [5,6] becomes LRU.
+    _release(alloc, idx.lookup([1, 2, 3, 4], aid=0)[0])
+    assert idx.evict(1) == 1
+    assert alloc.n_free == free0 + 1
+    assert idx.lookup([5, 6], aid=0) == ([], 0)
+
+    # A block aliased by a live table (refcount > 1) never evicts; the
+    # leaf [3,4] (refcount 1) goes first, then the now-leaf [1,2] is
+    # pinned by the external reference.
+    alloc.incref(chain_a[0])
+    assert idx.evict(4) == 1  # only [3,4] freed
+    assert idx.n_cached_blocks == 1
+    blocks, matched = idx.lookup([1, 2, 9, 9], aid=0)
+    assert blocks == chain_a[:1] and matched == 2
+    _release(alloc, blocks)
+    alloc.decref(chain_a[0])
+    assert idx.evict(4) == 1  # unpinned → evictable
+    assert idx.n_cached_blocks == 0
+    assert alloc.n_free == 32
+
+
+def test_radix_purge_aid_drops_subtree_and_respects_live_refs():
+    alloc = BlockAllocator(33)
+    idx = RadixPrefixIndex(2, alloc)
+    base = _fill(alloc, 2)
+    idx.insert([1, 2, 3, 4], base, aid=0)
+    lora = _fill(alloc, 2)
+    idx.insert([1, 2, 3, 4], lora, aid=3)
+    free0 = alloc.n_free
+
+    alloc.incref(lora[0])  # a live slot still aliases one block
+    assert idx.purge_aid(3) == 2
+    assert idx.lookup([1, 2, 3, 4], aid=3) == ([], 0)
+    # The shared block survives until its table releases it.
+    assert alloc.n_free == free0 + 1
+    alloc.decref(lora[0])
+    assert alloc.n_free == free0 + 2
+    # aid 0 untouched.
+    blocks, matched = idx.lookup([1, 2, 3, 4], aid=0)
+    assert matched == 4
+    _release(alloc, blocks)
+    assert idx.purge_aid(99) == 0
+
+
+def test_lookup_refs_survive_concurrent_purge():
+    alloc = BlockAllocator(9)
+    idx = RadixPrefixIndex(2, alloc)
+    row = _fill(alloc, 2)
+    idx.insert([1, 2, 3, 4], row, aid=1)
+    blocks, matched = idx.lookup([1, 2, 3, 4], aid=1)
+    assert blocks == row and matched == 4
+    # An adapter reload purges between the lookup and the table
+    # aliasing: the lookup-held references must keep the blocks
+    # allocated (taking refs AFTER lookup increfed a freed block here).
+    idx.purge_aid(1)
+    assert idx.n_cached_blocks == 0
+    for bid in blocks:
+        assert alloc.refcount(bid) == 1  # ours — purge could not free
+    _release(alloc, blocks)
+    assert alloc.n_free == 8  # fully reclaimed once we let go
+
+
+def test_radix_max_blocks_cap_evicts_on_insert():
+    alloc = BlockAllocator(33)
+    idx = RadixPrefixIndex(2, alloc, max_blocks=2)
+    idx.insert([1, 2, 3, 4], _fill(alloc, 2), aid=0)
+    idx.insert([7, 8], _fill(alloc, 1), aid=0)
+    assert idx.n_cached_blocks == 2  # LRU leaf [3,4] evicted at cap
+    for probe in ([1, 2, 3, 4], [7, 8]):
+        blocks, matched = idx.lookup(probe, aid=0)
+        assert matched == 2
+        _release(alloc, blocks)
+
+
+# ----------------------------------------------------------------------
+# fuzz churn: the refcount invariant under admit/retire/evict/restart
+# ----------------------------------------------------------------------
+
+
+class _SchedModel:
+    """Host-side mirror of the scheduler's aliasing/COW/release logic
+    (the same order of allocator and index operations), so the churn
+    test can interleave every lifecycle transition thousands of times
+    without compiling a model."""
+
+    B = 4
+
+    def __init__(self, n_blocks: int) -> None:
+        self.alloc = BlockAllocator(n_blocks)
+        self.idx = RadixPrefixIndex(self.B, self.alloc)
+        self.rows: dict[int, list[int]] = {}
+        self.meta: dict[int, list[int]] = {}
+
+    def _alloc_block(self):
+        bid = self.alloc.alloc()
+        if bid is None and self.idx.evict(1):
+            bid = self.alloc.alloc()
+        return bid
+
+    def admit(self, slot: int, ids: list[int]) -> bool:
+        B = self.B
+        # lookup returns with one reference per block already held
+        # (taken under the index lock — the anti-purge-race contract);
+        # each transfers to the slot row here.
+        blocks, matched = self.idx.lookup(ids, 0)
+        done = min(matched, len(ids) - 1)
+        row: list[int] = list(blocks)
+        if row and done < matched:  # COW the boundary block
+            src = row[-1]
+            dst = self._alloc_block()
+            if dst is None:
+                row.pop()
+                self.alloc.decref(src)
+                done = min(len(row) * B, len(ids) - 1)
+            else:
+                row[-1] = dst
+                self.alloc.decref(src)
+        target = (len(ids) + 1 + B - 1) // B
+        ok = True
+        while len(row) < target:
+            bid = self._alloc_block()
+            if bid is None:
+                ok = False
+                break
+            row.append(bid)
+        if not ok:  # defer: every reference dropped
+            for bid in row:
+                self.alloc.decref(bid)
+            return False
+        # THE decode/prefill write-safety invariant: every block this
+        # slot will write (positions ≥ done) is exclusively owned.
+        for j in range(done // B, len(row)):
+            assert self.alloc.refcount(row[j]) == 1, (slot, j, row)
+        self.rows[slot], self.meta[slot] = row, ids
+        return True
+
+    def grow(self, slot: int) -> None:
+        bid = self._alloc_block()
+        if bid is not None:
+            assert self.alloc.refcount(bid) == 1
+            self.rows[slot].append(bid)
+
+    def retire(self, slot: int) -> None:
+        ids, row = self.meta.pop(slot), self.rows.pop(slot)
+        n_full = min(len(ids) // self.B, len(row))
+        adopted: set[int] = set()
+        if n_full > 0:
+            flags = self.idx.insert(ids, row[:n_full], 0)
+            adopted = {row[j] for j, f in enumerate(flags) if f}
+        for bid in row:
+            if bid not in adopted:
+                self.alloc.decref(bid)
+
+    def check_invariant(self) -> None:
+        refs: dict[int, int] = {}
+        for row in self.rows.values():
+            for bid in row:
+                refs[bid] = refs.get(bid, 0) + 1
+        for bid in self.idx.cached_block_ids():
+            refs[bid] = refs.get(bid, 0) + 1
+        free = self.alloc.free_blocks
+        free_set = set(free)
+        assert len(free) == len(free_set)  # no double-free
+        for bid in range(1, self.alloc.n_blocks):
+            expected = refs.get(bid, 0)
+            assert self.alloc.refcount(bid) == expected, (
+                bid, self.alloc.refcount(bid), expected,
+            )
+            assert (bid in free_set) == (expected == 0), bid
+
+
+def test_refcount_invariants_under_fuzzed_churn():
+    rng = random.Random(0)
+    model = _SchedModel(n_blocks=24)  # tight pool → real pressure
+    slots = list(range(4))
+    for step in range(2000):
+        op = rng.random()
+        free_slots = [s for s in slots if s not in model.rows]
+        busy_slots = [s for s in slots if s in model.rows]
+        if op < 0.45 and free_slots:
+            # Small vocab + short prompts → heavy prefix collisions.
+            n = rng.randint(1, 14)
+            ids = [rng.randint(0, 2) for _ in range(n)]
+            model.admit(rng.choice(free_slots), ids)
+        elif op < 0.75 and busy_slots:
+            model.retire(rng.choice(busy_slots))
+        elif op < 0.85 and busy_slots:
+            model.grow(rng.choice(busy_slots))
+        elif op < 0.95:
+            model.idx.evict(rng.randint(1, 3))
+        else:
+            # Warm restart: cache planes, allocator, and index are
+            # rebuilt together; live rows die with the old scheduler.
+            model = _SchedModel(n_blocks=24)
+        model.check_invariant()
+    # Drain: after retiring everything, every block is free or cached.
+    for slot in list(model.rows):
+        model.retire(slot)
+    model.check_invariant()
+    assert (
+        model.alloc.n_free + model.idx.n_cached_blocks
+        == model.alloc.n_blocks - 1
+    )
+
+
+# ----------------------------------------------------------------------
+# engine integration (CPU, llama-tiny)
+# ----------------------------------------------------------------------
+
+_ENGINE_KW = dict(
+    n_slots=4, max_len=256, window_k=4, pipeline_depth=1,
+    prefill_chunk=32, kv_block=32, auto_prefix=True,
+)
+
+
+@pytest.fixture(scope="module")
+def metrics():
+    return _metrics_manager()
+
+
+@pytest.fixture(scope="module")
+def engine(metrics):
+    eng = InferenceEngine(
+        "llama-tiny", tokenizer=ByteTokenizer(), lora_slots=1,
+        metrics=metrics, **_ENGINE_KW,
+    )
+    eng.start_sync()
+    yield eng
+    eng.stop_sync()
+
+
+def _wait_idle(eng, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if (
+            all(s is None for s in eng._slots)
+            and not eng._prefilling
+            and eng._pending.empty()
+        ):
+            return
+        time.sleep(0.01)
+    raise AssertionError("engine did not go idle")
+
+
+def _engine_block_invariant(eng):
+    """Every pool block is free, or accounted for by exactly its
+    referencing slot tables plus the radix index."""
+    refs: dict[int, int] = {}
+    for row in eng._slot_blocks:
+        for bid in row:
+            refs[bid] = refs.get(bid, 0) + 1
+    for bid in eng._radix.cached_block_ids():
+        refs[bid] = refs.get(bid, 0) + 1
+    alloc = eng._allocator
+    free = set(alloc.free_blocks)
+    assert len(free) == len(alloc.free_blocks)
+    for bid in range(1, alloc.n_blocks):
+        expected = refs.get(bid, 0)
+        assert alloc.refcount(bid) == expected, (bid,)
+        assert (bid in free) == (expected == 0), (bid,)
+
+
+def test_warm_request_skips_prefill_chunks_byte_identically(
+    engine, metrics
+):
+    engine._radix.clear()
+    _wait_idle(engine)
+    preamble = list(range(10, 80))  # 70 tokens = 2 full blocks + tail
+    hit0 = engine._prefix_hit_tokens
+    mhit0 = _counter_total(metrics, "app_tpu_prefix_hit_tokens_total")
+    mmiss0 = _counter_total(
+        metrics, "app_tpu_prefix_lookup_total", result="miss"
+    )
+    mhits0 = _counter_total(
+        metrics, "app_tpu_prefix_lookup_total", result="hit"
+    )
+
+    s0 = engine._prefill_chunk_steps
+    cold = engine.generate_sync(
+        preamble + [100, 101, 102], max_new_tokens=6, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    _wait_idle(engine)
+    cold_steps = engine._prefill_chunk_steps - s0
+    assert engine._prefix_hit_tokens == hit0  # cold: no hit
+    assert engine._radix.n_cached_blocks == 2  # retirement indexed B0,B1
+
+    s1 = engine._prefill_chunk_steps
+    warm = engine.generate_sync(
+        preamble + [120, 121, 122], max_new_tokens=6, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    _wait_idle(engine)
+    warm_steps = engine._prefill_chunk_steps - s1
+    # The acceptance assertions: aliased tokens counted (host mirror AND
+    # the exported counter), STRICTLY fewer chunk steps, and a
+    # byte-identical stream vs a cold-cache run.
+    assert engine._prefix_hit_tokens - hit0 == 64
+    assert (
+        _counter_total(metrics, "app_tpu_prefix_hit_tokens_total") - mhit0
+        == 64
+    )
+    assert _counter_total(
+        metrics, "app_tpu_prefix_lookup_total", result="miss"
+    ) - mmiss0 >= 1
+    assert _counter_total(
+        metrics, "app_tpu_prefix_lookup_total", result="hit"
+    ) - mhits0 >= 1
+    assert warm_steps < cold_steps
+
+    engine._radix.clear()
+    s2 = engine._prefill_chunk_steps
+    reference = engine.generate_sync(
+        preamble + [120, 121, 122], max_new_tokens=6, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    _wait_idle(engine)
+    assert engine._prefill_chunk_steps - s2 == cold_steps
+    assert warm.token_ids == reference.token_ids
+    _engine_block_invariant(engine)
+
+
+def test_whole_prompt_hit_cows_boundary_block(engine):
+    engine._radix.clear()
+    _wait_idle(engine)
+    prompt = list(range(5, 69))  # exactly 64 tokens = 2 full blocks
+    first = engine.generate_sync(
+        prompt, max_new_tokens=5, temperature=0.0, stop_on_eos=False,
+        timeout=120,
+    )
+    _wait_idle(engine)
+    hit0 = engine._prefix_hit_tokens
+    second = engine.generate_sync(
+        prompt, max_new_tokens=5, temperature=0.0, stop_on_eos=False,
+        timeout=120,
+    )
+    _wait_idle(engine)
+    # done = len-1: the finalize position was COW'd out of the shared
+    # boundary block, everything before it aliased.
+    assert engine._prefix_hit_tokens - hit0 == 63
+    assert second.token_ids == first.token_ids
+    # The COW'd copy was NOT re-indexed as a duplicate: the incumbent
+    # blocks stay, the copy freed at retirement.
+    assert engine._radix.n_cached_blocks == 2
+    _engine_block_invariant(engine)
+
+
+def test_sampled_warm_hit_stays_byte_identical(engine):
+    engine._radix.clear()
+    _wait_idle(engine)
+    prompt = list(range(30, 100))  # 70 tokens
+    kw = dict(
+        max_new_tokens=6, temperature=0.9, seed=1234, stop_on_eos=False,
+        timeout=120,
+    )
+    cold = engine.generate_sync(prompt, **kw)
+    _wait_idle(engine)
+    warm = engine.generate_sync(prompt, **kw)  # whole-prompt hit + COW
+    _wait_idle(engine)
+    assert warm.token_ids == cold.token_ids
+
+
+def test_lora_unload_purges_adapter_entries(engine):
+    import jax
+
+    from gofr_tpu.models.transformer import lora_dims
+
+    engine._radix.clear()
+    _wait_idle(engine)
+    leaves = {}
+    for ti, t in enumerate(("wq", "wk", "wv", "wo")):
+        d_in, d_out = lora_dims(engine.cfg, t)
+        k1, k2 = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(9), ti), 2
+        )
+        leaves[t] = (
+            0.02 * jax.random.normal(k1, (engine.cfg.n_layers, d_in, 16)),
+            0.02 * jax.random.normal(k2, (engine.cfg.n_layers, 16, d_out)),
+        )
+    engine.load_lora("radix-ad", leaves)
+    try:
+        prompt = list(range(40, 110))  # 70 tokens
+        base_hit0 = engine._prefix_hit_tokens
+        engine.generate_sync(
+            prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False,
+            adapter="radix-ad", timeout=120,
+        )
+        _wait_idle(engine)
+        cached_after_lora = engine._radix.n_cached_blocks
+        assert cached_after_lora == 2
+        # Base requests never reuse adapter-prefilled blocks.
+        engine.generate_sync(
+            prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False,
+            timeout=120,
+        )
+        _wait_idle(engine)
+        assert engine._prefix_hit_tokens == base_hit0
+        assert engine._radix.n_cached_blocks == 4  # 2 per adapter slot
+        hit1 = engine._prefix_hit_tokens
+        # Same-adapter repeat DOES hit.
+        engine.generate_sync(
+            prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False,
+            adapter="radix-ad", timeout=120,
+        )
+        _wait_idle(engine)
+        assert engine._prefix_hit_tokens > hit1
+    finally:
+        engine.unload_lora("radix-ad")
+    # Unload purged the adapter subtree; base entries survive.
+    assert engine._radix.n_cached_blocks == 2
+    _wait_idle(engine)
+    _engine_block_invariant(engine)
+
+
+def test_pool_pressure_evicts_cached_blocks_not_requests():
+    # Pool of 8 usable blocks on 2 slots: cached prefixes must yield to
+    # live admissions instead of deadlocking the queue.
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, window_k=4,
+        pipeline_depth=1, prefill_chunk=32, kv_block=32,
+        kv_pool_blocks=9, auto_prefix=True, tokenizer=ByteTokenizer(),
+    )
+    eng.start_sync()
+    try:
+        reqs = [
+            eng.submit_generate(
+                [200 + i] + list(range(60)), max_new_tokens=3,
+                temperature=0.0, stop_on_eos=False,
+            )
+            for i in range(4)
+        ]
+        results = [r.future.result(timeout=180) for r in reqs]
+        assert all(len(r.token_ids) == 3 for r in results)
+        _wait_idle(eng)
+        # Everything is free or cached; nothing leaked.
+        assert (
+            eng._allocator.n_free + eng._radix.n_cached_blocks == 8
+        )
+        _engine_block_invariant(eng)
+    finally:
+        eng.stop_sync()
+
+
+def test_supervisor_restart_resets_index_and_replays_byte_identically():
+    from gofr_tpu.serving.supervisor import EngineSupervisor
+
+    eng = InferenceEngine(
+        "llama-tiny", tokenizer=ByteTokenizer(), **_ENGINE_KW,
+    )
+    EngineSupervisor(
+        eng, max_restarts=3, backoff_s=0.01, rng=random.Random(7),
+        sleep=lambda s: None,
+    ).start()
+    eng.start_sync()
+    try:
+        prompt = list(range(10, 80))  # 2 full blocks + tail
+        # 24 tokens: with the warm index the faulted request prefills in
+        # ONE chunk, so the budget must span enough decode windows that
+        # the armed fault (hit 5) still lands mid-generation.
+        ref = eng.generate_sync(
+            prompt, max_new_tokens=24, temperature=0.0,
+            stop_on_eos=False, timeout=120,
+        )
+        _wait_idle(eng)
+        assert eng._radix.n_cached_blocks == 2
+        radix_before = eng._radix
+
+        # Device dies mid-generation; the supervisor warm-restarts and
+        # replays. The radix index is rebuilt WITH the cache planes —
+        # the old object must not survive into the new engine state.
+        faults.arm(
+            "scheduler.device_step",
+            raises=RuntimeError("injected device loss"),
+            after=4, times=1,
+        )
+        req = eng.submit_generate(
+            prompt, max_new_tokens=24, temperature=0.0, stop_on_eos=False,
+        )
+        got = req.future.result(timeout=120)
+        assert got.token_ids == ref.token_ids  # replay: no gaps, no dupes
+        assert eng._radix is not radix_before  # fresh index post-restart
+        _wait_idle(eng)
+        # The replayed request re-prefilled through normal admission, so
+        # its retirement re-warmed the fresh index.
+        assert eng._radix.n_cached_blocks == 2
+        hit0 = eng._prefix_hit_tokens
+        again = eng.generate_sync(
+            prompt, max_new_tokens=24, temperature=0.0,
+            stop_on_eos=False, timeout=120,
+        )
+        assert again.token_ids == ref.token_ids
+        assert eng._prefix_hit_tokens > hit0  # cache-warm after replay
+        _wait_idle(eng)
+        _engine_block_invariant(eng)
+    finally:
+        eng.close()

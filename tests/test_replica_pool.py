@@ -197,10 +197,11 @@ def _release_pool(pool):
 class _StubReplica(Replica):
     supports_stream = True
 
-    def __init__(self, name, state="SERVING", load=0):
+    def __init__(self, name, state="SERVING", load=0, tput=0.0):
         super().__init__(name)
         self.state_value = state
         self.load_value = load
+        self.tput = tput
         self.submits = 0
 
     def state(self):
@@ -208,6 +209,9 @@ class _StubReplica(Replica):
 
     def load(self):
         return self.load_value
+
+    def throughput(self):
+        return self.tput
 
     def submit(self, prompt, **kw):
         self.submits += 1
@@ -252,6 +256,46 @@ def test_pick_round_robin_tie_break_and_exclude():
     assert pool.pick(exclude=[a]).name == "b"
     with pytest.raises(ErrorNoHealthyReplica):
         pool.pick(exclude=[a, b])
+
+
+def test_weighted_pick_routes_by_estimated_completion_time():
+    # Equal queues, 4× throughput difference: the faster replica has
+    # the lower estimated completion time.
+    a = _StubReplica("a", load=4, tput=100.0)
+    b = _StubReplica("b", load=4, tput=400.0)
+    pool = _make_pool(None, [a, b])
+    assert pool.pick().name == "b"
+    # A deeper queue on the fast replica still wins while its ECT is
+    # lower: (7+1)/400 = 0.02s < (1+1)/50 = 0.04s.
+    a.load_value, a.tput = 1, 50.0
+    b.load_value, b.tput = 7, 400.0
+    assert pool.pick().name == "b"
+    # ...until the queue outweighs the speed: (39+1)/400 > (1+1)/50.
+    b.load_value = 39
+    assert pool.pick().name == "a"
+
+
+def test_weighted_pick_degrades_to_least_loaded_without_signal():
+    # No replica reports throughput (cold pool, HTTP-only) → the scores
+    # collapse to load ordering, and equal loads still round-robin.
+    a = _StubReplica("a", load=3)
+    b = _StubReplica("b", load=1)
+    pool = _make_pool(None, [a, b])
+    assert pool.pick().name == "b"
+    # A replica WITHOUT a measurement is assumed as fast as the fastest
+    # measured sibling (cold ≈ idle), so its shorter queue wins.
+    a.load_value, a.tput = 2, 100.0
+    b.load_value, b.tput = 1, 0.0
+    assert pool.pick().name == "b"
+
+
+def test_unweighted_pick_restores_raw_queue_length_routing():
+    a = _StubReplica("a", load=1, tput=10.0)
+    b = _StubReplica("b", load=5, tput=1000.0)
+    pool = _make_pool(None, [a, b], weighted=False)
+    assert pool.pick().name == "a"  # raw least-loaded ignores speed
+    pool_w = _make_pool(None, [a, b])
+    assert pool_w.pick().name == "b"  # default weighted pick uses it
 
 
 def test_probe_demotion_blocks_routing_even_while_serving():
