@@ -48,6 +48,35 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _pct(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an ascending list (0 on empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _latency_fields(results: list) -> dict:
+    """Per-request TTFT and inter-token-latency percentiles (ms) for
+    the JSON result line, so BENCH_* trajectories capture tail latency
+    alongside throughput. ITL per request = (duration - ttft) over the
+    gaps between its generated tokens; requests with <2 tokens have no
+    gap and are skipped."""
+    ttfts = sorted(r.ttft_s * 1e3 for r in results)
+    itls = sorted(
+        (r.duration_s - r.ttft_s) / (len(r.token_ids) - 1) * 1e3
+        for r in results if len(r.token_ids) >= 2
+    )
+    return {
+        "ttft_p50": round(_pct(ttfts, 0.50), 2),
+        "ttft_p95": round(_pct(ttfts, 0.95), 2),
+        "ttft_p99": round(_pct(ttfts, 0.99), 2),
+        "itl_p50": round(_pct(itls, 0.50), 3),
+        "itl_p95": round(_pct(itls, 0.95), 3),
+        "itl_p99": round(_pct(itls, 0.99), 3),
+    }
+
+
 def _extract_json_line(out: str) -> str | None:
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
@@ -402,9 +431,13 @@ def _prefix_workload(on_tpu: bool) -> None:
     hit_tokens = engine._prefix_hit_tokens
     hit_ratio = hit_tokens / total_prompt if total_prompt else 0.0
     total_tokens = sum(len(r.token_ids) for r in results) + len(cold.token_ids)
+    latency = _latency_fields([cold, *results])
     log(f"bench[prefix]: {total_tokens} tokens in {wall:.2f}s; "
         f"hit_tokens={hit_tokens}/{total_prompt} ({100 * hit_ratio:.1f}%); "
-        f"TTFT cold={cold_ttft_ms:.1f}ms warm_p50={warm_p50:.1f}ms")
+        f"TTFT cold={cold_ttft_ms:.1f}ms warm_p50={warm_p50:.1f}ms; "
+        f"ttft p50/p95/p99={latency['ttft_p50']}/{latency['ttft_p95']}/"
+        f"{latency['ttft_p99']}ms itl p50/p95/p99={latency['itl_p50']}/"
+        f"{latency['itl_p95']}/{latency['itl_p99']}ms")
     engine.stop_sync()
     _set_stage("done")
     print(json.dumps({
@@ -421,6 +454,7 @@ def _prefix_workload(on_tpu: bool) -> None:
         "prefix_hit_tokens": int(hit_tokens),
         "cold_ttft_ms": round(cold_ttft_ms, 2),
         "warm_ttft_p50_ms": round(warm_p50, 2),
+        **latency,
     }), flush=True)
     os._exit(0)
 
@@ -637,9 +671,16 @@ def main() -> None:
     ttfts = sorted(r.ttft_s * 1e3 for r in results)
     p50 = statistics.median(ttfts)
     p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    # Tail-latency fields for the JSON line: per-request TTFT and
+    # inter-token latency p50/p95/p99 — BENCH_* trajectories must
+    # capture the tail, not just throughput.
+    latency = _latency_fields(results)
 
     log(f"generated {total_tokens} tokens in {measure_wall:.2f}s "
         f"→ {tps:.1f} tok/s/chip end-to-end")
+    log(f"ITL p50={latency['itl_p50']}ms p95={latency['itl_p95']}ms "
+        f"p99={latency['itl_p99']}ms (per-request mean gap between "
+        f"generated tokens)")
     workload = "burst"
     steady_tps = None
     if arrival_ms > 0 or spread > 0:
@@ -700,6 +741,7 @@ def main() -> None:
         "model": model,
         "workload": workload,
         "e2e_tps": round(tps, 2),
+        **latency,
         **({"lora": n_lora} if n_lora else {}),
     }), flush=True)
 

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL010).
+"""The graftlint rule set (GL001–GL011).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1291,6 +1291,99 @@ class RepeatedHostPullRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL011 — per-row clock reads in scheduler emit/decode loops
+# ----------------------------------------------------------------------
+
+
+class PerRowClockRule(Rule):
+    """``time.time()`` / ``time.monotonic()`` inside the per-row body of
+    a scheduler emit/decode loop stamps per TOKEN: at window size k over
+    S slots that is k×S clock syscalls per window of pure host overhead
+    on the dispatch path, for timestamps whose consumers (ttft fields,
+    phase timelines, histograms) cannot tell apart anyway — every row
+    processed in one window/flush landed together. Timestamps belong at
+    WINDOW granularity: read the clock once before the loop and share
+    the value (exactly what ``_process_window``/``_flush_prefill_emits``
+    do).
+
+    Scope and conservatism: hot-path files only (the composed scheduler
+    object), ``for`` loops only — ``while`` loops re-reading the clock
+    are deadline/poll loops whose *condition* is the time, not per-row
+    stamping — and nested function/lambda bodies are skipped (not run
+    per iteration by this loop). ``while`` subtrees inside a flagged
+    ``for`` are skipped for the same reason.
+    """
+
+    rule_id = "GL011"
+    name = "per-row-clock"
+    rationale = (
+        "clock reads inside per-row emit/decode loop bodies are "
+        "per-token host overhead; read the clock once per window/flush "
+        "and share the timestamp"
+    )
+
+    _CLOCKS = frozenset((
+        "time.time", "time.monotonic", "time.perf_counter",
+        "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+    ))
+
+    def __init__(
+        self,
+        hot_path_files: Sequence[str] = (
+            "serving/batcher.py",
+            "serving/scheduler.py",
+            "serving/engine.py",
+        ),
+    ) -> None:
+        self._hot_files = tuple(hot_path_files)
+
+    def applies_to(self, path: str) -> bool:
+        return any(path.endswith(f) for f in self._hot_files)
+
+    @staticmethod
+    def _loop_walk(loop: ast.AST) -> Iterator[ast.AST]:
+        """Nodes lexically inside the loop's body/orelse, skipping
+        nested function/lambda bodies and ``while`` subtrees (poll
+        loops legitimately re-read the clock per check)."""
+        stack = list(getattr(loop, "body", [])) + list(
+            getattr(loop, "orelse", [])
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.While),
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        seen: set[tuple[int, int]] = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor)):
+                continue
+            for node in self._loop_walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                if name not in self._CLOCKS:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:  # nested for-loops see the call twice
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, node,
+                    f"`{name}()` inside a per-row loop body stamps per "
+                    "token — host overhead on the dispatch path; read "
+                    "the clock once per window/flush before the loop "
+                    "and share the value",
+                )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1305,6 +1398,7 @@ ALL_RULES = (
     ScanBodyAsarrayRule,
     JitCacheGrowthRule,
     RepeatedHostPullRule,
+    PerRowClockRule,
 )
 
 
@@ -1321,4 +1415,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         ScanBodyAsarrayRule(),
         JitCacheGrowthRule(),
         RepeatedHostPullRule(),
+        PerRowClockRule(config.hot_path_files),
     ]

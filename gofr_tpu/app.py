@@ -356,6 +356,36 @@ class App:
                     headers={"Content-Type": "application/json"},
                     body=_json.dumps(stats).encode(),
                 )
+            if path == "/debug/flight":
+                # The serving flight recorder (docs/advanced-guide/
+                # observability.md): per-request lifecycle timelines —
+                # phase durations, token counts, prefix-cache hits,
+                # shed/cancel/replay/failover annotations, trace ids —
+                # from a fixed-size ring with slow/errored requests
+                # pinned so a burst can't evict the interesting ones.
+                # Engine-shaped and pool-shaped backends both expose
+                # flight_records(); a ReplicaPool aggregates per
+                # replica.
+                import json as _json
+
+                flights: dict = {}
+                for name, eng in (
+                    ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
+                ):
+                    if eng is None:
+                        continue
+                    records = getattr(eng, "flight_records", None)
+                    if not callable(records):
+                        continue
+                    try:
+                        flights[name] = records()
+                    except Exception as exc:  # noqa: BLE001 — debug surface
+                        flights[name] = {"error": str(exc)}
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(flights).encode(),
+                )
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json

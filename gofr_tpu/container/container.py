@@ -232,6 +232,50 @@ class Container:
             "app_tpu_hedged_requests_total",
             "unary requests hedged or retried on a second replica",
         )
+        # Request-lifecycle observability (serving/observability.py;
+        # docs/advanced-guide/observability.md): phase-latency
+        # histograms — exactly one record per request per phase,
+        # computed at retirement from host-side timestamps — and
+        # per-window utilization gauges.
+        lat_buckets = (
+            0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+            1, 2.5, 5, 10, 30,
+        )
+        m.new_histogram(
+            "app_tpu_queue_wait_seconds",
+            "submit → admission into a KV slot", lat_buckets,
+        )
+        m.new_histogram(
+            "app_tpu_prefill_seconds",
+            "admission → prefill finalize (chunked)", lat_buckets,
+        )
+        m.new_histogram(
+            "app_tpu_ttft_seconds",
+            "submit → first token emitted", lat_buckets,
+        )
+        m.new_histogram(
+            "app_tpu_inter_token_seconds",
+            "per-request mean gap between generated tokens",
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1),
+        )
+        m.new_histogram(
+            "app_tpu_e2e_seconds",
+            "submit → retirement (whole request)",
+            (0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+        )
+        m.new_gauge(
+            "app_tpu_batch_occupancy",
+            "live decode slots / total slots, set once per window",
+        )
+        m.new_gauge(
+            "app_tpu_decode_step_seconds",
+            "decode-step duration (window dispatch→processed over its "
+            "steps; includes pipeline queueing)",
+        )
+        m.new_gauge(
+            "app_tpu_tokens_per_step",
+            "client-visible tokens emitted per decode step, per window",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

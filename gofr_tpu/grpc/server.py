@@ -15,7 +15,7 @@ from typing import Optional
 
 import grpc
 
-from gofr_tpu.tracing import get_tracer
+from gofr_tpu.tracing import extract_traceparent, get_tracer
 
 
 def grpc_status_code(exc: BaseException) -> "grpc.StatusCode":
@@ -106,10 +106,26 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
             return None
         method = handler_call_details.method
         logger = self._logger
+        # W3C trace adoption from gRPC invocation metadata (the HTTP
+        # middleware's twin): a caller-supplied ``traceparent`` makes
+        # this RPC's span — and every engine phase span beneath it — a
+        # child in the CALLER's trace instead of a fresh root.
+        trace_id = parent_id = None
+        try:
+            md = {
+                str(k).lower(): str(v)
+                for k, v in (handler_call_details.invocation_metadata or ())
+            }
+            trace_id, parent_id = extract_traceparent(md)
+        except Exception:  # graftlint: disable=GL006 — absent/stub metadata APIs mean "no caller trace context", not an error
+            pass
 
         def wrap_unary(behavior):
             async def wrapped(request, context):
-                span = get_tracer().start_span(f"gRPC {method}")
+                span = get_tracer().start_span(
+                    f"gRPC {method}",
+                    trace_id=trace_id, parent_span_id=parent_id,
+                )
                 start = time.time()
                 status = "OK"
                 try:
@@ -130,7 +146,10 @@ class _LoggingInterceptor(grpc.aio.ServerInterceptor):
 
         def wrap_stream(behavior):
             async def wrapped(request, context):
-                span = get_tracer().start_span(f"gRPC {method}")
+                span = get_tracer().start_span(
+                    f"gRPC {method}",
+                    trace_id=trace_id, parent_span_id=parent_id,
+                )
                 start = time.time()
                 status = "OK"
                 try:

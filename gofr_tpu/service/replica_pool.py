@@ -336,6 +336,11 @@ class HTTPReplica(Replica):
                 )
             if kw.get("tenant"):
                 headers["X-Tenant-Id"] = str(kw["tenant"])
+            if kw.get("traceparent"):
+                # Cross-replica trace stitching: the remote replica's
+                # server middleware adopts this trace id, so its spans
+                # land in the SAME trace as the routing tier's.
+                headers["traceparent"] = str(kw["traceparent"])
             resp = self.service.post(
                 self.generate_path, json=body, headers=headers
             )
@@ -685,11 +690,21 @@ class ReplicaPool:
             return False
         return self.hedge_budget.try_acquire()
 
-    def _count_hedge(self, kind: str) -> None:
+    def _count_hedge(self, kind: str, kw: Optional[dict] = None) -> None:
         if self._metrics is not None:
             self._metrics.increment_counter(
                 "app_tpu_hedged_requests_total", "kind", kind
             )
+        # Trace annotation: the hedge/retry hop lands in the request's
+        # trace (instant span under the caller's traceparent). No-op
+        # without an active exporter.
+        from gofr_tpu.serving.observability import emit_instant_span
+
+        emit_instant_span(
+            "tpu.hedge",
+            (kw or {}).get("traceparent"),
+            {"kind": kind},
+        )
 
     def generate_sync(
         self, prompt: Any, timeout: float = 300.0, **kw: Any
@@ -734,7 +749,7 @@ class ReplicaPool:
             else:
                 live.append(second)
                 self._count_hedge(
-                    "retry" if primary_exc is not None else "hedge"
+                    "retry" if primary_exc is not None else "hedge", kw
                 )
         elif not live:
             # Primary failed with no budgeted/routable second attempt:
@@ -832,6 +847,15 @@ class ReplicaPool:
             tried.append(replica)
             if not replica.adopt(req):
                 continue
+            timeline = getattr(req, "timeline", None)
+            if timeline is not None:
+                # Rides the request's lifecycle timeline: the failover
+                # hop shows up in /debug/flight and as a span in the
+                # request's ONE trace (emitted at retirement on the
+                # adopting replica).
+                timeline.note_failover(
+                    source.name, replica.name, timeline.hub.now()
+                )
             if self._metrics is not None:
                 self._metrics.increment_counter(
                     "app_tpu_failovers_total",
@@ -970,6 +994,22 @@ class ReplicaPool:
         if "DEGRADED" in states or "RESTARTING" in states:
             return "DEGRADED"
         return "DOWN"
+
+    def flight_records(self) -> dict:
+        """Aggregate ``/debug/flight`` view: each in-proc replica's
+        flight recorder keyed by replica name. A request that failed
+        over appears ONCE — in its origin replica's recorder, with the
+        failover annotation naming the adopting replica."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            fn = getattr(replica, "engine", None)
+            records = getattr(fn, "flight_records", None)
+            if callable(records):
+                try:
+                    replicas[replica.name] = records()
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    replicas[replica.name] = {"error": str(exc)}
+        return {"replicas": replicas}
 
     def health_check(self) -> dict:
         replicas: dict[str, Any] = {}

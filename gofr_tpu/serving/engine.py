@@ -100,6 +100,9 @@ class InferenceEngine(
         expected_tps: float = 0.0,
         watchdog_s: float = 0.0,
         replay_exact: bool = True,
+        flight_recorder: Optional[bool] = None,
+        flight_records: int = 256,
+        flight_slow_s: float = 5.0,
         params=None,
         logger=None,
         metrics=None,
@@ -258,6 +261,35 @@ class InferenceEngine(
                 logger=logger,
                 model_name=model_name,
             )
+
+        # Request-lifecycle observability (serving/observability.py):
+        # the hub mints per-request timelines, owns the flight recorder,
+        # and summarizes phases into histograms/spans at retirement. It
+        # deliberately lives OUTSIDE _init_llm_serving_state so the
+        # recorder's history survives supervisor warm restarts (the
+        # replay/failover annotations are exactly what an operator wants
+        # to see after one). TPU_FLIGHT_RECORDER=0 disables the ring —
+        # the bench overhead A/B knob.
+        if flight_recorder is None:
+            flight_recorder = os.environ.get(
+                "TPU_FLIGHT_RECORDER", "1"
+            ).lower() not in ("0", "false", "no")
+        from gofr_tpu.serving.observability import (
+            FlightRecorder,
+            RequestObservability,
+        )
+
+        self._obs = RequestObservability(
+            model_name,
+            metrics=metrics,
+            recorder=(
+                FlightRecorder(
+                    capacity=max(1, flight_records),
+                    slow_s=flight_slow_s,
+                )
+                if flight_recorder else None
+            ),
+        )
 
         if self.family == "llm":
             self.max_len = min(max_len, self.cfg.max_len)
@@ -588,6 +620,18 @@ class InferenceEngine(
             replay_exact=config.get_or_default(
                 "TPU_REPLAY_EXACT", "true"
             ).lower() in ("1", "true", "yes"),
+            # Observability (docs/advanced-guide/observability.md): the
+            # flight recorder's ring size, slow-pin threshold, and the
+            # master switch (0 = off, the bench overhead A/B).
+            flight_recorder=config.get_or_default(
+                "TPU_FLIGHT_RECORDER", "1"
+            ).lower() not in ("0", "false", "no"),
+            flight_records=int(
+                config.get_or_default("TPU_FLIGHT_RECORDS", "256")
+            ),
+            flight_slow_s=float(
+                config.get_or_default("TPU_FLIGHT_SLOW_S", "5")
+            ),
             logger=logger,
             metrics=metrics,
             tokenizer=tokenizer_from_config(config, logger),
@@ -1179,6 +1223,11 @@ class InferenceEngine(
                 )
             self._sched_idle = False
         self._work.set()
+        if req.timeline is not None:
+            req.timeline.note_replay(
+                "regenerate" if req.replay_skip else "re-prefill",
+                self._obs.now(),
+            )
         if self._metrics is not None:
             self._metrics.increment_counter(
                 "app_tpu_requests_replayed_total", "model", self.model_name
@@ -1384,6 +1433,7 @@ class InferenceEngine(
         cancel: "Optional[CancelToken]" = None,
         tenant: str = "",
         pin_replica: bool = False,
+        traceparent: "Optional[str]" = None,
     ) -> _GenRequest:
         if self.family != "llm":
             raise RuntimeError(f"model {self.model_name} is not a generative LLM")
@@ -1527,7 +1577,21 @@ class InferenceEngine(
             # Share the transport's token (HTTP disconnect, gRPC cancel)
             # so tripping it retires this sequence mid-decode.
             req.cancel = cancel
-        self._enqueue(req)
+        # Observability: mint the request's lifecycle timeline, adopting
+        # the caller's trace context (explicit W3C traceparent from the
+        # HTTP/gRPC edge, else the submitting task's current span). None
+        # when the whole layer is off — the scheduler hooks all guard.
+        req.timeline = self._obs.begin(
+            prompt_tokens=len(ids), traceparent=traceparent
+        )
+        try:
+            self._enqueue(req)
+        except Exception as exc:
+            # Shed/rejected before a slot: close the timeline with the
+            # shed outcome so the flight recorder pins it and the trace
+            # shows WHY admission said no.
+            self._obs.note_shed(req.timeline, type(exc).__name__)
+            raise
         return req
 
     def register_prefix(
@@ -1593,6 +1657,16 @@ class InferenceEngine(
                 return
             yield tok
 
+
+    def flight_records(self) -> dict:
+        """The flight recorder's current contents (``/debug/flight`` on
+        the ops port): the ring of recent request timelines plus the
+        pinned slow/errored ones. ``{"enabled": False}`` when the
+        recorder is off (TPU_FLIGHT_RECORDER=0)."""
+        recorder = self._obs.recorder
+        if recorder is None:
+            return {"enabled": False}
+        return {"enabled": True, **recorder.snapshot()}
 
     def health_check(self) -> dict:
         devices = self._jax.devices()

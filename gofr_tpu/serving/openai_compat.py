@@ -168,12 +168,18 @@ def add_openai_routes(
         the HTTP server, threaded into every engine submit so abandoned
         or expired requests retire mid-decode and free their KV blocks.
         X-Tenant-Id rides along for per-tenant admission quotas
-        (TPU_TENANT_QUEUE_MAX)."""
+        (TPU_TENANT_QUEUE_MAX), and the tracer middleware's span becomes
+        the engine timeline's parent (one trace from socket to token —
+        and across replicas: a pool forwards it on HTTPReplica calls)."""
         header = getattr(ctx, "header", None)
         tenant = (header("x-tenant-id") if header is not None else "") or ""
-        return dict(
+        out = dict(
             deadline=ctx.deadline, cancel=ctx.cancel_token, tenant=tenant,
         )
+        span = ctx.get("span") if hasattr(ctx, "get") else None
+        if span is not None and hasattr(span, "traceparent"):
+            out["traceparent"] = span.traceparent()
+        return out
 
     def _params(body: dict) -> dict:
         # Explicit nulls are legal per the OpenAI spec → fall back to

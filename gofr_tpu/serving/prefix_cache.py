@@ -30,30 +30,33 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from functools import partial
-from typing import Optional
+from typing import Any, Optional, Sequence
 
 _COPY_BUCKET = 256  # positions per copy bucket (one compile per bucket)
+
+#: Registry key: (adapter slot id, the prefix's token ids).
+_PrefixKey = tuple[int, tuple[int, ...]]
 
 
 class PrefixPool:
     """Device pool of prefilled KV prefixes + host registry."""
 
-    def __init__(self, n_entries: int, cache, mesh=None) -> None:
+    def __init__(self, n_entries: int, cache: Any, mesh: Any = None) -> None:
         import jax
         import jax.numpy as jnp
 
         self.n_entries = n_entries
-        self.max_len = cache.max_len
+        self.max_len: int = cache.max_len
         # registry: (aid, token-tuple) → pool row; ordered for LRU
         # eviction. aid is the engine's adapter slot (0 = base).
         # The lock serializes registry access: lookup/store run in the
         # scheduler thread, but purge_aid runs in whichever thread calls
         # load_lora/unload_lora.
         self._lock = threading.Lock()
-        self._registry: "OrderedDict[tuple, int]" = OrderedDict()
+        self._registry: "OrderedDict[_PrefixKey, int]" = OrderedDict()
 
-        def make_pool():
-            def like(arr):
+        def make_pool() -> tuple[Any, ...]:
+            def like(arr: Any) -> Any:
                 if arr is None:
                     return None
                 shape = (arr.shape[0], n_entries) + arr.shape[2:]
@@ -84,7 +87,9 @@ class PrefixPool:
             self._pool = make_pool()
 
         @partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
-        def store(pool, cache, idx, slot, copy_len):
+        def store(
+            pool: Any, cache: Any, idx: Any, slot: Any, copy_len: int
+        ) -> Any:
             """cache slot's first copy_len positions → pool row idx."""
             pk, pv, pks, pvs = pool
             pk = pk.at[:, idx, :, :copy_len].set(cache.k[:, slot, :, :copy_len])
@@ -99,7 +104,9 @@ class PrefixPool:
             return pk, pv, pks, pvs
 
         @partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
-        def load(cache, pool, idx, slot, copy_len):
+        def load(
+            cache: Any, pool: Any, idx: Any, slot: Any, copy_len: int
+        ) -> Any:
             """pool row idx's first copy_len positions → cache slot."""
             pk, pv, pks, pvs = pool
             new = cache._replace(
@@ -128,7 +135,7 @@ class PrefixPool:
         b = -(-plen // _COPY_BUCKET) * _COPY_BUCKET
         return min(b, self.max_len)
 
-    def lookup(self, ids, aid: int = 0) -> tuple[int, int]:
+    def lookup(self, ids: Sequence[int], aid: int = 0) -> tuple[int, int]:
         """Longest prefix of ``ids`` registered under adapter ``aid`` →
         (pool_row, prefix_len); (-1, 0) on miss. Hit refreshes LRU
         order."""
@@ -147,9 +154,11 @@ class PrefixPool:
             self._registry.move_to_end((aid, best))
             return self._registry[(aid, best)], len(best)
 
-    def store(self, ids, cache, slot: int, aid: int = 0) -> int:
+    def store(
+        self, ids: Sequence[int], cache: Any, slot: int, aid: int = 0
+    ) -> int:
         """Copy a just-prefilled slot's prefix rows into the pool."""
-        key = (aid, tuple(ids))
+        key: _PrefixKey = (aid, tuple(ids))
         with self._lock:
             if key in self._registry:
                 idx = self._registry[key]
@@ -177,7 +186,7 @@ class PrefixPool:
                 del self._registry[k]
             return len(stale)
 
-    def load(self, cache, idx: int, slot: int, plen: int):
+    def load(self, cache: Any, idx: int, slot: int, plen: int) -> Any:
         """Returns the cache with pool row ``idx``'s prefix copied into
         ``slot`` (O(prefix) bucketed copy)."""
         return self._load_fn(cache, self._pool, idx, slot, self._bucket(plen))

@@ -92,6 +92,7 @@ class SchedulerMixin:
     _handoff: Any
     _watchdog: Any
     _metrics: Any
+    _obs: Any  # serving.observability.RequestObservability
     _logger: Any
     _tput: Any  # lifecycle.AggregateThroughput
     tokenizer: Any
@@ -292,6 +293,7 @@ class SchedulerMixin:
             except InvalidStateError:  # cancelled concurrently
                 pass
             req.stream.put(None)
+            self._obs_finish(req, "error", "engine_stopped")
 
         def _fail(req: _GenRequest) -> None:
             if salvaging and req.retryable():
@@ -372,6 +374,21 @@ class SchedulerMixin:
             self._supervisor.notify_crash(error)
 
     # ------------------------------------------------------------------
+    # observability (serving/observability.py)
+    # ------------------------------------------------------------------
+
+    def _obs_finish(
+        self, req: _GenRequest, outcome: str, reason: str = ""
+    ) -> None:
+        """Close a request's timeline from a terminal path. Latched by
+        the timeline itself, so racing terminal paths (reap vs drain vs
+        supervisor fail) summarize exactly once; no-op when the
+        observability layer is off."""
+        tl = req.timeline
+        if tl is not None:
+            tl.finish(outcome, reason, output_tokens=len(req.token_ids))
+
+    # ------------------------------------------------------------------
     # request-lifecycle reap (cancellation + deadlines)
     # ------------------------------------------------------------------
 
@@ -410,6 +427,7 @@ class SchedulerMixin:
         except InvalidStateError:  # caller cancelled concurrently
             pass
         req.stream.put(None)
+        self._obs_finish(req, reason)
         if slot >= 0:
             self._release_slot(slot)
         if self._metrics is not None:
@@ -706,6 +724,7 @@ class SchedulerMixin:
                             "resubmit against the current adapter set"
                         ))
                 req.stream.put(None)
+                self._obs_finish(req, "error", "lora_reloaded")
                 continue
             # Replay-aware admission: a request the supervisor carried
             # across a restart re-prefills prompt + already-delivered
@@ -746,6 +765,7 @@ class SchedulerMixin:
                             f"TPU_KV_POOL_BLOCKS"
                         ))
                     req.stream.put(None)
+                    self._obs_finish(req, "error", "kv_pool_too_small")
                     continue
                 # Automatic prefix cache (TPU_AUTO_PREFIX): alias the
                 # longest cached full-block prefix into the slot's table
@@ -832,13 +852,22 @@ class SchedulerMixin:
                             "adapter set"
                         ))
                 req.stream.put(None)
+                self._obs_finish(req, "error", "lora_reloaded")
                 continue
+            # Observability: admission is now CERTAIN (every reject path
+            # above `continue`d) — stamp the queue-wait end. One clock
+            # read per admitted request, admission-rate not token-rate.
+            tl = req.timeline
+            if tl is not None:
+                tl.mark_admitted(self._obs.now())
             if cached_done:
                 # Count hit tokens only once admission is CERTAIN —
                 # a pool-dry deferral re-runs the alias walk on
                 # re-admission (double-counting the same hit), and the
                 # staleness re-check above can still reject outright.
                 self._prefix_hit_tokens += cached_done
+                if tl is not None:
+                    tl.note_prefix_hit(cached_done)
                 if self._metrics is not None:
                     self._metrics.add_counter(
                         "app_tpu_prefix_hit_tokens_total", cached_done,
@@ -902,6 +931,7 @@ class SchedulerMixin:
                     tokens3[:, i, :] = tokens3[:, 0, :]
                     slots_m[i], starts_m[i] = slots_m[0], starts_m[0]
                 t0 = time.time()
+                t0m = self._obs.now()
                 self._push_table()
                 margs = (
                     self.params, self.cache, self._up(tokens3),
@@ -926,8 +956,14 @@ class SchedulerMixin:
                     self._history_dev = mhist
                 if self._lockstep:
                     self._jax.block_until_ready(self.cache.lengths)
+                # One clock read per multi-chunk DISPATCH, shared by
+                # every row it advanced (timestamps at window
+                # granularity — graftlint GL011).
+                t1m = self._obs.now()
                 for _, st, _ in deep:
                     st.done += d * c
+                    if st.request.timeline is not None:
+                        st.request.timeline.note_chunk(t0m, t1m, d * c)
                 if self._metrics is not None:
                     self._metrics.record_histogram(
                         "app_tpu_infer_latency", time.time() - t0,
@@ -966,6 +1002,7 @@ class SchedulerMixin:
 
         jnp = self._jnp
         t0 = time.time()
+        t0m = self._obs.now()
         self._push_table()
         args = (
             self.params, self.cache, self._up(tokens),
@@ -1016,9 +1053,17 @@ class SchedulerMixin:
             )
 
         emits_started = False
+        # One clock read per chunk DISPATCH (window granularity); the
+        # per-row loop below only copies it into timelines.
+        t1m = self._obs.now()
         for i, (slot, st) in enumerate(rows):
             st.done += int(lens[i])
+            tl = st.request.timeline
+            if tl is not None:
+                tl.note_chunk(t0m, t1m, int(lens[i]))
             if finalize[i]:
+                if tl is not None:
+                    tl.mark_prefill_done(t1m)
                 st.request.effective_prompt_len = st.done
                 del self._prefilling[slot]
                 if st.request.prefix_store:
@@ -1113,6 +1158,12 @@ class SchedulerMixin:
             return h
 
         keep = []
+        # One timestamp pair per FLUSH, shared by every entry that emits
+        # in it (per-row clock reads in this loop were exactly the host
+        # overhead graftlint GL011 exists to flag; entries in one flush
+        # landed together, so a shared stamp loses nothing).
+        now = time.time()
+        now_m = self._obs.now()
         for entry in self._prefill_emits:
             first_dev, lp_dev, ftopi_dev, ftopl_dev, row, slot, seq = entry
             req = seq.request
@@ -1136,10 +1187,11 @@ class SchedulerMixin:
                     (int(ti[j]), float(tl[j]))
                     for j in range(req.top_logprobs)
                 ]
-            now = time.time()
             req.ttft_s = now - req.enqueued_at
             seq.first_token_at = now
             seq.first_emitted = True
+            if req.timeline is not None:
+                req.timeline.mark_first_token(now_m)
             seq.last_token = tok
             seq.n_generated += 1
             self._emit_token(seq, tok, lp, top)
@@ -1245,6 +1297,7 @@ class SchedulerMixin:
                         "(raise TPU_KV_POOL_BLOCKS or lower concurrency)"
                     ))
                 req.stream.put(None)
+                self._obs_finish(req, "error", "kv_pool_exhausted")
                 self._release_slot(i)
                 if mega > 1:
                     # remaining_host was computed before this loop; the
@@ -1401,6 +1454,8 @@ class SchedulerMixin:
             )
 
         now = time.time()
+        mono_now = self._obs.now()  # shared by every row in this window
+        emitted_n = 0  # client-visible emissions this window (gauge)
         for i, seq in enumerate(snapshot):
             if seq is None:
                 continue
@@ -1410,6 +1465,15 @@ class SchedulerMixin:
                 # free the slot or it would stay active forever.
                 if self._slots[i] is seq:
                     seq.request.stream.put(None)
+                    # Overshoot after a normal retirement is already
+                    # summarized (the timeline latch makes this a
+                    # no-op); a caller-cancelled live generation gets
+                    # its terminal record here.
+                    self._obs_finish(
+                        seq.request,
+                        "cancelled" if seq.request.future.cancelled()
+                        else "ok",
+                    )
                     self._release_slot(i)
                     # A future in CANCELLED state (not resolved) means the
                     # caller abandoned a live generation — count it here
@@ -1429,6 +1493,8 @@ class SchedulerMixin:
             if seq.request.ttft_s == 0.0:
                 seq.request.ttft_s = now - seq.request.enqueued_at
                 seq.first_token_at = now
+                if seq.request.timeline is not None:
+                    seq.request.timeline.mark_first_token(mono_now)
             if counts_host is None:
                 step_toks = (
                     ((emitted_host[0, step, i], emitted_host[1, step, i]),)
@@ -1463,6 +1529,7 @@ class SchedulerMixin:
                         ]
                     seq.last_token = tok
                     seq.n_generated += 1
+                    emitted_n += 1
                     self._emit_token(seq, tok, float(lp), top)
                     if self._finished(seq):
                         self._retire(i, seq)
@@ -1482,6 +1549,28 @@ class SchedulerMixin:
                     float(counts_host[live].mean()),
                     "model", self.model_name,
                 )
+        if self._metrics is not None and steps:
+            # Per-WINDOW observability gauges (one set_gauge each per
+            # processed window, from host values already in hand — no
+            # per-token work, no device pulls): how full the batch is,
+            # how long a decode step takes (dispatch→processed over the
+            # window's steps — includes the pipeline's D windows of
+            # queueing, i.e. the number real tokens actually wait), and
+            # how many client-visible tokens a step yields.
+            in_use = sum(1 for s in self._slots if s is not None)
+            self._metrics.set_gauge(
+                "app_tpu_batch_occupancy",
+                in_use / max(1, self.n_slots),
+                "model", self.model_name,
+            )
+            self._metrics.set_gauge(
+                "app_tpu_decode_step_seconds", (now - t0) / steps,
+                "model", self.model_name,
+            )
+            self._metrics.set_gauge(
+                "app_tpu_tokens_per_step", emitted_n / steps,
+                "model", self.model_name,
+            )
         self._update_slot_gauges()
 
     def _emit_token(
@@ -1589,6 +1678,12 @@ class SchedulerMixin:
             finish_reason=reason,
             token_top_logprobs=tops,
         )
+        # Summarize BEFORE resolving: a caller that sees the result is
+        # guaranteed the flight-recorder entry, histogram records, and
+        # spans already exist (the deterministic-test contract; the work
+        # is host-side bookkeeping plus a non-blocking exporter enqueue).
+        if req.timeline is not None:
+            req.timeline.finish("ok", reason, output_tokens=len(ids))
         if not req.future.done():
             req.future.set_result(result)
         req.stream.put(None)  # stream sentinel (after the result resolves)

@@ -41,7 +41,9 @@ async def stream_generation(
     stops = normalize_stop(kw.get("stop"))
     req = engine.submit_generate(prompt, **kw)
     loop = asyncio.get_running_loop()
-    start = time.time()
+    # Monotonic: ttft/duration are INTERVALS — an NTP step between
+    # submit and first token would skew (or negate) a wall-clock diff.
+    start = time.monotonic()
     first_at = None
     n = 0
     hold = max((len(s) for s in stops), default=0)
@@ -55,7 +57,7 @@ async def stream_generation(
             if tok is None:
                 break
             if first_at is None:
-                first_at = time.time()
+                first_at = time.monotonic()
             n += 1
             ids.append(tok)
             if tokenizer is None:
@@ -83,7 +85,9 @@ async def stream_generation(
         yield {
             "type": "done",
             "tokens": n,
-            "ttft_ms": round(((first_at or time.time()) - start) * 1e3, 3),
+            "ttft_ms": round(
+                ((first_at or time.monotonic()) - start) * 1e3, 3
+            ),
             "finish_reason": result.finish_reason,
         }
     finally:
@@ -104,13 +108,13 @@ async def stream_seq2seq(engine, prompt, tokenizer) -> AsyncIterator[dict]:
     ``{"type": "done", "tokens", "ttft_ms", "finish_reason"}``. Pieces
     use cumulative decode so multi-byte text never splits mid-chunk.
     """
-    t0 = time.time()
+    t0 = time.monotonic()  # interval math: immune to NTP wall steps
     all_ids: list[int] = []
     printed = ""
     ttft_ms = 0.0
     async for toks in engine.seq2seq_stream(prompt):
         if not all_ids:
-            ttft_ms = round((time.time() - t0) * 1e3, 2)
+            ttft_ms = round((time.monotonic() - t0) * 1e3, 2)
         all_ids.extend(toks)
         decoded = tokenizer.decode(all_ids) if tokenizer is not None else ""
         piece, printed = decoded[len(printed):], decoded

@@ -499,6 +499,14 @@ class EngineSupervisor:
         except InvalidStateError:  # caller cancelled concurrently
             pass
         req.stream.put(None)
+        # Observability: a request failed across a restart still gets
+        # exactly one flight-recorder entry/trace (latched — no double
+        # summarization when this races a scheduler terminal path).
+        if req.timeline is not None:
+            req.timeline.finish(
+                "error", type(exc).__name__,
+                output_tokens=len(req.token_ids),
+            )
 
     def _give_up(self, reason: str) -> None:
         """Crash loop: ``max_restarts`` consecutive failures — land in

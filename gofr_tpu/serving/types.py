@@ -9,9 +9,12 @@ import queue
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from gofr_tpu.serving.lifecycle import CancelToken, Deadline
+
+if TYPE_CHECKING:  # import cycle: observability never imports types
+    from gofr_tpu.serving.observability import RequestTimeline
 
 
 _PREFILL_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
@@ -172,6 +175,14 @@ class _GenRequest:
     # decode-written K/V by bf16 rounding — enough to flip a sampled
     # token, though never a greedy argmax.
     replay_skip: int = 0
+    # Observability (serving/observability.py): the request's lifecycle
+    # timeline — trace context, phase timestamps collected at window
+    # granularity, replay/failover annotations. None when the layer is
+    # off (TPU_FLIGHT_RECORDER=0 with no metrics and no active trace
+    # exporter); every scheduler hook guards on that. The timeline rides
+    # the REQUEST so a failover carries it to the adopting replica and
+    # the final record covers the whole cross-replica journey.
+    timeline: "Optional[RequestTimeline]" = None
 
     @property
     def remaining_new_tokens(self) -> int:

@@ -30,6 +30,10 @@ from gofr_tpu.tracing.tracer import Span
 
 
 class NoopExporter:
+    #: Lets callers (serving/observability.py) skip span construction
+    #: entirely when completed spans would go nowhere.
+    is_noop = True
+
     def export(self, span: Span, service_name: str) -> None:  # noqa: ARG002
         pass
 

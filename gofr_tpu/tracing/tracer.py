@@ -116,6 +116,41 @@ class Tracer:
         span._token = _current_span.set(span)
         return span
 
+    def emit_span(
+        self,
+        name: str,
+        *,
+        trace_id: str,
+        parent_span_id: Optional[str] = None,
+        start_ns: int,
+        end_ns: int,
+        attributes: Optional[dict[str, Any]] = None,
+        status: str = "OK",
+    ) -> Span:
+        """Export an already-completed span with explicit timestamps.
+
+        The serving observability layer (``serving/observability.py``)
+        reconstructs a request's phase spans at retirement from host
+        timestamps it collected along the way — emitting them live from
+        the scheduler's dispatch path would put clock reads and exporter
+        queue traffic on the decode hot path. This constructs the span
+        fully ended (never touching the ambient context-var, so the
+        scheduler thread's context is untouched) and hands it straight
+        to the exporter."""
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_rand_hex(8),
+            parent_id=parent_span_id,
+            start_ns=int(start_ns),
+            end_ns=int(end_ns),
+            attributes=dict(attributes or {}),
+            status=status,
+            _tracer=None,  # already ended; do not re-enter _on_end
+        )
+        self._on_end(span)
+        return span
+
     def _on_end(self, span: Span) -> None:
         if self._exporter is not None:
             self._exporter.export(span, self.service_name)

@@ -67,6 +67,31 @@ def test_debug_engine_reports_health(debug_app):
     assert stats["tpu"]["details"]["model"] == "llama-tiny"
 
 
+def test_debug_flight_serves_request_timelines(debug_app):
+    """/debug/flight (docs/advanced-guide/observability.md): after one
+    generation the flight recorder serves its timeline — phase
+    durations, token counts, trace id — on the ops port."""
+    result = debug_app.container.tpu.generate_sync(
+        "flight recorder", max_new_tokens=4, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    st, body = _metrics_get(debug_app, "/debug/flight")
+    assert st == 200
+    flights = json.loads(body)
+    assert flights["tpu"]["enabled"] is True
+    entries = flights["tpu"]["records"] + flights["tpu"]["pinned"]
+    match = [
+        e for e in entries
+        if e["outcome"] == "ok"
+        and e["output_tokens"] == len(result.token_ids)
+    ]
+    assert match, entries
+    entry = match[-1]
+    assert entry["trace_id"]
+    for phase in ("queue_wait_s", "prefill_s", "ttft_s", "e2e_s"):
+        assert phase in entry["phases"], entry["phases"]
+
+
 def test_debug_tpu_trace_validates_and_captures(debug_app):
     st, body = _metrics_get(debug_app, "/debug/tpu-trace?ms=nope")
     assert st == 400 and b"integer" in body

@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL010), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL011), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -627,6 +627,79 @@ def test_gl010_ignores_hoisted_rebound_and_closure_pulls(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL011 — per-row clock reads in scheduler emit/decode loops
+# ----------------------------------------------------------------------
+
+
+def test_gl011_flags_clock_in_per_row_loop_on_hot_path(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import time
+
+        def process_window(snapshot):
+            for seq in snapshot:
+                now = time.time()  # per-row stamp: k*S syscalls/window
+                seq.ttft = now - seq.enqueued_at
+
+        def flush(entries):
+            for entry in entries:
+                entry.first_at = time.monotonic()
+        """,
+        select=["GL011"],
+    )
+    assert ids == ["GL011", "GL011"]
+    assert "once per window" in findings[0].message
+
+
+def test_gl011_ignores_hoisted_while_polls_cold_paths_and_closures(tmp_path):
+    # Hoisted stamps, while-loop deadline polls, and nested closures are
+    # all fine on the hot path.
+    ids, _ = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import time
+
+        def process_window(snapshot):
+            now = time.time()  # hoisted: the fix
+            for seq in snapshot:
+                seq.ttft = now - seq.enqueued_at
+
+        def drain(deadline_s):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:  # poll: condition IS time
+                pass
+
+        def fetch(emitted, entries):
+            for entry in entries:
+                while not emitted.is_ready():  # readiness poll in a for
+                    t = time.monotonic()
+                entry.mark = 1
+
+        def lazy(rows):
+            for row in rows:
+                stamp = lambda: time.time()  # not run by this loop
+            return stamp
+        """,
+        select=["GL011"],
+    )
+    assert ids == []
+    # Same per-row stamping OFF the hot path: not this rule's business.
+    ids, _ = _lint(
+        tmp_path, "datasource/poll.py",
+        """
+        import time
+
+        def poll(rows):
+            for row in rows:
+                row.at = time.time()
+        """,
+        select=["GL011"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -785,7 +858,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010",
+        "GL008", "GL009", "GL010", "GL011",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2
