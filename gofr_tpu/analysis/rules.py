@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL011).
+"""The graftlint rule set (GL001–GL012).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1384,6 +1384,107 @@ class PerRowClockRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL012 — blocking network I/O without an explicit timeout
+# ----------------------------------------------------------------------
+
+
+class BlockingIONoTimeoutRule(Rule):
+    """A socket/HTTP-client call without an explicit timeout in the
+    serving or service tier blocks a worker FOREVER when the peer
+    blackholes (SYN dropped by a dead pod's floating IP, a remote that
+    accepts and never answers). In the replica data plane that is not a
+    hung request — it is a leaked thread per hang, an in-flight count
+    that never drains, and a replica the pool cannot drain or retire.
+    Every outbound call must state its budget: library defaults are
+    either infinite (``socket``, ``urllib``) or owned by someone else's
+    upgrade (``httpx``).
+
+    Flagged (in ``serving/`` and ``service/`` only):
+
+    * ``httpx.Client(...)`` / ``httpx.AsyncClient(...)`` constructed
+      without a ``timeout=`` argument (per-request overrides exist, but
+      the constructor default is the safety net every call inherits);
+    * ``requests.get/post/…/request(...)`` without ``timeout=`` —
+      requests' default is no timeout at all;
+    * ``urllib.request.urlopen(...)`` without ``timeout`` (keyword or
+      second positional);
+    * ``socket.create_connection(addr)`` without a timeout (keyword or
+      second positional) — inherits the global default, usually None.
+
+    Conservative: only fully-dotted library entry points are matched
+    (a method call on an already-configured client object carries its
+    constructor's budget and is not re-flagged).
+    """
+
+    rule_id = "GL012"
+    name = "blocking-io-no-timeout"
+    rationale = (
+        "outbound network calls in the serving/service tier must carry "
+        "an explicit timeout; a blackholed peer otherwise parks the "
+        "worker thread forever and the replica can never drain"
+    )
+
+    #: Constructors whose ``timeout=`` kwarg is the budget.
+    _CLIENT_CTORS = frozenset(("httpx.Client", "httpx.AsyncClient"))
+    #: requests' module-level verbs (timeout kwarg only).
+    _REQUESTS_VERBS = frozenset(
+        f"requests.{verb}" for verb in (
+            "get", "post", "put", "patch", "delete", "head", "options",
+            "request",
+        )
+    )
+    #: Calls where the timeout may also be a positional argument:
+    #: name → index of the timeout positional.
+    _POSITIONAL_TIMEOUT = {
+        "urllib.request.urlopen": 2,
+        "socket.create_connection": 1,
+    }
+
+    def __init__(
+        self, scoped_dirs: Sequence[str] = ("serving", "service")
+    ) -> None:
+        self._dirs = tuple(scoped_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+                   for d in self._dirs)
+
+    @staticmethod
+    def _has_timeout_kwarg(call: ast.Call) -> bool:
+        return any(
+            kw.arg == "timeout" or kw.arg is None  # **kwargs may carry it
+            for kw in call.keywords
+        )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if name in self._CLIENT_CTORS or name in self._REQUESTS_VERBS:
+                if not self._has_timeout_kwarg(node):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{name}(...)` without an explicit `timeout=`: "
+                        "a blackholed peer blocks this call forever; "
+                        "state the budget at the call site",
+                    )
+            elif name in self._POSITIONAL_TIMEOUT:
+                n_pos = self._POSITIONAL_TIMEOUT[name]
+                if (
+                    len(node.args) < n_pos + 1
+                    and not self._has_timeout_kwarg(node)
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"`{name}(...)` without a timeout (keyword or "
+                        f"positional #{n_pos + 1}): inherits an infinite "
+                        "default; state the budget at the call site",
+                    )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1399,6 +1500,7 @@ ALL_RULES = (
     JitCacheGrowthRule,
     RepeatedHostPullRule,
     PerRowClockRule,
+    BlockingIONoTimeoutRule,
 )
 
 
@@ -1416,4 +1518,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         JitCacheGrowthRule(),
         RepeatedHostPullRule(),
         PerRowClockRule(config.hot_path_files),
+        BlockingIONoTimeoutRule(),
     ]

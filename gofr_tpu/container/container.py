@@ -232,6 +232,24 @@ class Container:
             "app_tpu_hedged_requests_total",
             "unary requests hedged or retried on a second replica",
         )
+        # Multi-host data plane (service/replica_pool.py +
+        # service/pool_scaler.py): pool composition by routing state,
+        # load-adaptive scale events, and remote SSE streams resumed on
+        # a sibling after a network loss.
+        m.new_gauge(
+            "app_tpu_pool_replicas",
+            "replica-pool composition by routing state "
+            "(serving/degraded/restarting/down/draining)",
+        )
+        m.new_counter(
+            "app_tpu_scale_events_total",
+            "pool-scaler resize events (direction=up|down)",
+        )
+        m.new_counter(
+            "app_tpu_remote_stream_failovers_total",
+            "remote SSE streams that died mid-stream and resumed on a "
+            "sibling replica",
+        )
         # Request-lifecycle observability (serving/observability.py;
         # docs/advanced-guide/observability.md): phase-latency
         # histograms — exactly one record per request per phase,

@@ -8,6 +8,14 @@ fires unconditionally and tests arm selectively:
 * ``engine.tokenize``     — before the tokenizer encodes a prompt
 * ``scheduler.window``    — top of every scheduler loop iteration
 * ``scheduler.device_step`` — before a decode/prefill device dispatch
+* ``http.request``        — in ``HTTPService.request`` before the wire:
+  raise = connect-refused / transport loss; return a ``Response`` =
+  canned upstream answer (5xx burst without a socket)
+* ``http.stream.open``    — before an SSE stream connects: raise =
+  connect-refused; return an iterable = serve the stream from it
+* ``http.stream.event``   — per received SSE line: raise = mid-body
+  connection reset; return ``"truncate"`` = upstream vanished without
+  EOF framing (truncated SSE); a blocking action models a read stall
 
 Unarmed, ``fire`` is one dict read (the serving hot path pays nothing
 measurable). Armed, a point either **raises** the configured exception

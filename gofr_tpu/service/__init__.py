@@ -19,6 +19,7 @@ from gofr_tpu.service.options import (
     OAuthConfig,
     RetryConfig,
 )
+from gofr_tpu.service.pool_scaler import PoolScaler
 from gofr_tpu.service.replica_pool import (
     EngineReplica,
     HTTPReplica,
@@ -27,6 +28,7 @@ from gofr_tpu.service.replica_pool import (
 )
 
 __all__ = [
+    "PoolScaler",
     "HTTPService",
     "Response",
     "new_http_service",

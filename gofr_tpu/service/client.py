@@ -5,17 +5,83 @@ traceparent injection, ``app_http_service_response`` histogram, structured
 request logs, ``Response{body, status_code}`` + header access, and a
 ``.well-known/alive`` health probe consumed by the container's aggregate
 health (``container/health.go:23-25``).
+
+Network-failure semantics the replica tier builds on:
+
+* **Separate connect and read budgets** — ``connect_timeout_s`` bounds
+  only the TCP/TLS handshake while ``timeout`` bounds the response read.
+  A *connect* failure means nothing is listening (dead upstream); a
+  *read* timeout usually means a live upstream busy behind queued work.
+  Conflating the two made the replica prober demote loaded-but-alive
+  remotes; every transport error raised here carries a ``kind``
+  attribute (``"connect"`` / ``"read"`` / ``"transport"``) so callers
+  can tell them apart.
+* **Deterministic fault points** — ``faults.fire("http.request")`` in
+  :meth:`HTTPService.request` and ``http.stream.open`` /
+  ``http.stream.event`` in :meth:`HTTPService.stream_lines` let the
+  network-chaos suite inject connect-refused, 5xx bursts, mid-body
+  resets, truncated SSE streams and read-stalls without real sockets
+  (see ``gofr_tpu/faults`` and ``tests/test_remote_failover.py``).
 """
 
 from __future__ import annotations
 
 import json as jsonlib
 import time
-from typing import Any, Mapping, Optional
+from contextlib import contextmanager
+from typing import Any, Iterator, Mapping, Optional
 
 import httpx
 
-from gofr_tpu.tracing import get_tracer, inject_traceparent
+from gofr_tpu import faults
+from gofr_tpu.tracing import (
+    current_span,
+    extract_traceparent,
+    get_tracer,
+    inject_traceparent,
+)
+
+
+def _client_span(name: str, hdrs: Mapping[str, str], url: str) -> Any:
+    """Client span for an outbound request. The ambient contextvar span
+    parents it when one exists (the in-app handler case). Without one —
+    the replica tier submits from detached worker threads, where the
+    contextvar chain is broken but the routing tier's trace context
+    rides the request as an explicit ``traceparent`` header — the span
+    joins THAT trace, so the header re-injected downstream carries the
+    same trace id with this span as parent: one trace across hosts."""
+    tracer = get_tracer()
+    if current_span() is None:
+        trace_id, parent_id = extract_traceparent(hdrs)
+        if trace_id:
+            return tracer.start_span(
+                name, trace_id=trace_id, parent_span_id=parent_id,
+                attributes={"http.url": url},
+            )
+    return tracer.start_span(name, attributes={"http.url": url})
+
+
+def classify_transport_error(exc: BaseException) -> str:
+    """Map a transport failure to its ``kind``: ``"connect"`` (nothing
+    accepted the connection — the upstream is gone), ``"read"`` (the
+    connection lives but bytes stopped — busy or stalled upstream), or
+    ``"transport"`` (anything else on the wire)."""
+    if isinstance(exc, (httpx.ConnectError, httpx.ConnectTimeout)):
+        return "connect"
+    if isinstance(exc, (httpx.ReadTimeout, httpx.ReadError)):
+        return "read"
+    kind = getattr(exc, "kind", None)
+    return kind if isinstance(kind, str) else "transport"
+
+
+def _unavailable(address: str, exc: BaseException) -> Exception:
+    """Typed 503 for a transport failure, tagged with the failure kind
+    so the replica tier can classify dead-vs-busy correctly."""
+    from gofr_tpu.errors import ErrorServiceUnavailable
+
+    err = ErrorServiceUnavailable(f"{address}: {exc}")
+    err.kind = classify_transport_error(exc)  # type: ignore[attr-defined]
+    return err
 
 
 class Response:
@@ -57,11 +123,34 @@ class ServiceLog:
 class HTTPService:
     """Concrete client; options wrap/extend it (``AddOption`` pattern)."""
 
-    def __init__(self, address: str, logger: Any = None, metrics: Any = None, timeout: float = 30.0) -> None:
+    def __init__(
+        self,
+        address: str,
+        logger: Any = None,
+        metrics: Any = None,
+        timeout: float = 30.0,
+        connect_timeout_s: Optional[float] = None,
+    ) -> None:
         self.address = address.rstrip("/")
         self._logger = logger
         self._metrics = metrics
-        self._client = httpx.Client(timeout=timeout)
+        self.timeout = float(timeout)
+        # Connect budget separate from (and much shorter than) the read
+        # budget: a dead upstream refuses/blackholes the HANDSHAKE in
+        # ~RTT time, while a busy-but-alive one accepts instantly and
+        # is merely slow to ANSWER. One shared budget forced callers to
+        # wait the full read timeout to learn nothing is listening — or,
+        # worse, to classify a loaded replica as dead.
+        self.connect_timeout_s = float(
+            connect_timeout_s
+            if connect_timeout_s is not None
+            else min(self.timeout, 5.0)
+        )
+        self._client = httpx.Client(
+            timeout=httpx.Timeout(
+                self.timeout, connect=self.connect_timeout_s
+            )
+        )
         self.health_endpoint = ".well-known/alive"  # reference service/health.go:18-20
 
     # -- core request (reference service/new.go:135-192) ------------------
@@ -78,13 +167,21 @@ class HTTPService:
     ) -> Response:
         url = f"{self.address}/{path.lstrip('/')}" if path else self.address
         hdrs = dict(headers or {})
-        span = get_tracer().start_span(
-            f"http-service {method} {url}", attributes={"http.url": url}
-        )
+        span = _client_span(f"http-service {method} {url}", hdrs, url)
         inject_traceparent(hdrs, span)
         start = time.time()
         status = 0
         try:
+            # Chaos seam: an armed fault either raises a transport error
+            # (connect-refused) or returns a canned Response (5xx burst)
+            # — the full request path below it stays exercised.
+            canned = faults.fire(
+                "http.request", address=self.address, method=method,
+                path=path,
+            )
+            if isinstance(canned, Response):
+                status = canned.status_code
+                return canned
             try:
                 resp = self._client.request(
                     method, url, params=params, headers=hdrs, content=body, json=json
@@ -92,10 +189,9 @@ class HTTPService:
             except httpx.TransportError as exc:
                 # Downstream unreachable → typed 503, not an anonymous 500
                 # (the responder honors status_code; the breaker still counts
-                # the raised error as a failure).
-                from gofr_tpu.errors import ErrorServiceUnavailable
-
-                raise ErrorServiceUnavailable(f"{self.address}: {exc}") from exc
+                # the raised error as a failure). The `kind` tag keeps
+                # connect-vs-read distinguishable for the replica prober.
+                raise _unavailable(self.address, exc) from exc
             status = resp.status_code
             return Response(resp.content, resp.status_code, resp.headers)
         finally:
@@ -132,19 +228,131 @@ class HTTPService:
     def delete(self, path: str, params: Any = None, body: Any = None, headers: Any = None) -> Response:
         return self.request("DELETE", path, params=params, body=body, headers=headers)
 
+    # -- streaming (SSE consumer for remote replicas) -----------------------
+
+    @contextmanager
+    def stream_lines(
+        self,
+        method: str,
+        path: str,
+        *,
+        json: Any = None,
+        headers: Optional[Mapping[str, str]] = None,
+        read_timeout_s: Optional[float] = None,
+    ) -> Iterator[Iterator[str]]:
+        """Open a streaming request and yield an iterator of decoded
+        response LINES (the SSE framing unit). ``read_timeout_s`` is the
+        per-read idle budget: an upstream that stops sending bytes for
+        longer raises a ``kind="read"`` 503 mid-iteration — the replica
+        tier's stall/slow-loris detector. Connect failures raise a
+        ``kind="connect"`` 503 before any line is yielded; non-2xx
+        statuses raise with the upstream's status attached.
+
+        Fault points: ``http.stream.open`` fires before the connection
+        attempt (raise = connect-refused; return an iterable = serve the
+        stream from it, no socket at all); ``http.stream.event`` fires
+        per line (raise = mid-body reset; return ``"truncate"`` = EOF
+        now, the truncated-SSE fault).
+        """
+        url = f"{self.address}/{path.lstrip('/')}" if path else self.address
+        hdrs = dict(headers or {})
+        span = _client_span(
+            f"http-service {method} {url} (stream)", hdrs, url
+        )
+        inject_traceparent(hdrs, span)
+        status = 0
+        try:
+            canned = faults.fire(
+                "http.stream.open", address=self.address, method=method,
+                path=path,
+            )
+            if canned is not None:
+                status = 200
+                yield self._guarded_lines(iter(canned))
+                return
+            timeout = httpx.Timeout(
+                self.timeout if read_timeout_s is None else read_timeout_s,
+                connect=self.connect_timeout_s,
+            )
+            try:
+                with self._client.stream(
+                    method, url, json=json, headers=hdrs, timeout=timeout
+                ) as resp:
+                    status = resp.status_code
+                    if status >= 400:
+                        # Read the (bounded) error body so callers can
+                        # map the upstream's status faithfully.
+                        body = resp.read()[:2048]
+                        from gofr_tpu.errors import GofrError
+
+                        exc = GofrError(
+                            f"{self.address} answered {status}: "
+                            f"{body.decode(errors='replace')}"
+                        )
+                        exc.status_code = status
+                        raise exc
+                    yield self._guarded_lines(resp.iter_lines())
+            except httpx.TransportError as exc:
+                raise _unavailable(self.address, exc) from exc
+        finally:
+            span.set_attribute("http.status_code", status)
+            span.end()
+
+    def _guarded_lines(self, lines: Iterator[str]) -> Iterator[str]:
+        """Wrap a line iterator with the per-event fault point and
+        transport-error tagging (mid-body failures surface as tagged
+        503s, same contract as the open path)."""
+        index = 0
+        while True:
+            try:
+                line = next(lines)
+            except StopIteration:
+                return
+            except httpx.TransportError as exc:
+                raise _unavailable(self.address, exc) from exc
+            verdict = faults.fire(
+                "http.stream.event", address=self.address, index=index,
+                line=line,
+            )
+            if verdict == "truncate":
+                return  # upstream vanished mid-stream, no EOF framing
+            index += 1
+            yield line
+
     # -- health (reference service/health.go) ------------------------------
 
     def health_check(self) -> dict:
         try:
             resp = self.get(self.health_endpoint)
             if resp.status_code < 400:
-                return {"status": "UP", "details": {"host": self.address}}
+                details: dict[str, Any] = {"host": self.address}
+                try:
+                    # Surface the upstream's own health payload (engine
+                    # state, loaded LoRA adapters, ...) so the replica
+                    # tier can read advertised capability sets from one
+                    # probe; liveness endpoints with non-JSON bodies
+                    # keep the plain host detail.
+                    body = resp.json()
+                    if isinstance(body, dict):
+                        if isinstance(body.get("data"), dict):
+                            body = body["data"]  # gofr envelope
+                        if isinstance(body.get("details"), dict):
+                            details.update(body["details"])
+                        if body.get("status"):
+                            details["upstream_status"] = body["status"]
+                except Exception:  # noqa: BLE001 — liveness bodies may be anything
+                    pass
+                return {"status": "UP", "details": details}
             return {
                 "status": "DOWN",
                 "details": {"host": self.address, "error": f"status {resp.status_code}"},
             }
         except Exception as exc:
-            return {"status": "DOWN", "details": {"host": self.address, "error": str(exc)}}
+            details = {"host": self.address, "error": str(exc)}
+            kind = getattr(exc, "kind", "")
+            if kind:
+                details["error_kind"] = kind
+            return {"status": "DOWN", "details": details}
 
     def close(self) -> None:
         self._client.close()

@@ -1,0 +1,285 @@
+"""Load-adaptive replica-pool scaling (``PoolScaler``).
+
+The :class:`~gofr_tpu.service.replica_pool.ReplicaPool` made the pool —
+not the engine — the availability boundary; this module makes it the
+CAPACITY boundary too. A :class:`PoolScaler` watches the load signals
+the pool already exposes (aggregate outstanding work per serving
+replica, measured throughput) and resizes the pool through two
+injectable callbacks:
+
+* ``spawn() -> Replica`` — build one new replica. Tests and
+  single-host deployments pass an in-proc engine factory
+  (``serving/backend.py`` wires exactly that from config); real
+  multi-host deployments pass an operator hook that provisions a pod
+  and returns an ``HTTPReplica`` pointing at it.
+* drain — not a callback but a protocol: scale-down picks the idlest
+  eligible replica and runs the pool's ``drain_replica`` (stop routing
+  → bounded in-flight completion → retire). A drain that cannot reach
+  zero load inside its budget ABORTS and re-admits the replica, so
+  scaling down never drops an in-flight request.
+
+Decision rule (deliberately boring — autoscalers earn trust by being
+predictable):
+
+* **Scale up** when outstanding work per serving replica stays above
+  ``up_load_per_replica`` for ``scale_up_wait_s`` continuously
+  (``TPU_SCALE_UP_WAIT_S``) and the pool is below
+  ``max_replicas`` (``TPU_POOL_MAX_REPLICAS``).
+* **Scale down** when it stays below ``down_load_per_replica`` for
+  ``scale_down_wait_s`` continuously (``TPU_SCALE_DOWN_WAIT_S``) and
+  the pool is above ``min_replicas`` (``TPU_POOL_MIN_REPLICAS``).
+* Replicas that are draining, probe-demoted, or DOWN don't count as
+  capacity — a pool of three replicas with two DOWN is a one-replica
+  pool under this rule, which is exactly when you want the spawn.
+
+The sustain windows are the flap guard: a single bursty sweep neither
+spawns (cold engines take seconds to compile) nor drains (the burst's
+tail would land on fewer replicas). Hysteresis comes from the gap
+between the two thresholds.
+
+Determinism contract (``tests/test_remote_failover.py``): the clock is
+injectable, ``evaluate()`` runs inline (the background thread is
+optional and owns no decision logic), and drains use an injectable
+sleep. Observability: ``app_tpu_scale_events_total{direction}`` and the
+pool's ``app_tpu_pool_replicas{state}`` gauge refresh every sweep.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from gofr_tpu.service.replica_pool import Replica, ReplicaPool
+
+
+class PoolScaler:
+    """Watches a :class:`ReplicaPool`'s load signals and spawns/drains
+    replicas through injectable callbacks. See the module docstring for
+    the decision rule."""
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        spawn: Callable[[], Replica],
+        *,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        up_load_per_replica: float = 4.0,
+        down_load_per_replica: float = 0.5,
+        scale_up_wait_s: float = 10.0,
+        scale_down_wait_s: float = 60.0,
+        drain_timeout_s: float = 30.0,
+        interval_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        metrics: Any = None,
+        logger: Any = None,
+    ) -> None:
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self.pool = pool
+        self.spawn = spawn
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_load_per_replica = float(up_load_per_replica)
+        self.down_load_per_replica = float(down_load_per_replica)
+        self.scale_up_wait_s = float(scale_up_wait_s)
+        self.scale_down_wait_s = float(scale_down_wait_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._metrics = metrics
+        self._logger = logger
+        # Sustain-window anchors: the first sweep that saw pressure
+        # (resp. idleness) continuously holding since.
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Replicas THIS scaler spawned, preferred for retirement: the
+        # operator's hand-configured replicas are the floor fleet.
+        self._spawned: list[Replica] = []
+
+    # -- signals -----------------------------------------------------------
+
+    def _capacity(self) -> list[Replica]:
+        """Replicas currently counting as capacity: routable and not
+        leaving."""
+        return [
+            r for r in self.pool.replicas
+            if not r.draining
+            and not r.probe_failed
+            and r.state() in ("SERVING", "DEGRADED")
+        ]
+
+    def load_per_replica(self) -> float:
+        """Aggregate outstanding work over serving capacity — the
+        scaling signal. Work queued while NO capacity serves reads as
+        infinite pressure (spawn immediately)."""
+        capacity = self._capacity()
+        total = sum(r.load() for r in capacity)
+        # Draining replicas still hold in-flight work but their load is
+        # leaving the pool with them; it is not future demand.
+        if not capacity:
+            return float("inf")
+        return total / len(capacity)
+
+    # -- one sweep ---------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> str:
+        """One scaling decision; returns ``"up"``, ``"down"``, or
+        ``"steady"``. The background thread calls this on its interval;
+        tests call it directly with a stated clock."""
+        now = self._clock() if now is None else now
+        capacity = self._capacity()
+        n = len(capacity)
+        load = self.load_per_replica()
+
+        # Floor repair outranks the sustain windows: below min the pool
+        # is in violation NOW (replicas died or an operator drained too
+        # far), not merely under pressure.
+        if n < self.min_replicas:
+            return self._scale_up(now, reason="below min_replicas")
+
+        if load > self.up_load_per_replica:
+            self._idle_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            if (
+                now - self._pressure_since >= self.scale_up_wait_s
+                and n < self.max_replicas
+            ):
+                return self._scale_up(
+                    now,
+                    reason=f"load/replica {load:.1f} > "
+                    f"{self.up_load_per_replica:.1f} for "
+                    f"{self.scale_up_wait_s:.0f}s",
+                )
+            return "steady"
+
+        self._pressure_since = None
+        if load < self.down_load_per_replica and n > self.min_replicas:
+            if self._idle_since is None:
+                self._idle_since = now
+            if now - self._idle_since >= self.scale_down_wait_s:
+                return self._scale_down(now)
+            return "steady"
+
+        self._idle_since = None
+        return "steady"
+
+    def _scale_up(self, now: float, reason: str) -> str:
+        if len(self.pool.replicas) >= self.max_replicas:
+            # Membership (not just capacity) is at the ceiling: respawn
+            # nothing — recovery of the existing DOWN replicas is the
+            # prober's job, and exceeding TPU_POOL_MAX_REPLICAS is never
+            # allowed, even to repair the floor.
+            return "steady"
+        try:
+            replica = self.spawn()
+        except Exception as exc:  # noqa: BLE001 — a failed spawn must not kill the sweep
+            if self._logger is not None:
+                self._logger.errorf("replica spawn failed: %s", exc)
+            return "steady"
+        self.pool.add_replica(replica)
+        self._spawned.append(replica)
+        self._pressure_since = None
+        self._idle_since = None
+        self._count("up")
+        if self._logger is not None:
+            self._logger.infof(
+                "scaled up: replica %s joined (%s); pool now %d",
+                replica.name, reason, len(self.pool.replicas),
+            )
+        return "up"
+
+    def _scale_down(self, now: float) -> str:
+        victim = self._pick_victim()
+        if victim is None:
+            return "steady"
+        drained = self.pool.drain_replica(
+            victim,
+            timeout_s=self.drain_timeout_s,
+            sleep=self._sleep,
+        )
+        if not drained:
+            # Bounded drain could not empty the replica: it re-entered
+            # routing, nothing was dropped; keep the idle anchor so the
+            # next sweep retries without restarting the sustain window.
+            return "steady"
+        if victim in self._spawned:
+            self._spawned.remove(victim)
+        self._idle_since = None
+        self._count("down")
+        if self._logger is not None:
+            self._logger.infof(
+                "scaled down: replica %s drained and retired; pool now "
+                "%d", victim.name, len(self.pool.replicas),
+            )
+        return "down"
+
+    def _pick_victim(self) -> Optional[Replica]:
+        """Idlest scaler-spawned replica first; never the last
+        ``min_replicas`` of capacity."""
+        capacity = self._capacity()
+        if len(capacity) <= self.min_replicas:
+            return None
+        spawned = [r for r in capacity if r in self._spawned]
+        candidates = spawned or capacity
+        return min(candidates, key=lambda r: r.load())
+
+    def _count(self, direction: str) -> None:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_scale_events_total", "direction", direction
+            )
+        self.pool.publish_pool_gauges()
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self) -> "PoolScaler":
+        if self.interval_s <= 0:
+            return self
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu-pool-scaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=5)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception as exc:  # noqa: BLE001 — the scaler must survive
+                if self._logger is not None:
+                    self._logger.errorf("pool scaler sweep failed: %s", exc)
+
+    def describe(self) -> dict:
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "replicas": len(self.pool.replicas),
+            "load_per_replica": (
+                -1.0 if self.load_per_replica() == float("inf")
+                else round(self.load_per_replica(), 3)
+            ),
+            "up_load_per_replica": self.up_load_per_replica,
+            "down_load_per_replica": self.down_load_per_replica,
+            "scale_up_wait_s": self.scale_up_wait_s,
+            "scale_down_wait_s": self.scale_down_wait_s,
+            "spawned": [r.name for r in self._spawned],
+        }

@@ -87,8 +87,12 @@ def _is_reroutable(exc: BaseException) -> bool:
 class Replica:
     """One pool member. Subclasses bind a concrete backend."""
 
-    #: Streaming + request adoption need an in-process engine.
+    #: Streaming + request adoption need a backend that can continue a
+    #: live stream handle: an in-process engine, or a remote replica
+    #: consuming SSE (``HTTPReplica`` with streaming enabled).
     supports_stream = False
+    #: True for network-backed replicas (remote-stream failover metric).
+    remote = False
 
     def __init__(self, name: str) -> None:
         self.name = name
@@ -96,6 +100,10 @@ class Replica:
         # one. While set, the router treats the replica as DOWN no
         # matter what its own state machine claims.
         self.probe_failed = False
+        # Set by the pool's drain path (scaler scale-down, operator
+        # retire): routing skips the replica immediately while in-flight
+        # work runs to completion, then the pool closes and removes it.
+        self.draining = False
 
     # -- routing surface ------------------------------------------------
 
@@ -105,6 +113,26 @@ class Replica:
     def load(self) -> int:
         """Outstanding work (queue + live); the least-loaded heuristic."""
         raise NotImplementedError
+
+    def adapters(self) -> frozenset[str]:
+        """LoRA adapter names this replica can serve RIGHT NOW (loaded
+        weights). The router sends adapter-bound requests only to
+        replicas advertising the adapter; in-proc replicas read their
+        engine's live slot table, remote ones cache the set from the
+        last health probe."""
+        return frozenset()
+
+    def load_adapter(self, name: str, source: Any) -> bool:
+        """Ask this replica to load adapter ``name`` from ``source``
+        (lazy reconciliation: the pool calls this when a request names
+        an adapter no routable replica advertises). False when this
+        backend cannot load adapters."""
+        return False
+
+    def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
+        """Install/remove the pool's mid-stream failover target: the
+        replica offers ``handoff(req)`` every still-retryable request it
+        would otherwise fail terminally."""
 
     def throughput(self) -> float:
         """Measured tokens/sec (sliding window), 0.0 when unknown — the
@@ -148,8 +176,11 @@ class Replica:
         return {
             "state": self.state(),
             "probe_failed": self.probe_failed,
+            "draining": self.draining,
             "load": self.load(),
             "supports_stream": self.supports_stream,
+            "remote": self.remote,
+            "adapters": sorted(self.adapters()),
         }
 
     def close(self) -> None:
@@ -190,10 +221,50 @@ class EngineReplica(Replica):
         except Exception:  # noqa: BLE001 — heuristic only, never break routing
             return 0.0
 
+    def adapters(self) -> frozenset[str]:
+        names = getattr(self.engine, "lora_names", None)
+        if not callable(names):
+            return frozenset()
+        try:
+            return frozenset(names())
+        except Exception:  # noqa: BLE001 — advertisement is a routing hint only
+            return frozenset()
+
+    def load_adapter(self, name: str, source: Any) -> bool:
+        try:
+            self.engine.load_lora(name, source)
+            return True
+        except Exception:  # noqa: BLE001 — reconciliation tries the next replica
+            return False
+
+    def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
+        self.engine.set_replica_handoff(handoff)
+
     def submit(self, prompt: Any, **kw: Any) -> Any:
         return self.engine.submit_generate(prompt, **kw)
 
     def adopt(self, req: Any) -> bool:
+        if req.adapter:
+            # LoRA slot ids are PER-ENGINE: re-resolve the adapter name
+            # against this engine's slot table (and its current load
+            # generation) before requeueing — adopting under the dying
+            # sibling's slot id would silently serve different weights.
+            aid = getattr(self.engine, "_lora_names", {}).get(req.adapter)
+            if aid is None:
+                return False
+            req.aid = aid
+            req.lora_gen = self.engine._lora_gen[aid]
+        if req.timeline is None and getattr(req, "traceparent", None):
+            # A request born on a REMOTE replica has no local timeline
+            # (the hub lives with the engine). Mint one on the adopting
+            # engine under the caller's traceparent so the continuation
+            # lands in the SAME trace the remote replica's spans joined.
+            obs = getattr(self.engine, "_obs", None)
+            if obs is not None:
+                req.timeline = obs.begin(
+                    prompt_tokens=len(req.prompt_ids),
+                    traceparent=req.traceparent,
+                )
         return bool(self.engine.requeue_replay(req))
 
     def probe(self, timeout_s: float) -> tuple[str, str]:
@@ -246,17 +317,36 @@ class EngineReplica(Replica):
 
 
 class HTTPReplica(Replica):
-    """A remote replica behind the service tier: unary generations via
-    its OpenAI-compatible endpoint, liveness via ``/.well-known/health``.
+    """A remote replica behind the service tier: generations via its
+    OpenAI-compatible endpoint, liveness + capability advertisement via
+    the health endpoint.
+
+    With ``stream=True`` (the default — the remote is another gofr_tpu
+    app) submissions consume the remote's SSE stream with the
+    ``stream_options.include_tokens`` extension: every received chunk
+    carries the raw token ids, which this side pushes into the local
+    ``_GenRequest`` handle — so the pool's streaming surface works over
+    the network, the delivered-token prefix is known EXACTLY, and a
+    remote that dies or stalls mid-stream hands the request to a
+    sibling via the pool handoff (in-proc siblings ``requeue_replay``
+    it; greedy requests can also continue on another remote). Connect
+    and read budgets are separate (``client.py``): a dead upstream
+    fails fast at the handshake, a busy one is classified busy — never
+    demoted — and an upstream that stops sending bytes for longer than
+    ``idle_timeout_s`` mid-stream is treated as stalled and failed
+    over.
+
+    With ``stream=False`` the replica is unary-only (plain POST; any
+    OpenAI-compatible upstream works) and streaming handles never route
+    to it.
 
     Compose the service with :class:`CircuitBreakerConfig`/auth options
     at construction — the pool does not duplicate the breaker, it
     reroutes on its fast-fails and half-opens it on passing probes.
-    Streams and request adoption stay on in-proc replicas: a remote
-    engine's stream cannot adopt another replica's live queue handle.
     """
 
-    supports_stream = False
+    supports_stream = False  # instance attr set from ``stream=``
+    remote = True
 
     def __init__(
         self,
@@ -264,13 +354,29 @@ class HTTPReplica(Replica):
         service: Any,
         *,
         generate_path: str = "v1/completions",
+        health_path: str = ".well-known/health",
+        stream: bool = True,
+        tokenizer: Any = None,
+        idle_timeout_s: float = 30.0,
+        metrics: Any = None,
+        logger: Any = None,
     ) -> None:
         super().__init__(name)
         self.service = service
         self.generate_path = generate_path
+        self.health_path = health_path
+        self.supports_stream = bool(stream) and hasattr(
+            service, "stream_lines"
+        )
+        self.tokenizer = tokenizer
+        self.idle_timeout_s = float(idle_timeout_s)
+        self._metrics = metrics
+        self._logger = logger
         self._lock = threading.Lock()
         self._inflight = 0
         self._state = "SERVING"
+        self._adapters: frozenset[str] = frozenset()
+        self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
         return self._state
@@ -279,26 +385,408 @@ class HTTPReplica(Replica):
         with self._lock:
             return self._inflight
 
+    def adapters(self) -> frozenset[str]:
+        return self._adapters
+
+    def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
+        self._handoff = handoff
+
+    # -- submit ---------------------------------------------------------
+
+    def _prompt_ids(self, prompt: Any) -> list[int]:
+        """Token ids for the request handle. A known id list is the
+        failover precondition: the delivered prefix can only be resumed
+        on a sibling when prompt + continuation are ids. String prompts
+        encode through the shared tokenizer when one was provided;
+        without one the request still serves, it just cannot fail over
+        mid-stream."""
+        if not isinstance(prompt, str):
+            return [int(t) for t in prompt]
+        if self.tokenizer is not None:
+            try:
+                return [int(t) for t in self.tokenizer.encode(prompt)]
+            except Exception:  # noqa: BLE001 — serve anyway, without failover rights
+                return []
+        return []
+
     def submit(self, prompt: Any, **kw: Any) -> Any:
         from gofr_tpu.serving.types import _GenRequest
 
+        prompt_ids = self._prompt_ids(prompt)
         req = _GenRequest(
-            prompt_ids=list(prompt) if not isinstance(prompt, str) else [],
+            prompt_ids=prompt_ids,
             max_new_tokens=int(kw.get("max_new_tokens", 128)),
             temperature=float(kw.get("temperature", 0.0)),
             stop_on_eos=bool(kw.get("stop_on_eos", True)),
+            top_p=float(kw.get("top_p", 1.0)),
+            stop_texts=list(kw.get("stop") or []),
+            seed=int(kw["seed"]) & 0x7FFFFFFF if kw.get("seed") is not None
+            else 0,
+            adapter=str(kw.get("adapter") or ""),
+            tenant=str(kw.get("tenant") or ""),
+            pin_replica=bool(kw.get("pin_replica", False)),
+            # The FULL sampling contract rides the local handle too, not
+            # just the wire body: a failover adoption (in-proc
+            # requeue_replay or remote re-submit) continues from this
+            # request, and a sibling missing logit_bias/penalties would
+            # silently sample different tokens.
+            frequency_penalty=float(kw.get("frequency_penalty") or 0.0),
+            presence_penalty=float(kw.get("presence_penalty") or 0.0),
+            logit_bias={
+                int(k): float(v)
+                for k, v in (kw.get("logit_bias") or {}).items()
+            },
+            top_logprobs=int(kw.get("top_logprobs") or 0),
         )
+        if kw.get("deadline") is not None:
+            req.deadline = kw["deadline"]
+        if kw.get("cancel") is not None:
+            req.cancel = kw["cancel"]
+        # Cross-replica trace stitching AND post-failover timeline
+        # minting both need the caller's trace context on the request.
+        req.traceparent = kw.get("traceparent")
+        # Sampled streams can only resume byte-identically on a sibling
+        # when the sample path is pinned by a CALLER-chosen seed; an
+        # upstream-drawn seed never leaves the remote.
+        req.remote_seeded = kw.get("seed") is not None
         deadline = kw.get("deadline")
         with self._lock:
             self._inflight += 1
         worker = threading.Thread(
-            target=self._run_unary,
+            target=self._run_stream if self.supports_stream
+            else self._run_unary,
             args=(req, prompt, kw, deadline),
             name=f"http-replica-{self.name}",
             daemon=True,
         )
         worker.start()
         return req
+
+    # -- wire helpers ----------------------------------------------------
+
+    @staticmethod
+    def _sampling_body(prompt: Any, kw: dict, stream: bool) -> dict:
+        """The generation body with the FULL sampling contract: a remote
+        replica that silently dropped logit_bias/penalties/adapter would
+        serve differently-sampled (or base-model) output with a 200."""
+        body: dict[str, Any] = {
+            "prompt": prompt,
+            "max_tokens": int(kw.get("max_new_tokens", 128)),
+            "temperature": float(kw.get("temperature", 0.0)),
+            "stream": bool(stream),
+        }
+        for src, dst in (
+            ("top_p", "top_p"), ("stop", "stop"),
+            ("logit_bias", "logit_bias"),
+            ("frequency_penalty", "frequency_penalty"),
+            ("presence_penalty", "presence_penalty"),
+            ("top_logprobs", "top_logprobs"),
+            # A loaded LoRA adapter's name IS a model on the OpenAI
+            # surface (this repo's own openai_compat convention).
+            ("adapter", "model"),
+        ):
+            if kw.get(src):
+                body[dst] = kw[src]
+        # seed=0 is a VALID explicit seed, not an absence: a truthiness
+        # filter would drop it from the wire while remote_seeded still
+        # marks the request resumable — the sibling would then re-walk
+        # the prefix on a different sample path than the remote took.
+        if kw.get("seed") is not None:
+            body["seed"] = kw["seed"]
+        return body
+
+    @staticmethod
+    def _request_headers(
+        kw: dict, deadline: Optional[Deadline]
+    ) -> dict[str, str]:
+        headers: dict[str, str] = {}
+        if deadline is not None:
+            headers["X-Request-Timeout"] = str(
+                max(deadline.remaining(), 0.001)
+            )
+        if kw.get("tenant"):
+            headers["X-Tenant-Id"] = str(kw["tenant"])
+        if kw.get("traceparent"):
+            # Cross-replica trace stitching: the remote replica's server
+            # middleware adopts this trace id, so its spans land in the
+            # SAME trace as the routing tier's.
+            headers["traceparent"] = str(kw["traceparent"])
+        return headers
+
+    # -- streaming (SSE) -------------------------------------------------
+
+    def _run_stream(
+        self, req: Any, prompt: Any, kw: dict, deadline: Optional[Deadline]
+    ) -> None:
+        """Worker: consume the remote SSE stream into the local request
+        handle. Token ids ride every chunk (``include_tokens``), so the
+        handle's ``token_ids`` IS the delivered prefix at any instant —
+        the failover precondition. Terminal paths: [DONE] after a
+        finish chunk resolves the future; a transport loss, stall past
+        the idle budget, or truncation offers the request to the pool
+        handoff; a request-shaped upstream error fails it untouched."""
+        import json as jsonlib
+
+        body = self._sampling_body(prompt, kw, stream=True)
+        body["stream_options"] = {"include_tokens": True}
+        headers = self._request_headers(kw, deadline)
+        start = time.monotonic()
+        first_at: Optional[float] = None
+        reason = "stop"
+        prompt_tokens = len(req.prompt_ids)
+        text_parts: list[str] = []
+        done_seen = False
+        finish_seen = False
+        try:
+            with self.service.stream_lines(
+                "POST", self.generate_path, json=body, headers=headers,
+                read_timeout_s=self.idle_timeout_s,
+            ) as lines:
+                for line in lines:
+                    if req.cancel.cancelled or req.future.cancelled():
+                        # Caller is gone: closing the connection cancels
+                        # the remote generation (its disconnect watcher)
+                        # — no failover for a stream nobody wants.
+                        self._finish_stream(req, None, cancelled=True)
+                        return
+                    if not line.startswith("data:"):
+                        continue  # SSE comments / keepalive heartbeats
+                    data = line[len("data:"):].strip()
+                    if not data:
+                        continue
+                    if data == "[DONE]":
+                        done_seen = True
+                        break
+                    try:
+                        event = jsonlib.loads(data)
+                    except ValueError:
+                        continue  # malformed frame: ignore, watch framing
+                    err = event.get("error")
+                    if isinstance(err, dict):
+                        exc = self._upstream_error(err)
+                        raise exc
+                    choices = event.get("choices") or []
+                    if not choices:
+                        continue  # usage-only chunk
+                    choice = choices[0]
+                    toks = choice.get("token_ids") or []
+                    if toks and first_at is None:
+                        first_at = time.monotonic()
+                    for tok in toks:
+                        req.token_ids.append(int(tok))
+                        req.stream.put(int(tok))
+                    text = choice.get("text")
+                    if text is None:
+                        text = (choice.get("delta") or {}).get("content")
+                    if text:
+                        text_parts.append(str(text))
+                    if choice.get("finish_reason"):
+                        reason = str(choice["finish_reason"])
+                        finish_seen = True
+                        # On an ADOPTED continuation the upstream's
+                        # prompt was prompt+delivered, so its reported
+                        # prompt_tokens would double-count the delivered
+                        # prefix — keep the original prompt length then.
+                        if "prompt_tokens" in choice and not req.replays:
+                            prompt_tokens = int(choice["prompt_tokens"])
+            if not (done_seen and finish_seen):
+                # EOF without terminal framing: the upstream vanished
+                # mid-stream (truncated SSE). Retryable replica loss.
+                from gofr_tpu.errors import ErrorServiceUnavailable
+
+                exc = ErrorServiceUnavailable(
+                    f"replica {self.name} stream truncated after "
+                    f"{len(req.token_ids)} token(s)"
+                )
+                exc.kind = "read"  # type: ignore[attr-defined]
+                raise exc
+        except Exception as exc:  # noqa: BLE001 — classified below, never dropped
+            self._on_stream_loss(req, exc)
+            return
+        finally:
+            with self._lock:
+                self._inflight -= 1
+        from gofr_tpu.serving.types import GenerationResult
+
+        text = "".join(text_parts)
+        if self.tokenizer is not None and req.token_ids and (
+            not text or req.replays
+        ):
+            # A replayed (adopted) continuation's text_parts cover only
+            # the post-failover tokens — the authoritative text is the
+            # decode of the FULL delivered id sequence.
+            try:
+                text = self.tokenizer.decode(req.token_ids)
+            except Exception:  # noqa: BLE001 — text is best-effort on the id wire
+                pass
+        result = GenerationResult(
+            text=text,
+            token_ids=list(req.token_ids),
+            prompt_tokens=prompt_tokens,
+            ttft_s=(first_at - start) if first_at is not None else 0.0,
+            duration_s=time.monotonic() - start,
+            finish_reason=reason,
+        )
+        self._finish_stream(req, result)
+
+    def _finish_stream(
+        self, req: Any, result: Any, cancelled: bool = False
+    ) -> None:
+        """Resolve the local handle exactly once (future first, then the
+        stream sentinel so consumers draining the stream see the end
+        AFTER the result exists). A cancelled request resolves with the
+        same typed error the in-proc scheduler's reap uses, so a caller
+        blocked on the future fails promptly instead of timing out."""
+        if cancelled and not req.future.done():
+            from gofr_tpu.errors import ErrorRequestCancelled
+
+            try:
+                req.future.set_exception(ErrorRequestCancelled())
+            except Exception:  # noqa: BLE001 — future cancelled concurrently
+                pass
+        if result is not None and not req.future.done():
+            try:
+                req.future.set_result(result)
+            except Exception:  # noqa: BLE001 — future cancelled concurrently
+                pass
+        timeline = getattr(req, "timeline", None)
+        if timeline is not None:
+            if cancelled:
+                timeline.finish("cancelled")
+            elif result is not None:
+                timeline.finish(
+                    "ok", result.finish_reason, len(req.token_ids)
+                )
+        req.stream.put(None)
+
+    @staticmethod
+    def _upstream_error(err: dict) -> Exception:
+        """Terminal SSE error event → typed exception carrying the
+        upstream's status code (so reroute-vs-propagate classification
+        matches the unary path)."""
+        from gofr_tpu.errors import GofrError
+
+        exc = GofrError(str(err.get("message", "upstream stream error")))
+        try:
+            exc.status_code = int(err.get("code", 500))
+        except (TypeError, ValueError):
+            exc.status_code = 500
+        return exc
+
+    def _on_stream_loss(self, req: Any, exc: BaseException) -> None:
+        """A stream died before its terminal framing. Replica-shaped
+        losses (connect/read/transport, 5xx, truncation) offer the
+        request to the pool handoff — a sibling resumes from the
+        delivered-token prefix, the client never notices. Request-shaped
+        errors (4xx) and non-resumable requests fail honestly."""
+        handoff = self._handoff
+        resumable = (
+            handoff is not None
+            and not req.pin_replica
+            and _is_reroutable(exc)
+            and req.retryable()
+            # The delivered prefix is only reconstructable as ids.
+            and bool(req.prompt_ids)
+            # Sampled continuations are only byte-identical when the
+            # sample path is pinned by an EXPLICIT seed the sibling can
+            # re-walk; an upstream-drawn seed is unknown here.
+            and (req.temperature == 0.0 or getattr(req, "remote_seeded",
+                                                   req.seed != 0))
+        )
+        if resumable:
+            try:
+                if handoff(req):
+                    if self._logger is not None:
+                        self._logger.warnf(
+                            "remote replica %s lost its stream (%s); "
+                            "request resumed on a sibling (%d token(s) "
+                            "already delivered)",
+                            self.name, exc, len(req.token_ids),
+                        )
+                    return
+            except Exception as handoff_exc:  # noqa: BLE001 — fall through to terminal fail
+                if self._logger is not None:
+                    self._logger.errorf(
+                        "stream handoff from %s failed: %s",
+                        self.name, handoff_exc,
+                    )
+        timeline = getattr(req, "timeline", None)
+        if timeline is not None:
+            timeline.finish("error", type(exc).__name__)
+        try:
+            if not req.future.done():
+                req.future.set_exception(exc)
+        except Exception:  # noqa: BLE001 — future cancelled concurrently
+            pass
+        req.stream.put(None)
+
+    def adopt(self, req: Any) -> bool:
+        """Continue a salvaged request on THIS remote: re-submit the
+        prompt plus the already-delivered continuation as a token-id
+        prompt (the OpenAI surface accepts id arrays) and keep filling
+        the SAME stream/future. Greedy-only: a remote cannot restore a
+        sampling counter mid-path, and re-walking a sampled prefix over
+        the wire is not byte-exact. Stop-sequence requests also stay
+        in-proc: a match spanning the failover boundary (delivered text
+        ends mid-sequence) is invisible to a remote that only scans its
+        OWN generated text. In-proc siblings re-decode the full history
+        and handle both."""
+        if not self.supports_stream or not req.retryable():
+            return False
+        if req.temperature != 0.0 or not req.prompt_ids:
+            return False
+        if req.stop_texts:
+            return False
+        if req.adapter and req.adapter not in self._adapters:
+            return False
+        if self._state != "SERVING" or self.probe_failed or self.draining:
+            return False
+        req.replays += 1
+        remaining = req.max_new_tokens - len(req.token_ids)
+        if remaining <= 0:
+            from gofr_tpu.serving.types import GenerationResult
+
+            text = ""
+            if self.tokenizer is not None and req.token_ids:
+                try:
+                    text = self.tokenizer.decode(req.token_ids)
+                except Exception:  # noqa: BLE001 — text is best-effort on the id wire
+                    pass
+            self._finish_stream(req, GenerationResult(
+                text=text, token_ids=list(req.token_ids),
+                prompt_tokens=len(req.prompt_ids), ttft_s=0.0,
+                duration_s=0.0, finish_reason="length",
+            ))
+            return True
+        kw: dict[str, Any] = {
+            "max_new_tokens": remaining,
+            "temperature": req.temperature,
+            "top_p": req.top_p,
+            "adapter": req.adapter,
+            "tenant": req.tenant,
+            "traceparent": getattr(req, "traceparent", None),
+            # The rest of the sampling contract rides along: dropping
+            # penalties/bias would continue on different logits.
+            "frequency_penalty": req.frequency_penalty,
+            "presence_penalty": req.presence_penalty,
+            "logit_bias": dict(req.logit_bias),
+            "top_logprobs": req.top_logprobs,
+        }
+        if req.seed:
+            kw["seed"] = req.seed
+        with self._lock:
+            self._inflight += 1
+        worker = threading.Thread(
+            target=self._run_stream,
+            args=(
+                req, list(req.prompt_ids) + list(req.token_ids), kw,
+                req.deadline,
+            ),
+            name=f"http-replica-{self.name}-adopt",
+            daemon=True,
+        )
+        worker.start()
+        return True
 
     def _run_unary(
         self, req: Any, prompt: Any, kw: dict, deadline: Optional[Deadline]
@@ -308,39 +796,8 @@ class HTTPReplica(Replica):
 
         start = time.monotonic()
         try:
-            body: dict[str, Any] = {
-                "prompt": prompt,
-                "max_tokens": int(kw.get("max_new_tokens", 128)),
-                "temperature": float(kw.get("temperature", 0.0)),
-                "stream": False,
-            }
-            # Forward the FULL sampling contract: a remote replica that
-            # silently dropped logit_bias/penalties/adapter would serve
-            # differently-sampled (or base-model) output with a 200.
-            for src, dst in (
-                ("top_p", "top_p"), ("stop", "stop"), ("seed", "seed"),
-                ("logit_bias", "logit_bias"),
-                ("frequency_penalty", "frequency_penalty"),
-                ("presence_penalty", "presence_penalty"),
-                ("top_logprobs", "top_logprobs"),
-                # A loaded LoRA adapter's name IS a model on the OpenAI
-                # surface (this repo's own openai_compat convention).
-                ("adapter", "model"),
-            ):
-                if kw.get(src):
-                    body[dst] = kw[src]
-            headers: dict[str, str] = {}
-            if deadline is not None:
-                headers["X-Request-Timeout"] = str(
-                    max(deadline.remaining(), 0.001)
-                )
-            if kw.get("tenant"):
-                headers["X-Tenant-Id"] = str(kw["tenant"])
-            if kw.get("traceparent"):
-                # Cross-replica trace stitching: the remote replica's
-                # server middleware adopts this trace id, so its spans
-                # land in the SAME trace as the routing tier's.
-                headers["traceparent"] = str(kw["traceparent"])
+            body = self._sampling_body(prompt, kw, stream=False)
+            headers = self._request_headers(kw, deadline)
             resp = self.service.post(
                 self.generate_path, json=body, headers=headers
             )
@@ -395,15 +852,81 @@ class HTTPReplica(Replica):
             req.stream.put(None)
 
     def probe(self, timeout_s: float) -> tuple[str, str]:
+        """Health probe with dead-vs-busy classification and capability
+        refresh. Separate connect/read budgets (``client.py``) make the
+        distinction observable: a CONNECT failure means nothing is
+        listening (fail → demote), while a READ timeout behind queued
+        work means a live upstream busy serving (busy → leave routing
+        alone; restarting a loaded replica would cascade its load onto
+        the siblings). The health payload's ``lora_adapters`` detail
+        refreshes the advertised adapter set the router filters on."""
         try:
-            health = self.service.health_check()
-        except Exception as exc:  # noqa: BLE001 — unreachable == failed probe
-            health = {"status": "DOWN", "details": {"error": str(exc)}}
+            health = self._fetch_health()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            kind = getattr(exc, "kind", "")
+            if kind == "read" and self.load() > 0:
+                # The upstream accepted the connection but answered
+                # slowly BEHIND real queued work: congested, not dead.
+                return "busy", (
+                    f"health read timed out behind {self.load()} "
+                    f"in-flight request(s)"
+                )
+            self._state = "DOWN"
+            return "fail", f"{type(exc).__name__}: {exc}"
+        details = health.get("details") or {}
+        adapters = details.get("lora_adapters")
+        if isinstance(adapters, (list, tuple, set, frozenset)):
+            self._adapters = frozenset(str(a) for a in adapters)
         if health.get("status") == "UP":
             self._state = "SERVING"
             return "pass", ""
         self._state = "DOWN"
-        return "fail", str(health.get("details", {}).get("error", "DOWN"))
+        return "fail", str(details.get("error", "DOWN"))
+
+    def _fetch_health(self) -> dict:
+        """GET the rich health endpoint (engine state + adapter set);
+        raises on transport failure so :meth:`probe` can classify the
+        error kind. The gofr ``/.well-known/health`` aggregate nests the
+        engine's check under ``details.tpu`` — when present, THAT status
+        governs (a remote whose redis is down still serves tokens) and
+        its details (``lora_adapters``, engine state) are lifted. Falls
+        back to the service's liveness check when the rich endpoint
+        404s (non-gofr upstreams)."""
+        get = getattr(self.service, "get", None)
+        if not callable(get) or not self.health_path:
+            return self.service.health_check()
+        resp = get(self.health_path)
+        if resp.status_code == 404:
+            return self.service.health_check()
+        body: Any = None
+        try:
+            body = resp.json()
+        except Exception:  # noqa: BLE001 — non-JSON health body
+            body = None
+        if isinstance(body, dict) and isinstance(body.get("data"), dict):
+            body = body["data"]  # gofr envelope
+        if not isinstance(body, dict):
+            body = {}
+        details = body.get("details")
+        details = dict(details) if isinstance(details, dict) else {}
+        tpu = details.get("tpu")
+        if isinstance(tpu, dict):
+            # The serving datasource's own check wins: it carries the
+            # engine state machine and the loaded adapter set.
+            status = "UP" if tpu.get("status") == "UP" else "DOWN"
+            inner = tpu.get("details")
+            return {
+                "status": status,
+                "details": dict(inner) if isinstance(inner, dict) else {},
+            }
+        if resp.status_code >= 400:
+            details.setdefault("error", f"status {resp.status_code}")
+            return {"status": "DOWN", "details": details}
+        status = str(body.get("status") or "UP")
+        return {
+            "status": "UP" if status == "UP" else "DOWN",
+            "details": details,
+        }
 
     def revive(self, probe_timeout_s: float = 5.0) -> bool:
         verdict, _ = self.probe(timeout_s=probe_timeout_s)
@@ -470,21 +993,33 @@ class ReplicaPool:
         self._logger = logger
         self._rr = 0
         self._rr_lock = threading.Lock()
+        # Guards replica-list MUTATION (scaler add/drain). Readers
+        # iterate the current list object; mutators swap in a new list
+        # atomically so routing never sees a half-edited one.
+        self._replicas_lock = threading.Lock()
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        # Optional load-adaptive scaler (service/pool_scaler.py), set by
+        # the config seam; started/stopped with the pool lifecycle.
+        self.scaler: Optional[Any] = None
+        # Lazy LoRA reconciliation: adapter name → load source (PEFT
+        # dir or raw leaves dict). When a request names an adapter no
+        # routable replica advertises, the pool asks one to load it
+        # from here before giving up.
+        self._adapter_sources: dict[str, Any] = {}
+        self._refresh_primary()
+        # Mid-stream failover: each replica offers the pool its
+        # otherwise-terminal retryable requests (engine.try_handoff /
+        # HTTPReplica stream loss → here → sibling.adopt).
+        for replica in self._replicas:
+            replica.set_handoff(self._make_handoff(replica))
+
+    def _refresh_primary(self) -> None:
         self._primary_engine = next(
             (r.engine for r in self._replicas
              if isinstance(r, EngineReplica)),
             None,
         )
-        # Mid-stream failover: each in-proc engine offers the pool its
-        # otherwise-terminal retryable requests (engine.try_handoff →
-        # here → sibling.adopt == requeue_replay).
-        for replica in self._replicas:
-            if isinstance(replica, EngineReplica):
-                replica.engine.set_replica_handoff(
-                    self._make_handoff(replica)
-                )
 
     # -- engine facade ----------------------------------------------------
 
@@ -532,21 +1067,26 @@ class ReplicaPool:
             if isinstance(replica, EngineReplica):
                 replica.engine.start_sync()
         self.start_prober()
+        if self.scaler is not None:
+            self.scaler.start()
 
     async def stop(self, drain_s: float = 0.0) -> None:
+        if self.scaler is not None:
+            self.scaler.stop()
         self.stop_prober()
         for replica in self._replicas:
-            if isinstance(replica, EngineReplica):
-                # Detach the handoff FIRST: a pool-wide shutdown must
-                # terminate in-flight work, not migrate it replica to
-                # replica (re-decoding delivered prefixes and emitting
-                # phantom failover metrics during a routine deploy).
-                replica.engine.set_replica_handoff(None)
+            # Detach the handoff FIRST: a pool-wide shutdown must
+            # terminate in-flight work, not migrate it replica to
+            # replica (re-decoding delivered prefixes and emitting
+            # phantom failover metrics during a routine deploy).
+            replica.set_handoff(None)
         for replica in self._replicas:
             if isinstance(replica, EngineReplica):
                 replica.engine.stop_sync(drain_s)
 
     def close(self) -> None:
+        if self.scaler is not None:
+            self.scaler.stop()
         self.stop_prober()
         for replica in self._replicas:
             try:
@@ -564,27 +1104,35 @@ class ReplicaPool:
         exclude: Iterable[Replica] = (),
         *,
         require_stream: bool = False,
+        adapter: str = "",
     ) -> Replica:
         """Least-loaded routable replica: SERVING first, spill to
-        DEGRADED, never RESTARTING/DOWN or probe-demoted. Round-robin
-        rotation breaks load ties so equal replicas share traffic.
-        ``require_stream`` restricts to stream-capable (in-proc)
-        backends — a unary-only HTTPReplica handed a streaming request
-        would answer a 200 SSE with zero tokens, which is worse than an
-        honest 502.
+        DEGRADED, never RESTARTING/DOWN, probe-demoted, or draining.
+        Round-robin rotation breaks load ties so equal replicas share
+        traffic. ``require_stream`` restricts to stream-capable
+        backends (in-proc engines and SSE-streaming remotes) — a
+        unary-only HTTPReplica handed a streaming request would answer
+        a 200 SSE with zero tokens, which is worse than an honest 502.
+        ``adapter`` restricts to replicas ADVERTISING that LoRA adapter
+        — routing a request where the weights aren't loaded would serve
+        base-model output with a 200 (callers reconcile on miss:
+        :meth:`_ensure_adapter`).
 
         Weighted mode ranks by estimated completion time instead:
         ``(load + 1) / measured tokens/sec`` — the ROADMAP follow-up to
         queue-length routing; with no throughput signal anywhere it
         collapses to the same least-loaded pick."""
         excluded = {id(r) for r in exclude}
+        replicas = self._replicas  # one snapshot: scaler swaps the list
 
         def routable(states: tuple[str, ...]) -> list[Replica]:
             return [
-                r for r in self._replicas
+                r for r in replicas
                 if id(r) not in excluded
                 and not r.probe_failed
+                and not r.draining
                 and (r.supports_stream or not require_stream)
+                and (not adapter or adapter in r.adapters())
                 and r.state() in states
             ]
 
@@ -598,8 +1146,9 @@ class ReplicaPool:
                 return min(rotated, key=lambda r: r.load())
             return min(rotated, key=self._completion_score(rotated))
         raise ErrorNoHealthyReplica(
-            f"{len(self._replicas)} replica(s), none "
+            f"{len(replicas)} replica(s), none "
             + ("stream-capable and " if require_stream else "")
+            + (f"serving adapter {adapter!r} and " if adapter else "")
             + "SERVING or DEGRADED"
         )
 
@@ -635,18 +1184,42 @@ class ReplicaPool:
         """Submit with failover across replicas: per-replica overload or
         failure (429/5xx, open breaker) reroutes to the next candidate;
         request-shaped errors (400/413/...) raise immediately — they
-        would fail identically everywhere."""
+        would fail identically everywhere. Adapter-bound requests route
+        only to replicas advertising the adapter, lazily reconciling
+        (asking a routable replica to load it) when none do."""
+        adapter = str(kw.get("adapter") or "")
         last: Optional[BaseException] = None
+        reconciled = False
         while True:
             try:
                 replica = self.pick(
-                    exclude=tried, require_stream=require_stream
+                    exclude=tried, require_stream=require_stream,
+                    adapter=adapter,
                 )
             except ErrorNoHealthyReplica:
+                if adapter and not reconciled:
+                    # No routable replica has the adapter loaded: ask
+                    # one to load it (registered source), or discover a
+                    # remote that has it but was never probed.
+                    reconciled = True
+                    if self._ensure_adapter(adapter, tried):
+                        continue
                 if isinstance(last, ErrorTooManyRequests):
                     raise last from None  # keep the 429 + Retry-After
                 if last is not None:
                     raise ErrorNoHealthyReplica(str(last)) from last
+                if adapter and self._no_replica_has(adapter):
+                    # Match the single-engine surface: an adapter nobody
+                    # can serve (no weights anywhere, no registered
+                    # source) is a REQUEST error, not an availability
+                    # one.
+                    from gofr_tpu.errors import ErrorInvalidParam
+
+                    raise ErrorInvalidParam([
+                        f"unknown LoRA adapter {adapter!r}; no replica "
+                        f"has it loaded and no source is registered "
+                        f"(pool.load_lora/register_adapter_source)"
+                    ]) from None
                 raise
             tried.append(replica)
             try:
@@ -670,6 +1243,112 @@ class ReplicaPool:
         here."""
         _, req = self._submit_routed(prompt, kw, [], require_stream=True)
         return req
+
+    # -- LoRA adapter reconciliation --------------------------------------
+
+    def register_adapter_source(self, name: str, source: Any) -> None:
+        """Record where adapter ``name`` loads from (PEFT checkpoint dir
+        or raw leaves) WITHOUT loading it anywhere yet: the first
+        request naming it triggers the lazy load on whichever replica
+        the router would use."""
+        self._adapter_sources[name] = source
+
+    def load_lora(self, name: str, source: Any) -> int:
+        """Engine-facade adapter load: registers the source for lazy
+        sibling reconciliation and loads eagerly on ONE in-proc replica
+        (the routing filter sends the adapter's traffic there; siblings
+        pull the weights on demand — at failover or under load — rather
+        than paying #replicas × load cost up front)."""
+        self._adapter_sources[name] = source
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                return int(replica.engine.load_lora(name, source))
+        raise RuntimeError(
+            "no in-process replica to load a LoRA adapter into; remote "
+            "replicas advertise their own adapter sets via health probes"
+        )
+
+    def unload_lora(self, name: str) -> None:
+        """Unload ``name`` from every in-proc replica holding it and
+        drop its lazy-load source (remote replicas manage their own
+        adapter lifecycle; their advertisement refreshes on the next
+        probe)."""
+        self._adapter_sources.pop(name, None)
+        found = False
+        for replica in self._replicas:
+            if isinstance(replica, EngineReplica):
+                try:
+                    replica.engine.unload_lora(name)
+                    found = True
+                except KeyError:
+                    continue
+        if not found:
+            raise KeyError(f"no loaded LoRA adapter {name!r}")
+
+    def lora_names(self) -> list[str]:
+        """Union of every replica's advertised adapter set plus the
+        registered lazy sources — the pool-level OpenAI ``/v1/models``
+        surface (a request may name any of these; routing/reconciliation
+        places it)."""
+        names: set[str] = set(self._adapter_sources)
+        for replica in self._replicas:
+            names.update(replica.adapters())
+        return sorted(names)
+
+    def _no_replica_has(self, adapter: str) -> bool:
+        """True when the pool IS routable but no routable replica serves
+        ``adapter`` — the request-shaped (400) case, distinct from an
+        entirely-down pool (502)."""
+        routable = [
+            r for r in self._replicas
+            if not r.probe_failed and not r.draining
+            and r.state() in ("SERVING", "DEGRADED")
+        ]
+        return bool(routable) and all(
+            adapter not in r.adapters() for r in routable
+        )
+
+    def _ensure_adapter(
+        self, adapter: str, exclude: list[Replica]
+    ) -> bool:
+        """Lazy reconciliation: make SOME routable replica serve
+        ``adapter``. First refresh unprobed remotes (a remote may have
+        the adapter loaded without this pool ever having asked), then
+        ask replicas to load it from the registered source. True when a
+        subsequent :meth:`pick` can succeed."""
+        excluded = {id(r) for r in exclude}
+        candidates = [
+            r for r in self._replicas
+            if id(r) not in excluded
+            and not r.probe_failed and not r.draining
+            and r.state() in ("SERVING", "DEGRADED")
+        ]
+        # Discovery pass: remotes advertise adapter sets via probes; an
+        # un-probed or stale remote may already have the weights. This
+        # runs INSIDE the submit path, so the budget is a short
+        # discovery one, not the prober thread's full probe_timeout_s —
+        # several slow remotes must not stack 30s each onto a request.
+        discovery_timeout_s = min(self.probe_timeout_s, 5.0)
+        for replica in candidates:
+            if replica.remote and adapter not in replica.adapters():
+                try:
+                    replica.probe(discovery_timeout_s)
+                except Exception:  # noqa: BLE001 — discovery is best-effort
+                    continue
+        if any(adapter in r.adapters() for r in candidates):
+            return True
+        source = self._adapter_sources.get(adapter)
+        if source is None:
+            return False
+        for replica in candidates:
+            if replica.load_adapter(adapter, source):
+                if self._logger is not None:
+                    self._logger.infof(
+                        "adapter %r reconciled onto replica %s (lazy "
+                        "load)", adapter, replica.name,
+                    )
+                return True
+        return False
 
     # -- unary with hedged retries ---------------------------------------
 
@@ -736,9 +1415,9 @@ class ReplicaPool:
         # second replica never burns tokens it cannot use — draining the
         # bucket on impossible hedges would starve real ones the moment
         # a sibling recovers.
-        if self._routable_sibling_exists(tried) and self.should_hedge(
-            deadline
-        ):
+        if self._routable_sibling_exists(
+            tried, adapter=str(kw.get("adapter") or "")
+        ) and self.should_hedge(deadline):
             try:
                 _, second = self._submit_routed(
                     prompt, kw, tried, require_stream=False
@@ -758,11 +1437,15 @@ class ReplicaPool:
             raise primary_exc
         return self._first_result(live, timeout, primary_exc)
 
-    def _routable_sibling_exists(self, tried: list[Replica]) -> bool:
+    def _routable_sibling_exists(
+        self, tried: list[Replica], adapter: str = ""
+    ) -> bool:
         excluded = {id(r) for r in tried}
         return any(
             id(r) not in excluded
             and not r.probe_failed
+            and not r.draining
+            and (not adapter or adapter in r.adapters())
             and r.state() in ("SERVING", "DEGRADED")
             for r in self._replicas
         )
@@ -836,13 +1519,25 @@ class ReplicaPool:
     def _failover(self, req: Any, source: Replica) -> bool:
         """Adopt a salvaged request from a dying replica onto a healthy
         sibling. True = requeued (stream/future intact); False = the
-        caller fails it through its terminal path."""
+        caller fails it through its terminal path. Adapter-bound
+        requests only land on siblings advertising the adapter — with
+        lazy reconciliation when none does, same as fresh submits."""
         tried: list[Replica] = [source]
-        for _ in range(len(self._replicas)):
+        reconciled = False
+        for _ in range(len(self._replicas) + 1):
             try:
-                # Adoption continues a live STREAM handle: in-proc only.
-                replica = self.pick(exclude=tried, require_stream=True)
+                # Adoption continues a live STREAM handle: in-proc
+                # replicas requeue_replay it; streaming remotes re-open
+                # the continuation over SSE (greedy only).
+                replica = self.pick(
+                    exclude=tried, require_stream=True,
+                    adapter=req.adapter,
+                )
             except ErrorNoHealthyReplica:
+                if req.adapter and not reconciled:
+                    reconciled = True
+                    if self._ensure_adapter(req.adapter, tried):
+                        continue
                 return False
             tried.append(replica)
             if not replica.adopt(req):
@@ -861,6 +1556,14 @@ class ReplicaPool:
                     "app_tpu_failovers_total",
                     "from", source.name, "to", replica.name,
                 )
+                if source.remote:
+                    # A REMOTE stream died mid-SSE and resumed on a
+                    # sibling — the multi-host data plane's signature
+                    # event, counted separately from in-proc failovers.
+                    self._metrics.increment_counter(
+                        "app_tpu_remote_stream_failovers_total",
+                        "from", source.name, "to", replica.name,
+                    )
             if self._logger is not None:
                 self._logger.infof(
                     "failover: request moved %s → %s (%d token(s) already "
@@ -869,6 +1572,105 @@ class ReplicaPool:
                 )
             return True
         return False
+
+    # -- membership (scaler spawn/drain) ----------------------------------
+
+    def add_replica(self, replica: Replica) -> Replica:
+        """Admit a new replica into routing: wire the failover handoff,
+        publish its state gauge, and (for in-proc engines) start it if
+        the factory did not. The list swap is atomic so concurrent
+        picks see either the old or the new membership, never a
+        half-edited one."""
+        if isinstance(replica, EngineReplica):
+            eng = replica.engine
+            if not getattr(eng, "_running", True):
+                eng.start_sync()
+        replica.set_handoff(self._make_handoff(replica))
+        with self._replicas_lock:
+            self._replicas = [*self._replicas, replica]
+            self._refresh_primary()
+        self._publish_state(replica)
+        self.publish_pool_gauges()
+        if self._logger is not None:
+            self._logger.infof(
+                "replica %s joined the pool (%d total)", replica.name,
+                len(self._replicas),
+            )
+        return replica
+
+    def drain_replica(
+        self,
+        replica: Replica,
+        *,
+        timeout_s: float = 30.0,
+        poll_s: float = 0.05,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> bool:
+        """Retire a replica WITHOUT dropping work: stop routing to it
+        immediately (``draining``), wait (bounded) for its in-flight
+        requests to complete, then close and remove it. If load has not
+        reached zero by ``timeout_s`` the drain ABORTS — the replica
+        re-enters routing and nothing in flight is dropped; the caller
+        (scaler sweep, operator) simply retries later."""
+        if replica not in self._replicas:
+            return False
+        replica.draining = True
+        self.publish_pool_gauges()
+        deadline = self._clock() + max(0.0, float(timeout_s))
+        while replica.load() > 0:
+            if self._clock() >= deadline:
+                replica.draining = False
+                self.publish_pool_gauges()
+                if self._logger is not None:
+                    self._logger.warnf(
+                        "drain of replica %s aborted: %d request(s) "
+                        "still in flight after %.1fs; re-admitted to "
+                        "routing", replica.name, replica.load(), timeout_s,
+                    )
+                return False
+            sleep(poll_s)
+        replica.set_handoff(None)
+        with self._replicas_lock:
+            self._replicas = [r for r in self._replicas if r is not replica]
+            self._refresh_primary()
+        try:
+            replica.close()
+        except Exception as exc:  # noqa: BLE001 — the replica already left routing
+            if self._logger is not None:
+                self._logger.errorf(
+                    "retired replica %s close failed: %s", replica.name, exc
+                )
+        self.publish_pool_gauges()
+        if self._logger is not None:
+            self._logger.infof(
+                "replica %s drained and retired (%d remain)", replica.name,
+                len(self._replicas),
+            )
+        return True
+
+    def publish_pool_gauges(self) -> None:
+        """``app_tpu_pool_replicas{state=…}``: pool composition by
+        routing state (draining counted as its own state — those
+        replicas still finish work but take no new requests)."""
+        if self._metrics is None:
+            return
+        counts = {
+            "serving": 0, "degraded": 0, "restarting": 0, "down": 0,
+            "draining": 0,
+        }
+        for r in self._replicas:
+            if r.draining:
+                counts["draining"] += 1
+            elif r.probe_failed:
+                counts["down"] += 1
+            else:
+                counts[r.state().lower()] = (
+                    counts.get(r.state().lower(), 0) + 1
+                )
+        for state, n in counts.items():
+            self._metrics.set_gauge(
+                "app_tpu_pool_replicas", float(n), "state", state
+            )
 
     # -- active probing ---------------------------------------------------
 
@@ -899,6 +1701,7 @@ class ReplicaPool:
             else:
                 results[replica.name] = self._probe_replica(replica)
             self._publish_state(replica)
+        self.publish_pool_gauges()
         return results
 
     def _probe_replica(self, replica: Replica) -> str:
@@ -997,18 +1800,30 @@ class ReplicaPool:
 
     def flight_records(self) -> dict:
         """Aggregate ``/debug/flight`` view: each in-proc replica's
-        flight recorder keyed by replica name. A request that failed
-        over appears ONCE — in its origin replica's recorder, with the
-        failover annotation naming the adopting replica."""
+        flight recorder keyed by replica name, stamped with the
+        replica's routing state and advertised adapter set (so an
+        operator reading a failover record can see WHERE the adapter's
+        weights lived at the time). Remote replicas contribute their
+        descriptor (their own recorder lives on their ops port). A
+        request that failed over appears ONCE — in its origin replica's
+        recorder, with the failover annotation naming the adopter."""
         replicas: dict[str, Any] = {}
         for replica in self._replicas:
             fn = getattr(replica, "engine", None)
             records = getattr(fn, "flight_records", None)
             if callable(records):
                 try:
-                    replicas[replica.name] = records()
+                    entry = dict(records())
                 except Exception as exc:  # noqa: BLE001 — debug surface
-                    replicas[replica.name] = {"error": str(exc)}
+                    entry = {"error": str(exc)}
+            else:
+                entry = {"remote": True}
+            entry["state"] = (
+                "DOWN" if replica.probe_failed
+                else ("DRAINING" if replica.draining else replica.state())
+            )
+            entry["adapters"] = sorted(replica.adapters())
+            replicas[replica.name] = entry
         return {"replicas": replicas}
 
     def health_check(self) -> dict:
@@ -1021,6 +1836,7 @@ class ReplicaPool:
                     detail["supervisor"] = sup.describe()
             replicas[replica.name] = detail
             self._publish_state(replica)
+        self.publish_pool_gauges()
         pool_state = self.state
         serving = sum(
             1 for r in self._replicas

@@ -58,11 +58,17 @@ def _new_tpu_pool_from_config(
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.lifecycle import HedgeBudget
     from gofr_tpu.service import new_http_service
+    from gofr_tpu.service.pool_scaler import PoolScaler
     from gofr_tpu.service.replica_pool import (
         EngineReplica,
         HTTPReplica,
         ReplicaPool,
     )
+
+    def truthy(key: str, default: str) -> bool:
+        return config.get_or_default(key, default).lower() in (
+            "1", "true", "yes",
+        )
 
     replicas = []
     for i in range(n_replicas):
@@ -70,11 +76,28 @@ def _new_tpu_pool_from_config(
             config, logger=logger, metrics=metrics
         )
         replicas.append(EngineReplica(f"engine-{i}", engine))
+    # Remote replicas stream by default (TPU_REMOTE_STREAM): the pool
+    # consumes the remote's SSE with the include_tokens extension, so
+    # streaming requests route to remote pods and a remote that dies
+    # mid-stream fails over to a sibling. They share the in-proc
+    # tokenizer (same model across the pool) so string prompts encode
+    # locally and the delivered-token prefix is reconstructable.
+    remote_stream = truthy("TPU_REMOTE_STREAM", "true")
+    shared_tokenizer = next(
+        (r.engine.tokenizer for r in replicas), None
+    )
     for addr in remote_addrs:
         replicas.append(
             HTTPReplica(
                 addr,
                 new_http_service(addr, logger, metrics),
+                stream=remote_stream,
+                tokenizer=shared_tokenizer,
+                idle_timeout_s=float(
+                    config.get_or_default("TPU_REMOTE_STREAM_IDLE_S", "30")
+                ),
+                metrics=metrics,
+                logger=logger,
             )
         )
     pool = ReplicaPool(
@@ -102,10 +125,58 @@ def _new_tpu_pool_from_config(
         metrics=metrics,
         logger=logger,
     )
+    # Load-adaptive scaling (docs/advanced-guide/resilience.md):
+    # TPU_POOL_MAX_REPLICAS above the configured fleet arms a PoolScaler
+    # that spawns in-proc engine replicas under sustained queue pressure
+    # and drains them (stop-routing → bounded completion → retire) when
+    # idle. Bounds: TPU_POOL_MIN_REPLICAS / TPU_POOL_MAX_REPLICAS;
+    # sustain windows: TPU_SCALE_UP_WAIT_S / TPU_SCALE_DOWN_WAIT_S.
+    max_replicas = int(config.get_or_default("TPU_POOL_MAX_REPLICAS", "0"))
+    if max_replicas > len(replicas):
+        counter = [len(replicas)]
+
+        def spawn_engine_replica():
+            engine = InferenceEngine.from_config(
+                config, logger=logger, metrics=metrics
+            )
+            engine.start_sync()
+            counter[0] += 1
+            return EngineReplica(f"engine-scaled-{counter[0]}", engine)
+
+        pool.scaler = PoolScaler(
+            pool,
+            spawn_engine_replica,
+            min_replicas=int(config.get_or_default(
+                "TPU_POOL_MIN_REPLICAS", str(len(replicas))
+            )),
+            max_replicas=max_replicas,
+            up_load_per_replica=float(config.get_or_default(
+                "TPU_SCALE_UP_LOAD", "4"
+            )),
+            down_load_per_replica=float(config.get_or_default(
+                "TPU_SCALE_DOWN_LOAD", "0.5"
+            )),
+            scale_up_wait_s=float(config.get_or_default(
+                "TPU_SCALE_UP_WAIT_S", "10"
+            )),
+            scale_down_wait_s=float(config.get_or_default(
+                "TPU_SCALE_DOWN_WAIT_S", "60"
+            )),
+            interval_s=float(config.get_or_default(
+                "TPU_SCALE_INTERVAL_S", "5"
+            )),
+            metrics=metrics,
+            logger=logger,
+        )
     if logger is not None:
         logger.infof(
             "TPU replica pool initialised: %d in-proc engine(s), %d "
-            "remote replica(s)", n_replicas, len(remote_addrs),
+            "remote replica(s)%s", n_replicas, len(remote_addrs),
+            (
+                f", scaler armed ({pool.scaler.min_replicas}-"
+                f"{pool.scaler.max_replicas} replicas)"
+                if pool.scaler is not None else ""
+            ),
         )
     return pool
 

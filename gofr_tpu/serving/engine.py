@@ -28,7 +28,7 @@ import queue
 import threading
 import time
 from functools import partial
-from typing import Any, Optional
+from typing import Any, AsyncIterator, Optional
 
 import numpy as np
 
@@ -87,7 +87,7 @@ class InferenceEngine(
         kv_pool_blocks: int = 0,
         auto_prefix: bool = False,
         prefix_cache_blocks: int = 0,
-        mesh=None,
+        mesh: Any = None,
         quant: str = "",
         kv_quant: str = "",
         prefix_slots: int = 0,
@@ -103,10 +103,10 @@ class InferenceEngine(
         flight_recorder: Optional[bool] = None,
         flight_records: int = 256,
         flight_slow_s: float = 5.0,
-        params=None,
-        logger=None,
-        metrics=None,
-        tokenizer=None,
+        params: Any = None,
+        logger: Any = None,
+        metrics: Any = None,
+        tokenizer: Any = None,
         seed: int = 0,
     ) -> None:
         import jax
@@ -498,7 +498,9 @@ class InferenceEngine(
     # ------------------------------------------------------------------
 
     @classmethod
-    def from_config(cls, config, logger=None, metrics=None) -> "InferenceEngine":
+    def from_config(
+        cls, config: Any, logger: Any = None, metrics: Any = None
+    ) -> "InferenceEngine":
         """Container seam: all knobs are TPU_* env keys (the datasource
         config idiom, reference ``sql/sql.go:109-118``).
 
@@ -702,7 +704,7 @@ class InferenceEngine(
         base = jax.random.PRNGKey(seed)
         counter = [0]
 
-        def make(name: str, sds):
+        def make(name: str, sds: Any) -> Any:
             counter[0] += 1
             key = jax.random.fold_in(base, counter[0])
             if name in ("attn_norm", "mlp_norm", "final_norm"):
@@ -714,7 +716,7 @@ class InferenceEngine(
                 return jnp.zeros(sds.shape, cfg.dtype)
             fan_in = sds.shape[-1] if name == "embed" else sds.shape[-2]
 
-            def init_leaf(k):
+            def init_leaf(k: Any) -> Any:
                 w = (
                     jax.random.normal(k, sds.shape, jnp.float32) * fan_in**-0.5
                 ).astype(cfg.dtype)
@@ -1098,13 +1100,16 @@ class InferenceEngine(
         """Offer one request to the attached replica-pool handoff.
         False when no handoff is installed, the request is no longer
         retryable, or the pool could not place it (the caller then runs
-        its normal terminal error path). Adapter-bound requests are
-        never handed off (LoRA slot ids are per-engine, so a sibling
-        would serve different weights), and neither are replica-pinned
-        ones (synthetic probes must measure THIS replica)."""
+        its normal terminal error path). Adapter-bound requests carry
+        their adapter NAME (``req.adapter``) and the pool routes them
+        only to siblings advertising that adapter — the adopting
+        replica re-resolves the name to its OWN slot id, so per-engine
+        slot numbering never leaks across replicas. Replica-pinned
+        requests are never handed off (synthetic probes must measure
+        THIS replica)."""
         handoff = self._handoff
         if (
-            handoff is None or req.aid or req.pin_replica
+            handoff is None or req.pin_replica
             or not req.retryable()
         ):
             return False
@@ -1565,6 +1570,7 @@ class InferenceEngine(
             logit_bias=bias,
             top_logprobs=int(top_logprobs or 0),
             aid=aid,
+            adapter=adapter,
             # Stamp the adapter slot's generation: if the slot is
             # reloaded/unloaded while this request is queued, admission
             # fails it instead of silently serving different weights.
@@ -1634,20 +1640,24 @@ class InferenceEngine(
         return req
 
     def register_prefix_sync(
-        self, prompt, timeout: float = 300.0, adapter: str = ""
+        self, prompt: Any, timeout: float = 300.0, adapter: str = ""
     ) -> int:
         return self.register_prefix(prompt, adapter=adapter).future.result(
             timeout=timeout
         )
 
-    def generate_sync(self, prompt, timeout: float = 300.0, **kw) -> GenerationResult:
+    def generate_sync(
+        self, prompt: Any, timeout: float = 300.0, **kw: Any
+    ) -> GenerationResult:
         return self.submit_generate(prompt, **kw).future.result(timeout=timeout)
 
-    async def generate(self, prompt, **kw) -> GenerationResult:
+    async def generate(self, prompt: Any, **kw: Any) -> GenerationResult:
         req = self.submit_generate(prompt, **kw)
         return await asyncio.wrap_future(req.future)
 
-    async def generate_stream(self, prompt, **kw):
+    async def generate_stream(
+        self, prompt: Any, **kw: Any
+    ) -> "AsyncIterator[int]":
         """Async iterator over generated token ids."""
         req = self.submit_generate(prompt, **kw)
         loop = asyncio.get_running_loop()
@@ -1703,6 +1713,11 @@ class InferenceEngine(
             details["max_len"] = self.max_len
             details["pending"] = self._pending.qsize()
             details["prefilling"] = len(self._prefilling)
+            # Advertised capability set: a replica pool fronting this
+            # engine over HTTP reads the loaded adapters from the health
+            # payload to route LoRA requests only where their weights
+            # actually live (service/replica_pool.py).
+            details["lora_adapters"] = self.lora_names()
             if self.kv_block:
                 details["kv_blocks"] = {
                     "block": self.kv_block,

@@ -215,7 +215,16 @@ def add_openai_routes(
     def _stream_response(
         engine, prompt, params: dict, *, rid: str, model: str, chat: bool,
         stop_seqs: Optional[list[str]] = None, include_usage: bool = False,
+        include_tokens: bool = False,
     ) -> Stream:
+        # ``stream_options.include_tokens`` (this repo's extension, the
+        # replica tier's internal wire): every chunk carries the raw
+        # ``token_ids`` drained since the previous chunk — even when the
+        # text is held back (UTF-8 tail / stop-sequence window) — and
+        # the finish chunk carries ``prompt_tokens``. A routing tier
+        # consuming the stream re-decodes text itself; what it needs on
+        # the wire is the exact delivered-token prefix, so a replica
+        # that dies mid-stream can resume on a sibling byte-identically.
         # Submit BEFORE returning the Stream: prompt validation
         # (ErrorPromptTooLong → 413 etc.) must fail the request proper,
         # not die silently after the 200/SSE headers are on the wire.
@@ -233,14 +242,20 @@ def add_openai_routes(
             created = int(time.time())
             loop = asyncio.get_running_loop()
             emitted_ids: list[int] = []
+            sent_tokens = 0  # ids already attached to a yielded chunk
             printed = ""
             reason = "stop"
 
             def payload_of(text):
-                return (
+                nonlocal sent_tokens
+                payload = (
                     {"delta": {"content": text}, "index": 0}
                     if chat else {"text": text, "index": 0}
                 )
+                if include_tokens:
+                    payload["token_ids"] = emitted_ids[sent_tokens:]
+                    sent_tokens = len(emitted_ids)
+                return payload
 
             def stop_hit(full):
                 return min(
@@ -264,6 +279,11 @@ def add_openai_routes(
                         break
                     emitted_ids.append(tok)
                     if engine.tokenizer is None:
+                        if include_tokens:
+                            # Token-id wire with no text surface: the
+                            # consumer (a routing tier) decodes itself.
+                            yield _sse(rid, object_name, model, created,
+                                       payload_of(""))
                         continue
                     # Cumulative decode: per-token decode would split
                     # multi-byte UTF-8 / BPE merges.
@@ -273,7 +293,13 @@ def add_openai_routes(
                         full = full[:at]
                         stopped = True
                     elif full.endswith("�"):
-                        # Possibly incomplete UTF-8 tail — hold back.
+                        # Possibly incomplete UTF-8 tail — hold back
+                        # (the ids still flow when the consumer asked
+                        # for them: delivered-prefix accounting must
+                        # not lag the generation).
+                        if include_tokens:
+                            yield _sse(rid, object_name, model, created,
+                                       payload_of(""))
                         continue
                     else:
                         full = full[: max(len(printed), len(full) - hold)]
@@ -281,6 +307,9 @@ def add_openai_routes(
                         text, printed = full[len(printed):], full
                         yield _sse(rid, object_name, model, created,
                                    payload_of(text))
+                    elif include_tokens:
+                        yield _sse(rid, object_name, model, created,
+                                   payload_of(""))
                 if stopped:
                     reason = "stop"
                 else:
@@ -317,6 +346,14 @@ def add_openai_routes(
                     if chat else
                     {"text": "", "index": 0, "finish_reason": reason}
                 )
+                if include_tokens:
+                    # Any ids still unattached (final flush) ride the
+                    # finish chunk, plus the prompt length so the
+                    # consumer can build its usage accounting without a
+                    # second round trip.
+                    done["token_ids"] = emitted_ids[sent_tokens:]
+                    sent_tokens = len(emitted_ids)
+                    done["prompt_tokens"] = len(req.prompt_ids)
                 yield _sse(rid, object_name, model, created, done)
                 if include_usage:
                     # stream_options.include_usage: one final chunk with
@@ -405,6 +442,9 @@ def add_openai_routes(
                 include_usage=bool(
                     (body.get("stream_options") or {}).get("include_usage")
                 ),
+                include_tokens=bool(
+                    (body.get("stream_options") or {}).get("include_tokens")
+                ),
             )
         lp_req = body.get("logprobs")
         want_logprobs = lp_req not in (None, False, 0)
@@ -492,6 +532,9 @@ def add_openai_routes(
                 stop_seqs=stop_seqs,
                 include_usage=bool(
                     (body.get("stream_options") or {}).get("include_usage")
+                ),
+                include_tokens=bool(
+                    (body.get("stream_options") or {}).get("include_tokens")
                 ),
             )
         want_logprobs = bool(body.get("logprobs"))

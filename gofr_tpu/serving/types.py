@@ -145,8 +145,12 @@ class _GenRequest:
     # Multi-LoRA: adapter slot index (0 = base model, no adapter) and
     # the slot's load-generation at submit time (prefix_store requests
     # whose adapter was reloaded/unloaded in flight must not register).
+    # ``adapter`` is the portable NAME: slot ids are per-engine, so a
+    # replica adopting this request after a failover re-resolves the
+    # name against its OWN slot table (aid/lora_gen are remapped).
     aid: int = 0
     lora_gen: int = 0
+    adapter: str = ""
     # Lifecycle: the scheduler's per-window reap retires the sequence
     # (and frees its KV blocks) when the deadline expires or the cancel
     # token trips — see serving/lifecycle.py and ``cancel_request``.

@@ -29,6 +29,7 @@ if command -v mypy >/dev/null 2>&1; then
     gofr_tpu/metrics gofr_tpu/tracing gofr_tpu/faults \
     gofr_tpu/service \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
+    gofr_tpu/serving/engine.py \
     gofr_tpu/serving/batcher.py gofr_tpu/serving/supervisor.py \
     gofr_tpu/serving/watchdog.py gofr_tpu/serving/scheduler.py \
     gofr_tpu/serving/observability.py gofr_tpu/serving/radix_cache.py \

@@ -700,6 +700,83 @@ def test_gl011_ignores_hoisted_while_polls_cold_paths_and_closures(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL012 — blocking network I/O without an explicit timeout
+# ----------------------------------------------------------------------
+
+
+def test_gl012_flags_timeoutless_clients_in_serving_and_service(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "service/wire.py",
+        """
+        import httpx
+        import requests
+        import socket
+        import urllib.request
+
+        def build():
+            return httpx.Client()  # inherits someone else's default
+
+        def fetch(url):
+            return requests.get(url)  # requests default: NO timeout
+
+        def open_raw(url):
+            return urllib.request.urlopen(url)
+
+        def connect(addr):
+            return socket.create_connection(addr)
+        """,
+        select=["GL012"],
+    )
+    assert ids == ["GL012", "GL012", "GL012", "GL012"]
+    assert "timeout" in findings[0].message
+
+
+def test_gl012_accepts_budgeted_calls_and_other_tiers(tmp_path):
+    # Explicit budgets (kwarg or positional) are the fix; client METHOD
+    # calls inherit their constructor's budget; other tiers are out of
+    # scope for this rule.
+    ids, _ = _lint(
+        tmp_path, "serving/wire.py",
+        """
+        import httpx
+        import requests
+        import socket
+        import urllib.request
+
+        def build(read_s, connect_s):
+            return httpx.Client(
+                timeout=httpx.Timeout(read_s, connect=connect_s)
+            )
+
+        def fetch(client, url):
+            return client.get(url)  # budget set at construction
+
+        def fetch2(url):
+            return requests.get(url, timeout=10)
+
+        def open_raw(url):
+            return urllib.request.urlopen(url, None, 10)
+
+        def connect(addr):
+            return socket.create_connection(addr, 5)
+        """,
+        select=["GL012"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "datasource/wire.py",
+        """
+        import requests
+
+        def fetch(url):
+            return requests.get(url)
+        """,
+        select=["GL012"],
+    )
+    assert ids == []  # datasource clients carry their own conventions
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -858,7 +935,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011",
+        "GL008", "GL009", "GL010", "GL011", "GL012",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2

@@ -732,6 +732,9 @@ def test_pool_from_config_builds_supervised_engine_replicas():
         "TPU_DECODE_WINDOW": "4",
         "TPU_RESTART_MAX": "2",
         "TPU_PROBE_INTERVAL_S": "0",
+        "TPU_POOL_MAX_REPLICAS": "3",
+        "TPU_SCALE_UP_WAIT_S": "7",
+        "TPU_SCALE_INTERVAL_S": "0",
     }))
     try:
         assert isinstance(pool, ReplicaPool)
@@ -750,6 +753,13 @@ def test_pool_from_config_builds_supervised_engine_replicas():
         assert health["status"] == "UP"
         assert health["details"]["total"] == 2
         assert pool.pick().name in ("engine-0", "engine-1")
+        # TPU_POOL_MAX_REPLICAS above the configured fleet arms a
+        # PoolScaler with an in-proc engine spawn factory (decision
+        # logic is covered in tests/test_remote_failover.py).
+        assert pool.scaler is not None
+        assert pool.scaler.min_replicas == 2
+        assert pool.scaler.max_replicas == 3
+        assert pool.scaler.scale_up_wait_s == 7.0
     finally:
         pool.close()
 
@@ -796,9 +806,11 @@ class _Harness:
 
 
 def test_http_replica_serves_unary_and_probe_demotes_dead_upstream():
-    """A remote replica behind the service tier answers unary
-    generations through its OpenAI endpoint; once the upstream dies,
-    the next probe demotes it and the pool fails fast with 502."""
+    """A UNARY-ONLY remote replica (``stream=False`` — any plain
+    OpenAI-compatible upstream) answers unary generations through its
+    endpoint; once the upstream dies, the next probe demotes it and the
+    pool fails fast with 502. Streaming remotes are covered by
+    tests/test_remote_failover.py."""
     from gofr_tpu import App
     from gofr_tpu.config import MockConfig
     from gofr_tpu.http.response import Raw
@@ -818,7 +830,7 @@ def test_http_replica_serves_unary_and_probe_demotes_dead_upstream():
 
     with _Harness(app) as harness:
         svc = new_http_service(harness.address)
-        replica = HTTPReplica("remote-0", svc)
+        replica = HTTPReplica("remote-0", svc, stream=False)
         pool = _make_pool(None, [replica])
         try:
             result = pool.generate_sync(
