@@ -362,13 +362,16 @@ def _prefix_workload(on_tpu: bool) -> None:
     max_len = int(os.environ.get("BENCH_MAX_LEN", "1024"))
     kv_block = int(os.environ.get("BENCH_KV_BLOCK", "128" if on_tpu else "64"))
     preamble_tokens = int(os.environ.get("BENCH_PREFIX_TOKENS", "512"))
+    # Proactive eviction watermark A/B (BENCH_PREFIX_EVICT_WM, blocks;
+    # 0 = shortfall-only eviction, the pre-watermark behavior).
+    evict_wm = int(os.environ.get("BENCH_PREFIX_EVICT_WM", "0"))
     quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "")
     if quant.lower() in ("none", "0"):
         quant = ""
 
     log(f"bench[prefix]: model={model} requests={n_requests} "
         f"preamble={preamble_tokens}tok kv_block={kv_block} "
-        f"auto_prefix={auto}")
+        f"auto_prefix={auto} evict_wm={evict_wm}")
     _set_stage("engine-init")
     engine = InferenceEngine(
         model, n_slots=n_slots, max_len=max_len, tokenizer=ByteTokenizer(),
@@ -377,6 +380,7 @@ def _prefix_workload(on_tpu: bool) -> None:
         quant=quant,
         kv_block=kv_block,
         auto_prefix=auto,
+        prefix_evict_watermark=evict_wm,
         prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "256")),
     )
     engine.start_sync()
@@ -450,6 +454,7 @@ def _prefix_workload(on_tpu: bool) -> None:
         "model": model,
         "workload": "prefix",
         "auto_prefix": auto,
+        "prefix_evict_wm": evict_wm,
         "prefix_hit_token_ratio": round(hit_ratio, 4),
         "prefix_hit_tokens": int(hit_tokens),
         "cold_ttft_ms": round(cold_ttft_ms, 2),

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL012).
+"""The graftlint rule set (GL001–GL013).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1485,6 +1485,153 @@ class BlockingIONoTimeoutRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL013 — retry loops without backoff
+# ----------------------------------------------------------------------
+
+
+class RetryNoBackoffRule(Rule):
+    """A retry loop that re-attempts I/O with NO delay between attempts
+    is a thundering-herd amplifier: every client that failed at t₀
+    retries at exactly t₀+ε, re-spiking the replica/service it just
+    helped knock over — the failure mode the serving tier's own
+    machinery (``RetryConfig``, the hedge budget, the tier-transfer
+    backoff) exists to prevent. In ``serving/`` and ``service/`` every
+    retry loop must back off (jittered, via ``RetryConfig.delay_s`` or
+    an explicit sleep between attempts).
+
+    Heuristics (deliberately conservative — plain iteration loops and
+    adoption walks must not trip it):
+
+    * a ``for`` loop counting attempts — target or ``range()`` argument
+      names matching ``retry``/``retries``/``attempt`` — or a ``while``
+      loop whose condition reads such a name;
+    * whose body contains a ``try`` with at least one handler that
+      swallows the failure (no ``raise`` anywhere in the handler — the
+      retry-semantics marker: failures are absorbed so the next
+      iteration re-attempts);
+    * and whose body contains NO backoff: no call to anything named
+      ``sleep``/``*.sleep``, no ``delay_s(...)``, and no ``RetryConfig``
+      reference inside the loop.
+    """
+
+    rule_id = "GL013"
+    name = "retry-no-backoff"
+    rationale = (
+        "retry loops in the serving/service tier must back off "
+        "(jittered) between attempts; immediate re-attempts amplify "
+        "the very overload they are retrying through"
+    )
+
+    _RETRYISH = ("retry", "retries", "attempt")
+
+    def __init__(
+        self, scoped_dirs: Sequence[str] = ("serving", "service")
+    ) -> None:
+        self._dirs = tuple(scoped_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(f"/{d}/" in norm or norm.startswith(f"{d}/")
+                   for d in self._dirs)
+
+    @classmethod
+    def _retryish(cls, name: Optional[str]) -> bool:
+        low = (name or "").lower()
+        return any(marker in low for marker in cls._RETRYISH)
+
+    @classmethod
+    def _names_in(cls, node: ast.AST) -> Iterator[str]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                yield sub.id
+            elif isinstance(sub, ast.Attribute):
+                yield sub.attr
+
+    @classmethod
+    def _is_retry_loop(cls, loop: ast.AST) -> bool:
+        if isinstance(loop, ast.For):
+            if any(cls._retryish(n) for n in cls._names_in(loop.target)):
+                return True
+            it = loop.iter
+            if (
+                isinstance(it, ast.Call)
+                and (dotted_name(it.func) or "") == "range"
+            ):
+                return any(
+                    cls._retryish(n)
+                    for arg in it.args for n in cls._names_in(arg)
+                )
+            return False
+        if isinstance(loop, ast.While):
+            return any(cls._retryish(n) for n in cls._names_in(loop.test))
+        return False
+
+    @staticmethod
+    def _loop_body(loop: ast.AST) -> Iterator[ast.AST]:
+        """Nodes lexically inside the loop body, skipping nested
+        function/lambda bodies (not run per attempt by this loop)."""
+        stack = list(getattr(loop, "body", [])) + list(
+            getattr(loop, "orelse", [])
+        )
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @classmethod
+    def _swallows(cls, loop: ast.AST) -> bool:
+        """True when some ``except`` handler in the loop body absorbs
+        the failure (no raise in it) — the marker that the loop's next
+        iteration is a RE-ATTEMPT, not plain iteration."""
+        for node in cls._loop_body(loop):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not any(
+                    isinstance(sub, ast.Raise)
+                    for stmt in handler.body for sub in ast.walk(stmt)
+                ):
+                    return True
+        return False
+
+    @classmethod
+    def _has_backoff(cls, loop: ast.AST) -> bool:
+        for node in cls._loop_body(loop):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                short = name.rsplit(".", 1)[-1].lstrip("_")
+                if short in ("sleep", "delay_s"):
+                    return True
+            if isinstance(node, ast.Name) and node.id == "RetryConfig":
+                return True
+            if isinstance(node, ast.Attribute) and node.attr == "RetryConfig":
+                return True
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            if not self._is_retry_loop(loop):
+                continue
+            if not self._swallows(loop):
+                continue
+            if self._has_backoff(loop):
+                continue
+            yield self.finding(
+                ctx, loop,
+                "retry loop re-attempts with no backoff between "
+                "attempts — failed peers get re-hit immediately and in "
+                "lockstep; sleep a jittered delay (RetryConfig.delay_s) "
+                "before each re-attempt",
+            )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1501,6 +1648,7 @@ ALL_RULES = (
     RepeatedHostPullRule,
     PerRowClockRule,
     BlockingIONoTimeoutRule,
+    RetryNoBackoffRule,
 )
 
 
@@ -1519,4 +1667,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         RepeatedHostPullRule(),
         PerRowClockRule(config.hot_path_files),
         BlockingIONoTimeoutRule(),
+        RetryNoBackoffRule(),
     ]

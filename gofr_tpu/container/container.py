@@ -294,6 +294,26 @@ class Container:
             "app_tpu_tokens_per_step",
             "client-visible tokens emitted per decode step, per window",
         )
+        # Disaggregated prefill/decode tiers (TPU_REPLICA_ROLES;
+        # docs/advanced-guide/resilience.md): cross-tier KV-block
+        # transfers by outcome, their wall-clock cost, and whether the
+        # pool is currently serving tiered or fused.
+        m.new_counter(
+            "app_tpu_tier_transfers_total",
+            "prefill→decode KV-block transfers by outcome (result="
+            "ok|fused|failed_over|local_fused|expired)",
+        )
+        m.new_histogram(
+            "app_tpu_tier_transfer_seconds",
+            "prefill→decode transfer wall clock (extract→import)",
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1, 2.5, 5),
+        )
+        m.new_gauge(
+            "app_tpu_tier_mode",
+            "replica-pool serving mode (1 = disaggregated tiers, 0 = "
+            "fused)",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -16,6 +16,15 @@ fires unconditionally and tests arm selectively:
 * ``http.stream.event``   — per received SSE line: raise = mid-body
   connection reset; return ``"truncate"`` = upstream vanished without
   EOF framing (truncated SSE); a blocking action models a read stall
+* ``tier.prefill_done``   — at the prefill→transfer boundary on a
+  prefill-tier replica (scheduler, just after finalize): raise = the
+  replica failing right as its prefill completes → local fused decode
+* ``tier.transfer``       — per tier-transfer attempt in the pool:
+  raise = the transfer leg dying mid-ship (retried with backoff, then
+  fused fallback on a sibling)
+* ``tier.import``         — in ``engine.handoff_prefilled`` on the
+  decode replica: raise = the importer rejecting the shipped blocks
+  (pool pressure / version mismatch)
 
 Unarmed, ``fire`` is one dict read (the serving hot path pays nothing
 measurable). Armed, a point either **raises** the configured exception

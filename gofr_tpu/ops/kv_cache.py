@@ -27,11 +27,14 @@ updated buffers which XLA aliases in place when the jitted step donates them
 
 from __future__ import annotations
 
+import zlib
+from dataclasses import dataclass, field
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class KVCache(NamedTuple):
@@ -310,6 +313,155 @@ def paged_view(block_table, layer_k, layer_v, rows, layer_ks=None,
     ks = layer_ks[bt].transpose(0, 2, 3, 1, 4).reshape(R, KV, 8, MB * B)
     vs = layer_vs[bt].transpose(0, 2, 3, 1, 4).reshape(R, KV, 8, MB * B)
     return k, v, ks, vs
+
+
+# ----------------------------------------------------------------------
+# Cross-engine block shipping (disaggregated prefill/decode tiers)
+# ----------------------------------------------------------------------
+
+
+@dataclass(frozen=True, eq=False)  # identity eq: ndarray fields don't compare
+class KVBlockPayload:
+    """A batch of fully-written paged KV blocks lifted off one engine's
+    pool so a sibling can alias the same content into its own — the
+    transfer unit of the disaggregated prefill/decode tier
+    (``service/replica_pool.py``).
+
+    This is the HOST-BOUNCE form: the planes are numpy arrays pulled
+    device→host on the exporting engine and re-uploaded block-by-block
+    on the importer (one fixed-shape jitted scatter per block, so the
+    importer pays no recompiles). A device-to-device path over a shared
+    mesh can later replace the numpy legs without changing this seam —
+    the content keys and validation travel the same either way.
+
+    ``token_ids`` is the blocks' token content in prompt order (exactly
+    ``len(blocks) × block`` ids): the importing engine inserts the
+    blocks into its radix prefix index under these content keys, so the
+    import IS a prefix-cache warm and admission aliases the blocks
+    zero-copy — an evicted or rejected import degrades to a plain
+    re-prefill, never to a wrong answer.
+
+    ``checksum`` covers the raw plane bytes; a short or corrupt payload
+    fails :meth:`verify` and the importer falls back to re-prefilling
+    (the transfer failure matrix's "corrupt payload" row).
+    """
+
+    block: int
+    token_ids: tuple[int, ...]
+    k: np.ndarray  # [L, n, KV, block, hd] — gathered pool blocks
+    v: np.ndarray
+    k_s: Optional[np.ndarray] = None  # int8 mode: [L, n, KV, 8, block]
+    v_s: Optional[np.ndarray] = None
+    src: str = ""
+    checksum: int = 0
+    # Geometry fingerprint of the exporting cache; importers with a
+    # different model/config/quant mode must reject, not alias garbage.
+    geometry: tuple = field(default_factory=tuple)
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.k.shape[1])
+
+    def compatible_with(self, cache: "PagedKVCache") -> bool:
+        """Geometry (version) match against the importing pool."""
+        return (
+            self.block == cache.block
+            and self.geometry == cache_geometry(cache)
+        )
+
+    def verify(self) -> bool:
+        """Payload integrity: the token chain covers the blocks exactly
+        and the plane bytes hash to the exporter's checksum. The CRC
+        verdict is memoized — a transfer retrying across decode targets
+        re-verifies the SAME in-process memory, which cannot rot
+        between attempts (the wire form will re-checksum on receipt
+        instead)."""
+        if len(self.token_ids) != self.n_blocks * self.block:
+            return False
+        cached = self.__dict__.get("_crc_ok")
+        if cached is None:
+            cached = payload_checksum(
+                self.k, self.v, self.k_s, self.v_s
+            ) == self.checksum
+            object.__setattr__(self, "_crc_ok", cached)
+        return bool(cached)
+
+
+def cache_geometry(cache: "PagedKVCache") -> tuple:
+    """The paged pool's compile-relevant shape signature — what must
+    match exactly for a foreign block's bytes to mean the same thing
+    here (layers, kv heads, block, head_dim, dtype, quant mode)."""
+    L, _, KV, B, hd = cache.k.shape
+    return (L, KV, B, hd, str(cache.k.dtype), cache.k_s is not None)
+
+
+def payload_checksum(
+    k: np.ndarray,
+    v: np.ndarray,
+    k_s: Optional[np.ndarray] = None,
+    v_s: Optional[np.ndarray] = None,
+) -> int:
+    crc = zlib.crc32(np.ascontiguousarray(k).tobytes())
+    crc = zlib.crc32(np.ascontiguousarray(v).tobytes(), crc)
+    if k_s is not None:
+        crc = zlib.crc32(np.ascontiguousarray(k_s).tobytes(), crc)
+    if v_s is not None:
+        crc = zlib.crc32(np.ascontiguousarray(v_s).tobytes(), crc)
+    return crc
+
+
+def export_blocks(
+    cache: "PagedKVCache",
+    block_ids: list[int],
+    token_ids: list[int],
+    src: str = "",
+) -> KVBlockPayload:
+    """Pull ``block_ids``' fully-written pool blocks to host as a
+    shippable payload (one gather + one device→host copy per plane —
+    the deliberate host bounce of the tier-transfer path, not a hot-
+    path sync; the caller is the exporting scheduler at prefill
+    finalize, where the blocks are immutable)."""
+    idx = np.asarray(block_ids, dtype=np.int32)
+    k = np.asarray(jax.device_get(cache.k[:, idx]))  # graftlint: disable=GL001 — the host bounce IS the transfer
+    v = np.asarray(jax.device_get(cache.v[:, idx]))  # graftlint: disable=GL001 — the host bounce IS the transfer
+    k_s = v_s = None
+    if cache.k_s is not None:
+        k_s = np.asarray(jax.device_get(cache.k_s[:, idx]))  # graftlint: disable=GL001 — the host bounce IS the transfer
+        v_s = np.asarray(jax.device_get(cache.v_s[:, idx]))  # graftlint: disable=GL001 — the host bounce IS the transfer
+    return KVBlockPayload(
+        block=cache.block,
+        token_ids=tuple(int(t) for t in token_ids),
+        k=k, v=v, k_s=k_s, v_s=v_s, src=src,
+        checksum=payload_checksum(k, v, k_s, v_s),
+        geometry=cache_geometry(cache),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def paged_insert_block(
+    cache: "PagedKVCache",
+    dst: Any,
+    k_blk: Any,
+    v_blk: Any,
+    k_s_blk: Any = None,
+    v_s_blk: Any = None,
+) -> "PagedKVCache":
+    """Write one imported block's planes into pool block ``dst`` (the
+    import half of the transfer seam). ``dst`` is a traced int32
+    scalar and the block operands are fixed ``[L, KV, block, hd]``
+    shapes, so this is ONE compile per cache geometry no matter how
+    many blocks an import carries; the donated pool aliases in place
+    (same discipline as :func:`paged_copy_block`)."""
+    new = cache._replace(
+        k=cache.k.at[:, dst].set(k_blk),
+        v=cache.v.at[:, dst].set(v_blk),
+    )
+    if cache.k_s is not None and k_s_blk is not None:
+        new = new._replace(
+            k_s=cache.k_s.at[:, dst].set(k_s_blk),
+            v_s=cache.v_s.at[:, dst].set(v_s_blk),
+        )
+    return new
 
 
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -42,9 +42,26 @@ What the pool owns:
   probe**; a passing probe also resets the supervisor's crash-loop
   counter and half-opens a stuck circuit breaker.
 
+* **Disaggregated prefill/decode tiers** (``TPU_REPLICA_ROLES``) —
+  replicas tagged ``prefill`` run prompt prefill and ship the finished
+  paged KV blocks (host bounce, ``ops/kv_cache.py`` export/import
+  seam) to a ``decode`` replica, which inserts them into its radix
+  prefix index and admission-aliases them zero-copy — chunked prefill
+  stops stealing decode windows from latency-sensitive streams
+  (DistServe/Splitwise). Robustness first: every transfer carries the
+  request's ``Deadline`` plus a jittered-backoff retry budget
+  (``TPU_TRANSFER_RETRIES``/``TPU_TRANSFER_TIMEOUT_S``), and every
+  failure — prefill replica dying mid-transfer, a decode replica
+  rejecting blocks, a corrupt payload, a whole tier with zero healthy
+  replicas — degrades down a ladder that ends in fused serving on
+  whatever survives, byte-identical and with one trace id, never a
+  5xx for a retryable request (``docs/advanced-guide/resilience.md``).
+
 Observability: ``app_tpu_replica_state`` (0=SERVING 1=DEGRADED
 2=RESTARTING 3=DOWN per replica), ``app_tpu_failovers_total``,
-``app_tpu_probe_failures_total``, ``app_tpu_hedged_requests_total``.
+``app_tpu_probe_failures_total``, ``app_tpu_hedged_requests_total``,
+``app_tpu_tier_transfers_total{result}``,
+``app_tpu_tier_transfer_seconds``, ``app_tpu_tier_mode`` (1 = tiered).
 
 Determinism contract (the chaos suite, ``tests/test_replica_pool.py``):
 clock/rng are injectable, the prober thread is optional (tests call
@@ -64,6 +81,7 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from gofr_tpu import faults
 from gofr_tpu.errors import (
     ErrorDeadlineExceeded,
     ErrorNoHealthyReplica,
@@ -93,9 +111,33 @@ class Replica:
     supports_stream = False
     #: True for network-backed replicas (remote-stream failover metric).
     remote = False
+    #: Can this backend adopt a shipped KV-block payload
+    #: (``import_prefilled``)? In-proc engines only until a wire form
+    #: of the payload exists — an import-incapable decode replica must
+    #: not count toward tiered mode, or every transfer to it is a
+    #: guaranteed-futile retry loop.
+    supports_tier_import = False
+    #: Can this backend EXPORT prefilled blocks (honor
+    #: ``set_tier_exporter``)? Same asymmetry guard on the prefill
+    #: side: a prefill-tagged replica that can never ship blocks must
+    #: not flip the pool tiered — it would pin fresh traffic to a
+    #: replica that serves fused end-to-end while the real decode tier
+    #: idles.
+    supports_tier_export = False
 
-    def __init__(self, name: str) -> None:
+    def __init__(self, name: str, role: str = "fused") -> None:
         self.name = name
+        # Disaggregated serving tier (TPU_REPLICA_ROLES): "prefill"
+        # replicas run prompt prefill and ship the KV blocks, "decode"
+        # replicas import blocks and stream tokens, "fused" (default)
+        # serves both phases — and every role can serve fused when its
+        # counterpart tier has no healthy replica (the degradation
+        # ladder's last rung before 5xx).
+        if role not in ("fused", "prefill", "decode"):
+            raise ValueError(
+                f"replica role must be fused|prefill|decode, got {role!r}"
+            )
+        self.role = role
         # Latched by a failed synthetic probe; cleared ONLY by a passing
         # one. While set, the router treats the replica as DOWN no
         # matter what its own state machine claims.
@@ -152,6 +194,20 @@ class Replica:
         future intact). False when this backend cannot."""
         return False
 
+    def set_tier_exporter(self, exporter: Optional[Callable[..., bool]]) -> None:
+        """Install/remove the pool's tier-transfer exporter on a
+        prefill-role backend (no-op for backends without the seam)."""
+
+    def import_prefilled(self, req: Any, payload: Any) -> Optional[str]:
+        """Adopt a request whose prefill a sibling already computed,
+        with its KV blocks as ``payload`` (None when the exporter had
+        no paged pool). ``"imported"`` / ``"fused"`` on success (see
+        ``engine.handoff_prefilled``), None when this backend cannot
+        take it — remote replicas return None until a wire form of the
+        block payload exists; the pool then tries another target or
+        falls back to fused serving."""
+        return None
+
     # -- probe surface ----------------------------------------------------
 
     def probe(self, timeout_s: float) -> tuple[str, str]:
@@ -175,6 +231,7 @@ class Replica:
     def describe(self) -> dict:
         return {
             "state": self.state(),
+            "role": self.role,
             "probe_failed": self.probe_failed,
             "draining": self.draining,
             "load": self.load(),
@@ -191,10 +248,15 @@ class EngineReplica(Replica):
     """An in-process :class:`InferenceEngine` (plus its supervisor)."""
 
     supports_stream = True
+    supports_tier_import = True
+    supports_tier_export = True
 
-    def __init__(self, name: str, engine: Any) -> None:
-        super().__init__(name)
+    def __init__(self, name: str, engine: Any, role: str = "fused") -> None:
+        super().__init__(name, role)
         self.engine = engine
+        # The engine's scheduler checks its OWN role at prefill
+        # finalize, so the replica's role is mirrored down.
+        engine.tier_role = role
 
     def state(self) -> str:
         return str(self.engine.state)
@@ -239,6 +301,16 @@ class EngineReplica(Replica):
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self.engine.set_replica_handoff(handoff)
+
+    def set_tier_exporter(self, exporter: Optional[Callable[..., bool]]) -> None:
+        self.engine.set_tier_exporter(exporter)
+
+    def import_prefilled(self, req: Any, payload: Any) -> Optional[str]:
+        if self.draining or self.probe_failed:
+            return None
+        if self.state() not in ("SERVING", "DEGRADED"):
+            return None
+        return self.engine.handoff_prefilled(req, payload)
 
     def submit(self, prompt: Any, **kw: Any) -> Any:
         return self.engine.submit_generate(prompt, **kw)
@@ -358,10 +430,11 @@ class HTTPReplica(Replica):
         stream: bool = True,
         tokenizer: Any = None,
         idle_timeout_s: float = 30.0,
+        role: str = "fused",
         metrics: Any = None,
         logger: Any = None,
     ) -> None:
-        super().__init__(name)
+        super().__init__(name, role)
         self.service = service
         self.generate_path = generate_path
         self.health_path = health_path
@@ -965,6 +1038,14 @@ class ReplicaPool:
         probe_interval_s: float = 30.0,
         probe_timeout_s: float = 30.0,
         weighted: bool = True,
+        # Disaggregated-tier transfer budget (TPU_TRANSFER_RETRIES /
+        # TPU_TRANSFER_TIMEOUT_S): extra import attempts after the
+        # first, the overall wall-clock bound, and the jittered-
+        # exponential backoff base between attempts.
+        transfer_retries: int = 2,
+        transfer_timeout_s: float = 10.0,
+        transfer_backoff_s: float = 0.05,
+        sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
         metrics: Any = None,
@@ -987,6 +1068,12 @@ class ReplicaPool:
         )
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
+        self.transfer_retries = max(0, int(transfer_retries))
+        self.transfer_timeout_s = max(0.0, float(transfer_timeout_s))
+        self.transfer_backoff_s = max(0.0, float(transfer_backoff_s))
+        self._sleep = sleep
+        # Last published tier mode (gauge updates only on change).
+        self._tier_mode_last: Optional[str] = None
         self._clock = clock
         self._rng = rng if rng is not None else random.Random()
         self._metrics = metrics
@@ -1010,9 +1097,15 @@ class ReplicaPool:
         self._refresh_primary()
         # Mid-stream failover: each replica offers the pool its
         # otherwise-terminal retryable requests (engine.try_handoff /
-        # HTTPReplica stream loss → here → sibling.adopt).
+        # HTTPReplica stream loss → here → sibling.adopt). Prefill-tier
+        # replicas additionally get the transfer exporter: finalized
+        # prefills ship their KV blocks to a decode replica through
+        # :meth:`_tier_transfer`.
         for replica in self._replicas:
             replica.set_handoff(self._make_handoff(replica))
+            if replica.role == "prefill":
+                replica.set_tier_exporter(self._make_tier_exporter(replica))
+        self._publish_tier_mode()
 
     def _refresh_primary(self) -> None:
         self._primary_engine = next(
@@ -1075,11 +1168,14 @@ class ReplicaPool:
             self.scaler.stop()
         self.stop_prober()
         for replica in self._replicas:
-            # Detach the handoff FIRST: a pool-wide shutdown must
-            # terminate in-flight work, not migrate it replica to
-            # replica (re-decoding delivered prefixes and emitting
-            # phantom failover metrics during a routine deploy).
+            # Detach the handoff (and tier exporter) FIRST: a pool-wide
+            # shutdown must terminate in-flight work, not migrate it
+            # replica to replica (re-decoding delivered prefixes and
+            # emitting phantom failover metrics during a routine
+            # deploy). A detached exporter makes prefill replicas
+            # decode their last prefills locally — fused.
             replica.set_handoff(None)
+            replica.set_tier_exporter(None)
         for replica in self._replicas:
             if isinstance(replica, EngineReplica):
                 replica.engine.stop_sync(drain_s)
@@ -1105,6 +1201,7 @@ class ReplicaPool:
         *,
         require_stream: bool = False,
         adapter: str = "",
+        prefer_roles: tuple = (),
     ) -> Replica:
         """Least-loaded routable replica: SERVING first, spill to
         DEGRADED, never RESTARTING/DOWN, probe-demoted, or draining.
@@ -1117,6 +1214,11 @@ class ReplicaPool:
         — routing a request where the weights aren't loaded would serve
         base-model output with a 200 (callers reconcile on miss:
         :meth:`_ensure_adapter`).
+
+        ``prefer_roles`` narrows to tier roles when any candidate holds
+        one (tiered routing sends fresh work to the prefill tier) and
+        falls through to every candidate otherwise — a role tag must
+        never turn a servable request into a 502.
 
         Weighted mode ranks by estimated completion time instead:
         ``(load + 1) / measured tokens/sec`` — the ROADMAP follow-up to
@@ -1137,6 +1239,15 @@ class ReplicaPool:
             ]
 
         candidates = routable(("SERVING",)) or routable(("DEGRADED",))
+        if prefer_roles:
+            # Tier routing is a PREFERENCE, never a partition: with the
+            # preferred tier empty the pick falls through to whatever
+            # still serves (the fused degradation rung), because a
+            # request that could be served must never 502 over a role
+            # tag.
+            preferred = [r for r in candidates if r.role in prefer_roles]
+            if preferred:
+                candidates = preferred
         if candidates:
             with self._rr_lock:
                 start = self._rr % len(candidates)
@@ -1188,13 +1299,23 @@ class ReplicaPool:
         only to replicas advertising the adapter, lazily reconciling
         (asking a routable replica to load it) when none do."""
         adapter = str(kw.get("adapter") or "")
+        # Disaggregated tiers: while both tiers are healthy, fresh work
+        # lands on the prefill tier (the prefill replica ships KV blocks
+        # to a decode replica after finalize); with either tier empty
+        # the preference dissolves and any replica serves fused.
+        # Adapter-bound requests route purely by adapter advertisement —
+        # tier transfers exclude LoRA, so tier-routing them would just
+        # pin adapter traffic to the prefill tier end-to-end.
+        prefer: tuple = ()
+        if not adapter and self.tier_mode == "tiered":
+            prefer = ("prefill",)
         last: Optional[BaseException] = None
         reconciled = False
         while True:
             try:
                 replica = self.pick(
                     exclude=tried, require_stream=require_stream,
-                    adapter=adapter,
+                    adapter=adapter, prefer_roles=prefer,
                 )
             except ErrorNoHealthyReplica:
                 if adapter and not reconciled:
@@ -1573,6 +1694,265 @@ class ReplicaPool:
             return True
         return False
 
+    # -- disaggregated prefill/decode tier --------------------------------
+
+    def _compute_tier_mode(self) -> str:
+        """``"tiered"`` while BOTH tiers have a routable replica,
+        ``"fused"`` otherwise (including pools with no roles at all).
+        Fused means role tags stop steering routing and every replica
+        serves both phases — draining the last prefill replica degrades
+        the pool to exactly the pre-tier behavior, with requests still
+        served."""
+        replicas = self._replicas  # one snapshot
+
+        def healthy(role: str) -> bool:
+            return any(
+                r.role == role
+                and not r.probe_failed
+                and not r.draining
+                # A replica that cannot do its tier's HALF of the
+                # transfer (remote, until the payload grows a wire
+                # form) must not flip the pool tiered: an
+                # import-incapable decode target makes every transfer
+                # a guaranteed-futile retry loop, and an
+                # export-incapable prefill replica would pin fresh
+                # traffic to fused serving while the real decode tier
+                # idles. Either still serves as an ordinary routable
+                # replica.
+                and (role != "decode" or r.supports_tier_import)
+                and (role != "prefill" or r.supports_tier_export)
+                and r.state() in ("SERVING", "DEGRADED")
+                for r in replicas
+            )
+
+        if not any(r.role != "fused" for r in replicas):
+            return "fused"
+        return "tiered" if healthy("prefill") and healthy("decode") else (
+            "fused"
+        )
+
+    @property
+    def tier_mode(self) -> str:
+        mode = self._compute_tier_mode()
+        self._publish_tier_mode(mode)
+        return mode
+
+    def _publish_tier_mode(self, mode: Optional[str] = None) -> None:
+        """``app_tpu_tier_mode`` (1 = tiered, 0 = fused), published on
+        change only — every submit consults the mode, and a gauge write
+        per request would be noise."""
+        if mode is None:
+            mode = self._compute_tier_mode()
+        if mode == self._tier_mode_last:
+            return
+        self._tier_mode_last = mode
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_tier_mode", 1.0 if mode == "tiered" else 0.0
+            )
+        if self._logger is not None:
+            self._logger.infof(
+                "replica pool tier mode → %s", mode,
+            )
+
+    def _make_tier_exporter(
+        self, source: Replica
+    ) -> Callable[[Any, Any], bool]:
+        def exporter(req: Any, payload: Any) -> bool:
+            return self._tier_transfer(req, payload, source)
+
+        return exporter
+
+    def _pick_tier_target(
+        self, exclude: Iterable[Replica]
+    ) -> Optional[Replica]:
+        """A routable decode-tier replica for a block transfer, or None
+        (the caller then falls back through the degradation ladder).
+        Same weighted/least-loaded ranking as :meth:`pick`, restricted
+        to decode-role stream-capable replicas."""
+        excluded = {id(r) for r in exclude}
+        candidates = [
+            r for r in self._replicas
+            if r.role == "decode"
+            and id(r) not in excluded
+            and not r.probe_failed
+            and not r.draining
+            and r.supports_stream
+            and r.supports_tier_import
+            and r.state() in ("SERVING", "DEGRADED")
+        ]
+        if not candidates:
+            return None
+        if not self.weighted:
+            return min(candidates, key=lambda r: r.load())
+        return min(candidates, key=self._completion_score(candidates))
+
+    def _transfer_delay(self, attempt: int) -> float:
+        """Jittered exponential backoff between transfer attempts —
+        uncoordinated retries, so a fleet of prefill replicas hitting
+        one rejecting decode replica cannot re-spike it in lockstep
+        (graftlint GL013: every I/O retry loop backs off)."""
+        base = self.transfer_backoff_s * (2 ** attempt)
+        return base * (0.5 + self._rng.random())
+
+    def _count_transfer(self, result: str) -> None:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_tier_transfers_total", "result", result
+            )
+
+    def _tier_transfer(
+        self, req: Any, payload_src: Any, source: Replica
+    ) -> bool:
+        """Ship a finalized prefill (request + KV-block payload) to a
+        decode replica. ``payload_src`` is the payload or a zero-arg
+        factory for it — the exporting scheduler defers the expensive
+        device→host extraction behind this method's cheap gates, so a
+        hop-capped request or a collapsed decode tier never pays the
+        host bounce. Robustness-first: the attempt loop carries the
+        request's own ``Deadline``/``CancelToken`` plus a transfer-wide
+        wall-clock bound (``TPU_TRANSFER_TIMEOUT_S``) and a jittered-
+        backoff retry budget (``TPU_TRANSFER_RETRIES``); every exit is
+        a rung of the degradation ladder, never a dropped request:
+
+        1. a decode replica imports the blocks → ``result="ok"``
+           (zero-copy decode) or ``"fused"`` (it rejected the payload
+           but adopted the request → re-prefills there);
+        2. retries exhausted / transfer deadline with the decode tier
+           still nominally present → the request requeues WITHOUT
+           blocks on any stream-capable sibling via the ordinary
+           failover path → ``result="failed_over"`` (fused re-prefill
+           elsewhere);
+        3. no routable decode target at all (tier collapsed before
+           anything was tried), the hop cap, or nothing adopting it →
+           False: the PREFILL replica decodes it locally
+           (``result="local_fused"``) — its slot and blocks are still
+           live, so this rung costs nothing and can never fail.
+
+        A request whose deadline expired or whose caller cancelled
+        mid-transfer is released to the scheduler's reap instead of
+        being shipped (``result="expired"``) — transferring work nobody
+        will wait for helps no one.
+
+        The backoff sleeps run on the exporting scheduler thread
+        (bounded by the transfer deadline and taken only on FAILING
+        attempts), so a flaky decode tier briefly slows that replica's
+        other prefills rather than silently doubling its work."""
+        if req.tier_hops >= 2 or self._compute_tier_mode() != "tiered":
+            # Hop cap (settle into fused serving rather than ping-pong
+            # between a prefill tier and a rejecting decode tier), or
+            # the decode tier already collapsed — decode locally with
+            # the blocks that are still live in this replica's slot
+            # instead of paying a sibling re-prefill.
+            self._count_transfer("local_fused")
+            return False
+        req.tier_hops += 1
+        if req.cancel.cancelled or req.future.cancelled() or (
+            req.deadline is not None and req.deadline.expired()
+        ):
+            # Dead before the expensive leg: never pay the device→host
+            # extraction for work nobody will consume — the source's
+            # reap retires it within one window.
+            self._count_transfer("expired")
+            return False
+        # The clock starts BEFORE extraction: the histogram's meaning
+        # is extract→import, and the device→host pull is routinely the
+        # dominant leg.
+        start = self._clock()
+        payload = payload_src() if callable(payload_src) else payload_src
+        bound = Deadline.after(self.transfer_timeout_s, clock=self._clock)
+        tried: list[Replica] = []
+        result = "abandoned"
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.transfer_retries + 1):
+            if req.cancel.cancelled or req.future.cancelled() or (
+                req.deadline is not None and req.deadline.expired()
+            ):
+                self._count_transfer("expired")
+                return False  # the source's reap retires it within one window
+            if bound.expired():
+                result = "timeout"
+                break
+            verdict: Optional[str] = None
+            target: Optional[Replica] = None
+            try:
+                # Fault seam: the transfer leg itself dying (prefill
+                # replica lost mid-ship, serialization fault).
+                faults.fire(
+                    "tier.transfer", request=req, source=source.name,
+                    attempt=attempt,
+                )
+                target = self._pick_tier_target([source, *tried])
+                if target is None:
+                    result = "no_target"
+                    break
+                # Excluded from later attempts whether the import
+                # returns None OR raises — re-picking the same broken
+                # replica would skip its healthy siblings.
+                tried.append(target)
+                verdict = target.import_prefilled(req, payload)
+            except Exception as exc:  # noqa: BLE001 — every attempt failure is retried or degraded
+                last_exc = exc
+                verdict = None
+            if verdict:
+                assert target is not None
+                duration = self._clock() - start
+                outcome = "ok" if verdict == "imported" else "fused"
+                self._count_transfer(outcome)
+                if self._metrics is not None:
+                    self._metrics.record_histogram(
+                        "app_tpu_tier_transfer_seconds", duration
+                    )
+                timeline = getattr(req, "timeline", None)
+                if timeline is not None:
+                    timeline.note_transfer(
+                        source.name, target.name, start, self._clock(),
+                        outcome,
+                    )
+                if self._logger is not None:
+                    self._logger.infof(
+                        "tier transfer %s → %s: %s (%d block(s), "
+                        "attempt %d)",
+                        source.name, target.name, outcome,
+                        payload.n_blocks if payload is not None else 0,
+                        attempt + 1,
+                    )
+                return True
+            if attempt < self.transfer_retries:
+                self._sleep(self._transfer_delay(attempt))
+        if self._logger is not None:
+            self._logger.warnf(
+                "tier transfer from %s abandoned (%s%s); falling back "
+                "to fused serving",
+                source.name, result,
+                f": {last_exc}" if last_exc is not None else "",
+            )
+        if result == "no_target" and not tried:
+            # The decode tier vanished mid-transfer (nothing was even
+            # tried): the prefill replica's slot still holds the
+            # finished blocks, so local decode is strictly cheaper than
+            # a sibling re-prefill. With targets TRIED and rejecting,
+            # fall through to the failover rung instead — re-prefilling
+            # on the (live, merely import-rejecting) decode tier keeps
+            # the decode windows off this prefill replica.
+            self._count_transfer("local_fused")
+            return False
+        # Retries/deadline exhausted against a PRESENT-but-rejecting
+        # decode tier: requeue WITHOUT the payload through the ordinary
+        # failover path (decode siblings included) — the fused fallback
+        # rung. Byte-identical output either way: the adopting replica
+        # re-prefills the same prompt under the same seed.
+        if req.retryable() and self._failover(req, source):
+            self._count_transfer("failed_over")
+            timeline = getattr(req, "timeline", None)
+            if timeline is not None:
+                timeline.note_transfer(
+                    source.name, "", start, self._clock(), "failed_over"
+                )
+            return True
+        self._count_transfer("local_fused")
+        return False
+
     # -- membership (scaler spawn/drain) ----------------------------------
 
     def add_replica(self, replica: Replica) -> Replica:
@@ -1586,11 +1966,14 @@ class ReplicaPool:
             if not getattr(eng, "_running", True):
                 eng.start_sync()
         replica.set_handoff(self._make_handoff(replica))
+        if replica.role == "prefill":
+            replica.set_tier_exporter(self._make_tier_exporter(replica))
         with self._replicas_lock:
             self._replicas = [*self._replicas, replica]
             self._refresh_primary()
         self._publish_state(replica)
         self.publish_pool_gauges()
+        self._publish_tier_mode()
         if self._logger is not None:
             self._logger.infof(
                 "replica %s joined the pool (%d total)", replica.name,
@@ -1616,6 +1999,10 @@ class ReplicaPool:
             return False
         replica.draining = True
         self.publish_pool_gauges()
+        # Draining the last replica of a tier flips the pool to fused
+        # serving NOW — routing must not keep preferring a tier that
+        # can no longer complete its half.
+        self._publish_tier_mode()
         deadline = self._clock() + max(0.0, float(timeout_s))
         while replica.load() > 0:
             if self._clock() >= deadline:
@@ -1630,9 +2017,11 @@ class ReplicaPool:
                 return False
             sleep(poll_s)
         replica.set_handoff(None)
+        replica.set_tier_exporter(None)
         with self._replicas_lock:
             self._replicas = [r for r in self._replicas if r is not replica]
             self._refresh_primary()
+        self._publish_tier_mode()
         try:
             replica.close()
         except Exception as exc:  # noqa: BLE001 — the replica already left routing
@@ -1702,6 +2091,7 @@ class ReplicaPool:
                 results[replica.name] = self._probe_replica(replica)
             self._publish_state(replica)
         self.publish_pool_gauges()
+        self._publish_tier_mode()
         return results
 
     def _probe_replica(self, replica: Replica) -> str:
@@ -1823,8 +2213,9 @@ class ReplicaPool:
                 else ("DRAINING" if replica.draining else replica.state())
             )
             entry["adapters"] = sorted(replica.adapters())
+            entry["role"] = replica.role
             replicas[replica.name] = entry
-        return {"replicas": replicas}
+        return {"replicas": replicas, "tier_mode": self.tier_mode}
 
     def health_check(self) -> dict:
         replicas: dict[str, Any] = {}
@@ -1852,5 +2243,6 @@ class ReplicaPool:
                 "serving": serving,
                 "total": len(self._replicas),
                 "hedge_budget": round(self.hedge_budget.available(), 3),
+                "tier_mode": self.tier_mode,
             },
         }

@@ -8,10 +8,12 @@ so apps that don't serve models never import jax.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 
-def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
+def new_tpu_from_config(
+    config: Any, logger: Any = None, metrics: Any = None
+) -> Optional[object]:
     model = config.get_or_default("TPU_MODEL", "")
     if not model:
         return None
@@ -45,16 +47,52 @@ def new_tpu_from_config(config, logger=None, metrics=None) -> Optional[object]:
         return None
 
 
+def _parse_replica_roles(
+    config: Any, n_total: int, logger: Any
+) -> list[str]:
+    """``TPU_REPLICA_ROLES`` — comma-separated tier roles applied
+    positionally across the pool's replicas (in-proc engines first,
+    then remote addresses); replicas past the list's end default to
+    ``fused``. ``"prefill,decode"`` is the canonical disaggregated
+    pair. Unknown role names fail construction loudly — silently
+    serving fused under a typo'd topology would defeat the operator's
+    explicit disaggregation."""
+    raw = config.get_or_default("TPU_REPLICA_ROLES", "")
+    roles = [r.strip().lower() for r in raw.split(",") if r.strip()]
+    for role in roles:
+        if role not in ("fused", "prefill", "decode"):
+            raise ValueError(
+                f"TPU_REPLICA_ROLES entry {role!r} is not one of "
+                f"fused|prefill|decode"
+            )
+    if roles and len(roles) > n_total and logger is not None:
+        logger.warnf(
+            "TPU_REPLICA_ROLES names %d role(s) but the pool has %d "
+            "replica(s); extras ignored", len(roles), n_total,
+        )
+    return (roles + ["fused"] * n_total)[:n_total]
+
+
 def _new_tpu_pool_from_config(
-    config, n_replicas: int, remote_addrs: list, logger, metrics
-):
+    config: Any,
+    n_replicas: int,
+    remote_addrs: list,
+    logger: Any,
+    metrics: Any,
+) -> Any:
     """Build the replica pool: N in-process engines (each with its own
     supervisor when TPU_RESTART_MAX is set) plus one HTTPReplica per
     remote address, fronted by a ReplicaPool with the probe/hedge knobs
     (TPU_PROBE_INTERVAL_S / TPU_PROBE_TIMEOUT_S / TPU_HEDGE_DELAY_S /
     TPU_HEDGE_BUDGET). In-proc replicas share the same config — same
     params and engine seed — so cross-replica replay continues streams
-    byte-identically."""
+    byte-identically.
+
+    TPU_REPLICA_ROLES splits the pool into disaggregated prefill/
+    decode tiers (docs/advanced-guide/resilience.md): prefill replicas
+    ship finished KV blocks to decode replicas, budgeted by
+    TPU_TRANSFER_RETRIES / TPU_TRANSFER_TIMEOUT_S, and every failure
+    degrades back to fused serving."""
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.lifecycle import HedgeBudget
     from gofr_tpu.service import new_http_service
@@ -70,12 +108,34 @@ def _new_tpu_pool_from_config(
             "1", "true", "yes",
         )
 
-    replicas = []
+    roles = _parse_replica_roles(
+        config, n_replicas + len(remote_addrs), logger
+    )
+    if any(r != "fused" for r in roles):
+        # Tier transfers ship paged blocks into the importer's radix
+        # index: without TPU_KV_BLOCK + TPU_AUTO_PREFIX the tier still
+        # WORKS (requests re-prefill on the decode replica — fused
+        # import), it just never gets the saved prefill. Say so once at
+        # boot instead of letting the operator chase a silent perf gap.
+        if logger is not None and (
+            int(config.get_or_default("TPU_KV_BLOCK", "0")) <= 0
+            or not truthy("TPU_AUTO_PREFIX", "false")
+        ):
+            logger.warnf(
+                "TPU_REPLICA_ROLES set without TPU_KV_BLOCK>0 + "
+                "TPU_AUTO_PREFIX=true: tier transfers will re-prefill "
+                "on the decode tier instead of aliasing shipped KV "
+                "blocks"
+            )
+
+    replicas: list = []
     for i in range(n_replicas):
         engine = InferenceEngine.from_config(
             config, logger=logger, metrics=metrics
         )
-        replicas.append(EngineReplica(f"engine-{i}", engine))
+        replicas.append(
+            EngineReplica(f"engine-{i}", engine, role=roles[i])
+        )
     # Remote replicas stream by default (TPU_REMOTE_STREAM): the pool
     # consumes the remote's SSE with the include_tokens extension, so
     # streaming requests route to remote pods and a remote that dies
@@ -86,7 +146,7 @@ def _new_tpu_pool_from_config(
     shared_tokenizer = next(
         (r.engine.tokenizer for r in replicas), None
     )
-    for addr in remote_addrs:
+    for j, addr in enumerate(remote_addrs):
         replicas.append(
             HTTPReplica(
                 addr,
@@ -96,6 +156,7 @@ def _new_tpu_pool_from_config(
                 idle_timeout_s=float(
                     config.get_or_default("TPU_REMOTE_STREAM_IDLE_S", "30")
                 ),
+                role=roles[n_replicas + j],
                 metrics=metrics,
                 logger=logger,
             )
@@ -122,6 +183,14 @@ def _new_tpu_pool_from_config(
         weighted=config.get_or_default(
             "TPU_ROUTE_WEIGHTED", "true"
         ).lower() in ("1", "true", "yes"),
+        # Tier-transfer budget: extra import attempts past the first
+        # and the transfer-wide wall-clock bound.
+        transfer_retries=int(
+            config.get_or_default("TPU_TRANSFER_RETRIES", "2")
+        ),
+        transfer_timeout_s=float(
+            config.get_or_default("TPU_TRANSFER_TIMEOUT_S", "10")
+        ),
         metrics=metrics,
         logger=logger,
     )
@@ -135,7 +204,7 @@ def _new_tpu_pool_from_config(
     if max_replicas > len(replicas):
         counter = [len(replicas)]
 
-        def spawn_engine_replica():
+        def spawn_engine_replica() -> Any:
             engine = InferenceEngine.from_config(
                 config, logger=logger, metrics=metrics
             )
@@ -182,7 +251,7 @@ def _new_tpu_pool_from_config(
 
 
 def new_tpu_embed_from_config(
-    config, logger=None, metrics=None
+    config: Any, logger: Any = None, metrics: Any = None
 ) -> Optional[object]:
     """Secondary encoder engine (``TPU_EMBED_MODEL``) so one app can serve
     chat from the primary engine AND /v1/embeddings from an encoder —
@@ -240,15 +309,15 @@ def new_tpu_embed_from_config(
 class _Overlay:
     """Config view with a few keys overridden (keeps the Config protocol)."""
 
-    def __init__(self, base, overrides: dict) -> None:
+    def __init__(self, base: Any, overrides: dict) -> None:
         self._base, self._overrides = base, overrides
 
-    def get(self, key: str):
+    def get(self, key: str) -> Any:
         if key in self._overrides:
             return self._overrides[key]
         return self._base.get(key)
 
-    def get_or_default(self, key: str, default: str):
+    def get_or_default(self, key: str, default: str) -> Any:
         if key in self._overrides:
             return self._overrides[key]
         return self._base.get_or_default(key, default)

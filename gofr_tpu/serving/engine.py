@@ -87,6 +87,7 @@ class InferenceEngine(
         kv_pool_blocks: int = 0,
         auto_prefix: bool = False,
         prefix_cache_blocks: int = 0,
+        prefix_evict_watermark: int = 0,
         mesh: Any = None,
         quant: str = "",
         kv_quant: str = "",
@@ -215,6 +216,15 @@ class InferenceEngine(
         # (the pool requeues them on another replica) before failing
         # them. None outside a pool: failures stay terminal.
         self._handoff: Optional[Any] = None
+        # Disaggregated prefill/decode tier (TPU_REPLICA_ROLES): the
+        # pool stamps this engine's role and, for prefill-tier
+        # replicas, installs an exporter — the scheduler offers it
+        # every just-finalized prefill (request + extracted KV-block
+        # payload) instead of decoding locally; the pool ships the
+        # blocks to a decode replica. "fused" (the default) serves both
+        # phases locally, exactly as before this tier existed.
+        self.tier_role: str = "fused"
+        self._tier_exporter: Optional[Any] = None
         # Sampled-stream replay policy (TPU_REPLAY_EXACT): True (default)
         # regenerates the delivered prefix through the decode path —
         # byte-identical continuation at the cost of re-decoding it;
@@ -349,6 +359,12 @@ class InferenceEngine(
             # aliasing.
             self.auto_prefix = bool(auto_prefix)
             self.prefix_cache_blocks = max(0, prefix_cache_blocks)
+            # Prefix-cache eviction watermark (TPU_PREFIX_EVICT_WM):
+            # keep at least this many pool blocks FREE by sweeping LRU
+            # radix entries from the scheduler loop, so admission under
+            # pressure stops paying the synchronous pre-evict cost
+            # inside its own grow. 0 = off (evict only on shortfall).
+            self.prefix_evict_watermark = max(0, prefix_evict_watermark)
             if self.auto_prefix and not self.kv_block:
                 raise ValueError(
                     "TPU_AUTO_PREFIX requires the paged KV cache "
@@ -604,6 +620,11 @@ class InferenceEngine(
             prefix_cache_blocks=int(
                 config.get_or_default("TPU_PREFIX_CACHE_BLOCKS", "0")
             ),
+            # Free-block watermark for proactive radix-cache eviction
+            # (blocks; 0 = evict only on allocation shortfall).
+            prefix_evict_watermark=int(
+                config.get_or_default("TPU_PREFIX_EVICT_WM", "0")
+            ),
             # Request-lifecycle resilience knobs (docs/advanced-guide/
             # resilience.md): bounded submit queue + token budget,
             # throughput prior for projected-wait shedding, and the
@@ -839,6 +860,18 @@ class InferenceEngine(
         from collections import deque as _deque
 
         self._wait_kv: "_deque[_GenRequest]" = _deque()
+        # Tier transfers awaiting application: KVBlockPayloads a sibling
+        # prefill replica shipped here (handoff_prefilled), applied by
+        # the scheduler thread ahead of admission each iteration — the
+        # pool blocks they fill belong to THIS boot's allocator, so the
+        # deque is rebuilt (emptied) with the rest of the per-boot
+        # state; a payload dropped by a restart simply re-prefills.
+        self._tier_imports: "_deque[Any]" = _deque()
+        # Watermark-sweep fruitless latch (scheduler._radix_watermark_
+        # sweep): the (free, cached) signature of the last sweep that
+        # found nothing evictable, so the loop skips re-scanning the
+        # trie until pressure actually changes.
+        self._wm_fruitless: Optional[tuple[int, int]] = None
         self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
             maxsize=self.queue_max
         )
@@ -1120,6 +1153,63 @@ class InferenceEngine(
                 self._logger.errorf("replica handoff failed: %s", exc)
             return False
 
+    def set_tier_exporter(self, exporter: Optional[Any]) -> None:
+        """Install the pool's tier-transfer exporter on a prefill-role
+        engine: ``exporter(req, payload) -> bool`` is offered every
+        just-finalized prefill (payload = the prompt's full KV blocks,
+        host-bounced; None when the engine has no paged pool). True
+        means the pool placed the request on a decode replica — this
+        engine releases the slot and never decodes it. False (no decode
+        tier, retries exhausted AND no sibling adopted it, transfer cap
+        hit) means the scheduler decodes locally — the fused fallback,
+        so a collapsed decode tier degrades service, never drops it."""
+        self._tier_exporter = exporter
+
+    def handoff_prefilled(self, req: _GenRequest, payload: Any) -> Optional[str]:
+        """Decode-tier admission seam: adopt a request whose prompt a
+        prefill replica already computed, with its KV blocks shipped as
+        ``payload`` (``ops.kv_cache.KVBlockPayload``).
+
+        The payload is NOT applied here — this runs on the pool's
+        transfer path, and cache planes may only be touched by the
+        scheduler thread (pipelined windows donate the live buffers).
+        Instead the payload queues for the scheduler, which imports the
+        blocks into the radix prefix index ahead of admission; the
+        requeued request then admission-aliases them zero-copy, exactly
+        like any other prefix hit. Every validation failure (geometry
+        mismatch, short/corrupt payload, no paged pool or radix here)
+        quietly downgrades to ``"fused"``: the request re-prefills on
+        this replica — byte-identical output, just without the saved
+        prefill.
+
+        Returns ``"imported"`` (blocks queued + request admitted),
+        ``"fused"`` (request admitted, blocks unusable → re-prefill
+        here), or ``None`` (request not adoptable: draining, queue
+        full, no longer retryable — the pool tries elsewhere)."""
+        if self.family != "llm":
+            return None
+        # Fault seam: a decode replica rejecting the transfer (pool
+        # pressure, version mismatch) — the pool retries with backoff
+        # then falls back to fused serving.
+        faults.fire("tier.import", engine=self, request=req)
+        usable = bool(
+            payload is not None
+            and self.kv_block
+            and self._radix is not None
+            and payload.compatible_with(self.cache)
+            and payload.verify()
+        )
+        if usable:
+            self._tier_imports.append(payload)
+        if not self.requeue_replay(req, mode="transfer"):
+            if usable:
+                try:
+                    self._tier_imports.remove(payload)
+                except ValueError:
+                    pass  # the scheduler already consumed it: harmless cache warm
+            return None
+        return "imported" if usable else "fused"
+
     def synthetic_probe(self, timeout_s: float = 30.0) -> Any:
         """Active health probe: ONE cheap greedy token through the full
         submit → prefill → decode → retire path. Raises (or times out)
@@ -1175,20 +1265,36 @@ class InferenceEngine(
         self._init_llm_serving_state()
         self.start_sync()
 
-    def requeue_replay(self, req: _GenRequest) -> bool:
+    def requeue_replay(self, req: _GenRequest, mode: str = "replay") -> bool:
         """Re-admit a salvaged request after a restart, bypassing the
         admission shedders (it was admitted before the crash; shedding
         the replay would fail a client the restart exists to save).
         Returns False when the request stopped being retryable during
         the restart (cancelled / deadline expired) or the fresh queue is
         already full — the caller fails it with the terminal error path.
+
+        ``mode="transfer"`` is the disaggregated-tier admission path
+        (:meth:`handoff_prefilled`): the same shedder-bypassing requeue,
+        but nothing was delivered yet and nothing is being replayed, so
+        the replay counter/metrics/annotations stay untouched — the
+        transfer has its own (``app_tpu_tier_transfers_total``,
+        ``tpu.transfer``).
         """
         if not req.retryable():
             return False
+        transfer = mode == "transfer"
         # Admission-scoped fields reset so the fresh scheduler re-admits
-        # from scratch.
+        # from scratch — snapshotted first, because a requeue that FAILS
+        # (draining engine, full queue) hands the request back to its
+        # caller, whose fallback path (e.g. the tier exporter's local
+        # decode) still needs the pre-requeue state intact.
+        saved = (
+            req.effective_prompt_len, req.replays, req.replay_skip,
+            req.replayed_tokens,
+        )
         req.effective_prompt_len = 0
-        req.replays += 1
+        if not transfer:
+            req.replays += 1
         if req.temperature > 0 and self.replay_exact:
             # SAMPLED stream → EXACT replay (TPU_REPLAY_EXACT, default):
             # regenerate the delivered prefix from the prompt through
@@ -1216,10 +1322,14 @@ class InferenceEngine(
         cost = len(req.prompt_ids) + req.max_new_tokens
         with self._submit_lock:
             if not self._running or self._drained or self._draining:
+                (req.effective_prompt_len, req.replays, req.replay_skip,
+                 req.replayed_tokens) = saved
                 return False
             try:
                 self._pending.put_nowait(req)
             except queue.Full:
+                (req.effective_prompt_len, req.replays, req.replay_skip,
+                 req.replayed_tokens) = saved
                 return False
             self._queued_tokens += cost
             if self.tenant_queue_max and req.tenant:
@@ -1228,6 +1338,8 @@ class InferenceEngine(
                 )
             self._sched_idle = False
         self._work.set()
+        if transfer:
+            return True
         if req.timeline is not None:
             req.timeline.note_replay(
                 "regenerate" if req.replay_skip else "re-prefill",
@@ -1713,6 +1825,9 @@ class InferenceEngine(
             details["max_len"] = self.max_len
             details["pending"] = self._pending.qsize()
             details["prefilling"] = len(self._prefilling)
+            # Disaggregated-tier role (TPU_REPLICA_ROLES): which serving
+            # phase this engine owns in its pool ("fused" = both).
+            details["tier_role"] = self.tier_role
             # Advertised capability set: a replica pool fronting this
             # engine over HTTP reads the loaded adapters from the health
             # payload to route LoRA requests only where their weights

@@ -117,8 +117,9 @@ class RequestTimeline:
         "hub", "rid", "trace_id", "parent_span_id", "enqueued",
         "wall_ns_base", "mono_base", "admitted", "admissions",
         "prefill_done", "first_token", "done", "outcome", "finish_reason",
-        "chunks", "annotations", "prompt_tokens", "output_tokens",
-        "prefix_hit_tokens", "replays", "_lock", "_finished",
+        "chunks", "annotations", "transfers", "prompt_tokens",
+        "output_tokens", "prefix_hit_tokens", "replays", "_lock",
+        "_finished",
     )
 
     def __init__(
@@ -151,6 +152,11 @@ class RequestTimeline:
         self.chunks: list[tuple[float, float, int]] = []
         # (name, t, attrs) — shed/replay/failover events.
         self.annotations: list[tuple[str, float, dict[str, Any]]] = []
+        # (source, target, start, end, result) — disaggregated-tier KV
+        # transfers between the prefill and decode phases; rendered as
+        # a `tpu.transfer` span with real duration, unlike the instant
+        # annotations above.
+        self.transfers: list[tuple[str, str, float, float, str]] = []
         self.prompt_tokens = prompt_tokens
         self.output_tokens = 0
         self.prefix_hit_tokens = 0
@@ -192,6 +198,16 @@ class RequestTimeline:
 
     def note_failover(self, src: str, dst: str, now: float) -> None:
         self.annotate("tpu.failover", now, source=src, target=dst)
+
+    def note_transfer(
+        self, src: str, dst: str, start: float, end: float, result: str
+    ) -> None:
+        """One disaggregated-tier KV transfer hop (prefill replica →
+        decode replica), recorded from the pool's transfer thread —
+        shows up in /debug/flight and as a `tpu.transfer` child span
+        between the prefill and decode phases of the request's ONE
+        trace."""
+        self.transfers.append((src, dst, start, end, result))
 
     # -- terminal ------------------------------------------------------
 
@@ -260,6 +276,15 @@ class RequestTimeline:
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "prefill_chunks": len(self.chunks),
             "replays": self.replays,
+            "transfers": [
+                {
+                    "source": src,
+                    "target": dst,
+                    "duration_s": round(end - start, 6),
+                    "result": result,
+                }
+                for src, dst, start, end, result in self.transfers
+            ],
             "annotations": [
                 {
                     "name": name,
@@ -469,6 +494,14 @@ class RequestObservability:
             )
         if tl.prefill_done is not None and tl.first_token is not None:
             child("tpu.emit_flush", tl.prefill_done, tl.first_token)
+        for src, dst, start, end, result in tl.transfers:
+            # The disaggregated-tier hop: a real-duration span between
+            # the prefill phase (on `src`) and the decode phase (on
+            # `dst`), in the SAME trace.
+            child(
+                "tpu.transfer", start, end,
+                source=src, target=dst, result=result,
+            )
         if tl.first_token is not None:
             child(
                 "tpu.decode", tl.first_token, done,

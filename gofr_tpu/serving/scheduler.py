@@ -62,6 +62,9 @@ class SchedulerMixin:
     kv_block: int
     max_len: int
     mega_windows: int
+    tier_role: str
+    prefix_evict_watermark: int
+    _wm_fruitless: "Optional[tuple[int, int]]"
     n_slots: int
     pipeline_depth: int
     prefill_batch: int
@@ -90,6 +93,8 @@ class SchedulerMixin:
     _prefix_pool: Any  # Optional[serving.prefix_cache.PrefixPool]
     _supervisor: Any
     _handoff: Any
+    _tier_exporter: Any
+    _tier_imports: Any  # deque[ops.kv_cache.KVBlockPayload]
     _watchdog: Any
     _metrics: Any
     _obs: Any  # serving.observability.RequestObservability
@@ -181,6 +186,11 @@ class SchedulerMixin:
                 # sequences retire HERE, once per loop iteration, so a
                 # dead stream's KV blocks free within one decode window.
                 self._reap_lifecycle()
+                if self.kv_block:
+                    # Proactive prefix-eviction sweep: keep the free
+                    # list above the watermark so admission finds free
+                    # blocks instead of pre-evicting synchronously.
+                    self._radix_watermark_sweep()
                 # One chunk step per iteration, interleaved 1:1 with decode
                 # windows: a long prompt's prefill proceeds in bounded slices
                 # and never freezes active token streams (VERDICT r1 #9).
@@ -675,6 +685,230 @@ class SchedulerMixin:
             )
             self._table_dirty = False
 
+    # ------------------------------------------------------------------
+    # disaggregated prefill/decode tier (service/replica_pool.py)
+    # ------------------------------------------------------------------
+
+    def _apply_tier_imports(self) -> None:
+        """Apply queued tier-transfer payloads (decode tier): write each
+        shipped block into a freshly allocated pool block and insert it
+        into the radix index under its content key — the transferred
+        request (already requeued by ``handoff_prefilled``) then
+        admission-aliases them zero-copy like any prefix hit. Runs on
+        the scheduler thread only: the cache planes are donated to
+        in-flight dispatches, so no other thread may touch them.
+        Anything that cannot apply (no radix, geometry drift after a
+        warm restart, pool dry) is dropped and the request simply
+        re-prefills — the fused fallback, never a wrong answer."""
+        while self._tier_imports:
+            try:
+                payload = self._tier_imports.popleft()
+            except IndexError:  # raced handoff_prefilled's un-stash
+                return
+            self._import_payload(payload)
+
+    def _import_payload(self, payload: Any) -> int:
+        """One payload → pool blocks + radix entries; returns blocks
+        actually imported (possibly a prefix of the payload: content
+        already cached here is skipped, and a dry pool truncates the
+        tail)."""
+        radix = self._radix
+        if radix is None or not self.kv_block:
+            return 0
+        if not payload.compatible_with(self.cache) or len(
+            payload.token_ids
+        ) != payload.n_blocks * payload.block:
+            # Re-validated on the applying engine: a supervisor restart
+            # between handoff and apply rebuilds the cache, and a
+            # payload from a different model/quant geometry must never
+            # alias into it. (The byte checksum was already verified at
+            # handoff admission; in-proc payload memory cannot rot in
+            # between, so only the geometry can go stale here.)
+            if self._logger is not None:
+                self._logger.warnf(
+                    "tier import from %s rejected: stale or corrupt "
+                    "payload (%d block(s)); request will re-prefill",
+                    payload.src, payload.n_blocks,
+                )
+            return 0
+        B = self.kv_block
+        ids = list(payload.token_ids)
+        # Chunks already cached here need no copy: walk the longest
+        # cached prefix and import only the tail. The lookup references
+        # stay HELD until after the insert below — surrendering them
+        # first would let _alloc_block's pressure eviction free exactly
+        # these nodes mid-import, and insert would then rebuild the
+        # chain around stale (reused) block ids.
+        chain, matched = radix.lookup(ids, 0)
+        start = matched // B
+        imported = 0
+        from gofr_tpu.ops.kv_cache import paged_insert_block
+
+        for j in range(start, payload.n_blocks):
+            bid = self._alloc_block()
+            if bid is None:
+                break  # pool dry: the un-imported tail re-prefills
+            args = [
+                self.cache,
+                self._up(np.int32(bid)),
+                self._up(payload.k[:, j]),
+                self._up(payload.v[:, j]),
+            ]
+            if self.cache.k_s is not None and payload.k_s is not None:
+                args += [
+                    self._up(payload.k_s[:, j]),
+                    self._up(payload.v_s[:, j]),
+                ]
+            self.cache = paged_insert_block(*args)
+            chain.append(bid)
+            imported += 1
+        n = start + imported
+        if n:
+            # insert() walks the existing prefix nodes (flag False —
+            # the index keeps its own reference, OURS is surrendered
+            # below) and ADOPTS the fresh tail blocks' references.
+            # Nothing mutates the trie between the lookup above and
+            # this insert — both run on the scheduler thread, and
+            # purge_aid only ever targets LoRA slots, never aid 0.
+            flags = radix.insert(ids[: n * B], chain[:n], 0)
+            for j, adopted in enumerate(flags):
+                if not adopted:
+                    # j < start: drop the reference lookup handed us.
+                    # j >= start (duplicate raced in): drop our fresh
+                    # block — the incumbent wins.
+                    self._allocator.decref(chain[j])
+            self._publish_prefix_gauge()
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_kv_blocks_free", self._allocator.n_free,
+                "model", self.model_name,
+            )
+        if self._logger is not None:
+            self._logger.debugf(
+                "tier import from %s: %d/%d block(s) imported (%d "
+                "already cached)",
+                payload.src, imported, payload.n_blocks, start,
+            )
+        return imported
+
+    def _export_prefilled(self, slot: int, req: _GenRequest) -> bool:
+        """Prefill-tier export: offer a just-finalized prefill to the
+        pool's transfer exporter instead of decoding locally. True →
+        the pool placed the request on a decode replica; the slot's
+        blocks are indexed into the LOCAL radix (the next request with
+        this prefix aliases instead of re-prefilling) and released.
+        False → the caller decodes locally, the fused fallback — a
+        collapsed decode tier degrades to today's serving, never drops
+        a request. Probe requests (``pin_replica``) and LoRA requests
+        always decode locally (a probe must measure THIS replica;
+        adapter weights live per-engine)."""
+        if (
+            self.tier_role != "prefill"
+            or self._tier_exporter is None
+            or req.pin_replica
+            or req.prefix_store
+            or req.aid
+            # Requests carrying already-delivered tokens (failover
+            # continuations that landed here) decode locally: tier
+            # export ships FRESH prefills.
+            or req.token_ids
+        ):
+            return False
+
+        def make_payload() -> Any:
+            # Called by the pool AFTER its cheap gates (hop cap, tier
+            # mode, deadline): the device→host pull of every prompt KV
+            # plane is the expensive leg, and a collapsed decode tier
+            # must not pay it per request. Runs synchronously on this
+            # thread while the slot's blocks are still held.
+            if not self.kv_block:
+                return None
+            B = self.kv_block
+            row = self._slot_blocks[slot]
+            n_full = min(len(req.prompt_ids) // B, len(row))
+            if n_full <= 0:
+                return None
+            from gofr_tpu.ops.kv_cache import export_blocks
+
+            return export_blocks(
+                self.cache, row[:n_full],
+                req.prompt_ids[: n_full * B],
+                src=self.model_name,
+            )
+
+        try:
+            # Fault seam: the prefill replica failing at the prefill→
+            # transfer boundary (extraction crash, device loss right
+            # after finalize).
+            faults.fire("tier.prefill_done", engine=self, request=req)
+            placed = bool(self._tier_exporter(req, make_payload))
+        except Exception as exc:  # noqa: BLE001 — every export failure has a local fallback
+            if self._logger is not None:
+                self._logger.errorf(
+                    "tier export failed (%s: %s); decoding locally",
+                    type(exc).__name__, exc,
+                )
+            placed = False
+        if not placed:
+            return False
+        if self.kv_block:
+            # Warm the local radix with the full prompt blocks before
+            # releasing the slot (reads only immutable request fields —
+            # the decode replica owns the mutable ones by now), so the
+            # prefill tier's repeated-prefix traffic aliases instead of
+            # re-prefilling.
+            adopted: set[int] = set()
+            if self._radix is not None:
+                row = self._slot_blocks[slot]
+                n_full = min(len(req.prompt_ids) // self.kv_block, len(row))
+                if n_full > 0:
+                    flags = self._radix.insert(
+                        req.prompt_ids, row[:n_full], 0
+                    )
+                    adopted = {
+                        row[j] for j, f in enumerate(flags) if f
+                    }
+            self._release_blocks(slot, adopted)
+            if self._metrics is not None:
+                self._metrics.set_gauge(
+                    "app_tpu_kv_blocks_free", self._allocator.n_free,
+                    "model", self.model_name,
+                )
+                self._publish_prefix_gauge()
+        return True
+
+    def _radix_watermark_sweep(self) -> None:
+        """Proactive prefix-cache eviction (``TPU_PREFIX_EVICT_WM``):
+        keep at least the watermark's worth of pool blocks FREE by
+        sweeping LRU radix entries once per loop iteration, so
+        admission under pressure finds free blocks waiting instead of
+        paying a synchronous pre-evict scan inside its own grow. 0
+        (default) = off: eviction happens only on allocation shortfall,
+        exactly the pre-watermark behavior."""
+        wm = self.prefix_evict_watermark
+        if not wm or self._radix is None:
+            return
+        short = wm - self._allocator.n_free
+        if short <= 0:
+            return
+        # Fruitless-sweep latch: when nothing was evictable (every
+        # cached leaf still aliased by live slots), re-scanning the
+        # whole trie every loop iteration is pure hot-path overhead —
+        # skip until the free count or the cache composition changes.
+        sig = (self._allocator.n_free, self._radix.n_cached_blocks)
+        if sig == self._wm_fruitless:
+            return
+        if self._radix.evict(short):
+            self._wm_fruitless = None
+            self._publish_prefix_gauge()
+            if self._metrics is not None:
+                self._metrics.set_gauge(
+                    "app_tpu_kv_blocks_free", self._allocator.n_free,
+                    "model", self.model_name,
+                )
+        else:
+            self._wm_fruitless = sig
+
     def _window_tokens(self) -> int:
         return self.window_k * (self.spec_tokens + 1)
 
@@ -687,6 +921,14 @@ class SchedulerMixin:
         merge it into the decode token vector ON DEVICE (no host roundtrip
         between prefill and decode). Returns True if a step was dispatched.
         """
+        # Disaggregated-tier imports (shipped KV blocks → radix index)
+        # apply HERE, immediately ahead of the admission pops, so a
+        # just-transferred request's alias walk hits its own shipped
+        # blocks instead of re-prefilling them (a payload landing after
+        # its request was popped still applies next call — the request
+        # just pays a redundant prefill, never a wrong answer).
+        if self.kv_block:
+            self._apply_tier_imports()
         # Admission is host bookkeeping only — the device work is the
         # chunk steps that follow.
         free = [
@@ -1106,6 +1348,12 @@ class SchedulerMixin:
                     st.request.stream.put(None)
                     self._release_slot(slot)
                 else:
+                    if self._export_prefilled(slot, st.request):
+                        # Disaggregated tier: the pool placed this
+                        # request's decode phase on a decode replica
+                        # (KV blocks shipped or re-prefilling there);
+                        # the slot is free again for the next prefill.
+                        continue
                     seq = _ActiveSeq(request=st.request, last_token=-1)
                     self._slots[slot] = seq
                     self._slot_state_dirty = True
@@ -1170,6 +1418,13 @@ class SchedulerMixin:
             # The window emission path won the race (token already out),
             # or the request is gone — nothing to do.
             if req.future.done() or req.token_ids or seq.first_emitted:
+                continue
+            # Cancelled/expired between finalize and this flush: retire
+            # NOW instead of emitting a first token to a caller that
+            # already gave up (the reap releases the slot too).
+            if self._reap_request(
+                req, slot=slot if self._slots[slot] is seq else -1
+            ):
                 continue
             try:
                 if not first_dev.is_ready():

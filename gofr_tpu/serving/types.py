@@ -169,6 +169,12 @@ class _GenRequest:
     # sibling replica. Synthetic health probes set this — a probe that a
     # HEALTHY sibling completes would report the dead replica as alive.
     pin_replica: bool = False
+    # Disaggregated-tier transfers this request has already started
+    # (service/replica_pool.py): the pool refuses further exports past
+    # the cap, so a request bouncing between a prefill replica and a
+    # rejecting decode tier settles into fused serving instead of
+    # ping-ponging forever.
+    tier_hops: int = 0
     # EXACT (regeneration) replay, used for sampled streams: the engine
     # re-generates the delivered prefix from the prompt through the
     # decode path (counter-based sampling makes the walk bit-identical)

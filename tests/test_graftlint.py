@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL011), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL013), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -777,6 +777,93 @@ def test_gl012_accepts_budgeted_calls_and_other_tiers(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL013 — retry loops without backoff
+# ----------------------------------------------------------------------
+
+
+def test_gl013_flags_backoffless_retry_loops(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "service/retry.py",
+        """
+        def fetch(svc, url, max_retries):
+            for attempt in range(max_retries + 1):
+                try:
+                    return svc.get(url)
+                except ConnectionError:
+                    continue  # immediate re-attempt: herd amplifier
+
+        def push(svc, body, budget):
+            retries_left = budget
+            while retries_left > 0:
+                try:
+                    return svc.post("v1/x", json=body)
+                except ConnectionError:
+                    retries_left -= 1
+        """,
+        select=["GL013"],
+    )
+    assert ids == ["GL013", "GL013"]
+    assert "backoff" in findings[0].message
+
+
+def test_gl013_accepts_backoff_and_plain_loops(tmp_path):
+    # Jittered sleeps, RetryConfig, re-raising handlers, and loops that
+    # are not retry loops at all are the negative space.
+    ids, _ = _lint(
+        tmp_path, "serving/retry_ok.py",
+        """
+        import time
+
+        def fetch(svc, url, cfg):
+            for attempt in range(cfg.max_retries + 1):
+                try:
+                    return svc.get(url)
+                except ConnectionError:
+                    time.sleep(cfg.delay_s(attempt))
+
+        def ship(self, req, payload):
+            for attempt in range(self.transfer_retries + 1):
+                try:
+                    return self._import(req, payload)
+                except ConnectionError:
+                    pass
+                self._sleep(self._transfer_delay(attempt))
+
+        def strict(svc, url, max_retries):
+            for attempt in range(max_retries):
+                try:
+                    return svc.get(url)
+                except ConnectionError:
+                    raise  # not a retry: failures propagate
+
+        def walk(replicas):
+            for replica in replicas:  # adoption walk, not a retry loop
+                try:
+                    if replica.adopt():
+                        return True
+                except ValueError:
+                    continue
+            return False
+        """,
+        select=["GL013"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "datasource/retry.py",
+        """
+        def fetch(svc, url, max_retries):
+            for attempt in range(max_retries):
+                try:
+                    return svc.get(url)
+                except ConnectionError:
+                    continue
+        """,
+        select=["GL013"],
+    )
+    assert ids == []  # out of the serving/service scope
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -935,7 +1022,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011", "GL012",
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2

@@ -604,6 +604,39 @@ def test_pool_pressure_evicts_cached_blocks_not_requests():
         eng.stop_sync()
 
 
+def test_eviction_watermark_sweeps_ahead_of_admission():
+    """TPU_PREFIX_EVICT_WM: the scheduler loop trims LRU cached blocks
+    whenever the free list drops below the watermark, so admission
+    under pressure finds free blocks waiting instead of paying the
+    synchronous pre-evict scan inside its own grow."""
+    eng = InferenceEngine(
+        "llama-tiny", n_slots=2, max_len=128, window_k=4,
+        pipeline_depth=1, prefill_chunk=32, kv_block=32,
+        kv_pool_blocks=9, auto_prefix=True, prefix_evict_watermark=5,
+        tokenizer=ByteTokenizer(),
+    )
+    eng.start_sync()
+    try:
+        # Two distinct 2-full-block prompts: retiring both would cache
+        # 4+ blocks and leave < watermark free; the sweep must trim the
+        # LRU entries back down without any allocation shortfall.
+        for base in (300, 600):
+            eng.generate_sync(
+                [base] + list(range(60)), max_new_tokens=2,
+                temperature=0.0, stop_on_eos=False, timeout=180,
+            )
+        _wait_idle(eng)
+        deadline = time.monotonic() + 10
+        while (
+            eng._allocator.n_free < 5 and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert eng._allocator.n_free >= 5
+        _engine_block_invariant(eng)
+    finally:
+        eng.stop_sync()
+
+
 def test_supervisor_restart_resets_index_and_replays_byte_identically():
     from gofr_tpu.serving.supervisor import EngineSupervisor
 
