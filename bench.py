@@ -27,7 +27,13 @@ BENCH_PREFIX_WORKLOAD=1 (repeated-prefix burst: one shared
 BENCH_PREFIX_TOKENS=512 preamble + distinct suffixes on a paged engine;
 reports prefix hit-token ratio and warm-vs-cold TTFT;
 BENCH_AUTO_PREFIX=0 runs the same workload with the radix cache off —
-the prefix-caching A/B).
+the prefix-caching A/B),
+BENCH_TP_WORKLOAD=1 (GSPMD-sharded serving A/B: the SAME burst on a
+tp=1 then a tp=2 engine — token-identity enforced, the tp-invariance
+contract — emitting tp1_tps/tp2_tps/tp_speedup in one JSON line; on the
+CPU backend 8 virtual devices are forced and the row is degraded/NOT
+comparable, it exists so the perf trajectory captures sharded-engine
+step time until a real TPU window lands).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
 steady-state; the reported value is then the mid-window sustained rate,
 with the end-to-end rate in e2e_tps; set both to 0 for the synchronized
@@ -464,6 +470,100 @@ def _prefix_workload(on_tpu: bool) -> None:
     os._exit(0)
 
 
+def _tp_workload(on_tpu: bool) -> None:
+    """BENCH_TP_WORKLOAD=1: the GSPMD-sharded serving A/B — one
+    synchronized greedy burst served by a tp=1 engine, then the SAME
+    burst by a tp=2 engine (params Megatron-sharded, KV head axis
+    sharded). Greedy streams must be TOKEN-IDENTICAL between the two
+    (the tp-invariance contract the sharded-serving suite pins); a
+    mismatch fails the row rather than reporting a wrong-answer
+    speedup. On CPU virtual devices the collective overhead dominates,
+    so the row is degraded / NOT comparable — it captures the sharded
+    engine's step-time trajectory until a real multi-chip TPU window
+    lands."""
+    import jax
+
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny"
+    )
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "16" if on_tpu else "8"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32" if on_tpu else "8"))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "8"))
+    max_len = int(os.environ.get("BENCH_MAX_LEN", "1024" if on_tpu else "256"))
+    kv_block = int(os.environ.get("BENCH_KV_BLOCK", "0"))
+    devices = jax.devices()
+    if len(devices) < 2:
+        log(f"bench[tp]: only {len(devices)} device(s) visible — "
+            f"cannot A/B tp=2; rerun with 2+ chips or the CPU backend")
+        os._exit(4)
+    log(f"bench[tp]: model={model} requests={n_requests} "
+        f"new_tokens={new_tokens} slots={n_slots} devices={len(devices)}")
+
+    prompt = "The quick brown fox jumps over the lazy dog. " * 3
+
+    def run(tp: int) -> tuple[float, list]:
+        _set_stage(f"engine-init-tp{tp}")
+        engine = InferenceEngine(
+            model, n_slots=n_slots, max_len=max_len,
+            tokenizer=ByteTokenizer(),
+            window_k=int(os.environ.get("BENCH_WINDOW", "8")),
+            pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
+            kv_block=kv_block,
+            tp=tp, devices=devices[:tp] if tp > 1 else None, seed=0,
+        )
+        engine.start_sync()
+        _set_stage(f"warmup-tp{tp}")
+        engine.generate_sync(
+            prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        _set_stage(f"measure-tp{tp}")
+        t0 = time.time()
+        reqs = [
+            engine.submit_generate(
+                prompt, max_new_tokens=new_tokens, temperature=0.0,
+                stop_on_eos=False,
+            )
+            for _ in range(n_requests)
+        ]
+        results = [r.future.result(timeout=1800) for r in reqs]
+        wall = time.time() - t0
+        toks = sum(len(r.token_ids) for r in results)
+        engine.stop_sync()
+        log(f"bench[tp]: tp={tp} → {toks} tokens in {wall:.2f}s "
+            f"({toks / wall:.1f} tok/s)")
+        return toks / wall, [r.token_ids for r in results]
+
+    tp1_tps, streams1 = run(1)
+    tp2_tps, streams2 = run(2)
+    if streams1 != streams2:
+        log("bench[tp]: TOKEN MISMATCH between tp=1 and tp=2 — the "
+            "tp-invariance contract is broken; refusing to report a "
+            "wrong-answer speedup")
+        os._exit(5)
+    _set_stage("done")
+    platform = "tpu" if on_tpu else "cpu"
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tp2_tps / 2, 2),  # per-CHIP: tp=2 spans two
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tp2_tps / 2 / 1000.0, 4),
+        "platform": platform,
+        # CPU virtual devices measure gloo-collective overhead, not ICI:
+        # degraded rows never impersonate TPU numbers.
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "tp_ab",
+        "tp1_tps": round(tp1_tps, 2),
+        "tp2_tps": round(tp2_tps, 2),
+        "tp_speedup": round(tp2_tps / tp1_tps, 3) if tp1_tps else None,
+        "token_identical": True,
+    }), flush=True)
+    os._exit(0)
+
+
 def main() -> None:
     # Whole-run watchdog (round-2 lesson: the old init-only watchdog
     # released after jax.devices(), then engine-init remote compiles hung
@@ -471,6 +571,19 @@ def main() -> None:
     # child past BENCH_CHILD_WALL — exits 3 with the stage named, so the
     # parent retries in minutes and a timeout tail says where it hung.
     import threading
+
+    # The tp A/B needs ≥2 devices; on the CPU backend force virtual
+    # devices BEFORE jax initializes (the tests/conftest.py trick).
+    if (
+        os.environ.get("BENCH_TP_WORKLOAD", "") in ("1", "true", "yes")
+        and os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
     t_start = time.time()
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
@@ -511,6 +624,9 @@ def main() -> None:
     on_tpu = platform == "tpu"
     if os.environ.get("BENCH_PREFIX_WORKLOAD", "") in ("1", "true", "yes"):
         _prefix_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_TP_WORKLOAD", "") in ("1", "true", "yes"):
+        _tp_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL013).
+"""The graftlint rule set (GL001–GL014).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1632,6 +1632,128 @@ class RetryNoBackoffRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL014 — cross-mesh host pulls / sharding-annotation drift
+# ----------------------------------------------------------------------
+
+
+class CrossMeshHostPullRule(Rule):
+    """GSPMD-sharded serving (``TPU_TP``) puts the KV pool and params on
+    a mesh; the serving hot path must stay device-count-agnostic. Two
+    drift patterns break that silently:
+
+    * **Cross-mesh host pull**: ``jax.device_get`` / ``np.asarray`` /
+      ``np.array`` applied to the KV cache's planes (any expression
+      mentioning ``cache``) gathers a SHARDED array to host — on a tp
+      mesh that is an all-gather of pool HBM per call, and on a
+      multi-host mesh it deadlocks outright. Block extraction must go
+      through the export seam (``ops/kv_cache.export_blocks`` — one
+      deliberate, documented bounce at prefill finalize), so host-pull
+      calls inside ``export``-named functions are exempt.
+
+    * **Sharding-annotation drift**: a bare one-argument
+      ``jax.device_put(x)`` carries NO placement. In the mesh-aware hot
+      modules every host→device upload must say where it lands (the
+      engine's ``_up`` places replicated ``NamedSharding``s); an
+      unannotated put commits to the default device and every sharded
+      dispatch then drags the operand cross-mesh.
+
+    Scope: the serving hot-path modules (scheduler/engine/programs/
+    batcher) — boot/loader code may bounce deliberately.
+    """
+
+    rule_id = "GL014"
+    name = "cross-mesh-host-pull"
+    rationale = (
+        "sharded serving must not host-pull cache planes outside the "
+        "export seam, and hot-path uploads must carry an explicit "
+        "sharding — unannotated transfers silently all-gather or "
+        "replicate on a tp mesh"
+    )
+
+    #: numpy calls that materialize on host (module-qualified only —
+    #: ``jnp.asarray`` stays on device, bare ``asarray`` is ambiguous).
+    _PULLS = ("asarray", "array")
+    _HOST_MODS = ("np", "numpy")
+
+    def __init__(
+        self,
+        scoped_files: Sequence[str] = (
+            "serving/scheduler.py",
+            "serving/engine.py",
+            "serving/programs.py",
+            "serving/batcher.py",
+        ),
+    ) -> None:
+        self._files = tuple(scoped_files)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(norm.endswith(f) for f in self._files)
+
+    @staticmethod
+    def _mentions_cache(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and "cache" in sub.attr.lower():
+                return True
+            if isinstance(sub, ast.Name) and "cache" in sub.id.lower():
+                return True
+        return False
+
+    @classmethod
+    def _is_host_pull(cls, call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        parts = name.split(".")
+        short = parts[-1]
+        if short == "device_get":
+            # jax.device_get / self._jax.device_get / bare device_get.
+            return True
+        if short in cls._PULLS and len(parts) >= 2:
+            return parts[-2] in cls._HOST_MODS
+        return False
+
+    @staticmethod
+    def _is_bare_device_put(call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        if name.rsplit(".", 1)[-1] != "device_put":
+            return False
+        operands = len(call.args) + len(call.keywords)
+        return operands <= 1
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        # Function names walked INTO at each node, so seam functions
+        # (export_*) exempt their whole lexical body.
+        def visit(node: ast.AST, in_export: bool) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_export = in_export or "export" in node.name.lower()
+            if isinstance(node, ast.Call):
+                if (
+                    not in_export
+                    and self._is_host_pull(node)
+                    and any(self._mentions_cache(a) for a in node.args)
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "host pull of KV-cache planes outside the export "
+                        "seam — on a tp mesh this all-gathers sharded "
+                        "pool HBM per call (and deadlocks multi-host); "
+                        "ship blocks via ops/kv_cache.export_blocks",
+                    )
+                elif self._is_bare_device_put(node):
+                    yield self.finding(
+                        ctx, node,
+                        "device_put without an explicit sharding/device "
+                        "in a mesh-aware hot module — the operand "
+                        "commits to the default device and sharded "
+                        "dispatches drag it cross-mesh; place it with a "
+                        "NamedSharding (the engine's _up helper)",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_export)
+
+        yield from visit(tree, False)
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1649,6 +1771,7 @@ ALL_RULES = (
     PerRowClockRule,
     BlockingIONoTimeoutRule,
     RetryNoBackoffRule,
+    CrossMeshHostPullRule,
 )
 
 
@@ -1668,4 +1791,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         PerRowClockRule(config.hot_path_files),
         BlockingIONoTimeoutRule(),
         RetryNoBackoffRule(),
+        CrossMeshHostPullRule(),
     ]

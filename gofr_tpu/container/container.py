@@ -314,6 +314,13 @@ class Container:
             "replica-pool serving mode (1 = disaggregated tiers, 0 = "
             "fused)",
         )
+        # GSPMD-sharded serving (TPU_TP; docs/advanced-guide/
+        # sharded-serving.md): devices per mesh axis (axis label; an
+        # unsharded engine reports axis="tp" value 1).
+        m.new_gauge(
+            "app_tpu_mesh_devices",
+            "serving mesh devices per axis (axis=tp|cp; 1 = unsharded)",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

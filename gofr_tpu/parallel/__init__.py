@@ -14,7 +14,12 @@ tier") — jax.distributed for the runtime, the framework's HTTP client for
 app-level routing.
 """
 
-from gofr_tpu.parallel.mesh import make_mesh, mesh_axis_sizes
+from gofr_tpu.parallel.mesh import (
+    make_mesh,
+    mesh_axis_sizes,
+    mesh_topology,
+    partition_devices,
+)
 from gofr_tpu.parallel.sharding import shard_pytree, make_train_step
 from gofr_tpu.parallel.pipeline import pipeline_layer_fn, pipeline_spmd
 from gofr_tpu.parallel.dcn import initialize_multihost, process_topology
@@ -22,6 +27,8 @@ from gofr_tpu.parallel.dcn import initialize_multihost, process_topology
 __all__ = [
     "make_mesh",
     "mesh_axis_sizes",
+    "mesh_topology",
+    "partition_devices",
     "shard_pytree",
     "make_train_step",
     "pipeline_layer_fn",

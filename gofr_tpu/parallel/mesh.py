@@ -31,3 +31,47 @@ def make_mesh(
 
 def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
     return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def mesh_topology(mesh: Optional[Mesh]) -> Optional[dict]:
+    """JSON-able descriptor of a serving mesh — what health probes,
+    ``/debug/flight`` replica records, and pool descriptors advertise so
+    an operator can see each replica's pod shape without shelling into
+    it. ``None`` for an unsharded (single-chip) engine."""
+    if mesh is None:
+        return None
+    return {
+        "axes": mesh_axis_sizes(mesh),
+        "n_devices": int(mesh.devices.size),
+        "devices": [str(d) for d in mesh.devices.flat],
+    }
+
+
+def partition_devices(
+    devices: Sequence, group_size: int, n_groups: int
+) -> list[list]:
+    """Split ``devices`` into ``n_groups`` disjoint groups of
+    ``group_size`` — the replica-pool pod layout (dp across replicas, tp
+    within each). When the device count cannot cover every group
+    disjointly (e.g. in-proc replicas on one real TPU slice), every
+    group past the last full slice shares the FIRST group's devices:
+    correctness is unaffected (each engine jits its own programs), only
+    the parallel-speedup claim weakens, which the caller should log.
+    Fewer devices than ONE group is an error — an undersized group
+    would fail later inside ``make_mesh`` with misleading context."""
+    if group_size <= 0:
+        raise ValueError(f"group_size must be positive, got {group_size}")
+    devices = list(devices)
+    if len(devices) < group_size:
+        raise ValueError(
+            f"cannot carve a {group_size}-device group from "
+            f"{len(devices)} device(s)"
+        )
+    groups: list[list] = []
+    for i in range(n_groups):
+        lo, hi = i * group_size, (i + 1) * group_size
+        if hi <= len(devices):
+            groups.append(devices[lo:hi])
+        else:
+            groups.append(devices[:group_size])
+    return groups

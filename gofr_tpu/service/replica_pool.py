@@ -228,6 +228,14 @@ class Replica:
     def notify_probe_failure(self, reason: str) -> None:
         """Propagate a failing probe (supervisor restart request)."""
 
+    def mesh_topology(self) -> Optional[dict]:
+        """The replica's serving-mesh shape (axes/devices) or ``None``
+        when unsharded or unknown — in-proc replicas read their
+        engine's mesh, remote ones cache it from the last health probe.
+        The pool treats each sharded replica as ONE pod: dp across
+        replicas, tp within each."""
+        return None
+
     def describe(self) -> dict:
         return {
             "state": self.state(),
@@ -238,6 +246,7 @@ class Replica:
             "supports_stream": self.supports_stream,
             "remote": self.remote,
             "adapters": sorted(self.adapters()),
+            "mesh": self.mesh_topology(),
         }
 
     def close(self) -> None:
@@ -291,6 +300,15 @@ class EngineReplica(Replica):
             return frozenset(names())
         except Exception:  # noqa: BLE001 — advertisement is a routing hint only
             return frozenset()
+
+    def mesh_topology(self) -> Optional[dict]:
+        topo = getattr(self.engine, "mesh_topology", None)
+        if not callable(topo):
+            return None
+        try:
+            return topo()
+        except Exception:  # noqa: BLE001 — advertisement is a debug hint only
+            return None
 
     def load_adapter(self, name: str, source: Any) -> bool:
         try:
@@ -449,6 +467,10 @@ class HTTPReplica(Replica):
         self._inflight = 0
         self._state = "SERVING"
         self._adapters: frozenset[str] = frozenset()
+        # Mesh topology lifted from the last health probe (None until
+        # a probe sees one): a remote sharded pod advertises its shape
+        # the same way an in-proc one does.
+        self._mesh: Optional[dict] = None
         self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
@@ -460,6 +482,9 @@ class HTTPReplica(Replica):
 
     def adapters(self) -> frozenset[str]:
         return self._adapters
+
+    def mesh_topology(self) -> Optional[dict]:
+        return self._mesh
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -950,6 +975,11 @@ class HTTPReplica(Replica):
         adapters = details.get("lora_adapters")
         if isinstance(adapters, (list, tuple, set, frozenset)):
             self._adapters = frozenset(str(a) for a in adapters)
+        # Assign unconditionally: a remote pod restarted UNSHARDED
+        # omits the mesh key entirely, and a stale tp topology kept
+        # advertising forever would mislead the operator's fleet view.
+        mesh = details.get("mesh")
+        self._mesh = dict(mesh) if isinstance(mesh, dict) else None
         if health.get("status") == "UP":
             self._state = "SERVING"
             return "pass", ""
@@ -2214,6 +2244,9 @@ class ReplicaPool:
             )
             entry["adapters"] = sorted(replica.adapters())
             entry["role"] = replica.role
+            # Pod shape (GSPMD-sharded serving): dp across replicas,
+            # tp within each — None for unsharded replicas.
+            entry["mesh"] = replica.mesh_topology()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 

@@ -128,10 +128,53 @@ def _new_tpu_pool_from_config(
                 "blocks"
             )
 
+    # GSPMD pods (TPU_TP > 1 / TPU_MESH_CP > 1): each in-proc replica
+    # becomes ONE sharded pod over its own DISJOINT slice of the device
+    # list — dp across replicas, tp (× cp) within each. Without enough
+    # devices to cover every replica disjointly, overflow replicas
+    # share the first slice (correct, just without the parallel
+    # speedup) and the shortfall is logged once instead of the operator
+    # chasing a silent perf gap.
+    tp = int(
+        config.get_or_default(
+            "TPU_TP", config.get_or_default("TPU_MESH_TP", "1")
+        )
+    )
+    cp = int(config.get_or_default("TPU_MESH_CP", "1"))
+    pod_size = max(1, tp) * max(1, cp)
+    device_groups: list = [None] * n_replicas
+    if pod_size > 1:
+        import jax
+
+        from gofr_tpu.parallel.mesh import partition_devices
+
+        all_devices = list(jax.devices())
+        if len(all_devices) < pod_size:
+            # Not even ONE pod fits: fail at the seam with the real
+            # arithmetic instead of letting make_mesh crash after a
+            # log line that promised degraded boot.
+            raise ValueError(
+                f"sharded pool: one pod needs tp·cp={pod_size} "
+                f"device(s) but only {len(all_devices)} are visible — "
+                f"lower TPU_TP/TPU_MESH_CP or add devices"
+            )
+        if len(all_devices) < pod_size * n_replicas and logger is not None:
+            logger.warnf(
+                "sharded pool wants %d devices (%d replica(s) × tp·cp="
+                "%d) but only %d are visible: replicas past the last "
+                "full slice share the first slice's devices",
+                pod_size * n_replicas, n_replicas, pod_size,
+                len(all_devices),
+            )
+        device_groups = partition_devices(
+            all_devices, pod_size, n_replicas
+        )
+
     replicas: list = []
     for i in range(n_replicas):
         engine = InferenceEngine.from_config(
-            config, logger=logger, metrics=metrics
+            config, logger=logger, metrics=metrics,
+            devices=device_groups[i],
         )
         replicas.append(
             EngineReplica(f"engine-{i}", engine, role=roles[i])
@@ -205,8 +248,42 @@ def _new_tpu_pool_from_config(
         counter = [len(replicas)]
 
         def spawn_engine_replica() -> Any:
+            # Scaled pods land on a device slice no LIVE in-proc
+            # replica currently holds (remote replicas consume no local
+            # devices, and a drained replica's slice frees for reuse) —
+            # a spawn counter would double-occupy slice 0 while free
+            # slices sat idle. Only past the last free slice does a
+            # spawn share slice 0, mirroring the boot-time fallback.
+            spawn_devices = None
+            if pod_size > 1:
+                import jax
+
+                from gofr_tpu.parallel.mesh import partition_devices
+
+                all_devices = list(jax.devices())
+                slices = partition_devices(
+                    all_devices, pod_size,
+                    max(1, len(all_devices) // pod_size),
+                )
+                held = set()
+                for replica in pool.replicas:
+                    mesh = getattr(
+                        getattr(replica, "engine", None), "mesh", None
+                    )
+                    if mesh is not None:
+                        held.add(frozenset(
+                            str(d) for d in mesh.devices.flat
+                        ))
+                spawn_devices = next(
+                    (
+                        s for s in slices
+                        if frozenset(str(d) for d in s) not in held
+                    ),
+                    slices[0],
+                )
             engine = InferenceEngine.from_config(
-                config, logger=logger, metrics=metrics
+                config, logger=logger, metrics=metrics,
+                devices=spawn_devices,
             )
             engine.start_sync()
             counter[0] += 1

@@ -89,6 +89,8 @@ class InferenceEngine(
         prefix_cache_blocks: int = 0,
         prefix_evict_watermark: int = 0,
         mesh: Any = None,
+        tp: int = 0,
+        devices: Any = None,
         quant: str = "",
         kv_quant: str = "",
         prefix_slots: int = 0,
@@ -146,7 +148,25 @@ class InferenceEngine(
                 "alternatives plane)"
             )
         self.tokenizer = tokenizer
+        # GSPMD-sharded serving (TPU_TP): a caller may hand a pre-built
+        # mesh (dryruns, tests composing tp×cp), or just a tp degree —
+        # then the engine owns its topology, carving a {"tp": tp} mesh
+        # from ``devices`` (the replica-pool pod layout: dp across
+        # replicas, tp within each) or the process device list. The
+        # shard-init window (mesh build + param sharding + sharded
+        # quantization) is timed and emitted as a ``tpu.shard_init``
+        # span so slow boots are attributable.
+        shard_t0 = time.time_ns()
+        if mesh is None and int(tp or 0) > 1:
+            from gofr_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh({"tp": int(tp)}, devices=devices)
         self.mesh = mesh  # multi-chip: NamedSharding placement over ICI
+        from gofr_tpu.parallel.mesh import mesh_axis_sizes
+
+        self.tp = (
+            mesh_axis_sizes(mesh).get("tp", 1) if mesh is not None else 1
+        )
 
         t0 = time.time()
         self.quant = ""
@@ -181,6 +201,36 @@ class InferenceEngine(
 
         if quant and not self.quant:
             self.apply_quantization(quant)
+
+        if mesh is not None:
+            # Mesh topology observability: the per-axis device gauge
+            # (dashboards show pod shape per model) and the completed
+            # shard-init span covering mesh build + param sharding.
+            from gofr_tpu.serving.observability import emit_boot_span
+
+            if metrics is not None:
+                for axis, size in mesh_axis_sizes(mesh).items():
+                    metrics.set_gauge(
+                        "app_tpu_mesh_devices", size,
+                        "model", model_name, "axis", axis,
+                    )
+            emit_boot_span(
+                "tpu.shard_init", shard_t0, time.time_ns(),
+                attributes={
+                    "tpu.model": model_name,
+                    "tpu.mesh_axes": ",".join(
+                        f"{a}={n}" for a, n in mesh_axis_sizes(mesh).items()
+                    ),
+                    "tpu.mesh_devices": int(mesh.devices.size),
+                },
+            )
+        elif metrics is not None:
+            # Unsharded engines advertise tp=1 so the gauge is uniform
+            # across a mixed fleet.
+            metrics.set_gauge(
+                "app_tpu_mesh_devices", 1, "model", model_name,
+                "axis", "tp",
+            )
 
         if logger is not None:
             from gofr_tpu.models.transformer import count_params
@@ -515,18 +565,30 @@ class InferenceEngine(
 
     @classmethod
     def from_config(
-        cls, config: Any, logger: Any = None, metrics: Any = None
+        cls,
+        config: Any,
+        logger: Any = None,
+        metrics: Any = None,
+        devices: Any = None,
     ) -> "InferenceEngine":
         """Container seam: all knobs are TPU_* env keys (the datasource
         config idiom, reference ``sql/sql.go:109-118``).
 
-        ``TPU_MESH_TP=N`` serves tensor-parallel over N chips (ICI): params
-        Megatron-sharded, KV heads sharded, XLA inserts the collectives.
-        Data-parallel serving scale-out is engine replicas behind the
-        service tier (the DCN story, SURVEY §2.6), not a mesh axis here.
+        ``TPU_TP=N`` serves tensor-parallel over N chips (ICI): params
+        Megatron-sharded, the (paged) KV pool's head axis sharded, XLA
+        inserts the collectives. (``TPU_MESH_TP`` is the historical
+        alias.) Data-parallel serving scale-out is engine replicas
+        behind the service tier — with ``TPU_REPLICAS > 1`` each
+        in-proc replica becomes one sharded pod carved from a disjoint
+        ``devices`` slice (dp across replicas, tp within; see
+        ``serving/backend.py``).
         """
         mesh = None
-        tp = int(config.get_or_default("TPU_MESH_TP", "1"))
+        tp = int(
+            config.get_or_default(
+                "TPU_TP", config.get_or_default("TPU_MESH_TP", "1")
+            )
+        )
         # Serving context parallelism: the KV cache's length axis shards
         # over cp chips, so max_len can exceed one chip's cache HBM
         # (GSPMD turns the sharded softmax reductions into collectives).
@@ -539,7 +601,7 @@ class InferenceEngine(
                 axes["tp"] = tp
             if cp > 1:
                 axes["cp"] = cp
-            mesh = make_mesh(axes)
+            mesh = make_mesh(axes, devices=devices)
         model_name = config.get_or_default("TPU_MODEL", "llama-tiny")
         ckpt = config.get_or_default("TPU_CHECKPOINT", "")
         quant_cfg = config.get_or_default("TPU_QUANT", "")
@@ -1780,6 +1842,16 @@ class InferenceEngine(
             yield tok
 
 
+    def mesh_topology(self) -> Optional[dict]:
+        """The serving mesh's shape (axes, device count, device names)
+        or ``None`` when unsharded — advertised through health probes,
+        pool replica descriptors, and ``/debug/flight`` so an operator
+        can see each replica's pod layout (dp across replicas, tp
+        within) without shelling into it."""
+        from gofr_tpu.parallel.mesh import mesh_topology
+
+        return mesh_topology(self.mesh)
+
     def flight_records(self) -> dict:
         """The flight recorder's current contents (``/debug/flight`` on
         the ops port): the ring of recent request timelines plus the
@@ -1804,6 +1876,12 @@ class InferenceEngine(
             # HealthReply's details_json too.
             "state": self._state,
         }
+        mesh_topo = self.mesh_topology()
+        if mesh_topo is not None:
+            # Pod shape: a pool probing this replica (in-proc or over
+            # HTTP) lifts the mesh from the health payload into its
+            # descriptors — dp across replicas, tp within each.
+            details["mesh"] = mesh_topo
         sup = self._supervisor
         if sup is not None:
             details["supervisor"] = sup.describe()

@@ -102,6 +102,34 @@ def emit_instant_span(
     )
 
 
+def emit_boot_span(
+    name: str,
+    start_ns: int,
+    end_ns: int,
+    attributes: Optional[dict[str, Any]] = None,
+) -> None:
+    """Emit a completed boot-phase span (``tpu.shard_init`` and kin):
+    engine construction has no request to ride, so the span joins the
+    ambient trace when one is active (an app booting under a traced
+    startup hook) and otherwise mints its own trace id — an operator
+    asking "why did boot take 40s" still finds the mesh-build/param-
+    sharding window. No-op without an active exporter."""
+    tracer = get_tracer()
+    if not tracer_active(tracer):
+        return
+    span = current_span()
+    trace_id = span.trace_id if span is not None else _rand_hex(16)
+    parent_id = span.span_id if span is not None else None
+    tracer.emit_span(
+        name,
+        trace_id=trace_id,
+        parent_span_id=parent_id,
+        start_ns=start_ns,
+        end_ns=end_ns,
+        attributes=attributes,
+    )
+
+
 class RequestTimeline:
     """One request's host-side lifecycle record.
 

@@ -24,7 +24,7 @@ import asyncio
 import json
 import time
 import uuid
-from typing import Any, Callable, Optional
+from typing import Any, AsyncIterator, Callable, Optional, Union
 
 from gofr_tpu.errors import GofrError
 from gofr_tpu.http.response import Raw, Stream
@@ -100,17 +100,17 @@ def _n_choices(body: dict, streaming: bool) -> int:
     return n
 
 
-def _decoder(engine):
+def _decoder(engine: Any) -> Callable[[int], str]:
     if engine.tokenizer:
         return lambda t: engine.tokenizer.decode([t])
     return lambda t: ""
 
 
-def _completion_logprobs(engine, result) -> dict:
+def _completion_logprobs(engine: Any, result: Any) -> dict:
     """OpenAI completions logprobs block."""
     dec = _decoder(engine)
     tokens = [dec(t) for t in result.token_ids]
-    top = None
+    top: Optional[list[dict]] = None
     if result.token_top_logprobs is not None:
         # Keyed by decoded token STRING per the completions schema; when
         # two ids decode identically, the FIRST (highest logprob — alts
@@ -130,13 +130,13 @@ def _completion_logprobs(engine, result) -> dict:
 
 
 def add_openai_routes(
-    app,
+    app: Any,
     chat_template: Optional[Callable[[list[dict]], str]] = None,
 ) -> None:
     """Register /v1/* OpenAI-compatible routes on a gofr_tpu App."""
     template = chat_template or default_chat_template
 
-    def _engine(ctx):
+    def _engine(ctx: Any) -> Any:
         engine = getattr(ctx.container, "tpu", None)
         if engine is None:
             raise OpenAIRequestError(
@@ -144,7 +144,7 @@ def add_openai_routes(
             )
         return engine
 
-    def _check_model(body: dict, engine) -> str:
+    def _check_model(body: dict, engine: Any) -> str:
         """A request naming a model that is NOT the loaded one gets the
         OpenAI 404, not the loaded model's output. A loaded LoRA
         adapter's name IS a model here (the vLLM convention): the
@@ -156,14 +156,14 @@ def add_openai_routes(
             return ""
         names = engine.lora_names() if hasattr(engine, "lora_names") else []
         if want in names:
-            return want
+            return str(want)
         raise OpenAIModelNotFound(
             f"model {want!r} is not loaded (serving "
             f"{engine.model_name!r}); GET /v1/models lists "
             f"availability"
         )
 
-    def _lifecycle(ctx) -> dict:
+    def _lifecycle(ctx: Any) -> dict:
         """Deadline (X-Request-Timeout) + cancel token (disconnect) from
         the HTTP server, threaded into every engine submit so abandoned
         or expired requests retire mid-decode and free their KV blocks.
@@ -213,7 +213,8 @@ def add_openai_routes(
         )
 
     def _stream_response(
-        engine, prompt, params: dict, *, rid: str, model: str, chat: bool,
+        engine: Any, prompt: Any, params: dict, *, rid: str, model: str,
+        chat: bool,
         stop_seqs: Optional[list[str]] = None, include_usage: bool = False,
         include_tokens: bool = False,
     ) -> Stream:
@@ -238,7 +239,7 @@ def add_openai_routes(
         )
         stops = stop_seqs or []
 
-        async def events():
+        async def events() -> AsyncIterator[str]:
             created = int(time.time())
             loop = asyncio.get_running_loop()
             emitted_ids: list[int] = []
@@ -246,7 +247,7 @@ def add_openai_routes(
             printed = ""
             reason = "stop"
 
-            def payload_of(text):
+            def payload_of(text: str) -> dict:
                 nonlocal sent_tokens
                 payload = (
                     {"delta": {"content": text}, "index": 0}
@@ -257,7 +258,7 @@ def add_openai_routes(
                     sent_tokens = len(emitted_ids)
                 return payload
 
-            def stop_hit(full):
+            def stop_hit(full: str) -> int:
                 return min(
                     (at for at in (full.find(s) for s in stops) if at != -1),
                     default=-1,
@@ -387,7 +388,9 @@ def add_openai_routes(
 
         return Stream(chunks=events())
 
-    def _sse(rid, object_name, model, created, choice) -> str:
+    def _sse(
+        rid: str, object_name: str, model: str, created: int, choice: dict
+    ) -> str:
         return "data: " + json.dumps({
             "id": rid,
             "object": object_name,
@@ -396,7 +399,7 @@ def add_openai_routes(
             "choices": [choice],
         }) + "\n\n"
 
-    def _normalize_prompts(prompt) -> list:
+    def _normalize_prompts(prompt: Any) -> list:
         """OpenAI ``prompt`` forms: str, [int] (token ids), [str] /
         [[int]] (a batch — one completion per element)."""
         if isinstance(prompt, str):
@@ -416,7 +419,7 @@ def add_openai_routes(
         )
 
     @app.post("/v1/completions")
-    async def completions(ctx):  # noqa: ANN001
+    async def completions(ctx: Any) -> Union[Raw, Stream]:
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
         adapter = _check_model(body, engine)
@@ -501,7 +504,7 @@ def add_openai_routes(
         }, status=200)
 
     @app.post("/v1/chat/completions")
-    async def chat_completions(ctx):  # noqa: ANN001
+    async def chat_completions(ctx: Any) -> Union[Raw, Stream]:
         engine = _engine(ctx)
         body = _completion_body(ctx.request.raw.body)
         adapter = _check_model(body, engine)
@@ -589,7 +592,7 @@ def add_openai_routes(
         }, status=200)
 
     @app.post("/v1/embeddings")
-    async def embeddings(ctx):  # noqa: ANN001
+    async def embeddings(ctx: Any) -> Raw:
         """OpenAI embeddings: served by the secondary encoder engine
         (``TPU_EMBED_MODEL``), or by the primary when it IS an encoder."""
         engine = getattr(ctx.container, "tpu_embed", None)
@@ -636,7 +639,7 @@ def add_openai_routes(
         }, status=200)  # OpenAI wire-compat: POST answers 200
 
     @app.get("/v1/models")
-    async def models(ctx):  # noqa: ANN001
+    async def models(ctx: Any) -> Raw:
         from gofr_tpu.models.registry import list_models
 
         engine: Any = getattr(ctx.container, "tpu", None)

@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL013), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL014), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -864,6 +864,72 @@ def test_gl013_accepts_backoff_and_plain_loops(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL014 — cross-mesh host pulls / sharding-annotation drift
+# ----------------------------------------------------------------------
+
+
+def test_gl014_flags_cache_pulls_and_bare_device_put(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import jax
+        import numpy as np
+
+        def _flush(self):
+            planes = jax.device_get(self.cache.k)  # all-gathers the pool
+            rows = np.asarray(self.cache.lengths)
+            return planes, rows
+
+        def _upload(self, table):
+            return jax.device_put(table)  # no placement: drift
+        """,
+        select=["GL014"],
+    )
+    assert ids == ["GL014", "GL014", "GL014"]
+    assert "export seam" in findings[0].message
+    assert "NamedSharding" in findings[2].message
+
+
+def test_gl014_accepts_export_seam_placed_puts_and_cold_files(tmp_path):
+    # The export seam, device-side jnp.asarray, placed device_puts, and
+    # non-cache pulls are the negative space.
+    ids, _ = _lint(
+        tmp_path, "serving/engine.py",
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def export_blocks_for(self, ids):
+            # the deliberate host bounce: export-named seam
+            return np.asarray(jax.device_get(self.cache.k[:, ids]))
+
+        def _up(self, x, rep):
+            return jax.device_put(x, rep)  # placed: fine
+
+        def _emit(self, tokens_dev):
+            return np.asarray(tokens_dev)  # not a cache plane (GL001's job)
+
+        def _trace(self, cache):
+            return jnp.asarray(cache.lengths)  # stays on device
+        """,
+        select=["GL014"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "serving/hf_loader.py",
+        """
+        import jax
+
+        def to_device(x):
+            return jax.device_put(x)  # boot path, out of scope
+        """,
+        select=["GL014"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
@@ -1022,7 +1088,7 @@ def test_cli_list_rules_and_missing_path(capsys):
     out = capsys.readouterr().out
     for rule_id in (
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013",
+        "GL008", "GL009", "GL010", "GL011", "GL012", "GL013", "GL014",
     ):
         assert rule_id in out
     assert main(["/nonexistent/path"]) == 2
