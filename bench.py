@@ -83,6 +83,43 @@ def _latency_fields(results: list) -> dict:
     }
 
 
+def _device_resource_fields(engine) -> dict:
+    """Device-resource fields for the JSON result line (ISSUE 11):
+    total XLA compiles, compiles that fired AFTER the warm-up fence
+    (always a fixed-shape bug — see ``_recompile_guard``), and peak
+    per-device HBM (the runtime's own peak when the platform reports
+    one, else the ledger's per-device accounting)."""
+    stats = engine.compile_stats()
+    ledger = engine.hbm_ledger()
+    # The ledger snapshot already carries the platform cross-check
+    # (mesh-aware device pick); reuse it rather than re-probing.
+    mem = ledger.get("device") or {}
+    peak = int(
+        mem.get("peak_bytes_in_use") or mem.get("bytes_in_use") or 0
+    )
+    return {
+        "compiles_total": int(stats["total"]),
+        "steady_state_recompiles": int(stats["steady_state_recompiles"]),
+        "hbm_peak_bytes": max(peak, int(ledger.get("per_device_bytes", 0))),
+    }
+
+
+def _recompile_guard(engine) -> None:
+    """The fixed-shape contract as a bench guard (the compile-tracker
+    twin of BENCH_TP_WORKLOAD's token-identity exit): any XLA compile
+    after ``mark_steady_state`` means the measured run was serialized
+    behind a trace+compile — the number would be garbage AND the
+    serving config has a shape-discipline bug. Exit 6, no JSON."""
+    stats = engine.compile_stats()
+    if stats["steady_state_recompiles"]:
+        log(f"bench: {stats['steady_state_recompiles']} STEADY-STATE "
+            f"RECOMPILE(S) after the warm-up fence "
+            f"({ {k: v['compiles'] for k, v in stats['programs'].items() if v['compiles']} }) "
+            f"— fixed-shape contract broken; refusing to report a "
+            f"compile-serialized number")
+        os._exit(6)
+
+
 def _extract_json_line(out: str) -> str | None:
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
@@ -413,6 +450,10 @@ def _prefix_workload(on_tpu: bool) -> None:
     engine.generate_sync(
         "w" * 8, max_new_tokens=2, temperature=0.0, stop_on_eos=False
     )
+    # Warm-up fence: the chunked-prefill and decode programs are
+    # compiled; anything that compiles during the measured phase is a
+    # fixed-shape bug (exit 6 below) and would serialize the burst.
+    engine.mark_steady_state()
 
     _set_stage("measure")
     # COLD: the first preamble-carrying request prefills everything
@@ -448,6 +489,8 @@ def _prefix_workload(on_tpu: bool) -> None:
         f"ttft p50/p95/p99={latency['ttft_p50']}/{latency['ttft_p95']}/"
         f"{latency['ttft_p99']}ms itl p50/p95/p99={latency['itl_p50']}/"
         f"{latency['itl_p95']}/{latency['itl_p99']}ms")
+    device_fields = _device_resource_fields(engine)
+    _recompile_guard(engine)
     engine.stop_sync()
     _set_stage("done")
     print(json.dumps({
@@ -466,6 +509,7 @@ def _prefix_workload(on_tpu: bool) -> None:
         "cold_ttft_ms": round(cold_ttft_ms, 2),
         "warm_ttft_p50_ms": round(warm_p50, 2),
         **latency,
+        **device_fields,
     }), flush=True)
     os._exit(0)
 
@@ -747,6 +791,10 @@ def main() -> None:
     t0 = time.time()
     engine.generate_sync(prompt, max_new_tokens=4, temperature=0.0, stop_on_eos=False)
     log(f"warmup (compile) in {time.time() - t0:.1f}s")
+    # Warm-up fence: every serving program the measured run will touch
+    # is compiled; a compile past this point serializes the measurement
+    # behind XLA and is a fixed-shape bug — exit 6 (no JSON) below.
+    engine.mark_steady_state()
 
     # Measured run: n_requests concurrent, engine batches them over n_slots.
     # BENCH_ARRIVAL_MS staggers submissions (0 = one synchronized burst,
@@ -846,6 +894,8 @@ def main() -> None:
         f"(min={min(unloaded):.1f} max={max(unloaded):.1f}, "
         f"short prompt, empty queue)")
 
+    device_fields = _device_resource_fields(engine)
+    _recompile_guard(engine)
     engine.stop_sync()
     _set_stage("done")
 
@@ -863,6 +913,7 @@ def main() -> None:
         "workload": workload,
         "e2e_tps": round(tps, 2),
         **latency,
+        **device_fields,
         **({"lora": n_lora} if n_lora else {}),
     }), flush=True)
 

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL014).
+"""The graftlint rule set (GL001–GL015).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1754,6 +1754,122 @@ class CrossMeshHostPullRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL015 — jax.jit created inside a per-request function body
+# ----------------------------------------------------------------------
+
+
+class JitInRequestPathRule(Rule):
+    """``jax.jit``/``pjit`` CALLED inside a per-request function body
+    builds a fresh jitted callable per call — its XLA cache is garbage-
+    collected with it, so every request pays a full trace+compile (and
+    the compile lock serializes the scheduler behind it). The serving
+    discipline is: programs are built ONCE, at module scope or in a
+    builder, and request paths only *call* them. This rule is the
+    static twin of the runtime
+    ``app_tpu_steady_state_recompiles_total`` counter
+    (``serving/device_telemetry.py``): the counter catches shape drift
+    through a correctly-built program, this catches the program being
+    rebuilt at all.
+
+    Exempt (not request paths):
+
+    * module scope — the normal home of shared jits;
+    * builder functions: ``_build_*`` / ``*_program`` (the
+      ``serving/programs.py`` idiom), with exemption inherited by
+      their nested defs (a decorator inside ``_build_llm_steps`` runs
+      at build time);
+    * constructors and boot/state rebuilds: ``__init__`` / ``_init*``
+      / ``init*`` — they run per boot, not per request;
+    * loader modules (``hf_loader.py`` / ``checkpoint.py`` /
+      ``lora.py``): checkpoint ingestion jits leaf-transforms by
+      design.
+
+    Deliberate boot-path jits elsewhere carry an inline
+    ``# graftlint: disable=GL015`` with their justification.
+    """
+
+    rule_id = "GL015"
+    name = "jit-in-request-path"
+    rationale = (
+        "jax.jit created inside a per-request function recompiles on "
+        "every call and serializes the scheduler behind the compile "
+        "lock; build programs once (module scope or a _build_*/"
+        "*_program builder) and only CALL them on request paths"
+    )
+
+    _EXEMPT_FILES = (
+        "serving/hf_loader.py",
+        "serving/checkpoint.py",
+        "serving/lora.py",
+    )
+
+    def __init__(self, scoped_dirs: Sequence[str] = ("serving",)) -> None:
+        self._dirs = tuple(scoped_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        if any(norm.endswith(f) for f in self._EXEMPT_FILES):
+            return False
+        return any(
+            f"/{d}/" in norm or norm.startswith(f"{d}/")
+            for d in self._dirs
+        )
+
+    @staticmethod
+    def _exempt_name(name: str) -> bool:
+        return (
+            name.startswith("_build")
+            or name.endswith("_program")
+            or name == "__init__"
+            or name.startswith("_init")
+            or name.startswith("init")
+        )
+
+    @classmethod
+    def _is_jit_maker(cls, call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        short = name.rsplit(".", 1)[-1]
+        if short in ("jit", "pjit"):
+            return True
+        if short == "partial":
+            # partial(jax.jit, ...) — the decorator-factory idiom.
+            return any(
+                (dotted_name(a) or "").rsplit(".", 1)[-1]
+                in ("jit", "pjit")
+                for a in call.args
+            )
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        # Exemption inherits downward (the GL014 in_export idiom): a
+        # jit created anywhere inside a builder's lexical body runs at
+        # build time, however deeply nested.
+        def visit(
+            node: ast.AST, in_function: bool, exempt: bool
+        ) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                exempt = exempt or self._exempt_name(node.name)
+                in_function = True
+            if (
+                in_function
+                and not exempt
+                and isinstance(node, ast.Call)
+                and self._is_jit_maker(node)
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "jax.jit created inside a per-request function — "
+                    "each call rebuilds and recompiles the program; "
+                    "build it once at module scope or in a _build_*/"
+                    "*_program builder and call the built program here",
+                )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_function, exempt)
+
+        yield from visit(tree, False, False)
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1772,6 +1888,7 @@ ALL_RULES = (
     BlockingIONoTimeoutRule,
     RetryNoBackoffRule,
     CrossMeshHostPullRule,
+    JitInRequestPathRule,
 )
 
 
@@ -1792,4 +1909,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         BlockingIONoTimeoutRule(),
         RetryNoBackoffRule(),
         CrossMeshHostPullRule(),
+        JitInRequestPathRule(),
     ]

@@ -386,6 +386,35 @@ class App:
                     headers={"Content-Type": "application/json"},
                     body=_json.dumps(flights).encode(),
                 )
+            if path == "/debug/capacity":
+                # Device-resource capacity (docs/advanced-guide/
+                # observability.md "Device-resource signals"): the HBM
+                # ledger (per-component bytes, budget, headroom), XLA
+                # compile counts with the steady-state recompile
+                # counter, and paged-KV pool pressure — per engine, or
+                # per replica through a pool. The operator's one read
+                # for "is this pod running out of the resources that
+                # actually bound it".
+                import json as _json
+
+                caps: dict = {}
+                for name, eng in (
+                    ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
+                ):
+                    if eng is None:
+                        continue
+                    report = getattr(eng, "capacity_report", None)
+                    if not callable(report):
+                        continue
+                    try:
+                        caps[name] = report()
+                    except Exception as exc:  # noqa: BLE001 — debug surface
+                        caps[name] = {"error": str(exc)}
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(caps).encode(),
+                )
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json

@@ -321,6 +321,47 @@ class Container:
             "app_tpu_mesh_devices",
             "serving mesh devices per axis (axis=tp|cp; 1 = unsharded)",
         )
+        # Device-resource observability (serving/device_telemetry.py;
+        # docs/advanced-guide/observability.md "Device-resource
+        # signals"): the HBM ledger's per-component bytes and derived
+        # headroom, XLA compile accounting with the steady-state
+        # recompile counter (a compile after the warm-up fence is
+        # always a fixed-shape-discipline bug), and paged-KV pool
+        # saturation.
+        m.new_gauge(
+            "app_tpu_hbm_bytes",
+            "HBM ledger bytes by component "
+            "(params/lora/kv_pool/prefix_pool/workspace)",
+        )
+        m.new_gauge(
+            "app_tpu_hbm_headroom_ratio",
+            "free fraction of the per-device HBM budget "
+            "(budget slack + free paged-KV blocks)",
+        )
+        m.new_counter(
+            "app_tpu_compiles_total",
+            "XLA program compiles by serving program",
+        )
+        m.new_histogram(
+            "app_tpu_compile_seconds",
+            "wall clock of a compiling call (trace + XLA compile — the "
+            "latency a request actually pays)",
+            (0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+        )
+        m.new_counter(
+            "app_tpu_steady_state_recompiles_total",
+            "compiles AFTER the warm-up fence — always a fixed-shape-"
+            "discipline bug (graftlint GL015 is the static twin)",
+        )
+        m.new_gauge(
+            "app_tpu_kv_pool_occupancy_ratio",
+            "paged KV pool: used blocks / total blocks",
+        )
+        m.new_gauge(
+            "app_tpu_kv_pool_fragmentation_ratio",
+            "paged KV pool: radix-cached (reclaimable-under-pressure) "
+            "blocks / used blocks",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -196,6 +196,12 @@ class PagedKVCache(NamedTuple):
             total += self.k_s.size * self.k_s.dtype.itemsize * 2
         return int(total)
 
+    def block_bytes(self) -> int:
+        """Global bytes of ONE pool block across every layer's K/V
+        (and int8-scale) planes — the unit the HBM ledger converts the
+        eviction watermark's byte fractions into block counts with."""
+        return self.hbm_bytes() // self.n_blocks
+
 
 class BlockAllocator:
     """Host-side refcounted allocator over the paged pool's physical

@@ -67,6 +67,7 @@ class PoolScaler:
         max_replicas: int = 4,
         up_load_per_replica: float = 4.0,
         down_load_per_replica: float = 0.5,
+        up_headroom_floor: float = 0.0,
         scale_up_wait_s: float = 10.0,
         scale_down_wait_s: float = 60.0,
         drain_timeout_s: float = 30.0,
@@ -86,6 +87,13 @@ class PoolScaler:
         self.max_replicas = int(max_replicas)
         self.up_load_per_replica = float(up_load_per_replica)
         self.down_load_per_replica = float(down_load_per_replica)
+        # Saturation-aware scale-up (TPU_SCALE_UP_HEADROOM, 0 = off):
+        # a serving replica whose HBM headroom ratio sits below this
+        # floor counts as pressure even when its queue looks shallow —
+        # a nearly-full paged pool sheds/fails work the queue-depth
+        # signal never sees coming (device_telemetry's headroom is the
+        # same signal admission and the eviction watermark read).
+        self.up_headroom_floor = float(up_headroom_floor)
         self.scale_up_wait_s = float(scale_up_wait_s)
         self.scale_down_wait_s = float(scale_down_wait_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -116,6 +124,21 @@ class PoolScaler:
             and r.state() in ("SERVING", "DEGRADED")
         ]
 
+    def _min_headroom(self, capacity: list[Replica]) -> Optional[float]:
+        """The worst advertised HBM headroom across serving capacity
+        when it violates the floor, else None. None-advertising
+        replicas (remotes before their first probe) don't count —
+        absence of the signal must not read as pressure."""
+        if self.up_headroom_floor <= 0:
+            return None
+        ratios = [
+            h for r in capacity for h in (r.headroom(),) if h is not None
+        ]
+        if not ratios:
+            return None
+        worst = min(ratios)
+        return worst if worst < self.up_headroom_floor else None
+
     def load_per_replica(self) -> float:
         """Aggregate outstanding work over serving capacity — the
         scaling signal. Work queued while NO capacity serves reads as
@@ -145,7 +168,8 @@ class PoolScaler:
         if n < self.min_replicas:
             return self._scale_up(now, reason="below min_replicas")
 
-        if load > self.up_load_per_replica:
+        low_headroom = self._min_headroom(capacity)
+        if load > self.up_load_per_replica or low_headroom is not None:
             self._idle_since = None
             if self._pressure_since is None:
                 self._pressure_since = now
@@ -153,12 +177,18 @@ class PoolScaler:
                 now - self._pressure_since >= self.scale_up_wait_s
                 and n < self.max_replicas
             ):
-                return self._scale_up(
-                    now,
-                    reason=f"load/replica {load:.1f} > "
+                reason = (
+                    f"load/replica {load:.1f} > "
                     f"{self.up_load_per_replica:.1f} for "
-                    f"{self.scale_up_wait_s:.0f}s",
+                    f"{self.scale_up_wait_s:.0f}s"
                 )
+                if low_headroom is not None:
+                    reason = (
+                        f"HBM headroom {low_headroom:.3f} < "
+                        f"{self.up_headroom_floor:.3f} for "
+                        f"{self.scale_up_wait_s:.0f}s"
+                    )
+                return self._scale_up(now, reason=reason)
             return "steady"
 
         self._pressure_since = None
@@ -279,6 +309,7 @@ class PoolScaler:
             ),
             "up_load_per_replica": self.up_load_per_replica,
             "down_load_per_replica": self.down_load_per_replica,
+            "up_headroom_floor": self.up_headroom_floor,
             "scale_up_wait_s": self.scale_up_wait_s,
             "scale_down_wait_s": self.scale_down_wait_s,
             "spawned": [r.name for r in self._spawned],

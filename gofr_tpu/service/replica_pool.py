@@ -236,6 +236,14 @@ class Replica:
         replicas, tp within each."""
         return None
 
+    def headroom(self) -> Optional[float]:
+        """The replica's HBM headroom ratio (device_telemetry's
+        saturation signal) or ``None`` when unknown — in-proc replicas
+        read their engine's ledger, remote ones cache it from the last
+        health probe. The pool scaler treats low headroom like load
+        pressure (``TPU_SCALE_UP_HEADROOM``)."""
+        return None
+
     def describe(self) -> dict:
         return {
             "state": self.state(),
@@ -247,6 +255,7 @@ class Replica:
             "remote": self.remote,
             "adapters": sorted(self.adapters()),
             "mesh": self.mesh_topology(),
+            "hbm_headroom": self.headroom(),
         }
 
     def close(self) -> None:
@@ -308,6 +317,15 @@ class EngineReplica(Replica):
         try:
             return topo()
         except Exception:  # noqa: BLE001 — advertisement is a debug hint only
+            return None
+
+    def headroom(self) -> Optional[float]:
+        ratio = getattr(self.engine, "hbm_headroom_ratio", None)
+        if not callable(ratio):
+            return None
+        try:
+            return float(ratio())
+        except Exception:  # noqa: BLE001 — advertisement is a routing hint only
             return None
 
     def load_adapter(self, name: str, source: Any) -> bool:
@@ -467,10 +485,11 @@ class HTTPReplica(Replica):
         self._inflight = 0
         self._state = "SERVING"
         self._adapters: frozenset[str] = frozenset()
-        # Mesh topology lifted from the last health probe (None until
-        # a probe sees one): a remote sharded pod advertises its shape
-        # the same way an in-proc one does.
+        # Mesh topology and HBM headroom lifted from the last health
+        # probe (None until a probe sees one): a remote pod advertises
+        # its shape and saturation the same way an in-proc one does.
         self._mesh: Optional[dict] = None
+        self._hbm_headroom: Optional[float] = None
         self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
@@ -485,6 +504,9 @@ class HTTPReplica(Replica):
 
     def mesh_topology(self) -> Optional[dict]:
         return self._mesh
+
+    def headroom(self) -> Optional[float]:
+        return self._hbm_headroom
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -980,6 +1002,16 @@ class HTTPReplica(Replica):
         # advertising forever would mislead the operator's fleet view.
         mesh = details.get("mesh")
         self._mesh = dict(mesh) if isinstance(mesh, dict) else None
+        # Same unconditional-assign discipline for the saturation
+        # signal: a restarted remote without a ledger clears it.
+        ledger = details.get("hbm_ledger")
+        ratio = (
+            ledger.get("headroom_ratio")
+            if isinstance(ledger, dict) else None
+        )
+        self._hbm_headroom = (
+            float(ratio) if isinstance(ratio, (int, float)) else None
+        )
         if health.get("status") == "UP":
             self._state = "SERVING"
             return "pass", ""
@@ -2247,6 +2279,36 @@ class ReplicaPool:
             # Pod shape (GSPMD-sharded serving): dp across replicas,
             # tp within each — None for unsharded replicas.
             entry["mesh"] = replica.mesh_topology()
+            # Saturation headline (device_telemetry): flight readers
+            # chasing tail latency see each replica's HBM pressure
+            # next to its timelines.
+            entry["hbm_headroom"] = replica.headroom()
+            replicas[replica.name] = entry
+        return {"replicas": replicas, "tier_mode": self.tier_mode}
+
+    def capacity_report(self) -> dict:
+        """Aggregate ``/debug/capacity`` view: each in-proc replica's
+        device-resource report (HBM ledger, compile counts, paged-pool
+        pressure) keyed by replica name, stamped with routing state and
+        tier role. Remote replicas contribute their cached headroom —
+        their full report lives on their own ops port."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            engine = getattr(replica, "engine", None)
+            report_fn = getattr(engine, "capacity_report", None)
+            if callable(report_fn):
+                try:
+                    entry = dict(report_fn())
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    entry = {"error": str(exc)}
+            else:
+                entry = {"remote": True}
+            entry["state"] = (
+                "DOWN" if replica.probe_failed
+                else ("DRAINING" if replica.draining else replica.state())
+            )
+            entry["role"] = replica.role
+            entry["hbm_headroom"] = replica.headroom()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 

@@ -302,6 +302,12 @@ def _new_tpu_pool_from_config(
             down_load_per_replica=float(config.get_or_default(
                 "TPU_SCALE_DOWN_LOAD", "0.5"
             )),
+            # Saturation-aware scale-up (device_telemetry headroom):
+            # a serving replica below this HBM headroom ratio counts
+            # as pressure even with a shallow queue. 0 = off.
+            up_headroom_floor=float(config.get_or_default(
+                "TPU_SCALE_UP_HEADROOM", "0"
+            )),
             scale_up_wait_s=float(config.get_or_default(
                 "TPU_SCALE_UP_WAIT_S", "10"
             )),

@@ -88,6 +88,9 @@ class InferenceEngine(
         auto_prefix: bool = False,
         prefix_cache_blocks: int = 0,
         prefix_evict_watermark: int = 0,
+        prefix_evict_hbm_frac: float = 0.0,
+        admit_min_headroom: float = 0.0,
+        hbm_budget_bytes: int = 0,
         mesh: Any = None,
         tp: int = 0,
         devices: Any = None,
@@ -351,6 +354,30 @@ class InferenceEngine(
             ),
         )
 
+        # Device-resource observability (serving/device_telemetry.py):
+        # the compile tracker wraps every jitted serving program built
+        # below (so it must exist before the family branch), and the
+        # HBM ledger is built with the serving state (its component
+        # sizes are fixed per boot). The tracker captures the ambient
+        # trace context HERE — warm-up compiles fire on the scheduler
+        # thread, but their tpu.compile spans belong to the boot trace.
+        from gofr_tpu.serving.device_telemetry import CompileTracker
+
+        self._compiles = CompileTracker(
+            model_name, metrics=metrics, logger=logger
+        )
+        self._ledger: Any = None
+        # Saturation-aware control knobs (docs/advanced-guide/
+        # observability.md "Device-resource signals"): the HBM-fraction
+        # eviction watermark (TPU_PREFIX_EVICT_WM stays the explicit
+        # override), admission's headroom floor, and the operator's
+        # explicit per-device HBM budget for backends whose
+        # memory_stats() reports nothing.
+        self.prefix_evict_hbm_frac = max(0.0, prefix_evict_hbm_frac)
+        self.admit_min_headroom = max(0.0, admit_min_headroom)
+        self.hbm_budget_bytes = max(0, hbm_budget_bytes)
+        self.effective_evict_watermark = 0
+
         if self.family == "llm":
             self.max_len = min(max_len, self.cfg.max_len)
             self.n_slots = n_slots
@@ -558,6 +585,11 @@ class InferenceEngine(
             )
         else:
             raise ValueError(f"unknown model family {self.family}")
+        if self.family != "llm":
+            # Non-LLM families have no serving-state rebuild seam: the
+            # ledger (params + batcher workspace is negligible) builds
+            # once here.
+            self._build_hbm_ledger()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -686,6 +718,22 @@ class InferenceEngine(
             # (blocks; 0 = evict only on allocation shortfall).
             prefix_evict_watermark=int(
                 config.get_or_default("TPU_PREFIX_EVICT_WM", "0")
+            ),
+            # Device-resource observability knobs (docs/advanced-guide/
+            # observability.md "Device-resource signals"): derive the
+            # eviction watermark from HBM headroom instead of a raw
+            # block count (the explicit TPU_PREFIX_EVICT_WM wins when
+            # both are set), shed admissions below a headroom floor,
+            # and state the per-device HBM budget on backends whose
+            # memory_stats() reports nothing.
+            prefix_evict_hbm_frac=float(
+                config.get_or_default("TPU_PREFIX_EVICT_HBM_FRAC", "0")
+            ),
+            admit_min_headroom=float(
+                config.get_or_default("TPU_ADMIT_MIN_HEADROOM", "0")
+            ),
+            hbm_budget_bytes=int(
+                config.get_or_default("TPU_HBM_BYTES", "0")
             ),
             # Request-lifecycle resilience knobs (docs/advanced-guide/
             # resilience.md): bounded submit queue + token budget,
@@ -997,6 +1045,44 @@ class InferenceEngine(
             self._up(np.zeros((n_slots, self.max_len), dtype=np.int32))
             if self.spec_tokens else None
         )
+        # Compile-tracked paged-pool jits: the COW copy (prefix-hit
+        # boundary) and the tier-transfer importer are module-level
+        # fixed-shape programs; wrapping them per engine makes a mid-
+        # steady-state geometry drift show up in the recompile counter
+        # like any other program.
+        if self.kv_block:
+            from gofr_tpu.ops.kv_cache import (
+                paged_copy_block,
+                paged_insert_block,
+            )
+
+            # shared=True: these jits' XLA caches span every engine in
+            # the process — per-wrapper signature tracking keeps the
+            # attribution per-engine and race-free.
+            self._paged_copy_block = self._compiles.wrap(
+                "paged_copy_block", paged_copy_block, shared=True
+            )
+            self._paged_insert_block = self._compiles.wrap(
+                "paged_insert_block", paged_insert_block, shared=True
+            )
+        # HBM ledger (serving/device_telemetry.py): every component this
+        # boot allocated, rebuilt with the serving state so a warm
+        # restart's fresh pool re-accounts exactly. The derived eviction
+        # watermark is fixed per boot too — geometry and budget don't
+        # move between restarts.
+        self._build_hbm_ledger()
+        self.effective_evict_watermark = self.prefix_evict_watermark
+        if (
+            self.prefix_evict_watermark <= 0
+            and self.prefix_evict_hbm_frac > 0
+            and self.kv_block
+            and self._ledger is not None
+        ):
+            self.effective_evict_watermark = (
+                self._ledger.derive_block_watermark(
+                    self.prefix_evict_hbm_frac
+                )
+            )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1037,7 +1123,7 @@ class InferenceEngine(
                 )
             from gofr_tpu.models.t5 import quantize_t5_params
 
-            self.params = self._jax.jit(
+            self.params = self._jax.jit(  # graftlint: disable=GL015 — boot path (guarded: raises if the engine is running)
                 lambda p: quantize_t5_params(p, mode), donate_argnums=(0,)
             )(self.params)
             self.quant = mode
@@ -1059,12 +1145,12 @@ class InferenceEngine(
                 prune_specs(transformer_param_specs(self.cfg), self.mesh),
                 mode,
             )
-            self.params = self._jax.jit(
+            self.params = self._jax.jit(  # graftlint: disable=GL015 — boot path (guarded: raises if the engine is running)
                 partial(quantize_params, mode=mode), donate_argnums=(0,),
                 out_shardings=named_shardings(specs, self.mesh),
             )(self.params)
         else:
-            self.params = self._jax.jit(
+            self.params = self._jax.jit(  # graftlint: disable=GL015 — boot path (guarded: raises if the engine is running)
                 partial(quantize_params, mode=mode), donate_argnums=(0,)
             )(self.params)
         self.quant = mode
@@ -1555,6 +1641,22 @@ class InferenceEngine(
                     f"(TPU_TENANT_QUEUE_MAX={self.tenant_queue_max})",
                     retry_after_s=wait_s,
                 )
+            if self.admit_min_headroom > 0:
+                # Saturation-aware admission (TPU_ADMIT_MIN_HEADROOM):
+                # below the HBM headroom floor new work is shed 429 —
+                # the honest answer when the paged pool is nearly full
+                # is "retry elsewhere", not a mid-stream
+                # kv_pool_exhausted failure after a slot was burned.
+                headroom = self.hbm_headroom_ratio()
+                if headroom < self.admit_min_headroom:
+                    self._shed("hbm_headroom", wait_s)
+                    raise ErrorTooManyRequests(
+                        f"HBM headroom {headroom:.3f} below the "
+                        f"admission floor {self.admit_min_headroom:.3f} "
+                        f"(TPU_ADMIT_MIN_HEADROOM); retry against "
+                        f"another replica",
+                        retry_after_s=wait_s,
+                    )
             if (
                 self.queue_max_tokens
                 and self._queued_tokens + cost > self.queue_max_tokens
@@ -1852,6 +1954,166 @@ class InferenceEngine(
 
         return mesh_topology(self.mesh)
 
+    # ------------------------------------------------------------------
+    # device-resource observability (serving/device_telemetry.py)
+    # ------------------------------------------------------------------
+
+    def _device_memory_stats(self) -> Optional[dict]:
+        """One mesh device's (or the default device's) runtime memory
+        accounting, None on backends without it (CPU)."""
+        try:
+            if self.mesh is not None:
+                dev = next(iter(self.mesh.devices.flat))
+            else:
+                dev = self._jax.local_devices()[0]
+            stats = dev.memory_stats()
+            return dict(stats) if stats else None
+        except Exception:  # graftlint: disable=GL006 — gauge-only path; memory_stats support varies by backend
+            return None
+
+    def _build_hbm_ledger(self) -> None:
+        """Account every device-resident component this boot allocated
+        into an :class:`HBMLedger`. Sizes are attribute reads on
+        already-built arrays — no device traffic — and fixed per boot,
+        so this runs once per (re)start."""
+        from gofr_tpu.serving.device_telemetry import (
+            HBMLedger,
+            tree_device_bytes,
+        )
+
+        layers = (
+            self.params.get("layers", {})
+            if isinstance(self.params, dict) else {}
+        )
+        lora_bytes = sum(
+            tree_device_bytes(v) for k, v in layers.items()
+            if k.endswith("_lora_a") or k.endswith("_lora_b")
+        )
+        components: dict[str, int] = {
+            "params": tree_device_bytes(self.params) - lora_bytes,
+        }
+        if lora_bytes:
+            components["lora"] = lora_bytes
+        block_bytes = n_blocks = 0
+        if self.family == "llm":
+            cache = self.cache
+            # Exactly the pool's own hbm_bytes() — the ledger must
+            # agree with the allocator's accounting to the byte
+            # (tests pin this at tp=1 AND tp=2).
+            components["kv_pool"] = cache.hbm_bytes()
+            workspace = tree_device_bytes([
+                cache.lengths, getattr(cache, "block_table", None),
+                self._tokens_dev, self._logps_dev, self._nsteps_dev,
+                self._seeds_dev, self._noff_dev, self._aids_dev,
+                self._active_dev, self._temps_dev, self._topp_dev,
+                self._greedy_dev, self._pcounts_dev, self._fpen_dev,
+                self._ppen_dev, self._bidx_dev, self._bval_dev,
+                self._topi_dev, self._topl_dev, self._history_dev,
+            ])
+            components["workspace"] = workspace
+            if self._prefix_pool is not None:
+                components["prefix_pool"] = self._prefix_pool.hbm_bytes()
+            if self.kv_block:
+                block_bytes = cache.block_bytes()
+                n_blocks = cache.n_blocks
+        self._ledger = HBMLedger(
+            components,
+            mesh_devices=(
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            ),
+            block_bytes=block_bytes,
+            n_blocks=n_blocks,
+            budget_bytes=self.hbm_budget_bytes,
+            device_stats=self._device_memory_stats,
+        )
+        self._ledger.publish(self._metrics, self.model_name)
+
+    def hbm_ledger(self) -> dict:
+        """The HBM ledger's snapshot (components, totals, budget,
+        headroom, platform cross-check) — ``/debug/capacity``'s hbm
+        block and the health detail."""
+        if self._ledger is None:
+            return {}
+        return dict(self._ledger.snapshot(self._ledger_free_blocks()))
+
+    def _ledger_free_blocks(self) -> int:
+        if self.family == "llm" and self.kv_block:
+            return int(self._allocator.n_free)
+        return 0
+
+    def _kv_pool_counts(self) -> tuple[int, int, int]:
+        """Paged-pool pressure counts ``(total, used, cached)`` —
+        allocatable blocks (block 0 parks), blocks held by live tables
+        or the radix index, and the radix-cached (reclaimable) subset.
+        The ONE accounting both the scheduler's gauge pass and
+        ``capacity_report`` read, so Prometheus and /debug/capacity can
+        never disagree."""
+        total = self.cache.n_blocks - 1
+        used = max(0, total - self._allocator.n_free)
+        cached = (
+            self._radix.n_cached_blocks if self._radix is not None else 0
+        )
+        return total, used, cached
+
+    def hbm_headroom_ratio(self) -> float:
+        """THE saturation signal: fraction of the per-device HBM budget
+        currently free (budget slack + free paged-KV blocks). Read by
+        admission shedding (TPU_ADMIT_MIN_HEADROOM), the radix eviction
+        watermark (TPU_PREFIX_EVICT_HBM_FRAC), and the pool scaler
+        (TPU_SCALE_UP_HEADROOM). O(1) host arithmetic."""
+        if self._ledger is None:
+            return 1.0
+        return float(
+            self._ledger.headroom_ratio(self._ledger_free_blocks())
+        )
+
+    def mark_steady_state(self) -> None:
+        """Arm the compile tracker's warm-up fence: every XLA compile
+        after this call counts (and warns) as a steady-state recompile
+        — always a fixed-shape-discipline bug. Bench calls this after
+        its warm-up phase; operators after a canary sweep."""
+        self._compiles.mark_warm()
+
+    def compile_stats(self) -> dict:
+        """The compile tracker's snapshot: per-program compile counts
+        and wall clock, the steady-state recompile count, and whether
+        the warm-up fence is armed."""
+        return dict(self._compiles.snapshot())
+
+    def capacity_report(self) -> dict:
+        """``/debug/capacity``'s per-engine record: the HBM ledger,
+        compile counts, and paged-pool pressure in one read."""
+        report: dict[str, Any] = {
+            "model": self.model_name,
+            "state": self._state,
+            "hbm": self.hbm_ledger(),
+            "compiles": self.compile_stats(),
+        }
+        if self.family == "llm" and self.kv_block:
+            total, used, cached = self._kv_pool_counts()
+            pool: dict[str, Any] = {
+                "block_tokens": self.kv_block,
+                "total_blocks": total,
+                "free_blocks": total - used,
+                "used_blocks": used,
+                "occupancy_ratio": round(used / max(1, total), 6),
+                "evict_watermark": self.effective_evict_watermark,
+                "evict_watermark_source": (
+                    "explicit" if self.prefix_evict_watermark > 0
+                    else (
+                        "hbm_frac" if self.effective_evict_watermark > 0
+                        else "off"
+                    )
+                ),
+            }
+            if self._radix is not None:
+                pool["cached_blocks"] = cached
+                pool["fragmentation_ratio"] = round(
+                    cached / used, 6
+                ) if used else 0.0
+            report["kv_pool"] = pool
+        return report
+
     def flight_records(self) -> dict:
         """The flight recorder's current contents (``/debug/flight`` on
         the ops port): the ring of recent request timelines plus the
@@ -1860,7 +2122,17 @@ class InferenceEngine(
         recorder = self._obs.recorder
         if recorder is None:
             return {"enabled": False}
-        return {"enabled": True, **recorder.snapshot()}
+        return {
+            "enabled": True,
+            # The device-resource headline rides every flight read: an
+            # operator chasing tail latency sees HBM pressure and
+            # steady-state recompiles next to the slow timelines.
+            "hbm_headroom_ratio": round(self.hbm_headroom_ratio(), 6),
+            "steady_state_recompiles": (
+                self._compiles.steady_state_recompiles
+            ),
+            **recorder.snapshot(),
+        }
 
     def health_check(self) -> dict:
         devices = self._jax.devices()
@@ -1923,6 +2195,26 @@ class InferenceEngine(
                         "lookups": self._prefix_lookups,
                         "hit_tokens": self._prefix_hit_tokens,
                     }
+        if self._ledger is not None:
+            # Device-resource observability: the ledger's compact form
+            # (components + headroom) rides health so pool probes —
+            # in-proc and over HTTP — lift the saturation signal into
+            # their replica descriptors without another endpoint.
+            snap = self.hbm_ledger()
+            details["hbm_ledger"] = {
+                "components": snap.get("components", {}),
+                "total_bytes": snap.get("total_bytes", 0),
+                "per_device_bytes": snap.get("per_device_bytes", 0),
+                "budget_bytes": snap.get("budget_bytes", 0),
+                "budget_source": snap.get("budget_source", ""),
+                "headroom_ratio": snap.get("headroom_ratio", 1.0),
+            }
+            details["compiles"] = {
+                "total": self._compiles.total,
+                "steady_state_recompiles": (
+                    self._compiles.steady_state_recompiles
+                ),
+            }
         try:
             stats = devices[0].memory_stats()
             if stats:

@@ -24,8 +24,14 @@ class ModalityMixin:
         from gofr_tpu.models.bert import bert_embed
 
         cfg = self.cfg
-        self._embed_step = self._jax.jit(
-            lambda params, tokens, mask: bert_embed(params, tokens, mask, cfg)
+        # Compile-tracked like every other serving program.
+        self._embed_step = self._compiles.wrap(
+            "embed",
+            self._jax.jit(
+                lambda params, tokens, mask: bert_embed(
+                    params, tokens, mask, cfg
+                )
+            ),
         )
 
     def _build_seq2seq_step(self) -> None:
@@ -40,10 +46,13 @@ class ModalityMixin:
             os.environ.get("TPU_SEQ2SEQ_MAX_NEW", "64")
         )
         eos = self.spec.eos_token
-        self._seq2seq_step = self._jax.jit(
-            lambda params, tokens, lengths: t5_generate(
-                params, tokens, lengths, cfg, max_new=max_new, eos_id=eos
-            )
+        self._seq2seq_step = self._compiles.wrap(
+            "seq2seq",
+            self._jax.jit(
+                lambda params, tokens, lengths: t5_generate(
+                    params, tokens, lengths, cfg, max_new=max_new, eos_id=eos
+                )
+            ),
         )
         # Stepped decode for STREAMING (r4 VERDICT weak #7): encode once,
         # then advance the answer buffer TPU_SEQ2SEQ_CHUNK greedy steps
@@ -54,16 +63,22 @@ class ModalityMixin:
             1, int(os.environ.get("TPU_SEQ2SEQ_CHUNK", "8"))
         )
         self._seq2seq_buf_len = ((max_new + chunk - 1) // chunk) * chunk
-        self._seq2seq_encode = self._jax.jit(
-            lambda params, tokens, lengths: t5_encode(
-                params, tokens, lengths, cfg
-            )
-        )
-        self._seq2seq_chunk_step = self._jax.jit(
-            lambda params, buf, done, enc, lengths, start: t5_generate_chunk(
-                params, buf, done, enc, lengths, start, cfg, chunk, eos
+        self._seq2seq_encode = self._compiles.wrap(
+            "seq2seq_encode",
+            self._jax.jit(
+                lambda params, tokens, lengths: t5_encode(
+                    params, tokens, lengths, cfg
+                )
             ),
-            donate_argnums=(1, 2),
+        )
+        self._seq2seq_chunk_step = self._compiles.wrap(
+            "seq2seq_chunk",
+            self._jax.jit(
+                lambda params, buf, done, enc, lengths, start: t5_generate_chunk(
+                    params, buf, done, enc, lengths, start, cfg, chunk, eos
+                ),
+                donate_argnums=(1, 2),
+            ),
         )
 
     def _build_vision_step(self) -> None:
@@ -74,8 +89,11 @@ class ModalityMixin:
                 f"vision model {self.model_name} registered without a "
                 f"forward fn (ModelSpec.forward)"
             )
-        self._classify_step = self._jax.jit(
-            lambda params, images: fwd(params, images, cfg)
+        self._classify_step = self._compiles.wrap(
+            "classify",
+            self._jax.jit(
+                lambda params, images: fwd(params, images, cfg)
+            ),
         )
 
 

@@ -135,6 +135,15 @@ class PrefixPool:
         b = -(-plen // _COPY_BUCKET) * _COPY_BUCKET
         return min(b, self.max_len)
 
+    def hbm_bytes(self) -> int:
+        """Device bytes of the pool's K/V (and scale) planes — the HBM
+        ledger's ``prefix_pool`` component."""
+        total = 0
+        for arr in self._pool:
+            if arr is not None:
+                total += int(arr.size) * int(arr.dtype.itemsize)
+        return total
+
     def lookup(self, ids: Sequence[int], aid: int = 0) -> tuple[int, int]:
         """Longest prefix of ``ids`` registered under adapter ``aid`` →
         (pool_row, prefix_len); (-1, 0) on miss. Hit refreshes LRU

@@ -9,12 +9,64 @@ from __future__ import annotations
 
 import time
 from functools import partial
+from typing import Any, Optional
 
 import numpy as np
 
 
 class LLMProgramsMixin:
     """Jitted-program construction + device profiling."""
+
+    # -- the mixin contract (mypy strict scope) ------------------------
+    # Provided by InferenceEngine.__init__ / _init_llm_serving_state;
+    # declared so the strict type gate checks this module's own logic
+    # against a written-down contract (the SchedulerMixin idiom).
+    _jax: Any
+    _jnp: Any
+    cfg: Any
+    mesh: Any
+    tokenizer: Any
+    cache: Any
+    params: Any
+    quant: str
+    family: str
+    _running: bool
+    _seed: int
+    _top_k: int
+    enable_top_p: bool
+    enable_penalties: bool
+    top_logprobs: int
+    spec_tokens: int
+    n_slots: int
+    window_k: int
+    prefill_batch: int
+    prefill_chunk: int
+    _slot_state_dirty: bool
+    _up: Any  # host→device placement callable
+    _compiles: Any  # serving.device_telemetry.CompileTracker
+    # Device-resident slot planes (jax arrays).
+    _tokens_dev: Any
+    _logps_dev: Any
+    _nsteps_dev: Any
+    _seeds_dev: Any
+    _noff_dev: Any
+    _aids_dev: Any
+    _pcounts_dev: Any
+    _fpen_dev: Any
+    _ppen_dev: Any
+    _bidx_dev: Any
+    _bval_dev: Any
+    _topi_dev: Any
+    _topl_dev: Any
+    # Compiled-program callables (built below, compile-tracked).
+    _prefill_chunk_step: Any
+    _prefill_chunk_step_hist: Any
+    _prefill_multi_chunk: Any
+    _prefill_multi_chunk_hist: Any
+    _decode_window: Any
+    _mega_window: Any
+    _spec_window: Any
+    _mega_spec_window: Any
 
     def _build_llm_steps(self) -> None:
         jax, jnp = self._jax, self._jnp
@@ -34,21 +86,24 @@ class LLMProgramsMixin:
 
             _rep_sh = NamedSharding(self.mesh, PartitionSpec())
 
-            def rep(x):
+            def rep(x: Any) -> Any:
                 # Host-fetched outputs must be REPLICATED: on a multi-host
                 # (DCN) mesh every process np.asarray()s its local shard,
                 # which is only the full value if the sharding says so.
                 return jax.lax.with_sharding_constraint(x, _rep_sh)
         else:
-            def rep(x):
+            def rep(x: Any) -> Any:
                 return x
 
         enable_top_p = self.enable_top_p
         enable_penalties = self.enable_penalties
         top_lp_k = self.top_logprobs
 
-        def sample(logits, keys, temps, greedy, topps, pen=None,
-                   bias=None):
+        def sample(
+            logits: Any, keys: Any, temps: Any, greedy: Any,
+            topps: Any, pen: Optional[tuple] = None,
+            bias: Optional[tuple] = None,
+        ) -> tuple:
             """Returns (token, logprob) — the logprob is the log-softmax at
             the chosen token of the distribution the choice was made from
             (the model's own when no penalties apply), the number the
@@ -131,8 +186,8 @@ class LLMProgramsMixin:
         # batch composition, window size, or mega/pipelined scheduling.
         base_key = jax.random.PRNGKey(self._seed + 2)
 
-        def row_keys(seeds, nsteps):
-            def one(sd, n):
+        def row_keys(seeds: Any, nsteps: Any) -> Any:
+            def one(sd: Any, n: Any) -> Any:
                 return jax.random.fold_in(
                     jax.random.fold_in(base_key, sd), n
                 )
@@ -140,10 +195,13 @@ class LLMProgramsMixin:
             return jax.vmap(one)(seeds, nsteps)
 
         def _prefill_core(
-            params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, aids, noff, use_bias,
-        ):
+            params: Any, cache: Any, tokens: Any, slots: Any, starts: Any,
+            lens: Any, finalize: Any, row_valid: Any, temps: Any,
+            greedy: Any, topps: Any, seeds: Any, all_tokens: Any,
+            all_logps: Any, pcounts: Any, nsteps: Any, bidx: Any,
+            bval: Any, topi: Any, topl: Any, aids: Any, noff: Any,
+            use_bias: bool,
+        ) -> tuple:
             """One [P, c] chunk: write K/V + attend; on rows whose prompt
             finishes (finalize) sample the first token and merge it into
             the decode token vector ON DEVICE. Padding rows duplicate row 0
@@ -201,8 +259,10 @@ class LLMProgramsMixin:
             static_argnames=("use_bias",),
         )(_prefill_core)
 
-        def _multi_chunk_core(params, cache, tokens3, slots, starts0,
-                              n_chunks, history, aids):
+        def _multi_chunk_core(
+            params: Any, cache: Any, tokens3: Any, slots: Any,
+            starts0: Any, n_chunks: Any, history: Any, aids: Any,
+        ) -> tuple:
             """Up to D FULL (non-finalizing) [P, c] chunks in ONE dispatch
             — the long-prompt TTFT amortizer: through a network-attached
             relay every chunk dispatch costs a host↔device RTT, so an 8k
@@ -214,10 +274,10 @@ class LLMProgramsMixin:
             a runtime operand, so one compile serves every prompt length."""
             D, Pb, c = tokens3.shape
 
-            def cond(s):
+            def cond(s: tuple) -> Any:
                 return s[0] < n_chunks
 
-            def body(s):
+            def body(s: tuple) -> tuple:
                 i, cache, history = s
                 toks = jax.lax.dynamic_index_in_dim(
                     tokens3, i, 0, keepdims=False
@@ -242,16 +302,20 @@ class LLMProgramsMixin:
             return cache, history
 
         @partial(jax.jit, donate_argnums=(1,))
-        def prefill_multi_chunk(params, cache, tokens3, slots, starts0,
-                                n_chunks, aids):
+        def prefill_multi_chunk(
+            params: Any, cache: Any, tokens3: Any, slots: Any,
+            starts0: Any, n_chunks: Any, aids: Any,
+        ) -> Any:
             cache, _ = _multi_chunk_core(
                 params, cache, tokens3, slots, starts0, n_chunks, None, aids
             )
             return cache
 
         @partial(jax.jit, donate_argnums=(1, 6))
-        def prefill_multi_chunk_hist(params, cache, tokens3, slots, starts0,
-                                     n_chunks, history, aids):
+        def prefill_multi_chunk_hist(
+            params: Any, cache: Any, tokens3: Any, slots: Any,
+            starts0: Any, n_chunks: Any, history: Any, aids: Any,
+        ) -> tuple:
             return _multi_chunk_core(
                 params, cache, tokens3, slots, starts0, n_chunks, history,
                 aids,
@@ -262,11 +326,13 @@ class LLMProgramsMixin:
             static_argnames=("use_bias",),
         )
         def prefill_chunk_step_hist(
-            params, cache, tokens, slots, starts, lens, finalize, row_valid,
-            temps, greedy, topps, seeds, all_tokens, all_logps, pcounts,
-            nsteps, bidx, bval, topi, topl, aids, noff, history,
-            use_bias=False,
-        ):
+            params: Any, cache: Any, tokens: Any, slots: Any, starts: Any,
+            lens: Any, finalize: Any, row_valid: Any, temps: Any,
+            greedy: Any, topps: Any, seeds: Any, all_tokens: Any,
+            all_logps: Any, pcounts: Any, nsteps: Any, bidx: Any,
+            bval: Any, topi: Any, topl: Any, aids: Any, noff: Any,
+            history: Any, use_bias: bool = False,
+        ) -> tuple:
             """Prefill + record the chunk's tokens into the draft history
             (speculation on). Padding rows duplicate row 0 — idempotent."""
             out = _prefill_core(
@@ -283,13 +349,16 @@ class LLMProgramsMixin:
             history = history.at[slots[:, None], hpos].set(tokens)
             return out + (history,)
 
-        def make_decode_body(params, active, temps, greedy, topps, fpen,
-                             ppen, seeds, bidx, bval, use_bias, aids):
+        def make_decode_body(
+            params: Any, active: Any, temps: Any, greedy: Any, topps: Any,
+            fpen: Any, ppen: Any, seeds: Any, bidx: Any, bval: Any,
+            use_bias: bool, aids: Any,
+        ) -> Any:
             """One decode step (scan body): forward + sample + penalty
             count scatter — shared by the plain window and the mega
             while_loop so the two dispatch modes cannot drift."""
 
-            def body(carry, _):
+            def body(carry: tuple, _: Any) -> tuple:
                 tokens, logps, cache, nsteps, pcounts, topi, topl = carry
                 logits, cache = transformer_decode_step(
                     params, tokens, cache, active, cfg,
@@ -322,9 +391,12 @@ class LLMProgramsMixin:
             jax.jit, static_argnames=("k", "use_bias"),
             donate_argnums=(3, 5, 11, 15, 16),
         )
-        def decode_window(params, tokens, logps, cache, active, nsteps,
-                          temps, greedy, topps, fpen, ppen, pcounts, seeds,
-                          bidx, bval, topi, topl, aids, k, use_bias):
+        def decode_window(
+            params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
+            nsteps: Any, temps: Any, greedy: Any, topps: Any, fpen: Any,
+            ppen: Any, pcounts: Any, seeds: Any, bidx: Any, bval: Any,
+            topi: Any, topl: Any, aids: Any, k: int, use_bias: bool,
+        ) -> tuple:
             """Run k decode steps entirely on device; emit the k
             (token, logprob) pairs that ENTER each step (so a freshly
             prefilled slot's first token is emitted by its first window)
@@ -361,10 +433,13 @@ class LLMProgramsMixin:
             jax.jit, static_argnames=("k", "m", "use_bias"),
             donate_argnums=(3, 5, 11, 15, 16),
         )
-        def mega_window(params, tokens, logps, cache, active, nsteps, temps,
-                        greedy, topps, fpen, ppen, pcounts, seeds, bidx,
-                        bval, topi, topl, remaining, eos_stop, aids, k, m,
-                        use_bias):
+        def mega_window(
+            params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
+            nsteps: Any, temps: Any, greedy: Any, topps: Any, fpen: Any,
+            ppen: Any, pcounts: Any, seeds: Any, bidx: Any, bval: Any,
+            topi: Any, topl: Any, remaining: Any, eos_stop: Any,
+            aids: Any, k: int, m: int, use_bias: bool,
+        ) -> tuple:
             """Up to m k-step windows in ONE dispatch. A device-side
             while_loop runs windows until every slot's `remaining` budget
             is covered (decremented k per window; zeroed when the slot
@@ -386,7 +461,7 @@ class LLMProgramsMixin:
                 if top_lp_k else jnp.zeros((0,), dtype=jnp.float32)
             )
 
-            def win_body(state):
+            def win_body(state: tuple) -> tuple:
                 (w, tokens, logps, cache, nsteps, pcounts, remaining,
                  emitted, etops, topi, topl) = state
                 ((tokens, logps, cache, nsteps, pcounts, topi, topl),
@@ -413,7 +488,7 @@ class LLMProgramsMixin:
                 return (w + 1, tokens, logps, cache, nsteps, pcounts,
                         remaining, emitted, etops, topi, topl)
 
-            def win_cond(state):
+            def win_cond(state: tuple) -> Any:
                 return (state[0] < m) & jnp.any(state[6] > 0)
 
             (w, final, final_lp, cache, nsteps, pcounts, _, emitted, etops,
@@ -427,8 +502,10 @@ class LLMProgramsMixin:
 
         G = self.spec_tokens
 
-        def make_spec_body(params, active, temps, greedy, topps, seeds,
-                           aids):
+        def make_spec_body(
+            params: Any, active: Any, temps: Any, greedy: Any, topps: Any,
+            seeds: Any, aids: Any,
+        ) -> Any:
             """One speculative step (scan body), shared by the plain spec
             window and the mega-spec while_loop."""
             from gofr_tpu.models.transformer import (
@@ -437,7 +514,7 @@ class LLMProgramsMixin:
                 transformer_verify_step,
             )
 
-            def body(carry, _):
+            def body(carry: tuple, _: Any) -> tuple:
                 tokens, logps, cache, nsteps, history = carry
                 sub = row_keys(seeds, nsteps)
                 draft = ngram_draft(history, cache.lengths, tokens, G)
@@ -509,8 +586,11 @@ class LLMProgramsMixin:
         @partial(
             jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9)
         )
-        def spec_window(params, tokens, logps, cache, active, nsteps, temps,
-                        greedy, topps, history, seeds, aids, k):
+        def spec_window(
+            params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
+            nsteps: Any, temps: Any, greedy: Any, topps: Any,
+            history: Any, seeds: Any, aids: Any, k: int,
+        ) -> tuple:
             """k speculative steps on device. Each step drafts G tokens by
             n-gram lookup in the slot's own history, verifies draft+current
             in ONE [S, G+1] forward (cache read-only), accepts the longest
@@ -534,9 +614,12 @@ class LLMProgramsMixin:
         @partial(
             jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 9)
         )
-        def mega_spec_window(params, tokens, logps, cache, active, nsteps,
-                             temps, greedy, topps, history, seeds, remaining,
-                             eos_stop, aids, k, m):
+        def mega_spec_window(
+            params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
+            nsteps: Any, temps: Any, greedy: Any, topps: Any,
+            history: Any, seeds: Any, remaining: Any, eos_stop: Any,
+            aids: Any, k: int, m: int,
+        ) -> tuple:
             """Mega × speculation: up to m k-step spec windows in ONE
             dispatch. `remaining` decrements by the ACTUAL emitted token
             counts (speculation emits ≥ k per window per live slot, so
@@ -549,7 +632,7 @@ class LLMProgramsMixin:
             emitted0 = jnp.zeros((2, m * k, S, G + 1), dtype=jnp.float32)
             ecnt0 = jnp.zeros((m * k, S), dtype=jnp.int32)
 
-            def win_body(state):
+            def win_body(state: tuple) -> tuple:
                 (w, tokens, logps, cache, nsteps, history, remaining,
                  emitted, ecnt) = state
                 ((tokens, logps, cache, nsteps, history),
@@ -576,7 +659,7 @@ class LLMProgramsMixin:
                 return (w + 1, tokens, logps, cache, nsteps, history,
                         remaining, emitted, ecnt)
 
-            def win_cond(state):
+            def win_cond(state: tuple) -> Any:
                 return (state[0] < m) & jnp.any(state[6] > 0)
 
             ((w, final, final_lp, cache, nsteps, history, _, emitted,
@@ -588,21 +671,35 @@ class LLMProgramsMixin:
             return (rep(emitted), rep(ecnt), rep(w), final, final_lp, cache,
                     nsteps, history)
 
-        self._prefill_chunk_step = prefill_chunk_step
-        self._prefill_chunk_step_hist = prefill_chunk_step_hist
-        self._prefill_multi_chunk = prefill_multi_chunk
-        self._prefill_multi_chunk_hist = prefill_multi_chunk_hist
-        self._decode_window = decode_window
-        self._mega_window = mega_window
-        self._spec_window = spec_window
-        self._mega_spec_window = mega_spec_window
+        # Compile tracking (serving/device_telemetry.py): every serving
+        # program is wrapped so each XLA cache growth counts under its
+        # program name — and a compile after the warm-up fence bumps
+        # the steady-state recompile counter, the dynamic twin of
+        # graftlint GL015's static jit-in-request-path check.
+        wrap = self._compiles.wrap
+        self._prefill_chunk_step = wrap("prefill_chunk", prefill_chunk_step)
+        self._prefill_chunk_step_hist = wrap(
+            "prefill_chunk_hist", prefill_chunk_step_hist
+        )
+        self._prefill_multi_chunk = wrap(
+            "prefill_multi_chunk", prefill_multi_chunk
+        )
+        self._prefill_multi_chunk_hist = wrap(
+            "prefill_multi_chunk_hist", prefill_multi_chunk_hist
+        )
+        self._decode_window = wrap("decode_window", decode_window)
+        self._mega_window = wrap("mega_window", mega_window)
+        self._spec_window = wrap("spec_window", spec_window)
+        self._mega_spec_window = wrap("mega_spec_window", mega_spec_window)
 
 
     # ------------------------------------------------------------------
     # profiling (bench harness; VERDICT r1 weak #4 — know where time goes)
     # ------------------------------------------------------------------
 
-    def profile_decode(self, n_windows: int = 8, prompt_len: int = 16) -> dict:
+    def profile_decode(
+        self, n_windows: int = 8, prompt_len: int = 16
+    ) -> dict:
         """Measure device-only decode window time and the host↔device fetch
         RTT, with the engine stopped. Chains ``n_windows`` windows
         back-to-back with one final block, so the relay RTT amortizes out:
@@ -661,7 +758,7 @@ class LLMProgramsMixin:
         pdev = jnp.ones((B,), dtype=jnp.float32)
         gdev = jnp.ones((B,), dtype=bool)
 
-        def window():
+        def window() -> Any:
             out = self._decode_window(
                 self.params, self._tokens_dev, self._logps_dev, self.cache,
                 active, self._nsteps_dev, tdev, gdev, pdev,

@@ -64,6 +64,8 @@ class SchedulerMixin:
     mega_windows: int
     tier_role: str
     prefix_evict_watermark: int
+    effective_evict_watermark: int
+    prefix_evict_hbm_frac: float
     _wm_fruitless: "Optional[tuple[int, int]]"
     n_slots: int
     pipeline_depth: int
@@ -98,6 +100,8 @@ class SchedulerMixin:
     _watchdog: Any
     _metrics: Any
     _obs: Any  # serving.observability.RequestObservability
+    _ledger: Any  # Optional[serving.device_telemetry.HBMLedger]
+    _compiles: Any  # serving.device_telemetry.CompileTracker
     _logger: Any
     _tput: Any  # lifecycle.AggregateThroughput
     tokenizer: Any
@@ -141,8 +145,14 @@ class SchedulerMixin:
     _spec_window: Any
     _mega_window: Any
     _mega_spec_window: Any
+    # Compile-tracked paged-pool jits (engine._init_llm_serving_state
+    # wraps ops.kv_cache.paged_{copy,insert}_block per engine).
+    _paged_copy_block: Any
+    _paged_insert_block: Any
     _note_dequeued: Any
     _set_state: Any
+    hbm_headroom_ratio: Any
+    _kv_pool_counts: Any
     try_handoff: Any
 
     def _check_superseded(self) -> None:
@@ -644,11 +654,10 @@ class SchedulerMixin:
                 self._allocator.decref(src)
                 done = min(len(row) * B, len(pids) - 1)
             else:
-                from gofr_tpu.ops.kv_cache import paged_copy_block
-
                 # Table upload can ride the next _push_table — the copy
-                # only touches pool planes, not the table.
-                self.cache = paged_copy_block(
+                # only touches pool planes, not the table (compile-
+                # tracked: the COW jit is one program per geometry).
+                self.cache = self._paged_copy_block(
                     self.cache,
                     self._up(np.int32(src)),
                     self._up(np.int32(dst)),
@@ -742,8 +751,6 @@ class SchedulerMixin:
         chain, matched = radix.lookup(ids, 0)
         start = matched // B
         imported = 0
-        from gofr_tpu.ops.kv_cache import paged_insert_block
-
         for j in range(start, payload.n_blocks):
             bid = self._alloc_block()
             if bid is None:
@@ -759,7 +766,7 @@ class SchedulerMixin:
                     self._up(payload.k_s[:, j]),
                     self._up(payload.v_s[:, j]),
                 ]
-            self.cache = paged_insert_block(*args)
+            self.cache = self._paged_insert_block(*args)
             chain.append(bid)
             imported += 1
         n = start + imported
@@ -884,8 +891,14 @@ class SchedulerMixin:
         admission under pressure finds free blocks waiting instead of
         paying a synchronous pre-evict scan inside its own grow. 0
         (default) = off: eviction happens only on allocation shortfall,
-        exactly the pre-watermark behavior."""
-        wm = self.prefix_evict_watermark
+        exactly the pre-watermark behavior.
+
+        The EFFECTIVE watermark is resolved at boot: the explicit
+        block-count knob when set, else derived from the HBM ledger's
+        headroom target (``TPU_PREFIX_EVICT_HBM_FRAC`` — keep
+        frac×budget of device HBM free, converted to blocks via the
+        pool's bytes-per-block)."""
+        wm = self.effective_evict_watermark
         if not wm or self._radix is None:
             return
         short = wm - self._allocator.n_free
@@ -1951,6 +1964,29 @@ class SchedulerMixin:
         self._metrics.set_gauge(
             "app_tpu_queue_depth", self._pending.qsize(), "batcher", "generate"
         )
+        # Saturation signals (device_telemetry): headroom is O(1)
+        # arithmetic over the allocator's free count; occupancy and
+        # fragmentation are two divisions. All host values already in
+        # hand — no device pulls, window granularity.
+        self._metrics.set_gauge(
+            "app_tpu_hbm_headroom_ratio", self.hbm_headroom_ratio(),
+            "model", self.model_name,
+        )
+        if self.kv_block:
+            total, used, cached = self._kv_pool_counts()
+            self._metrics.set_gauge(
+                "app_tpu_kv_pool_occupancy_ratio", used / max(1, total),
+                "model", self.model_name,
+            )
+            # The used pool's radix-cached (reclaimable-under-pressure)
+            # share: high occupancy + high fragmentation = pressure the
+            # eviction watermark can relieve; high occupancy + LOW
+            # fragmentation = live streams genuinely need the blocks.
+            self._metrics.set_gauge(
+                "app_tpu_kv_pool_fragmentation_ratio",
+                (cached / used) if used else 0.0,
+                "model", self.model_name,
+            )
         try:
             stats = self._jax.local_devices()[0].memory_stats() or {}
             if "bytes_in_use" in stats:

@@ -92,6 +92,22 @@ def test_debug_flight_serves_request_timelines(debug_app):
         assert phase in entry["phases"], entry["phases"]
 
 
+def test_debug_capacity_reports_device_resources(debug_app):
+    """/debug/capacity (docs/advanced-guide/observability.md
+    "Device-resource signals"): the HBM ledger, XLA compile counts,
+    and the steady-state recompile counter on the ops port."""
+    st, body = _metrics_get(debug_app, "/debug/capacity")
+    assert st == 200
+    caps = json.loads(body)
+    report = caps["tpu"]
+    assert report["model"] == "llama-tiny"
+    comps = report["hbm"]["components"]
+    assert comps["params"] > 0 and comps["kv_pool"] > 0
+    assert report["hbm"]["total_bytes"] == sum(comps.values())
+    assert 0.0 <= report["hbm"]["headroom_ratio"] <= 1.0
+    assert report["compiles"]["steady_state_recompiles"] == 0
+
+
 def test_debug_tpu_trace_validates_and_captures(debug_app):
     st, body = _metrics_get(debug_app, "/debug/tpu-trace?ms=nope")
     assert st == 400 and b"integer" in body

@@ -1,0 +1,558 @@
+"""Device-resource observability suite (ISSUE 11 acceptance gate).
+
+Deterministic throughout: real engines on the conftest's 8 virtual CPU
+devices (no sleeps as synchronization — sweeps are driven directly with
+the scheduler stopped), an in-memory span collector, and exact-count
+assertions against the compile tracker.
+
+Covered:
+
+* the HBM ledger's component sum equals the engine's actual accounting
+  — ``kv_pool`` is exactly ``cache.hbm_bytes()`` and ``params + lora``
+  exactly ``quantized_bytes(engine.params)`` — at **tp=1 AND tp=2**
+  (global bytes are tp-invariant; ``per_device_bytes`` divides);
+* THE acceptance path: zero ``app_tpu_steady_state_recompiles_total``
+  across a mixed cold + prefix-warm + seeded-sampled + LoRA workload
+  after the warm-up fence;
+* a genuinely new program variant AFTER the fence is detected and
+  counted (the logit-bias compile choice);
+* ``tpu.compile`` spans parent under the trace that was ambient at
+  engine construction (a traced boot owns its warm-up compiles even
+  though they fire on the scheduler thread);
+* ``TPU_PREFIX_EVICT_HBM_FRAC`` derives the block watermark from the
+  ledger, with ``TPU_PREFIX_EVICT_WM`` as the explicit override —
+  both precedence orders — and the derived watermark actually sweeps
+  the radix cache;
+* ``/debug/capacity`` JSON shape, engine- and pool-shaped;
+* headroom advertised through a pool probe (describe / flight
+  records), admission's headroom floor, and the pool scaler's
+  headroom-pressure scale-up.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.container import Container
+from gofr_tpu.errors import ErrorTooManyRequests
+from gofr_tpu.ops.quant import quantized_bytes
+from gofr_tpu.serving.device_telemetry import HBMLedger
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+from gofr_tpu.service.pool_scaler import PoolScaler
+from gofr_tpu.service.replica_pool import EngineReplica, Replica, ReplicaPool
+from gofr_tpu.tracing import Tracer, get_tracer, set_tracer
+
+#: Shared serving geometry: one compile set per mesh placement.
+ENG_KW = dict(
+    n_slots=4, max_len=256, window_k=4, pipeline_depth=1,
+    prefill_chunk=32, kv_block=32, auto_prefix=True,
+)
+
+#: 96 tokens = exactly 3 full 32-token KV blocks: retirement caches
+#: full-block prefixes and a repeat hits the COW boundary.
+PROMPT = list(range(2, 200, 3)) + [7] * 30
+assert len(PROMPT) == 96
+
+
+@pytest.fixture(scope="module")
+def metrics():
+    return Container.create(
+        MockConfig({"APP_NAME": "devtel-test"})
+    ).metrics
+
+
+def _make_engine(metrics=None, start=True, **kw):
+    eng = InferenceEngine(
+        "llama-tiny", tokenizer=ByteTokenizer(), metrics=metrics,
+        **{**ENG_KW, **kw},
+    )
+    if start:
+        eng.start_sync()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def eng_lora(metrics):
+    """The shared workhorse engine (module-scoped — engine boots and
+    first-dispatch compiles dominate this suite's wall clock): paged +
+    auto-prefix + one adapter slot. Tests on it are order-independent:
+    compile assertions are delta-based and the ledger/capacity
+    invariants hold whether or not another test generated first."""
+    eng = _make_engine(metrics, lora_slots=1, lora_rank=4)
+    yield eng
+    eng.close()
+
+
+@pytest.fixture(scope="module")
+def lora_pool(eng_lora, metrics):
+    pool = ReplicaPool(
+        [EngineReplica("shared-0", eng_lora)], metrics=metrics
+    )
+    yield pool
+    # Detach only: the engine belongs to its own fixture.
+    eng_lora.set_replica_handoff(None)
+
+
+def _gauge(metrics, name, **labels):
+    inst = {i.name: i for i in metrics.instruments()}[name]
+    for key, value in inst.collect().items():
+        if all((k, str(v)) in key for k, v in labels.items()):
+            return value
+    return None
+
+
+def _counter_total(metrics, name, **labels):
+    inst = {i.name: i for i in metrics.instruments()}[name]
+    total = 0.0
+    for key, value in inst.collect().items():
+        if all((k, str(v)) in key for k, v in labels.items()):
+            total += value
+    return total
+
+
+class _CaptureExporter:
+    """In-memory span sink; ``is_noop`` absent → the tracer is ACTIVE."""
+
+    def __init__(self):
+        self.spans = []
+        self._lock = threading.Lock()
+
+    def export(self, span, service_name):
+        with self._lock:
+            self.spans.append(span)
+
+    def by_name(self, name):
+        with self._lock:
+            return [s for s in self.spans if s.name == name]
+
+
+# ----------------------------------------------------------------------
+# the HBM ledger agrees with the engine's own accounting — tp=1 and tp=2
+# ----------------------------------------------------------------------
+
+
+def _assert_ledger_exact(eng):
+    snap = eng.hbm_ledger()
+    comps = snap["components"]
+    # The pool component IS the cache's own accounting, to the byte.
+    assert comps["kv_pool"] == eng.cache.hbm_bytes()
+    # params + adapter planes == the whole quantized weight tree.
+    assert comps["params"] + comps.get("lora", 0) == quantized_bytes(
+        eng.params
+    )
+    assert snap["total_bytes"] == sum(comps.values())
+    assert comps["workspace"] > 0
+    assert 0.0 <= snap["headroom_ratio"] <= 1.0
+    return snap
+
+
+def test_hbm_ledger_matches_engine_accounting_tp1(eng_lora, metrics):
+    snap = _assert_ledger_exact(eng_lora)
+    assert snap["mesh_devices"] == 1
+    assert snap["per_device_bytes"] == snap["total_bytes"]
+    assert snap["components"]["lora"] > 0
+    # With no platform memory_stats and no TPU_HBM_BYTES the budget
+    # falls back to the ledger's own footprint.
+    assert snap["budget_source"] == "ledger"
+    assert snap["budget_bytes"] == snap["per_device_bytes"]
+    # Per-component gauges exported at boot (every ENG_KW engine shares
+    # the pool geometry, so the kv_pool gauge is stable across the
+    # suite's engines regardless of test order).
+    assert _gauge(
+        metrics, "app_tpu_hbm_bytes", component="kv_pool"
+    ) == snap["components"]["kv_pool"]
+    assert _gauge(
+        metrics, "app_tpu_hbm_headroom_ratio", model="llama-tiny"
+    ) is not None
+
+
+def test_hbm_ledger_matches_engine_accounting_tp2(metrics):
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 2, "suite needs the conftest's 8 virtual devices"
+    eng = _make_engine(metrics, tp=2, devices=devs[:2])
+    try:
+        snap = _assert_ledger_exact(eng)  # global bytes: tp-invariant
+        assert snap["mesh_devices"] == 2
+        # Sharded components divide across the mesh; replicated
+        # workspace does not — per-device strictly between total/2 and
+        # total.
+        assert (
+            snap["total_bytes"] // 2
+            <= snap["per_device_bytes"]
+            < snap["total_bytes"]
+        )
+    finally:
+        eng.close()
+
+
+def test_explicit_budget_wins_and_headroom_uses_it():
+    eng = _make_engine(hbm_budget_bytes=1 << 30, start=False)
+    try:
+        snap = eng.hbm_ledger()
+        assert snap["budget_source"] == "env"
+        assert snap["budget_bytes"] == 1 << 30
+        # A huge budget over a tiny engine: headroom ≈ 1.
+        assert eng.hbm_headroom_ratio() > 0.99
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# compile tracker: THE acceptance path — zero steady-state recompiles
+# across a mixed workload after warm-up
+# ----------------------------------------------------------------------
+
+
+def test_zero_steady_state_recompiles_across_mixed_workload(
+    eng_lora, metrics
+):
+    from gofr_tpu.models.transformer import lora_dims
+    import jax
+
+    eng = eng_lora
+    leaves = {}
+    for ti, t in enumerate(("wq", "wk", "wv", "wo")):
+        d_in, d_out = lora_dims(eng.cfg, t)
+        k1, k2 = jax.random.split(
+            jax.random.fold_in(jax.random.PRNGKey(9), ti), 2
+        )
+        leaves[t] = (
+            0.02 * jax.random.normal(k1, (eng.cfg.n_layers, d_in, 4)),
+            0.02 * jax.random.normal(k2, (eng.cfg.n_layers, 4, d_out)),
+        )
+    eng.load_lora("mixed-test", leaves)
+
+    def run(prompt, **kw):
+        return eng.generate_sync(
+            prompt, max_new_tokens=6, stop_on_eos=False, **kw
+        )
+
+    # Warm-up: one request per program variant the mixed workload
+    # will exercise — cold greedy, seeded sampled, LoRA, and an
+    # IDENTICAL repeat (whole-prompt prefix hit → the COW boundary
+    # compiles paged_copy_block).
+    run(PROMPT, temperature=0.0)
+    run(PROMPT, temperature=0.0)
+    run(PROMPT, temperature=0.8, seed=7)
+    run(PROMPT, temperature=0.0, adapter="mixed-test")
+    warm_stats = eng.compile_stats()
+    assert warm_stats["total"] >= 2
+    assert not warm_stats["warm"]
+
+    steady_before = _counter_total(
+        metrics, "app_tpu_steady_state_recompiles_total"
+    )
+    eng.mark_steady_state()
+    assert eng.compile_stats()["warm"]
+
+    # The mixed steady-state workload: a NEW cold prompt, the warm
+    # repeat (prefix alias + COW), seeded sampling, LoRA — all
+    # through the already-compiled fixed-shape programs.
+    cold = list(range(3, 150, 2))
+    run(cold, temperature=0.0)
+    run(PROMPT, temperature=0.0)
+    run(PROMPT, temperature=0.9, seed=11)
+    run(PROMPT, temperature=0.0, adapter="mixed-test")
+
+    stats = eng.compile_stats()
+    assert stats["steady_state_recompiles"] == 0, stats
+    assert stats["total"] == warm_stats["total"], stats
+    assert _counter_total(
+        metrics, "app_tpu_steady_state_recompiles_total"
+    ) == steady_before
+    # Total compiles exported per program.
+    assert _counter_total(
+        metrics, "app_tpu_compiles_total", model="llama-tiny"
+    ) >= stats["total"]
+
+
+def test_steady_state_recompile_detected_and_counted(metrics):
+    eng = _make_engine(metrics)
+    try:
+        eng.generate_sync(
+            PROMPT, max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        before = _counter_total(
+            metrics, "app_tpu_steady_state_recompiles_total"
+        )
+        eng.mark_steady_state()
+        # A program VARIANT never exercised during warm-up: logit_bias
+        # flips the use_bias static arg — a genuinely new compile, the
+        # exact bug class the fence exists to catch.
+        eng.generate_sync(
+            PROMPT, max_new_tokens=4, temperature=0.0, stop_on_eos=False,
+            logit_bias={1: 5.0},
+        )
+        stats = eng.compile_stats()
+        assert stats["steady_state_recompiles"] >= 1, stats
+        assert _counter_total(
+            metrics, "app_tpu_steady_state_recompiles_total"
+        ) > before
+        # The flight surface carries the headline too.
+        assert eng.flight_records()["steady_state_recompiles"] >= 1
+    finally:
+        eng.close()
+
+
+def test_compile_span_parents_under_boot_trace():
+    old = get_tracer()
+    cap = _CaptureExporter()
+    set_tracer(Tracer(service_name="devtel-test", exporter=cap))
+    try:
+        tracer = get_tracer()
+        boot = tracer.start_span("tpu.boot")
+        try:
+            # Tracker construction captures the ambient boot span…
+            eng = _make_engine()
+        finally:
+            boot.end()
+        try:
+            # …and the compiles fire LATER, on the scheduler thread
+            # (no ambient span there) — they must still join the boot
+            # trace.
+            eng.generate_sync(
+                PROMPT, max_new_tokens=4, temperature=0.0,
+                stop_on_eos=False,
+            )
+            spans = cap.by_name("tpu.compile")
+            assert spans, [s.name for s in cap.spans]
+            for span in spans:
+                assert span.trace_id == boot.trace_id
+                assert span.parent_id == boot.span_id
+                assert span.attributes["tpu.steady_state"] is False
+                assert span.attributes["tpu.program"]
+                assert span.end_ns >= span.start_ns
+        finally:
+            eng.close()
+    finally:
+        set_tracer(old)
+
+
+# ----------------------------------------------------------------------
+# HBM-frac eviction watermark: derivation, precedence, behavior
+# ----------------------------------------------------------------------
+
+
+def test_ledger_derives_block_watermark_exactly():
+    # Unit arithmetic, no engine: budget 1000, per-device total 700
+    # (slack 300), 10-block pool at 50 B/block. frac=0.5 wants 500 B
+    # free → 200 B beyond slack → ceil(200/50) = 4 blocks.
+    ledger = HBMLedger(
+        {"params": 600, "kv_pool": 100},
+        block_bytes=50, n_blocks=10, budget_bytes=1000,
+    )
+    assert ledger.per_device_bytes == 700
+    assert ledger.derive_block_watermark(0.5) == 4
+    # Slack already covers the target → no blocks needed.
+    assert ledger.derive_block_watermark(0.3) == 0
+    # Impossible target clamps to the pool minus the parking block.
+    assert ledger.derive_block_watermark(5.0) == 9
+    assert ledger.derive_block_watermark(0.0) == 0
+    # Headroom: slack 300 + 2 free blocks × 50 = 400 over 1000.
+    assert ledger.headroom_ratio(free_blocks=2) == pytest.approx(0.4)
+
+
+def test_watermark_precedence_both_orders():
+    # Explicit only.
+    eng = _make_engine(prefix_evict_watermark=3, start=False)
+    try:
+        assert eng.effective_evict_watermark == 3
+    finally:
+        eng.close()
+    # Frac only → derived from the ledger (> 0: frac 1.0 of the budget
+    # can only be covered by freeing pool blocks).
+    eng = _make_engine(prefix_evict_hbm_frac=1.0, start=False)
+    try:
+        derived = eng._ledger.derive_block_watermark(1.0)
+        assert derived > 0
+        assert eng.effective_evict_watermark == derived
+    finally:
+        eng.close()
+    # Both set → the explicit block count wins (the carried ROADMAP
+    # contract: TPU_PREFIX_EVICT_WM stays the override).
+    eng = _make_engine(
+        prefix_evict_watermark=2, prefix_evict_hbm_frac=1.0, start=False
+    )
+    try:
+        assert eng.effective_evict_watermark == 2
+    finally:
+        eng.close()
+    # Neither → off.
+    eng = _make_engine(start=False)
+    try:
+        assert eng.effective_evict_watermark == 0
+    finally:
+        eng.close()
+
+
+def test_hbm_frac_watermark_sweeps_radix_under_pressure(metrics):
+    # frac=1.0: the whole budget must be free-able → the derived
+    # watermark clamps to every allocatable block, so ANY radix-cached
+    # block is pressure the sweep must relieve.
+    eng = _make_engine(metrics, prefix_evict_hbm_frac=1.0)
+    try:
+        total = eng.cache.n_blocks - 1
+        assert eng.effective_evict_watermark == total
+        eng.generate_sync(
+            PROMPT, max_new_tokens=4, temperature=0.0, stop_on_eos=False
+        )
+        eng.stop_sync()  # drive the sweep directly, no scheduler race
+        # Retirement inserted the prompt's full blocks into the radix…
+        # (the running scheduler may already have swept them — run one
+        # explicit sweep either way and assert the watermark HOLDS).
+        eng._radix_watermark_sweep()
+        assert eng._radix.n_cached_blocks == 0
+        assert eng._allocator.n_free == total
+        assert eng.hbm_headroom_ratio() == pytest.approx(
+            eng._ledger.headroom_ratio(total)
+        )
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# /debug/capacity shapes + headroom through the pool
+# ----------------------------------------------------------------------
+
+
+def test_capacity_report_shape_engine_and_pool(eng_lora, lora_pool):
+    eng, pool = eng_lora, lora_pool
+    report = eng.capacity_report()
+    assert report["model"] == "llama-tiny"
+    assert set(report["hbm"]["components"]) >= {
+        "params", "kv_pool", "workspace",
+    }
+    assert report["compiles"]["total"] >= 0
+    assert "steady_state_recompiles" in report["compiles"]
+    pool_kv = report["kv_pool"]
+    assert pool_kv["total_blocks"] == eng.cache.n_blocks - 1
+    assert (
+        pool_kv["free_blocks"] + pool_kv["used_blocks"]
+        == pool_kv["total_blocks"]
+    )
+    assert pool_kv["evict_watermark_source"] == "off"
+
+    agg = pool.capacity_report()
+    entry = agg["replicas"]["shared-0"]
+    assert entry["state"] == "SERVING"
+    assert entry["role"] == "fused"
+    assert entry["hbm"]["total_bytes"] == report["hbm"]["total_bytes"]
+    assert 0.0 < entry["hbm_headroom"] <= 1.0
+    assert agg["tier_mode"] == "fused"
+
+
+def test_headroom_advertised_through_pool_probe(eng_lora, lora_pool):
+    eng, pool = eng_lora, lora_pool
+    assert pool.probe_once() == {"shared-0": "pass"}
+    replica = pool.replicas[0]
+    desc = replica.describe()
+    assert 0.0 < desc["hbm_headroom"] <= 1.0
+    # Health carries the compact ledger (what a remote pool's
+    # probe lifts into ITS descriptor over the wire).
+    details = eng.health_check()["details"]
+    assert details["hbm_ledger"]["headroom_ratio"] == pytest.approx(
+        desc["hbm_headroom"], abs=1e-4
+    )
+    assert details["hbm_ledger"]["components"]["kv_pool"] > 0
+    assert details["compiles"]["steady_state_recompiles"] == 0
+    # Flight records stamp the headline per replica.
+    flights = pool.flight_records()
+    assert (
+        0.0 < flights["replicas"]["shared-0"]["hbm_headroom"] <= 1.0
+    )
+
+
+def test_admission_sheds_below_headroom_floor(metrics):
+    # A floor above 1.0 is unreachable → every submit sheds 429 with
+    # the hbm_headroom reason (the real-world case — a nearly-full
+    # pool — just moves the ratio, not the mechanism).
+    eng = _make_engine(metrics, admit_min_headroom=1.1)
+    try:
+        before = _counter_total(
+            metrics, "app_tpu_requests_shed_total", reason="hbm_headroom"
+        )
+        with pytest.raises(ErrorTooManyRequests):
+            eng.submit_generate(PROMPT, max_new_tokens=4)
+        assert _counter_total(
+            metrics, "app_tpu_requests_shed_total", reason="hbm_headroom"
+        ) == before + 1
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# pool scaler reads the same headroom signal
+# ----------------------------------------------------------------------
+
+
+class _HeadroomStub(Replica):
+    supports_stream = True
+
+    def __init__(self, name, load=0, headroom=None):
+        super().__init__(name)
+        self.load_value = load
+        self.headroom_value = headroom
+
+    def state(self):
+        return "SERVING"
+
+    def load(self):
+        return self.load_value
+
+    def headroom(self):
+        return self.headroom_value
+
+    def set_handoff(self, handoff):
+        pass
+
+
+def test_scaler_scales_up_on_sustained_low_headroom(metrics):
+    spawned = []
+
+    def spawn():
+        replica = _HeadroomStub(f"scaled-{len(spawned)}", headroom=0.9)
+        spawned.append(replica)
+        return replica
+
+    # Queue looks SHALLOW (load 0) but the pool is nearly out of HBM —
+    # the exact pressure the queue-depth signal never sees.
+    a = _HeadroomStub("a", load=0, headroom=0.02)
+    pool = ReplicaPool([a], metrics=metrics)
+    scaler = PoolScaler(
+        pool, spawn, min_replicas=1, max_replicas=3,
+        up_headroom_floor=0.1, scale_up_wait_s=10.0, interval_s=0,
+        sleep=lambda s: None, metrics=metrics,
+    )
+    # Sustain window applies to headroom pressure exactly like load.
+    assert scaler.evaluate(now=0.0) == "steady"
+    assert scaler.evaluate(now=9.9) == "steady"
+    assert scaler.evaluate(now=10.0) == "up"
+    assert len(pool.replicas) == 2
+    # The spawned replica's healthy headroom lifts the worst-of above
+    # the floor → steady.
+    a.headroom_value = 0.9
+    assert scaler.evaluate(now=20.0) == "steady"
+    # None-advertising replicas (remotes pre-probe) are not pressure.
+    a.headroom_value = None
+    spawned[0].headroom_value = None
+    assert scaler.evaluate(now=30.0) == "steady"
+    pool.close()
+
+
+def test_scaler_headroom_floor_off_by_default(metrics):
+    a = _HeadroomStub("a", load=0, headroom=0.0)
+    pool = ReplicaPool([a], metrics=metrics)
+    scaler = PoolScaler(
+        pool, lambda: _HeadroomStub("x"), min_replicas=1, max_replicas=3,
+        scale_up_wait_s=10.0, interval_s=0, sleep=lambda s: None,
+    )
+    for t in (0.0, 10.0, 20.0):
+        assert scaler.evaluate(now=t) == "steady"
+    assert len(pool.replicas) == 1
+    pool.close()

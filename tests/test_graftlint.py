@@ -1,5 +1,5 @@
 """graftlint rule-by-rule suite: one positive and one negative fixture
-per rule (GL001–GL014), suppression syntax, baseline round-trip/drift,
+per rule (GL001–GL015), suppression syntax, baseline round-trip/drift,
 CLI exit codes, and the gate that keeps the committed baseline in sync
 with the tree."""
 
@@ -925,6 +925,92 @@ def test_gl014_accepts_export_seam_placed_puts_and_cold_files(tmp_path):
             return jax.device_put(x)  # boot path, out of scope
         """,
         select=["GL014"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL015 — jax.jit created inside a per-request function body
+# ----------------------------------------------------------------------
+
+
+def test_gl015_flags_jit_built_in_request_path(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/pipeline.py",
+        """
+        import jax
+        from functools import partial
+
+        def handle_generate(self, tokens):
+            step = jax.jit(lambda t: t + 1)  # fresh program per request
+            return step(tokens)
+
+        def _decode_once(self, params, x):
+            fn = partial(jax.jit, donate_argnums=(0,))(self._fwd)
+            return fn(params, x)
+        """,
+        select=["GL015"],
+    )
+    assert ids == ["GL015", "GL015"]
+    assert "per-request" in findings[0].message
+
+
+def test_gl015_exempts_module_scope_builders_and_boot(tmp_path):
+    # Module scope, _build_*/*_program builders (exemption inherited by
+    # their nested defs), __init__/_init* boot paths, and the loader
+    # files are the negative space; calling an already-built program in
+    # a request path is of course fine.
+    ids, _ = _lint(
+        tmp_path, "serving/steps.py",
+        """
+        import jax
+        from functools import partial
+
+        shared_step = jax.jit(lambda t: t + 1)  # module scope
+
+        class EngineBits:
+            def __init__(self):
+                self._cache_init = jax.jit(self._make_cache)
+
+            def _init_serving_state(self):
+                self._pool = jax.jit(self._make_pool)()
+
+            def _build_steps(self):
+                @partial(jax.jit, donate_argnums=(1,))
+                def decode(params, cache):
+                    return params, cache
+
+                self._decode = decode
+
+            def sampling_program(self):
+                return jax.jit(self._sample)
+
+            def handle(self, tokens):
+                return self._decode(tokens)  # CALLING a program: fine
+        """,
+        select=["GL015"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "serving/hf_loader.py",
+        """
+        import jax
+
+        def load_leaf(x):
+            return jax.jit(lambda v: v)(x)  # loader module, out of scope
+        """,
+        select=["GL015"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "ops/kernels.py",
+        """
+        import jax
+
+        def helper(x):
+            return jax.jit(lambda v: v)(x)  # outside serving/
+        """,
+        select=["GL015"],
     )
     assert ids == []
 

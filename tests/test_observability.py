@@ -435,10 +435,14 @@ def test_failover_mid_stream_keeps_one_trace_and_flight_timeline(
             "tpu.decode", "tpu.replay", "tpu.failover",
         ):
             assert needed in span_names, needed
+        # tpu.compile spans are the one deliberate exception: a warm-up
+        # compile belongs to the ENGINE's boot trace (or its own), not
+        # to whichever request happened to trigger it — the request's
+        # trace must still be complete without them.
         assert all(
             s.trace_id == root.trace_id
             for s in capture.spans
-            if s.name.startswith("tpu.")
+            if s.name.startswith("tpu.") and s.name != "tpu.compile"
         )
         failover_span = capture.by_name("tpu.failover")[0]
         assert failover_span.attributes["source"] == "a"
