@@ -33,7 +33,13 @@ tp=1 then a tp=2 engine — token-identity enforced, the tp-invariance
 contract — emitting tp1_tps/tp2_tps/tp_speedup in one JSON line; on the
 CPU backend 8 virtual devices are forced and the row is degraded/NOT
 comparable, it exists so the perf trajectory captures sharded-engine
-step time until a real TPU window lands).
+step time until a real TPU window lands),
+BENCH_TENANT_WORKLOAD=1 (mixed-tenant burst: one hog tenant floods the
+queue while BENCH_TENANTS=3 well-behaved tenants submit small requests;
+the same burst runs with fairness shedding off then on
+(BENCH_TENANT_FAIR_SHARE=0.3) and the JSON line carries tenant_count,
+per-tenant tok/s spread, the well-behaved tenants' TTFT under both
+policies, hog fair-share shed counts, and the TTFT SLO's 5m burn rate).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
 steady-state; the reported value is then the mid-window sustained rate,
 with the end-to-end rate in e2e_tps; set both to 0 for the synchronized
@@ -514,6 +520,166 @@ def _prefix_workload(on_tpu: bool) -> None:
     os._exit(0)
 
 
+def _tenant_workload(on_tpu: bool) -> None:
+    """BENCH_TENANT_WORKLOAD=1: mixed-tenant burst — one hog tenant
+    floods the queue with long-prompt requests while N well-behaved
+    tenants submit small interactive ones, the shape a multi-tenant pod
+    degrades under today. Runs the SAME burst twice: fairness shedding
+    off, then on (``TPU_TENANT_FAIR_SHARE``, default
+    BENCH_TENANT_FAIR_SHARE=0.3) — the A/B that decides whether the
+    hog's burst degrades the hog or the fleet. Reports per-tenant tok/s
+    spread, the well-behaved tenants' TTFT under both policies, the
+    hog's fair-share shed count, and the TTFT SLO's 5m burn rate.
+    Self-contained: paged engine, no profile phase, CPU-safe."""
+    from gofr_tpu.errors import ErrorTooManyRequests
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny"
+    )
+    n_tenants = int(os.environ.get("BENCH_TENANTS", "3"))
+    wb_requests = int(os.environ.get("BENCH_REQUESTS", "4"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "16" if on_tpu else "8"))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "2"))
+    max_len = int(os.environ.get("BENCH_MAX_LEN", "256"))
+    kv_block = int(os.environ.get("BENCH_KV_BLOCK", "32"))
+    hog_requests = int(os.environ.get("BENCH_HOG_REQUESTS", "16"))
+    fair_share = float(os.environ.get("BENCH_TENANT_FAIR_SHARE", "0.3"))
+    slo_ttft_ms = float(os.environ.get("BENCH_SLO_TTFT_MS", "1000"))
+
+    log(f"bench[tenant]: model={model} tenants={n_tenants} "
+        f"wb_requests={wb_requests} hog_requests={hog_requests} "
+        f"fair_share={fair_share} slots={n_slots}")
+
+    def run(share: float) -> dict:
+        _set_stage(f"engine-init-fair{share}")
+        engine = InferenceEngine(
+            model, n_slots=n_slots, max_len=max_len,
+            tokenizer=ByteTokenizer(),
+            window_k=int(os.environ.get("BENCH_WINDOW", "8")),
+            pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
+            kv_block=kv_block,
+            # The queue-token budget the fair share divides: small
+            # enough that the hog's flood saturates it.
+            queue_max_tokens=int(os.environ.get(
+                "BENCH_QUEUE_TOKENS", "512"
+            )),
+            tenant_ledger=True,
+            tenant_fair_share=share,
+            slo_ttft_ms=slo_ttft_ms,
+            seed=0,
+        )
+        engine.start_sync()
+        _set_stage(f"warmup-fair{share}")
+        engine.generate_sync(
+            "w" * 8, max_new_tokens=2, temperature=0.0, stop_on_eos=False
+        )
+        engine.mark_steady_state()
+        _set_stage(f"measure-fair{share}")
+        hog_prompt = "H" * min(96, engine.max_prompt_tokens - new_tokens - 8)
+        t0 = time.time()
+        hog_handles = []
+        hog_shed = 0
+        # The hog floods first — its queued cost is what the fairness
+        # share caps; the well-behaved tenants' small submits follow
+        # behind it, exactly the arrival order that starves them today.
+        for i in range(hog_requests):
+            try:
+                hog_handles.append(engine.submit_generate(
+                    hog_prompt + f" {i:03d}", max_new_tokens=new_tokens,
+                    temperature=0.0, stop_on_eos=False, tenant="hog",
+                ))
+            except ErrorTooManyRequests:
+                hog_shed += 1
+        wb_handles: dict = {}
+        for t in range(n_tenants):
+            name = f"wb-{t}"
+            wb_handles[name] = []
+            for i in range(wb_requests):
+                try:
+                    wb_handles[name].append(engine.submit_generate(
+                        f"tenant {name} request {i:02d}",
+                        max_new_tokens=new_tokens, temperature=0.0,
+                        stop_on_eos=False, tenant=name,
+                    ))
+                except ErrorTooManyRequests:
+                    pass
+        per_tenant: dict = {}
+        wb_results = []
+        for name, handles in wb_handles.items():
+            results = [h.future.result(timeout=1800) for h in handles]
+            wb_results.extend(results)
+            per_tenant[name] = sum(len(r.token_ids) for r in results)
+        hog_results = [h.future.result(timeout=1800) for h in hog_handles]
+        per_tenant["hog"] = sum(len(r.token_ids) for r in hog_results)
+        wall = time.time() - t0
+        slo = engine.slo_report()
+        burn = (
+            slo["slos"]["ttft"]["windows"]["5m"]["burn_rate"]
+            if slo.get("enabled") else 0.0
+        )
+        tenants_table = engine.tenant_report()["tenants"]
+        _recompile_guard(engine)
+        engine.stop_sync()
+        tps = {
+            name: round(tokens / wall, 2)
+            for name, tokens in per_tenant.items()
+        }
+        wb_ttfts = sorted(r.ttft_s * 1e3 for r in wb_results)
+        # The bench's own except-counter and the ledger's shed outcome
+        # count the SAME submit-time events — report one, cross-check
+        # the other.
+        ledger_shed = int(
+            tenants_table.get("hog", {})
+            .get("requests", {}).get("shed", 0)
+        )
+        if ledger_shed != hog_shed:
+            log(f"bench[tenant]: WARNING ledger hog sheds "
+                f"({ledger_shed}) != submit-path sheds ({hog_shed})")
+        out = {
+            "wall_s": round(wall, 2),
+            "tenant_tps": tps,
+            "tenant_tps_min": min(tps.values()),
+            "tenant_tps_max": max(tps.values()),
+            "wb_ttft_p95_ms": round(_pct(wb_ttfts, 0.95), 2),
+            "hog_shed": hog_shed,
+            "slo_ttft_burn": round(burn, 4),
+        }
+        log(f"bench[tenant]: fair_share={share} → wb ttft_p95="
+            f"{out['wb_ttft_p95_ms']}ms hog_shed={out['hog_shed']} "
+            f"tps={tps} slo_ttft_burn={out['slo_ttft_burn']}")
+        return out
+
+    unfair = run(0.0)
+    fair = run(fair_share)
+    _set_stage("done")
+    total_tps = sum(unfair["tenant_tps"].values())
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(total_tps, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(total_tps / 1000.0, 4),
+        "platform": "tpu" if on_tpu else "cpu",
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "tenant",
+        "tenant_count": n_tenants + 1,  # N well-behaved + the hog
+        "fair_share": fair_share,
+        "tenant_tps_min": unfair["tenant_tps_min"],
+        "tenant_tps_max": unfair["tenant_tps_max"],
+        "slo_ttft_burn": unfair["slo_ttft_burn"],
+        # The fairness A/B: the well-behaved tenants' TTFT with the
+        # hog shed on its own budget vs sharing the pain.
+        "wb_ttft_p95_unfair_ms": unfair["wb_ttft_p95_ms"],
+        "wb_ttft_p95_fair_ms": fair["wb_ttft_p95_ms"],
+        "hog_shed_unfair": unfair["hog_shed"],
+        "hog_shed_fair": fair["hog_shed"],
+        "slo_ttft_burn_fair": fair["slo_ttft_burn"],
+    }), flush=True)
+    os._exit(0)
+
+
 def _tp_workload(on_tpu: bool) -> None:
     """BENCH_TP_WORKLOAD=1: the GSPMD-sharded serving A/B — one
     synchronized greedy burst served by a tp=1 engine, then the SAME
@@ -671,6 +837,9 @@ def main() -> None:
         return  # unreachable (os._exit) — keeps the control flow obvious
     if os.environ.get("BENCH_TP_WORKLOAD", "") in ("1", "true", "yes"):
         _tp_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_TENANT_WORKLOAD", "") in ("1", "true", "yes"):
+        _tenant_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL015).
+"""The graftlint rule set (GL001–GL016).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1870,6 +1870,135 @@ class JitInRequestPathRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL016 — request-controlled strings as metric label values
+# ----------------------------------------------------------------------
+
+
+class UnboundedMetricLabelRule(Rule):
+    """A metric label whose value flows from a request-controlled
+    string (tenant ids, header values) is an unbounded-cardinality
+    time series: every distinct client-chosen value mints a new series,
+    and an adversarial (or merely enthusiastic) client can blow up the
+    exporter's memory and the scrape size. The serving discipline is
+    the ``TPU_TENANT_LABEL_MAX`` clamp (``serving/tenant_ledger.py``):
+    request-controlled values pass through a bounded label mapper
+    (first-K distinct values, overflow folded into ``_other``) before
+    they may reach a label. This rule is the static twin of that
+    runtime clamp.
+
+    Flagged (in ``serving/`` and ``service/`` only):
+
+    * metrics-manager recording calls (``increment_counter`` /
+      ``add_counter`` / ``record_histogram`` / ``set_gauge`` /
+      ``delta_updown_counter``) whose *label value* positions (the odd
+      elements of the trailing key/value pairs) contain a
+      request-controlled expression — an identifier or attribute named
+      ``tenant`` / ``tenant_id``, or a ``header``/``headers`` access;
+    * prometheus-style ``.labels(...)`` calls with such a value.
+
+    Clean: the value is wrapped in a clamp/allowlist helper — a call to
+    a function whose name is ``label_for`` / ``clamp_label`` or ends
+    with ``_label`` (the bounded-mapper naming convention).
+
+    Conservative: only the marker names above taint; a label value
+    computed from engine-owned state (model names, reason literals,
+    outcome vocabularies) never matches.
+    """
+
+    rule_id = "GL016"
+    name = "unbounded-metric-label"
+    rationale = (
+        "request-controlled strings (tenant ids, headers) as metric "
+        "label values are unbounded cardinality; route them through a "
+        "bounded clamp/allowlist helper (TPU_TENANT_LABEL_MAX idiom) "
+        "before they reach a label"
+    )
+
+    #: Recorder method → index of the first label element in args
+    #: (after name [+ value]); the trailing args alternate key, value.
+    _RECORDERS = {
+        "increment_counter": 1,
+        "add_counter": 2,
+        "record_histogram": 2,
+        "set_gauge": 2,
+        "delta_updown_counter": 2,
+    }
+    _TAINT = frozenset(("tenant", "tenant_id", "header", "headers"))
+    _CLAMPS = frozenset(("label_for", "clamp_label"))
+
+    def __init__(
+        self, scoped_dirs: Sequence[str] = ("serving", "service")
+    ) -> None:
+        self._dirs = tuple(scoped_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(
+            f"/{d}/" in norm or norm.startswith(f"{d}/")
+            for d in self._dirs
+        )
+
+    @classmethod
+    def _is_clamped(cls, node: ast.AST) -> bool:
+        """The value is a clamp-helper call — bounded by construction."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        return name in cls._CLAMPS or name.endswith("_label")
+
+    @classmethod
+    def _tainted(cls, node: ast.AST) -> bool:
+        if cls._is_clamped(node):
+            return False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in cls._TAINT:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in cls._TAINT:
+                return True
+        return False
+
+    def _check_value(
+        self, ctx: FileContext, call: ast.Call, value: ast.AST
+    ) -> Iterator[Finding]:
+        if self._tainted(value):
+            yield self.finding(
+                ctx, call,
+                "request-controlled string as a metric label value — "
+                "unbounded series cardinality; clamp it through a "
+                "bounded label mapper (label_for/*_label; "
+                "TPU_TENANT_LABEL_MAX idiom) first",
+            )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            if attr in self._RECORDERS:
+                first = self._RECORDERS[attr]
+                labels = node.args[first:]
+                # Values sit at the odd offsets of the key/value tail.
+                for i in range(1, len(labels), 2):
+                    for f in self._check_value(ctx, node, labels[i]):
+                        yield f
+                        break
+            elif attr == "labels":
+                # prometheus_client idiom: .labels(v1, k2=v2).
+                for value in (*node.args, *(
+                    kw.value for kw in node.keywords
+                )):
+                    found = False
+                    for f in self._check_value(ctx, node, value):
+                        yield f
+                        found = True
+                        break
+                    if found:
+                        break
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -1889,6 +2018,7 @@ ALL_RULES = (
     RetryNoBackoffRule,
     CrossMeshHostPullRule,
     JitInRequestPathRule,
+    UnboundedMetricLabelRule,
 )
 
 
@@ -1910,4 +2040,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         RetryNoBackoffRule(),
         CrossMeshHostPullRule(),
         JitInRequestPathRule(),
+        UnboundedMetricLabelRule(),
     ]

@@ -293,6 +293,33 @@ class App:
 
         container = self.container
 
+        def engine_report(method: str) -> Response:
+            """One JSON ops read per engine (`tpu`, `tpu_embed`) — or
+            per replica when `container.tpu` is a ReplicaPool — from an
+            engine-shaped `method()` report. The shared shape of
+            /debug/flight, /debug/capacity, /debug/tenants, and
+            /debug/slo."""
+            import json as _json
+
+            reports: dict = {}
+            for name, eng in (
+                ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
+            ):
+                if eng is None:
+                    continue
+                fn = getattr(eng, method, None)
+                if not callable(fn):
+                    continue
+                try:
+                    reports[name] = fn()
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    reports[name] = {"error": str(exc)}
+            return Response(
+                status=200,
+                headers={"Content-Type": "application/json"},
+                body=_json.dumps(reports).encode(),
+            )
+
         async def handler(raw) -> Response:
             path = raw.target.split("?")[0]
             if path == "/metrics":
@@ -363,58 +390,30 @@ class App:
                 # shed/cancel/replay/failover annotations, trace ids —
                 # from a fixed-size ring with slow/errored requests
                 # pinned so a burst can't evict the interesting ones.
-                # Engine-shaped and pool-shaped backends both expose
-                # flight_records(); a ReplicaPool aggregates per
-                # replica.
-                import json as _json
-
-                flights: dict = {}
-                for name, eng in (
-                    ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
-                ):
-                    if eng is None:
-                        continue
-                    records = getattr(eng, "flight_records", None)
-                    if not callable(records):
-                        continue
-                    try:
-                        flights[name] = records()
-                    except Exception as exc:  # noqa: BLE001 — debug surface
-                        flights[name] = {"error": str(exc)}
-                return Response(
-                    status=200,
-                    headers={"Content-Type": "application/json"},
-                    body=_json.dumps(flights).encode(),
-                )
+                return engine_report("flight_records")
             if path == "/debug/capacity":
                 # Device-resource capacity (docs/advanced-guide/
                 # observability.md "Device-resource signals"): the HBM
                 # ledger (per-component bytes, budget, headroom), XLA
                 # compile counts with the steady-state recompile
-                # counter, and paged-KV pool pressure — per engine, or
-                # per replica through a pool. The operator's one read
-                # for "is this pod running out of the resources that
-                # actually bound it".
-                import json as _json
-
-                caps: dict = {}
-                for name, eng in (
-                    ("tpu", container.tpu), ("tpu_embed", container.tpu_embed)
-                ):
-                    if eng is None:
-                        continue
-                    report = getattr(eng, "capacity_report", None)
-                    if not callable(report):
-                        continue
-                    try:
-                        caps[name] = report()
-                    except Exception as exc:  # noqa: BLE001 — debug surface
-                        caps[name] = {"error": str(exc)}
-                return Response(
-                    status=200,
-                    headers={"Content-Type": "application/json"},
-                    body=_json.dumps(caps).encode(),
-                )
+                # counter, and paged-KV pool pressure — the operator's
+                # one read for "is this pod running out of the
+                # resources that actually bound it".
+                return engine_report("capacity_report")
+            if path == "/debug/tenants":
+                # Tenant attribution (docs/advanced-guide/
+                # observability.md "Tenant attribution and SLOs"): the
+                # FULL unclamped per-tenant table — tokens by phase,
+                # KV-block·seconds, outcome counts, live queue share —
+                # next to the clamped Prometheus export. The operator's
+                # one read for "which tenant is eating the pod".
+                return engine_report("tenant_report")
+            if path == "/debug/slo":
+                # SLO burn-rate state (docs/advanced-guide/
+                # observability.md): per-objective multi-window burn
+                # rates and the compliance bit — the "is the service
+                # breaking its promise right now" read.
+                return engine_report("slo_report")
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json

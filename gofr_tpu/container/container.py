@@ -362,6 +362,39 @@ class Container:
             "paged KV pool: radix-cached (reclaimable-under-pressure) "
             "blocks / used blocks",
         )
+        # Tenant attribution + SLO burn rates (serving/tenant_ledger.py
+        # + serving/slo.py; docs/advanced-guide/observability.md "Tenant
+        # attribution & SLOs"). Tenant labels are CLAMPED to the first
+        # TPU_TENANT_LABEL_MAX distinct tenants (overflow folds into
+        # tenant="_other"; the full table is /debug/tenants) — tenant
+        # ids are request-controlled strings and must never become
+        # unbounded label cardinality (graftlint GL016 is the static
+        # twin of the clamp).
+        m.new_counter(
+            "app_tpu_tenant_tokens_total",
+            "tokens attributed per tenant (phase=prefill|decode; "
+            "label-clamped, overflow in tenant=_other)",
+        )
+        m.new_counter(
+            "app_tpu_tenant_kv_block_seconds_total",
+            "paged-KV occupancy attributed per tenant "
+            "(block·seconds; Σ tenants == pool-wide occupancy integral)",
+        )
+        m.new_counter(
+            "app_tpu_tenant_requests_total",
+            "requests per tenant by outcome "
+            "(ok|shed|cancelled|deadline|error)",
+        )
+        m.new_gauge(
+            "app_tpu_slo_burn_rate",
+            "error-budget burn rate per objective and window "
+            "(slo=ttft|e2e|availability, window=5m|1h; 1.0 = spending "
+            "exactly the budget)",
+        )
+        m.new_gauge(
+            "app_tpu_slo_compliant",
+            "1 while every SLO burn rate is within budget, else 0",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -244,6 +244,14 @@ class Replica:
         pressure (``TPU_SCALE_UP_HEADROOM``)."""
         return None
 
+    def slo_compliant(self) -> Optional[bool]:
+        """Whether the replica's configured SLOs are currently within
+        budget (every burn rate ≤ 1), ``None`` when unknown or no SLOs
+        are configured — in-proc replicas read their engine's SLO
+        engine, remote ones cache the health payload's ``slo`` detail
+        from the last probe."""
+        return None
+
     def describe(self) -> dict:
         return {
             "state": self.state(),
@@ -256,6 +264,7 @@ class Replica:
             "adapters": sorted(self.adapters()),
             "mesh": self.mesh_topology(),
             "hbm_headroom": self.headroom(),
+            "slo_compliant": self.slo_compliant(),
         }
 
     def close(self) -> None:
@@ -326,6 +335,15 @@ class EngineReplica(Replica):
         try:
             return float(ratio())
         except Exception:  # noqa: BLE001 — advertisement is a routing hint only
+            return None
+
+    def slo_compliant(self) -> Optional[bool]:
+        slo = getattr(self.engine, "_slo", None)
+        if slo is None:
+            return None
+        try:
+            return bool(slo.compliant())
+        except Exception:  # noqa: BLE001 — advertisement is a debug hint only
             return None
 
     def load_adapter(self, name: str, source: Any) -> bool:
@@ -490,6 +508,7 @@ class HTTPReplica(Replica):
         # its shape and saturation the same way an in-proc one does.
         self._mesh: Optional[dict] = None
         self._hbm_headroom: Optional[float] = None
+        self._slo_compliant: Optional[bool] = None
         self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
@@ -507,6 +526,9 @@ class HTTPReplica(Replica):
 
     def headroom(self) -> Optional[float]:
         return self._hbm_headroom
+
+    def slo_compliant(self) -> Optional[bool]:
+        return self._slo_compliant
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -1011,6 +1033,15 @@ class HTTPReplica(Replica):
         )
         self._hbm_headroom = (
             float(ratio) if isinstance(ratio, (int, float)) else None
+        )
+        # SLO advertisement rides the same unconditional-assign
+        # discipline: a restarted remote without objectives clears it.
+        slo = details.get("slo")
+        compliant = (
+            slo.get("compliant") if isinstance(slo, dict) else None
+        )
+        self._slo_compliant = (
+            bool(compliant) if isinstance(compliant, bool) else None
         )
         if health.get("status") == "UP":
             self._state = "SERVING"
@@ -2281,8 +2312,9 @@ class ReplicaPool:
             entry["mesh"] = replica.mesh_topology()
             # Saturation headline (device_telemetry): flight readers
             # chasing tail latency see each replica's HBM pressure
-            # next to its timelines.
+            # next to its timelines — and whether its SLOs are burning.
             entry["hbm_headroom"] = replica.headroom()
+            entry["slo_compliant"] = replica.slo_compliant()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 
@@ -2309,8 +2341,53 @@ class ReplicaPool:
             )
             entry["role"] = replica.role
             entry["hbm_headroom"] = replica.headroom()
+            entry["slo_compliant"] = replica.slo_compliant()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
+
+    def tenant_report(self) -> dict:
+        """Aggregate ``/debug/tenants`` view: each in-proc replica's
+        tenant ledger keyed by replica name (remote replicas contribute
+        their descriptor — their full table lives on their own ops
+        port), so "which tenant holds the pool" has a fleet answer."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            engine = getattr(replica, "engine", None)
+            report_fn = getattr(engine, "tenant_report", None)
+            if callable(report_fn):
+                try:
+                    entry = dict(report_fn())
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    entry = {"error": str(exc)}
+            else:
+                entry = {"remote": True}
+            entry["state"] = (
+                "DOWN" if replica.probe_failed
+                else ("DRAINING" if replica.draining else replica.state())
+            )
+            replicas[replica.name] = entry
+        return {"replicas": replicas}
+
+    def slo_report(self) -> dict:
+        """Aggregate ``/debug/slo`` view: each in-proc replica's
+        burn-rate state keyed by replica name; remote replicas
+        contribute their probe-cached compliance bit."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            engine = getattr(replica, "engine", None)
+            report_fn = getattr(engine, "slo_report", None)
+            if callable(report_fn):
+                try:
+                    entry = dict(report_fn())
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    entry = {"error": str(exc)}
+            else:
+                entry = {
+                    "remote": True,
+                    "compliant": replica.slo_compliant(),
+                }
+            replicas[replica.name] = entry
+        return {"replicas": replicas}
 
     def health_check(self) -> dict:
         replicas: dict[str, Any] = {}

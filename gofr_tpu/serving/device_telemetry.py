@@ -272,6 +272,11 @@ class CompileTracker:
         self.total = 0
         self.steady_state_recompiles = 0
         self._warm = False
+        # Persistent-compile-cache provenance (TPU_COMPILE_CACHE_DIR):
+        # set by the engine at boot when the operator points jax's
+        # compilation cache at a directory; rides health details and
+        # /debug/capacity so "did this restart re-trace" is answerable.
+        self.cache_info: Optional[dict[str, Any]] = None
         # Boot trace context: compiles fire on the scheduler thread
         # (no ambient span there), so the trace that was ambient when
         # the ENGINE was constructed parents the warm-up compile spans
@@ -426,9 +431,30 @@ class CompileTracker:
 
     # -- rendering -----------------------------------------------------
 
+    def set_cache_info(self, info: dict[str, Any]) -> None:
+        """Record the persistent compile cache's provenance (dir,
+        enabled, error) — shown by :meth:`snapshot` with a live entry
+        count where the directory is readable."""
+        self.cache_info = dict(info)
+
+    def _cache_snapshot(self) -> Optional[dict[str, Any]]:
+        if self.cache_info is None:
+            return None
+        out = dict(self.cache_info)
+        try:
+            import os
+
+            out["entries"] = len(os.listdir(str(out.get("dir", ""))))
+        except OSError:
+            # Not created yet (jax writes lazily on first compile) or
+            # unreadable — provenance still reports.
+            pass
+        return out
+
     def snapshot(self) -> dict[str, Any]:
+        cache = self._cache_snapshot()
         with self._lock:
-            return {
+            out: dict[str, Any] = {
                 "total": self.total,
                 "steady_state_recompiles": self.steady_state_recompiles,
                 "warm": self._warm,
@@ -440,6 +466,9 @@ class CompileTracker:
                     for name, entry in sorted(self._programs.items())
                 },
             }
+        if cache is not None:
+            out["compile_cache"] = cache
+        return out
 
 
 def _call_signature(args: tuple, kwargs: dict) -> tuple:

@@ -103,6 +103,14 @@ class InferenceEngine(
         queue_max: int = 1024,
         queue_max_tokens: int = 0,
         tenant_queue_max: int = 0,
+        tenant_ledger: Optional[bool] = None,
+        tenant_label_max: int = 8,
+        tenant_table_max: int = 256,
+        tenant_fair_share: float = 0.0,
+        slo_ttft_ms: float = 0.0,
+        slo_e2e_ms: float = 0.0,
+        slo_availability: float = 0.0,
+        compile_cache_dir: str = "",
         expected_tps: float = 0.0,
         watchdog_s: float = 0.0,
         replay_exact: bool = True,
@@ -121,6 +129,49 @@ class InferenceEngine(
         from gofr_tpu.models.registry import get_model
 
         self._jax, self._jnp = jax, jnp
+        # Compile-cache persistence (TPU_COMPILE_CACHE_DIR): point jax's
+        # persistent compilation cache at an operator-owned directory so
+        # supervisor warm restarts and whole-process restarts re-LOAD
+        # compiled executables instead of re-tracing. Wired FIRST —
+        # before the params-init jit below, because jax initializes the
+        # persistent cache lazily at the first compile and ignores a
+        # later config write for the life of the process. Recorded on
+        # the compile tracker (below) so health and /debug/capacity
+        # show the cache's provenance.
+        self._compile_cache_info: Optional[dict[str, Any]] = None
+        if compile_cache_dir:
+            cache_info: dict[str, Any] = {
+                "dir": compile_cache_dir, "enabled": False,
+            }
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir", compile_cache_dir
+                )
+                cache_info["enabled"] = True
+            except Exception as exc:  # noqa: BLE001 — cache support varies by jax version; serving must boot without it
+                cache_info["error"] = f"{type(exc).__name__}: {exc}"
+            # Persist even trivial CPU-backend programs: the defaults
+            # skip sub-second compiles, which is every program in the
+            # deterministic test/bench environments where restart
+            # behavior is pinned.
+            for knob, val in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ):
+                try:
+                    jax.config.update(knob, val)
+                except Exception:  # noqa: BLE001  # graftlint: disable=GL006 — optional tuning knob; older jax lacks it and the cache dir alone still works
+                    pass
+            # A sibling engine (or an import-time jit) may already have
+            # initialized the lazy cache singleton dir-less — reset it
+            # so THIS boot's dir takes effect.
+            try:
+                from jax._src import compilation_cache as _cc
+
+                _cc.reset_cache()
+            except Exception:  # noqa: BLE001  # graftlint: disable=GL006 — private seam; absent on some jax versions, where a fresh process honors the dir anyway
+                pass
+            self._compile_cache_info = cache_info
         self.model_name = model_name
         self.spec = get_model(model_name)
         self.family = self.spec.family
@@ -354,6 +405,51 @@ class InferenceEngine(
             ),
         )
 
+        # Tenant attribution + SLO burn rates (serving/tenant_ledger.py
+        # + serving/slo.py; docs/advanced-guide/observability.md "Tenant
+        # attribution & SLOs"). Like the flight recorder, both live
+        # OUTSIDE _init_llm_serving_state so attribution and burn state
+        # survive supervisor warm restarts. TPU_TENANT_LEDGER=0 removes
+        # the whole attribution layer — every scheduler hook degrades to
+        # one `is not None`.
+        if tenant_ledger is None:
+            tenant_ledger = os.environ.get(
+                "TPU_TENANT_LEDGER", "1"
+            ).lower() not in ("0", "false", "no")
+        from gofr_tpu.serving.tenant_ledger import TenantLedger
+
+        self._tenant_ledger: Optional[TenantLedger] = (
+            TenantLedger(
+                model_name,
+                metrics=metrics,
+                label_max=tenant_label_max,
+                table_max=tenant_table_max,
+            )
+            if tenant_ledger else None
+        )
+        # Fairness-aware shedding (TPU_TENANT_FAIR_SHARE, off by
+        # default): the fraction of the queue budget one tenant may
+        # hold before admission sheds IT (429 reason=tenant_fair_share)
+        # instead of letting its burst exhaust the global budget for
+        # everyone. Needs the ledger (the share denominator).
+        self.tenant_fair_share = max(0.0, min(1.0, tenant_fair_share))
+        from gofr_tpu.serving.slo import SLOEngine
+
+        self._slo: Optional[SLOEngine] = None
+        if slo_ttft_ms > 0 or slo_e2e_ms > 0 or slo_availability > 0:
+            self._slo = SLOEngine(
+                model_name,
+                ttft_ms=slo_ttft_ms,
+                e2e_ms=slo_e2e_ms,
+                availability=slo_availability,
+                metrics=metrics,
+            )
+        # The observability hub feeds every retired timeline's phases
+        # into the burn-rate engine (and keeps minting timelines even
+        # when recorder/metrics/exporter are all off, so SLOs alone
+        # still see every request).
+        self._obs.slo = self._slo
+
         # Device-resource observability (serving/device_telemetry.py):
         # the compile tracker wraps every jitted serving program built
         # below (so it must exist before the family branch), and the
@@ -366,6 +462,10 @@ class InferenceEngine(
         self._compiles = CompileTracker(
             model_name, metrics=metrics, logger=logger
         )
+        if self._compile_cache_info is not None:
+            # Wired at the very top of __init__ (must precede the first
+            # jit); recorded here once the tracker exists.
+            self._compiles.set_cache_info(self._compile_cache_info)
         self._ledger: Any = None
         # Saturation-aware control knobs (docs/advanced-guide/
         # observability.md "Device-resource signals"): the HBM-fraction
@@ -745,6 +845,36 @@ class InferenceEngine(
             ),
             tenant_queue_max=int(
                 config.get_or_default("TPU_TENANT_QUEUE_MAX", "0")
+            ),
+            # Tenant attribution + SLO layer (docs/advanced-guide/
+            # observability.md "Tenant attribution & SLOs"): the ledger
+            # master switch (0 = zero scheduler-hook overhead), the
+            # metric-label cardinality clamp, the fairness-shed share
+            # (0 = off), the declarative objectives, and the persistent
+            # XLA compile-cache directory.
+            tenant_ledger=config.get_or_default(
+                "TPU_TENANT_LEDGER", "1"
+            ).lower() not in ("0", "false", "no"),
+            tenant_label_max=int(
+                config.get_or_default("TPU_TENANT_LABEL_MAX", "8")
+            ),
+            tenant_table_max=int(
+                config.get_or_default("TPU_TENANT_TABLE_MAX", "256")
+            ),
+            tenant_fair_share=float(
+                config.get_or_default("TPU_TENANT_FAIR_SHARE", "0")
+            ),
+            slo_ttft_ms=float(
+                config.get_or_default("TPU_SLO_TTFT_MS", "0")
+            ),
+            slo_e2e_ms=float(
+                config.get_or_default("TPU_SLO_E2E_MS", "0")
+            ),
+            slo_availability=float(
+                config.get_or_default("TPU_SLO_AVAILABILITY", "0")
+            ),
+            compile_cache_dir=config.get_or_default(
+                "TPU_COMPILE_CACHE_DIR", ""
             ),
             expected_tps=float(
                 config.get_or_default("TPU_EXPECTED_TPS", "0")
@@ -1180,6 +1310,8 @@ class InferenceEngine(
             self._unhealthy_reason = None
             self._queued_tokens = 0
             self._tenant_queued.clear()
+            if self._tenant_ledger is not None:
+                self._tenant_ledger.reset_queued()
             self._idle_evt.clear()
         self._tput.reset()
         self._set_state("SERVING")
@@ -1484,6 +1616,11 @@ class InferenceEngine(
                 self._tenant_queued[req.tenant] = (
                     self._tenant_queued.get(req.tenant, 0) + 1
                 )
+            if self._tenant_ledger is not None:
+                # Keep the fair-share numerator balanced (the pop will
+                # note_dequeued); replays bypass the SHEDDERS, not the
+                # accounting.
+                self._tenant_ledger.note_enqueued(req)
             self._sched_idle = False
         self._work.set()
         if transfer:
@@ -1574,6 +1711,8 @@ class InferenceEngine(
                     self._tenant_queued[req.tenant] = left
                 else:  # drop empty entries: the dict stays O(live tenants)
                     del self._tenant_queued[req.tenant]
+        if self._tenant_ledger is not None:
+            self._tenant_ledger.note_dequeued(req)
 
     def _shed(self, reason: str, retry_after_s: float) -> None:
         if self._metrics is not None:
@@ -1641,6 +1780,29 @@ class InferenceEngine(
                     f"(TPU_TENANT_QUEUE_MAX={self.tenant_queue_max})",
                     retry_after_s=wait_s,
                 )
+            # Fairness-aware shedding (TPU_TENANT_FAIR_SHARE, ledger-
+            # derived, off by default): a tenant already holding more
+            # than its share of the queue budget is shed FIRST — its
+            # burst degrades that tenant, not the fleet. Checked before
+            # the global budgets so the hog's 429s leave room for
+            # everyone else's admissions.
+            if (
+                self._tenant_ledger is not None
+                and self.tenant_fair_share > 0
+                and req.tenant
+                and self._tenant_ledger.over_fair_share(
+                    req.tenant, cost, self.tenant_fair_share,
+                    self.queue_max_tokens, self.queue_max,
+                )
+            ):
+                self._shed("tenant_fair_share", wait_s)
+                raise ErrorTooManyRequests(
+                    f"tenant {req.tenant!r} is over its fair share of "
+                    f"the queue budget "
+                    f"(TPU_TENANT_FAIR_SHARE={self.tenant_fair_share}); "
+                    f"reason=tenant_fair_share",
+                    retry_after_s=wait_s,
+                )
             if self.admit_min_headroom > 0:
                 # Saturation-aware admission (TPU_ADMIT_MIN_HEADROOM):
                 # below the HBM headroom floor new work is shed 429 —
@@ -1692,6 +1854,8 @@ class InferenceEngine(
                 self._tenant_queued[req.tenant] = (
                     self._tenant_queued.get(req.tenant, 0) + 1
                 )
+            if self._tenant_ledger is not None:
+                self._tenant_ledger.note_enqueued(req)
             self._sched_idle = False
         self._work.set()
 
@@ -1871,8 +2035,12 @@ class InferenceEngine(
         except Exception as exc:
             # Shed/rejected before a slot: close the timeline with the
             # shed outcome so the flight recorder pins it and the trace
-            # shows WHY admission said no.
+            # shows WHY admission said no — and charge the tenant's
+            # shed count (the fairness signal /debug/tenants names the
+            # culprit by).
             self._obs.note_shed(req.timeline, type(exc).__name__)
+            if self._tenant_ledger is not None:
+                self._tenant_ledger.finish_request(req, "shed")
             raise
         return req
 
@@ -2080,15 +2248,40 @@ class InferenceEngine(
         the warm-up fence is armed."""
         return dict(self._compiles.snapshot())
 
+    def tenant_report(self) -> dict:
+        """The tenant ledger's full unclamped table (``/debug/tenants``
+        on the ops port): per-tenant tokens, KV-block·seconds, outcome
+        counts, live queue share, and the conservation anchor.
+        ``{"enabled": False}`` when the layer is off
+        (``TPU_TENANT_LEDGER=0``)."""
+        if self._tenant_ledger is None:
+            return {"enabled": False}
+        report = dict(self._tenant_ledger.snapshot())
+        report["fair_share"] = self.tenant_fair_share
+        return report
+
+    def slo_report(self) -> dict:
+        """The SLO engine's burn-rate state (``/debug/slo`` on the ops
+        port). ``{"enabled": False}`` when no objective is configured."""
+        if self._slo is None:
+            return {"enabled": False}
+        return dict(self._slo.snapshot())
+
     def capacity_report(self) -> dict:
         """``/debug/capacity``'s per-engine record: the HBM ledger,
-        compile counts, and paged-pool pressure in one read."""
+        compile counts, paged-pool pressure, and the heaviest tenants
+        in one read."""
         report: dict[str, Any] = {
             "model": self.model_name,
             "state": self._state,
             "hbm": self.hbm_ledger(),
             "compiles": self.compile_stats(),
         }
+        if self._tenant_ledger is not None:
+            # "Which tenant filled it" next to "how full is it".
+            report["tenants"] = self._tenant_ledger.top_tenants()
+        if self._slo is not None:
+            report["slo"] = self._slo.describe()
         if self.family == "llm" and self.kv_block:
             total, used, cached = self._kv_pool_counts()
             pool: dict[str, Any] = {
@@ -2122,7 +2315,7 @@ class InferenceEngine(
         recorder = self._obs.recorder
         if recorder is None:
             return {"enabled": False}
-        return {
+        out = {
             "enabled": True,
             # The device-resource headline rides every flight read: an
             # operator chasing tail latency sees HBM pressure and
@@ -2133,6 +2326,11 @@ class InferenceEngine(
             ),
             **recorder.snapshot(),
         }
+        if self._tenant_ledger is not None:
+            # The attribution headline: slow-timeline readers see WHO
+            # holds the pool without a second request.
+            out["tenants"] = self._tenant_ledger.top_tenants()
+        return out
 
     def health_check(self) -> dict:
         devices = self._jax.devices()
@@ -2214,6 +2412,23 @@ class InferenceEngine(
                 "steady_state_recompiles": (
                     self._compiles.steady_state_recompiles
                 ),
+            }
+            if self._compiles.cache_info is not None:
+                # Persistent compile-cache provenance
+                # (TPU_COMPILE_CACHE_DIR): warm restarts re-load
+                # executables from here instead of re-tracing.
+                details["compiles"]["compile_cache"] = dict(
+                    self._compiles.cache_info
+                )
+        if self._slo is not None:
+            # SLO advertisement: pool probes (in-proc and over HTTP)
+            # lift compliance + fast-window burn into their replica
+            # descriptors, the same path the HBM headroom rides.
+            details["slo"] = self._slo.describe()
+        if self._tenant_ledger is not None:
+            details["tenant_ledger"] = {
+                "tenants": len(self._tenant_ledger.snapshot()["tenants"]),
+                "fair_share": self.tenant_fair_share,
             }
         try:
             stats = devices[0].memory_stats()

@@ -395,6 +395,11 @@ class RequestObservability:
         self._wall_ns = wall_ns
         self._seq_lock = threading.Lock()
         self._seq = 0
+        # SLO evaluation (serving/slo.py): when the engine configures
+        # objectives, finalize feeds every retired timeline's outcome
+        # and phases into the burn-rate engine — the PR 6 phase records
+        # ARE the SLO input, no second measurement path.
+        self.slo: Any = None
 
     def now(self) -> float:
         return self._clock()
@@ -412,6 +417,7 @@ class RequestObservability:
         if (
             self.recorder is None
             and self._metrics is None
+            and self.slo is None
             and not tracer_active()
         ):
             return None
@@ -458,6 +464,10 @@ class RequestObservability:
                     self._metrics.record_histogram(
                         metric, phases[key], "model", self.model_name
                     )
+        if self.slo is not None:
+            # Burn-rate input (serving/slo.py): the retired request's
+            # outcome + phases, judged at request granularity.
+            self.slo.observe(timeline.outcome, phases)
         tracer = get_tracer()
         if tracer_active(tracer):
             self._emit_spans(tracer, timeline, phases)

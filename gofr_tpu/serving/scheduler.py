@@ -100,6 +100,7 @@ class SchedulerMixin:
     _watchdog: Any
     _metrics: Any
     _obs: Any  # serving.observability.RequestObservability
+    _tenant_ledger: Any  # Optional[serving.tenant_ledger.TenantLedger]
     _ledger: Any  # Optional[serving.device_telemetry.HBMLedger]
     _compiles: Any  # serving.device_telemetry.CompileTracker
     _logger: Any
@@ -196,6 +197,12 @@ class SchedulerMixin:
                 # sequences retire HERE, once per loop iteration, so a
                 # dead stream's KV blocks free within one decode window.
                 self._reap_lifecycle()
+                # Tenant attribution (serving/tenant_ledger.py): one
+                # KV-occupancy integration pass per loop iteration —
+                # one clock read shared by every live slot, never per
+                # token. Off (TPU_TENANT_LEDGER=0) = this one check.
+                if self._tenant_ledger is not None:
+                    self._ledger_tick()
                 if self.kv_block:
                     # Proactive prefix-eviction sweep: keep the free
                     # list above the watermark so admission finds free
@@ -362,6 +369,8 @@ class SchedulerMixin:
             self._drained = True
             self._queued_tokens = 0
             self._tenant_queued.clear()
+            if self._tenant_ledger is not None:
+                self._tenant_ledger.reset_queued()
             while not self._pending.empty():
                 try:
                     req = self._pending.get_nowait()
@@ -403,10 +412,34 @@ class SchedulerMixin:
         """Close a request's timeline from a terminal path. Latched by
         the timeline itself, so racing terminal paths (reap vs drain vs
         supervisor fail) summarize exactly once; no-op when the
-        observability layer is off."""
+        observability layer is off. The tenant ledger's exactly-once
+        attribution rides the same seam (its own latch on the request)."""
         tl = req.timeline
         if tl is not None:
             tl.finish(outcome, reason, output_tokens=len(req.token_ids))
+        if self._tenant_ledger is not None:
+            self._tenant_ledger.finish_request(req, outcome)
+
+    def _ledger_tick(self) -> None:
+        """Snapshot (tenant, blocks held) for every slot with a live
+        block table — decoding AND mid-prefill — and hand it to the
+        tenant ledger's occupancy integrator with ONE clock read.
+        Unpaged engines still tick (token/outcome attribution needs a
+        clock base), just with no rows."""
+        led = self._tenant_ledger
+        rows: list[tuple[str, int]] = []
+        if self.kv_block:
+            for i, seq in enumerate(self._slots):
+                if seq is not None and self._slot_blocks[i]:
+                    rows.append(
+                        (seq.request.tenant, len(self._slot_blocks[i]))
+                    )
+            for slot, st in self._prefilling.items():
+                if self._slot_blocks[slot]:
+                    rows.append(
+                        (st.request.tenant, len(self._slot_blocks[slot]))
+                    )
+        led.tick(self._obs.now(), rows)
 
     # ------------------------------------------------------------------
     # request-lifecycle reap (cancellation + deadlines)
@@ -1111,10 +1144,16 @@ class SchedulerMixin:
                 continue
             # Observability: admission is now CERTAIN (every reject path
             # above `continue`d) — stamp the queue-wait end. One clock
-            # read per admitted request, admission-rate not token-rate.
+            # read per admitted request, admission-rate not token-rate,
+            # shared by the timeline and the tenant ledger.
             tl = req.timeline
-            if tl is not None:
-                tl.mark_admitted(self._obs.now())
+            led = self._tenant_ledger
+            if tl is not None or led is not None:
+                now_adm = self._obs.now()
+                if tl is not None:
+                    tl.mark_admitted(now_adm)
+                if led is not None:
+                    led.note_admitted(req, now_adm)
             if cached_done:
                 # Count hit tokens only once admission is CERTAIN —
                 # a pool-dry deferral re-runs the alias walk on
@@ -1952,6 +1991,8 @@ class SchedulerMixin:
         # is host-side bookkeeping plus a non-blocking exporter enqueue).
         if req.timeline is not None:
             req.timeline.finish("ok", reason, output_tokens=len(ids))
+        if self._tenant_ledger is not None:
+            self._tenant_ledger.finish_request(req, "ok")
         if not req.future.done():
             req.future.set_result(result)
         req.stream.put(None)  # stream sentinel (after the result resolves)

@@ -1,0 +1,306 @@
+"""Declarative serving SLOs and multi-window burn rates (ISSUE 12).
+
+PR 6's phase histograms tell an operator what latency *was*; they do
+not say whether the service is currently **breaking its promise** or
+how fast it is spending its error budget. This module turns three
+declarative objectives into that signal:
+
+* ``TPU_SLO_TTFT_MS``   — a request is *good* when its time-to-first-
+  token is at or under the threshold;
+* ``TPU_SLO_E2E_MS``    — good when its end-to-end latency is at or
+  under the threshold;
+* ``TPU_SLO_AVAILABILITY`` — the compliance target (e.g. ``0.999``):
+  for the ``availability`` SLO a request is good when it retired
+  ``ok`` (sheds and errors are the server failing the client; client
+  cancellations are excluded from the denominator). The same target is
+  the latency SLOs' compliance fraction — one error budget discipline
+  across all three (``0.99`` when unset but a latency SLO is).
+
+**Burn rate** is the SRE-workbook form: over a window, the fraction of
+bad requests divided by the error budget (``1 − target``). 1.0 means
+the budget is being spent exactly as fast as it accrues; 10 means ten
+times too fast. Evaluated over two windows — 5 minutes (page-fast) and
+1 hour (sustained) — from bucketed ring counters, so memory is fixed
+and old samples age out without timers. Exported as
+``app_tpu_slo_burn_rate{slo,window}`` gauges plus an
+``app_tpu_slo_compliant`` 0/1 gauge (every burn rate ≤ 1) that rides
+health details and replica probes; the full state serves on
+``/debug/slo``.
+
+Observations arrive from the PR 6 phase records: the observability
+hub's ``finalize`` feeds every retired timeline's outcome and phases
+here — request granularity, zero work on the dispatch path, and the
+layer shares the flight recorder's off-switch semantics (no SLOs
+configured → the engine holds no :class:`SLOEngine` at all).
+
+Determinism: the clock is injectable and bucket boundaries are pure
+arithmetic — tests state time instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Mapping, Optional
+
+#: (window label, window seconds, ring buckets) — 10 s buckets for the
+#: fast window, 60 s for the sustained one.
+WINDOWS: tuple[tuple[str, float, int], ...] = (
+    ("5m", 300.0, 30),
+    ("1h", 3600.0, 60),
+)
+
+#: Default compliance target when TPU_SLO_AVAILABILITY is unset but a
+#: latency SLO is configured.
+DEFAULT_TARGET = 0.99
+
+
+class _Ring:
+    """Good/total counts over a sliding window, in fixed buckets.
+
+    ``observe`` lands in the bucket for ``now``; ``counts`` sums the
+    buckets still inside the window. Stale buckets are lazily zeroed on
+    first touch — no timers, O(buckets) worst case per read."""
+
+    __slots__ = ("window_s", "bucket_s", "_good", "_total", "_stamp")
+
+    def __init__(self, window_s: float, buckets: int) -> None:
+        self.window_s = float(window_s)
+        self.bucket_s = float(window_s) / buckets
+        self._good = [0] * buckets
+        self._total = [0] * buckets
+        # Bucket epoch (``now // bucket_s``) each slot was last used
+        # for; a mismatch means the slot's data is a lap old.
+        self._stamp = [-1] * buckets
+
+    def _slot(self, epoch: int) -> int:
+        return epoch % len(self._total)
+
+    def observe(self, now: float, good: bool) -> None:
+        epoch = int(now / self.bucket_s)
+        i = self._slot(epoch)
+        if self._stamp[i] != epoch:
+            self._stamp[i] = epoch
+            self._good[i] = 0
+            self._total[i] = 0
+        self._total[i] += 1
+        if good:
+            self._good[i] += 1
+
+    def counts(self, now: float) -> tuple[int, int]:
+        """(good, total) over the buckets still inside the window."""
+        epoch = int(now / self.bucket_s)
+        lo = epoch - len(self._total) + 1
+        good = total = 0
+        for i, stamp in enumerate(self._stamp):
+            if lo <= stamp <= epoch:
+                good += self._good[i]
+                total += self._total[i]
+        return good, total
+
+
+class _SLO:
+    """One objective: a goodness predicate plus its per-window rings."""
+
+    __slots__ = ("name", "threshold_ms", "rings")
+
+    def __init__(self, name: str, threshold_ms: float) -> None:
+        self.name = name
+        self.threshold_ms = threshold_ms  # 0 for availability
+        self.rings = {
+            label: _Ring(seconds, buckets)
+            for label, seconds, buckets in WINDOWS
+        }
+
+
+class SLOEngine:
+    """Burn-rate evaluation over the configured objectives (see the
+    module docstring). All mutation happens under one lock at request
+    granularity — nothing here is on the dispatch path."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        ttft_ms: float = 0.0,
+        e2e_ms: float = 0.0,
+        availability: float = 0.0,
+        metrics: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.model_name = model_name
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.target = (
+            min(max(float(availability), 0.0), 0.9999999)
+            if availability > 0 else DEFAULT_TARGET
+        )
+        self.error_budget = max(1e-7, 1.0 - self.target)
+        self._slos: dict[str, _SLO] = {}
+        if ttft_ms > 0:
+            self._slos["ttft"] = _SLO("ttft", float(ttft_ms))
+        if e2e_ms > 0:
+            self._slos["e2e"] = _SLO("e2e", float(e2e_ms))
+        if availability > 0:
+            self._slos["availability"] = _SLO("availability", 0.0)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._slos)
+
+    # -- ingestion (request granularity, from the observability hub) ---
+
+    def observe(
+        self,
+        outcome: str,
+        phases: Mapping[str, float],
+        now: Optional[float] = None,
+    ) -> None:
+        """One retired request: judge it against every configured SLO.
+        Latency SLOs only see requests that reached the phase (a shed
+        never had a TTFT — availability is the SLO that charges it);
+        cancelled requests are the client's choice and count nowhere."""
+        if not self._slos or outcome == "cancelled":
+            return
+        t = self._clock() if now is None else now
+        with self._lock:
+            slo = self._slos.get("ttft")
+            if slo is not None and "ttft_s" in phases:
+                good = phases["ttft_s"] * 1e3 <= slo.threshold_ms
+                for ring in slo.rings.values():
+                    ring.observe(t, good)
+            slo = self._slos.get("e2e")
+            if slo is not None and "e2e_s" in phases:
+                good = phases["e2e_s"] * 1e3 <= slo.threshold_ms
+                for ring in slo.rings.values():
+                    ring.observe(t, good)
+            slo = self._slos.get("availability")
+            if slo is not None:
+                for ring in slo.rings.values():
+                    ring.observe(t, outcome == "ok")
+        self._publish(t)
+
+    # -- evaluation -----------------------------------------------------
+
+    def _window_counts(
+        self, now: float
+    ) -> dict[tuple[str, str], tuple[int, int]]:
+        """(slo, window) → (good, total) for every ring, read under ONE
+        lock pass — burn rates, compliance, gauges, and the debug
+        snapshot all derive from this single read (no repeated ring
+        scans contending with the retirement-path ``observe``)."""
+        with self._lock:
+            return {
+                (name, label): ring.counts(now)
+                for name, obj in self._slos.items()
+                for label, ring in obj.rings.items()
+            }
+
+    def _burn(self, counts: tuple[int, int]) -> float:
+        good, total = counts
+        if total == 0:
+            return 0.0  # an idle window burns nothing
+        return ((total - good) / total) / self.error_budget
+
+    def burn_rate(
+        self, slo: str, window: str, now: Optional[float] = None
+    ) -> float:
+        """Bad fraction over the window divided by the error budget;
+        0.0 with no samples (an idle service burns nothing)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            obj = self._slos.get(slo)
+            ring = obj.rings.get(window) if obj is not None else None
+            if ring is None:
+                return 0.0
+            counts = ring.counts(t)
+        return self._burn(counts)
+
+    def compliant(self, now: Optional[float] = None) -> bool:
+        """True while every (slo, window) burn rate is ≤ 1 — spending
+        the error budget no faster than it accrues."""
+        t = self._clock() if now is None else now
+        return all(
+            self._burn(c) <= 1.0
+            for c in self._window_counts(t).values()
+        )
+
+    def _publish_counts(
+        self, counts: dict[tuple[str, str], tuple[int, int]]
+    ) -> bool:
+        """Refresh the burn-rate and compliance gauges from one counts
+        read; returns the compliance bit. Called on every observation
+        AND every health/describe/snapshot read, so recovery (an empty
+        window) reaches Prometheus through the periodic health probes
+        even when no new request arrives to trigger it."""
+        burns = {key: self._burn(c) for key, c in counts.items()}
+        ok = all(b <= 1.0 for b in burns.values())
+        if self._metrics is not None:
+            for (name, label), burn in burns.items():
+                self._metrics.set_gauge(
+                    "app_tpu_slo_burn_rate", round(burn, 6),
+                    "model", self.model_name,
+                    "slo", name, "window", label,
+                )
+            self._metrics.set_gauge(
+                "app_tpu_slo_compliant", 1.0 if ok else 0.0,
+                "model", self.model_name,
+            )
+        return ok
+
+    def _publish(self, now: float) -> None:
+        self._publish_counts(self._window_counts(now))
+
+    # -- rendering -------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The ``/debug/slo`` form: objective, target, and per-window
+        burn state for every configured SLO. One ring read serves the
+        snapshot AND refreshes the gauges."""
+        t = self._clock()
+        counts = self._window_counts(t)
+        ok = self._publish_counts(counts)
+        slos: dict[str, Any] = {}
+        for name, obj in self._slos.items():
+            windows: dict[str, Any] = {}
+            for label, seconds, _ in WINDOWS:
+                good, total = counts[(name, label)]
+                windows[label] = {
+                    "window_s": seconds,
+                    "good": good,
+                    "total": total,
+                    "burn_rate": round(
+                        self._burn((good, total)), 6
+                    ),
+                }
+            slos[name] = {
+                "threshold_ms": obj.threshold_ms,
+                "target": self.target,
+                "windows": windows,
+            }
+        return {
+            "enabled": True,
+            "target": self.target,
+            "error_budget": round(self.error_budget, 7),
+            "compliant": ok,
+            "slos": slos,
+        }
+
+    def describe(self) -> dict[str, Any]:
+        """The compact health-detail form (rides probes): compliance
+        plus the fast window's burn per SLO. Health checks and pool
+        probes call this periodically, so it also refreshes the gauges
+        — alerts keyed on ``app_tpu_slo_*`` recover when the windows
+        empty, not only when the next request arrives."""
+        t = self._clock()
+        counts = self._window_counts(t)
+        ok = self._publish_counts(counts)
+        return {
+            "compliant": ok,
+            "target": self.target,
+            "burn_rate_5m": {
+                name: round(self._burn(counts[(name, "5m")]), 6)
+                for name in self._slos
+            },
+        }

@@ -420,6 +420,11 @@ class EngineSupervisor:
             eng._wait_kv.clear()
             eng._queued_tokens = 0
             eng._tenant_queued.clear()
+            if eng._tenant_ledger is not None:
+                # Live queue shares reset with the queues (replays
+                # re-note on requeue); cumulative attribution survives
+                # the restart like the flight recorder does.
+                eng._tenant_ledger.reset_queued()
             # Partition ONCE: retryability can flip between evaluations
             # (a cancel racing in), and a request must land on exactly
             # one side.
@@ -501,12 +506,16 @@ class EngineSupervisor:
         req.stream.put(None)
         # Observability: a request failed across a restart still gets
         # exactly one flight-recorder entry/trace (latched — no double
-        # summarization when this races a scheduler terminal path).
+        # summarization when this races a scheduler terminal path), and
+        # the tenant ledger attributes it at the same seam (its own
+        # latch) so attribution stays total across restarts too.
         if req.timeline is not None:
             req.timeline.finish(
                 "error", type(exc).__name__,
                 output_tokens=len(req.token_ids),
             )
+        if self._engine._tenant_ledger is not None:
+            self._engine._tenant_ledger.finish_request(req, "error")
 
     def _give_up(self, reason: str) -> None:
         """Crash loop: ``max_restarts`` consecutive failures — land in

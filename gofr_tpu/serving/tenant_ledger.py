@@ -1,0 +1,390 @@
+"""Per-tenant workload attribution: the TenantLedger (ISSUE 12).
+
+PR 6 answered "how long did this request take", PR 10 answered "how full
+is this pod" — but a multi-tenant pod under pressure needs a third
+answer neither floor gives: **which tenant is eating it**. This module
+owns that attribution spine:
+
+* **Token accounting** — prefill and decode tokens per tenant, summed
+  exactly once per request at its terminal path (the same latched
+  seams the flight recorder uses), so per-tenant totals reconcile to
+  the engine's aggregate counters at any quiescent point — pinned in
+  CI at ``tp=1`` AND ``tp=2``.
+* **KV-block·seconds** — HBM occupancy attributed to the tenant holding
+  each slot's block table, integrated once per scheduler-loop pass
+  (one clock read per pass, shared by every row — graftlint GL011
+  discipline, never per token). The pool-wide integral is accumulated
+  in the SAME call with the SAME ``dt``, so the conservation invariant
+  — Σ per-tenant block·seconds == pool-wide occupancy·seconds — holds
+  *exactly*, by construction, under any clock.
+* **Outcome accounting** — ok / shed / cancelled / deadline / error
+  per tenant, plus queue-wait and e2e sums, so "tenant X is being shed"
+  is a metric, not a grep through logs.
+* **Fair-share state** — live queued requests/tokens per tenant, the
+  denominator admission's fairness shed (``TPU_TENANT_FAIR_SHARE``,
+  ``engine._enqueue``) divides by: a tenant holding more than its share
+  of the queue budget is shed ``429 reason=tenant_fair_share`` while
+  everyone else keeps being admitted.
+
+Cardinality contract: tenant ids are request-controlled strings, so the
+Prometheus export clamps to the first ``TPU_TENANT_LABEL_MAX`` distinct
+tenants — later tenants fold into ``tenant="_other"`` (monotonic
+counters never change label mid-flight) — while the **full unclamped
+table** serves on ``/debug/tenants``. graftlint GL016
+(``unbounded-metric-label``) is the static twin of this clamp: a
+request-controlled string must never reach a metric label without one.
+
+Overhead contract: with the layer off (``TPU_TENANT_LEDGER=0``) every
+scheduler hook is a single ``is not None`` — the flight-recorder idiom.
+With it on, the per-pass cost is one clock read, one small loop over
+live slots, and dict arithmetic; nothing here touches device state.
+
+Determinism: every timestamp is either passed in by the caller (the
+scheduler's shared per-pass read) or read from the injectable ``clock``
+— tests state time instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+#: Pseudo-tenant for requests without an ``X-Tenant-Id`` — attribution
+#: must be total (conservation needs every slot accounted to someone).
+UNTENANTED = "_untenanted"
+
+#: Fold bucket for tenants beyond the metric-label clamp. The full
+#: unclamped table lives on ``/debug/tenants``.
+OVERFLOW = "_other"
+
+#: Bounded outcome vocabulary for ``app_tpu_tenant_requests_total``.
+OUTCOMES = ("ok", "shed", "cancelled", "deadline", "error")
+
+
+class _TenantStats:
+    """One tenant's accumulators (mutated under the ledger lock)."""
+
+    __slots__ = (
+        "prefill_tokens", "decode_tokens", "kv_block_seconds",
+        "queue_wait_s", "e2e_s", "outcomes", "queued_requests",
+        "queued_tokens", "held_blocks",
+    )
+
+    def __init__(self) -> None:
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.kv_block_seconds = 0.0
+        self.queue_wait_s = 0.0
+        self.e2e_s = 0.0
+        self.outcomes: dict[str, int] = {}
+        # Live admission state (fair-share numerator).
+        self.queued_requests = 0
+        self.queued_tokens = 0
+        # Blocks held at the last integration pass (a snapshot for the
+        # debug table; the integral is what conservation pins).
+        self.held_blocks = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        n = sum(self.outcomes.values())
+        return {
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": self.decode_tokens,
+            "kv_block_seconds": round(self.kv_block_seconds, 6),
+            "requests": dict(self.outcomes),
+            "queue_wait_s_total": round(self.queue_wait_s, 6),
+            "e2e_s_total": round(self.e2e_s, 6),
+            "queued_requests": self.queued_requests,
+            "queued_tokens": self.queued_tokens,
+            "held_blocks": self.held_blocks,
+            "requests_total": n,
+        }
+
+
+class TenantLedger:
+    """Per-engine tenant attribution (see the module docstring)."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        metrics: Any = None,
+        label_max: int = 8,
+        table_max: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.model_name = model_name
+        self._metrics = metrics
+        self.label_max = max(1, int(label_max))
+        # The in-memory table is bounded too: tenant ids are
+        # request-controlled strings, and a client minting a fresh id
+        # per request must not grow ledger memory (or the scheduler
+        # tick's O(tenants) pass) without bound. Past the cap, NEW
+        # tenants account into the OVERFLOW row wholesale — attribution
+        # stays total, the table stays O(table_max).
+        self.table_max = max(self.label_max, int(table_max))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stats: dict[str, _TenantStats] = {}
+        # tenant → exported metric label: its own id for the first
+        # ``label_max`` distinct tenants, OVERFLOW after (stable for a
+        # tenant's lifetime — counters stay monotonic per series).
+        self._labels: dict[str, str] = {}
+        self._last_tick: Optional[float] = None
+        #: Pool-wide KV occupancy integral, accumulated in the same
+        #: pass as the per-tenant shares — the conservation anchor.
+        self.pool_block_seconds = 0.0
+
+    # -- internals (call under self._lock) -----------------------------
+
+    def _stat(self, tenant: str) -> _TenantStats:
+        st = self._stats.get(tenant)
+        if st is None:
+            if (
+                len(self._stats) >= self.table_max
+                and tenant not in (UNTENANTED, OVERFLOW)
+            ):
+                # Table full: this tenant accounts into the overflow
+                # row (bounded memory under adversarial tenant churn).
+                return self._stat(OVERFLOW)
+            st = _TenantStats()
+            self._stats[tenant] = st
+        return st
+
+    def _label(self, tenant: str) -> str:
+        """The tenant's exported metric label: its own id for the first
+        ``label_max`` distinct client tenants, OVERFLOW after. Folded
+        tenants are NOT stored (the dict stays O(label_max) under
+        adversarial tenant churn — only own-label assignments persist,
+        so every stored value equals its key)."""
+        if tenant in self._labels:
+            return tenant
+        # The pseudo-tenants always keep their own label and never
+        # consume a clamp slot — the clamp bounds CLIENT-chosen ids.
+        if tenant in (UNTENANTED, OVERFLOW):
+            self._labels[tenant] = tenant
+            return tenant
+        assigned = len([
+            t for t in self._labels
+            if t not in (UNTENANTED, OVERFLOW)
+        ])
+        if assigned < self.label_max:
+            self._labels[tenant] = tenant
+            return tenant
+        return OVERFLOW
+
+    @staticmethod
+    def _tenant_of(req: Any) -> str:
+        return str(getattr(req, "tenant", "") or "") or UNTENANTED
+
+    def _lookup(self, tenant: str) -> Optional[_TenantStats]:
+        """Read-side twin of :meth:`_stat`: an absent tenant whose row
+        would have folded (table full) reads the OVERFLOW row, so
+        enqueue/dequeue accounting stays balanced for folded tenants."""
+        st = self._stats.get(tenant)
+        if st is None and len(self._stats) >= self.table_max:
+            return self._stats.get(OVERFLOW)
+        return st
+
+    # -- admission-state tracking (fair-share numerator) ----------------
+
+    def note_enqueued(self, req: Any) -> None:
+        """A request landed in the submit queue: stamp its ledger clock
+        (queue-wait/e2e measurement base) and count its seat and token
+        cost toward its tenant's live queue share. Called under the
+        engine's submit lock (one clock read per submit)."""
+        cost = len(req.prompt_ids) + int(req.max_new_tokens)
+        now = self._clock()
+        with self._lock:
+            if req.ledger_t0 == 0.0:
+                req.ledger_t0 = now
+            st = self._stat(self._tenant_of(req))
+            st.queued_requests += 1
+            st.queued_tokens += cost
+
+    def note_dequeued(self, req: Any) -> None:
+        """The scheduler popped the request: return its seat and token
+        cost to the tenant's live queue share."""
+        cost = len(req.prompt_ids) + int(req.max_new_tokens)
+        with self._lock:
+            st = self._lookup(self._tenant_of(req))
+            if st is not None:
+                st.queued_requests = max(0, st.queued_requests - 1)
+                st.queued_tokens = max(0, st.queued_tokens - cost)
+
+    def reset_queued(self) -> None:
+        """Drain/restart: the engine just failed or salvaged everything
+        in its queue, so every tenant's live queue share is zero (the
+        cumulative attribution is untouched — it survives restarts like
+        the flight recorder does)."""
+        with self._lock:
+            for st in self._stats.values():
+                st.queued_requests = 0
+                st.queued_tokens = 0
+
+    def over_fair_share(
+        self,
+        tenant: str,
+        cost: int,
+        fair_share: float,
+        budget_tokens: int,
+        budget_requests: int,
+    ) -> bool:
+        """Would admitting ``cost`` more tokens put ``tenant`` over
+        ``fair_share`` of the queue budget? Token-denominated when the
+        engine has a token budget (``TPU_QUEUE_TOKENS``), else
+        seat-denominated against ``TPU_QUEUE_MAX``. Untenanted requests
+        never trip this — fairness shedding names a culprit."""
+        if fair_share <= 0 or not tenant:
+            return False
+        with self._lock:
+            # A folded tenant shares the OVERFLOW row's queue counts:
+            # fairness then applies to the overflow AGGREGATE — still
+            # bounded, and a flood of fresh tenant ids cannot dodge it.
+            st = self._lookup(tenant)
+            queued_tokens = st.queued_tokens if st is not None else 0
+            queued_requests = st.queued_requests if st is not None else 0
+        if budget_tokens > 0:
+            return queued_tokens + cost > fair_share * budget_tokens
+        return queued_requests + 1 > fair_share * max(1, budget_requests)
+
+    # -- scheduler hooks (window granularity) ---------------------------
+
+    def note_admitted(self, req: Any, now: float) -> None:
+        """Admission is certain: stamp the queue-wait end. ``now`` is
+        the scheduler's shared per-admission clock read (the same value
+        the timeline's ``mark_admitted`` gets) — no extra syscall."""
+        if req.ledger_admitted == 0.0:
+            req.ledger_admitted = now
+
+    def tick(
+        self, now: float, rows: Iterable[tuple[str, int]]
+    ) -> None:
+        """One occupancy-integration pass: ``rows`` is (tenant, blocks
+        held) for every slot with a live block table, snapshotted by the
+        scheduler with ONE clock read (``now``). Each tenant gains
+        ``blocks × dt`` block·seconds and the pool total gains the sum —
+        same ``dt``, same call, so conservation is exact."""
+        flush: list[tuple[str, float]] = []
+        with self._lock:
+            last = self._last_tick
+            self._last_tick = now
+            dt = max(0.0, now - last) if last is not None else 0.0
+            for st in self._stats.values():
+                st.held_blocks = 0
+            for tenant, blocks in rows:
+                key = str(tenant or "") or UNTENANTED
+                st = self._stat(key)
+                st.held_blocks += int(blocks)
+                if dt > 0.0 and blocks > 0:
+                    share = blocks * dt
+                    st.kv_block_seconds += share
+                    self.pool_block_seconds += share
+                    flush.append((self._label(key), share))
+        if self._metrics is not None:
+            for label, share in flush:
+                self._metrics.add_counter(
+                    "app_tpu_tenant_kv_block_seconds_total", share,
+                    "model", self.model_name, "tenant", label,
+                )
+
+    # -- terminal accounting --------------------------------------------
+
+    def finish_request(self, req: Any, outcome: str) -> None:
+        """Attribute a request's totals exactly once, from whichever
+        terminal path wins (retire / reap / drain / shed) — latched on
+        the request under the ledger lock, the timeline-finish idiom."""
+        if outcome not in OUTCOMES:
+            outcome = "error"
+        tenant = self._tenant_of(req)
+        admitted = req.ledger_admitted > 0.0
+        prefill = (
+            int(req.effective_prompt_len) or len(req.prompt_ids)
+        ) if admitted else 0
+        decode = len(req.token_ids)
+        now = self._clock()
+        with self._lock:
+            if req.ledger_done:
+                return
+            req.ledger_done = True
+            st = self._stat(tenant)
+            st.prefill_tokens += prefill
+            st.decode_tokens += decode
+            st.outcomes[outcome] = st.outcomes.get(outcome, 0) + 1
+            if admitted and req.ledger_t0 > 0.0:
+                st.queue_wait_s += max(
+                    0.0, req.ledger_admitted - req.ledger_t0
+                )
+            if req.ledger_t0 > 0.0:
+                st.e2e_s += max(0.0, now - req.ledger_t0)
+            label = self._label(tenant)
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_tenant_requests_total",
+                "model", self.model_name,
+                "tenant", label, "outcome", outcome,
+            )
+            if prefill:
+                self._metrics.add_counter(
+                    "app_tpu_tenant_tokens_total", prefill,
+                    "model", self.model_name,
+                    "tenant", label, "phase", "prefill",
+                )
+            if decode:
+                self._metrics.add_counter(
+                    "app_tpu_tenant_tokens_total", decode,
+                    "model", self.model_name,
+                    "tenant", label, "phase", "decode",
+                )
+
+    # -- rendering -------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """The full unclamped table (``/debug/tenants``): every tenant's
+        accumulators plus the conservation anchor and the label-clamp
+        state — the operator's one read for "which tenant holds the
+        pool"."""
+        with self._lock:
+            tenants = {
+                name: st.to_dict() for name, st in self._stats.items()
+            }
+            # Tenants with a table row but no own metric label (their
+            # export folded into _other).
+            folded = sorted(
+                t for t in self._stats
+                if t not in self._labels
+                and t not in (UNTENANTED, OVERFLOW)
+            )
+            return {
+                "enabled": True,
+                "label_max": self.label_max,
+                "table_max": self.table_max,
+                "folded_tenants": folded,
+                "pool_kv_block_seconds": round(
+                    self.pool_block_seconds, 6
+                ),
+                "tenants": tenants,
+            }
+
+    def top_tenants(self, n: int = 5) -> list[dict[str, Any]]:
+        """The ``n`` heaviest tenants by KV-block·seconds (falling back
+        to decode tokens for unpaged engines) — the compact stamp that
+        rides ``flight_records()`` / ``capacity_report()``."""
+        with self._lock:
+            ranked = sorted(
+                self._stats.items(),
+                key=lambda kv: (
+                    kv[1].kv_block_seconds, kv[1].decode_tokens
+                ),
+                reverse=True,
+            )[: max(1, n)]
+            return [
+                {
+                    "tenant": name,
+                    "kv_block_seconds": round(st.kv_block_seconds, 6),
+                    "decode_tokens": st.decode_tokens,
+                    "shed": st.outcomes.get("shed", 0),
+                    "held_blocks": st.held_blocks,
+                }
+                for name, st in ranked
+            ]

@@ -193,6 +193,14 @@ class _GenRequest:
     # the REQUEST so a failover carries it to the adopting replica and
     # the final record covers the whole cross-replica journey.
     timeline: "Optional[RequestTimeline]" = None
+    # Tenant attribution (serving/tenant_ledger.py): the ledger's own
+    # clock stamps (enqueue / admission) and its exactly-once terminal
+    # latch. Plain fields, not ledger-held state, so a request adopted
+    # by a sibling replica after failover carries them along and the
+    # adopter's ledger still attributes it exactly once.
+    ledger_t0: float = 0.0
+    ledger_admitted: float = 0.0
+    ledger_done: bool = False
 
     @property
     def remaining_new_tokens(self) -> int:

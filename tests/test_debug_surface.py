@@ -22,6 +22,9 @@ def debug_app():
     app = App(config=MockConfig({
         "APP_NAME": "debug-test", "HTTP_PORT": "0", "METRICS_PORT": "0",
         "TPU_MODEL": "llama-tiny", "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+        # Generous objectives so /debug/slo is populated AND compliant
+        # regardless of CI machine speed.
+        "TPU_SLO_TTFT_MS": "600000", "TPU_SLO_AVAILABILITY": "0.999",
     }))
     loop = asyncio.new_event_loop()
     threading.Thread(target=loop.run_forever, daemon=True).start()
@@ -106,6 +109,47 @@ def test_debug_capacity_reports_device_resources(debug_app):
     assert report["hbm"]["total_bytes"] == sum(comps.values())
     assert 0.0 <= report["hbm"]["headroom_ratio"] <= 1.0
     assert report["compiles"]["steady_state_recompiles"] == 0
+
+
+def test_debug_tenants_serves_attribution_table(debug_app):
+    """/debug/tenants (docs/advanced-guide/observability.md "Tenant
+    attribution & SLOs"): the FULL unclamped per-tenant table — tokens
+    by phase, KV-block·seconds, outcome counts — on the ops port."""
+    result = debug_app.container.tpu.generate_sync(
+        "tenant table", max_new_tokens=4, temperature=0.0,
+        stop_on_eos=False, tenant="acme", timeout=120,
+    )
+    st, body = _metrics_get(debug_app, "/debug/tenants")
+    assert st == 200
+    report = json.loads(body)["tpu"]
+    assert report["enabled"] is True
+    acme = report["tenants"]["acme"]
+    assert acme["decode_tokens"] >= len(result.token_ids)
+    assert acme["requests"]["ok"] >= 1
+    assert acme["prefill_tokens"] > 0
+    # Conservation anchor rides the table.
+    assert report["pool_kv_block_seconds"] >= sum(
+        t["kv_block_seconds"] for t in report["tenants"].values()
+    ) - 1e-3
+    assert report["label_max"] >= 1
+
+
+def test_debug_slo_serves_burn_state(debug_app):
+    """/debug/slo: per-objective multi-window burn rates and the
+    compliance bit on the ops port."""
+    debug_app.container.tpu.generate_sync(
+        "slo probe", max_new_tokens=4, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    st, body = _metrics_get(debug_app, "/debug/slo")
+    assert st == 200
+    report = json.loads(body)["tpu"]
+    assert report["enabled"] is True and report["compliant"] is True
+    for slo in ("ttft", "availability"):
+        windows = report["slos"][slo]["windows"]
+        assert set(windows) == {"5m", "1h"}
+        for w in windows.values():
+            assert w["total"] >= 1 and w["burn_rate"] == 0.0
 
 
 def test_debug_tpu_trace_validates_and_captures(debug_app):

@@ -1016,6 +1016,71 @@ def test_gl015_exempts_module_scope_builders_and_boot(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL016 — request-controlled strings as metric label values
+# ----------------------------------------------------------------------
+
+
+def test_gl016_flags_request_controlled_label_values(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/handlers.py",
+        """
+        def account(self, req, ctx):
+            self._metrics.increment_counter(
+                "app_requests_total", "tenant", req.tenant
+            )
+            self._metrics.add_counter(
+                "app_tokens_total", 5, "model", self.model_name,
+                "tenant", tenant_id,
+            )
+            self._metrics.set_gauge(
+                "app_queue", 1.0, "who", ctx.headers["x-tenant-id"]
+            )
+            REQUESTS.labels(tenant=req.tenant).inc()
+        """,
+        select=["GL016"],
+    )
+    assert ids == ["GL016", "GL016", "GL016", "GL016"]
+    assert "cardinality" in findings[0].message
+
+
+def test_gl016_accepts_clamped_and_engine_owned_labels(tmp_path):
+    # A clamp-helper call (label_for/*_label) bounds the value by
+    # construction; engine-owned values (model names, reason literals)
+    # never taint; key POSITIONS named "tenant" are fine — only the
+    # VALUE matters; and metric calls outside serving//service/ are out
+    # of scope.
+    ids, _ = _lint(
+        tmp_path, "serving/handlers.py",
+        """
+        def account(self, req, ledger):
+            self._metrics.increment_counter(
+                "app_requests_total",
+                "tenant", ledger.label_for(req.tenant),
+            )
+            self._metrics.add_counter(
+                "app_tokens_total", 5,
+                "tenant", clamp_label(req.tenant),
+            )
+            self._metrics.increment_counter(
+                "app_requests_shed_total",
+                "model", self.model_name, "reason", "tenant_quota",
+            )
+        """,
+        select=["GL016"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "metrics/export.py",
+        """
+        def account(m, req):
+            m.increment_counter("app_requests_total", "tenant", req.tenant)
+        """,
+        select=["GL016"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
