@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL016).
+"""The graftlint rule set (GL001–GL017).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -1999,6 +1999,152 @@ class UnboundedMetricLabelRule(Rule):
 
 
 # ----------------------------------------------------------------------
+# GL017 — control-loop threshold comparisons without hysteresis
+# ----------------------------------------------------------------------
+
+
+class ThresholdNoHysteresisRule(Rule):
+    """A control loop that flips state the first time a noisy load
+    signal crosses a threshold oscillates: one bad tick trips the
+    actuator, the next good tick untrips it, and the system flaps at
+    the noise frequency instead of responding to sustained pressure.
+    Every controller in this repo that earned its keep — the watchdog,
+    the pool scaler's sustain windows, the brownout ladder
+    (``serving/brownout.py``), the hedge budget — pairs its thresholds
+    with a sustain window, an enter/exit hysteresis band, or a budget
+    guard. This rule is the static twin of that discipline.
+
+    Flagged (in ``serving/`` and ``service/`` only): an ``if`` whose
+    test compares a *signal* expression (a name mentioning ``burn``,
+    ``headroom``, ``load_per_replica``, ``occupancy``, or
+    ``saturation``) against a *threshold* expression (a name mentioning
+    ``threshold``, ``floor``, ``enter``, ``exit``, ``watermark``, or
+    ``limit`` — the env-derived-knob naming convention), where the
+    branch body **assigns instance state** (``self.x = ...`` — a level,
+    a mode, an open/tripped flag), and the enclosing function shows no
+    guard evidence: no name mentioning ``since`` / ``sustain`` /
+    ``streak`` / ``consecutive`` / ``hysteresis`` / ``budget`` /
+    ``window``.
+
+    Clean: shedding or raising inside the branch (a per-request
+    decision, not controller state), sustain-anchor idioms
+    (``self._pressure_since``), ``Sustain``/``HedgeBudget``-style
+    guards, and comparisons whose sides don't carry both marker
+    families. Conservative by construction — it looks for the *shape*
+    of a flapping controller, not for every threshold.
+    """
+
+    rule_id = "GL017"
+    name = "threshold-no-hysteresis"
+    rationale = (
+        "state flipped on a raw signal-vs-threshold comparison flaps "
+        "at the noise frequency; pair the threshold with a sustain "
+        "window or an enter/exit hysteresis band (the "
+        "serving/brownout.py ladder idiom)"
+    )
+
+    _SIGNALS = ("burn", "headroom", "load_per_replica", "occupancy",
+                "saturation")
+    _THRESHOLDS = ("threshold", "floor", "enter", "exit", "watermark",
+                   "limit")
+    _GUARDS = ("since", "sustain", "streak", "consecutive",
+               "hysteresis", "budget", "window")
+
+    def __init__(
+        self, scoped_dirs: Sequence[str] = ("serving", "service")
+    ) -> None:
+        self._dirs = tuple(scoped_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(
+            f"/{d}/" in norm or norm.startswith(f"{d}/")
+            for d in self._dirs
+        )
+
+    @staticmethod
+    def _idents(node: ast.AST) -> list[str]:
+        """Every identifier string mentioned in the expression."""
+        out: list[str] = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                out.append(sub.id.lower())
+            elif isinstance(sub, ast.Attribute):
+                out.append(sub.attr.lower())
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(sub.name.lower())
+        return out
+
+    @classmethod
+    def _mentions(cls, node: ast.AST, markers: Sequence[str]) -> bool:
+        return any(
+            m in ident for ident in cls._idents(node) for m in markers
+        )
+
+    @classmethod
+    def _threshold_compare(cls, test: ast.AST) -> bool:
+        """One side mentions a signal, the other a threshold knob."""
+        for node in ast.walk(test):
+            if not isinstance(node, ast.Compare) or len(node.comparators) != 1:
+                continue
+            left, right = node.left, node.comparators[0]
+            if (
+                cls._mentions(left, cls._SIGNALS)
+                and cls._mentions(right, cls._THRESHOLDS)
+            ) or (
+                cls._mentions(right, cls._SIGNALS)
+                and cls._mentions(left, cls._THRESHOLDS)
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _flips_self_state(body: Sequence[ast.stmt]) -> bool:
+        """The branch assigns an attribute on ``self`` — controller
+        state, as opposed to shedding/raising a request decision."""
+        for stmt in body:
+            for node in ast.walk(stmt):
+                targets: list[ast.expr] = []
+                if isinstance(node, ast.Assign):
+                    targets = list(node.targets)
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        return True
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # Guard evidence anywhere in the function exempts every
+            # comparison in it: sustain anchors and hysteresis pairs
+            # live next to the thresholds they guard.
+            if self._mentions(fn, self._GUARDS):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.If):
+                    continue
+                if not self._threshold_compare(node.test):
+                    continue
+                if not self._flips_self_state(node.body):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    "state flipped on a raw threshold comparison of a "
+                    "load signal — one noisy tick trips it and the "
+                    "next untrips it; add a sustain window or an "
+                    "enter/exit hysteresis pair (the brownout-ladder "
+                    "idiom)",
+                )
+
+
+# ----------------------------------------------------------------------
 # registry
 # ----------------------------------------------------------------------
 
@@ -2019,6 +2165,7 @@ ALL_RULES = (
     CrossMeshHostPullRule,
     JitInRequestPathRule,
     UnboundedMetricLabelRule,
+    ThresholdNoHysteresisRule,
 )
 
 
@@ -2041,4 +2188,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         CrossMeshHostPullRule(),
         JitInRequestPathRule(),
         UnboundedMetricLabelRule(),
+        ThresholdNoHysteresisRule(),
     ]

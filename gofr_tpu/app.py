@@ -414,6 +414,13 @@ class App:
                 # rates and the compliance bit — the "is the service
                 # breaking its promise right now" read.
                 return engine_report("slo_report")
+            if path == "/debug/brownout":
+                # Brownout-ladder state (docs/advanced-guide/
+                # resilience.md "Brownout & overload control"): the
+                # degradation level, AIMD budget factor, thresholds,
+                # per-action counters — what the burn-rate actuator is
+                # DOING about the /debug/slo signal right now.
+                return engine_report("brownout_report")
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json

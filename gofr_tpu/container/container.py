@@ -395,6 +395,30 @@ class Container:
             "app_tpu_slo_compliant",
             "1 while every SLO burn rate is within budget, else 0",
         )
+        m.new_gauge(
+            "app_tpu_slo_tenant_burn_rate",
+            "per-tenant-override burn rate (TPU_SLO_TENANT_* knobs; "
+            "label set bounded by configuration, not by traffic)",
+        )
+        # Brownout overload control (serving/brownout.py; docs/
+        # advanced-guide/resilience.md "Brownout & overload control"):
+        # the degradation-ladder level, its transitions, and the
+        # per-action counters (clamp_tokens / suppress_hedge /
+        # skip_probe / shed_<class> — all bounded vocabularies).
+        m.new_gauge(
+            "app_tpu_brownout_level",
+            "brownout degradation level (0 = nominal .. 3 = replica "
+            "deprioritized from routing)",
+        )
+        m.new_counter(
+            "app_tpu_brownout_transitions_total",
+            "brownout ladder transitions (direction=up|down)",
+        )
+        m.new_counter(
+            "app_tpu_brownout_actions_total",
+            "brownout actions taken (action=clamp_tokens|"
+            "suppress_hedge|skip_probe|shed_<slo class>)",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -38,7 +38,10 @@ class InferenceServicer:
         self.tokenizer = tokenizer or engine.tokenizer
 
     def _gen_kwargs(self, request, stream: bool, context=None) -> dict:
-        from gofr_tpu.grpc.server import tenant_from_context
+        from gofr_tpu.grpc.server import (
+            slo_class_from_context,
+            tenant_from_context,
+        )
         from gofr_tpu.serving.stream_text import normalize_stop
 
         kw = dict(
@@ -53,6 +56,11 @@ class InferenceServicer:
             tenant = tenant_from_context(context)
             if tenant:
                 kw["tenant"] = tenant
+            # Brownout SLO class (x-slo-class): priority-aware shedding
+            # under overload (serving/brownout.py).
+            slo_class = slo_class_from_context(context)
+            if slo_class:
+                kw["slo_class"] = slo_class
         if request.get("top_p") is not None:
             kw["top_p"] = float(request["top_p"])
         if request.get("adapter"):

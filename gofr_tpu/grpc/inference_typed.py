@@ -33,6 +33,7 @@ class TypedInferenceServicer(_Base):
     def _gen_kwargs(self, request, context=None) -> tuple:
         from gofr_tpu.grpc.server import (
             deadline_from_context,
+            slo_class_from_context,
             tenant_from_context,
         )
 
@@ -55,6 +56,11 @@ class TypedInferenceServicer(_Base):
             tenant = tenant_from_context(context)
             if tenant:
                 kw["tenant"] = tenant
+            # Brownout SLO class (x-slo-class): priority-aware shedding
+            # under overload (serving/brownout.py).
+            slo_class = slo_class_from_context(context)
+            if slo_class:
+                kw["slo_class"] = slo_class
             # Caller's gRPC deadline → engine Deadline: when it expires
             # the scheduler retires the sequence and frees its KV blocks
             # instead of decoding past an RPC nobody is waiting on.

@@ -54,6 +54,23 @@ def tenant_from_context(context) -> str:
     return ""
 
 
+def slo_class_from_context(context) -> str:
+    """The ``x-slo-class`` invocation-metadata value ("" when absent) —
+    the gRPC twin of the ``X-SLO-Class`` HTTP header feeding the
+    brownout controller's priority-aware shedding
+    (``serving/brownout.py``: batch sheds first, interactive last)."""
+    meta = getattr(context, "invocation_metadata", None)
+    if not callable(meta):
+        return ""
+    try:
+        for key, value in meta() or ():
+            if str(key).lower() == "x-slo-class":
+                return str(value)
+    except Exception:  # graftlint: disable=GL006 — absent/stub metadata APIs mean "standard class", not an error
+        return ""
+    return ""
+
+
 def deadline_from_context(context) -> Optional[float]:
     """Seconds remaining on the caller's gRPC deadline, or None. The
     servicers turn this into a ``Deadline`` on engine submits so an

@@ -68,6 +68,7 @@ class PoolScaler:
         up_load_per_replica: float = 4.0,
         down_load_per_replica: float = 0.5,
         up_headroom_floor: float = 0.0,
+        up_on_brownout: bool = True,
         scale_up_wait_s: float = 10.0,
         scale_down_wait_s: float = 60.0,
         drain_timeout_s: float = 30.0,
@@ -94,6 +95,12 @@ class PoolScaler:
         # signal never sees coming (device_telemetry's headroom is the
         # same signal admission and the eviction watermark read).
         self.up_headroom_floor = float(up_headroom_floor)
+        # Brownout-aware scale-up (TPU_SCALE_UP_BROWNOUT, default on):
+        # a serving replica holding brownout level 2+ is deliberately
+        # shedding admissions — demand the queue-depth signal no longer
+        # sees. Sustained through the same scale_up_wait_s window, so a
+        # short burn spike spawns nothing.
+        self.up_on_brownout = bool(up_on_brownout)
         self.scale_up_wait_s = float(scale_up_wait_s)
         self.scale_down_wait_s = float(scale_down_wait_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -139,6 +146,22 @@ class PoolScaler:
         worst = min(ratios)
         return worst if worst < self.up_headroom_floor else None
 
+    def _max_brownout(self, capacity: list[Replica]) -> Optional[int]:
+        """The worst advertised brownout level across serving capacity
+        when it reaches the admission-shedding rungs (L2+), else None.
+        None-advertising replicas don't count — absence of the signal
+        must not read as pressure."""
+        if not self.up_on_brownout:
+            return None
+        levels = [
+            lvl for r in capacity
+            for lvl in (r.brownout_level(),) if lvl is not None
+        ]
+        if not levels:
+            return None
+        worst = max(levels)
+        return worst if worst >= 2 else None
+
     def load_per_replica(self) -> float:
         """Aggregate outstanding work over serving capacity — the
         scaling signal. Work queued while NO capacity serves reads as
@@ -169,7 +192,12 @@ class PoolScaler:
             return self._scale_up(now, reason="below min_replicas")
 
         low_headroom = self._min_headroom(capacity)
-        if load > self.up_load_per_replica or low_headroom is not None:
+        hot_brownout = self._max_brownout(capacity)
+        if (
+            load > self.up_load_per_replica
+            or low_headroom is not None
+            or hot_brownout is not None
+        ):
             self._idle_since = None
             if self._pressure_since is None:
                 self._pressure_since = now
@@ -187,6 +215,11 @@ class PoolScaler:
                         f"HBM headroom {low_headroom:.3f} < "
                         f"{self.up_headroom_floor:.3f} for "
                         f"{self.scale_up_wait_s:.0f}s"
+                    )
+                elif hot_brownout is not None:
+                    reason = (
+                        f"brownout level {hot_brownout} (L2+ sheds "
+                        f"admissions) for {self.scale_up_wait_s:.0f}s"
                     )
                 return self._scale_up(now, reason=reason)
             return "steady"
@@ -310,6 +343,7 @@ class PoolScaler:
             "up_load_per_replica": self.up_load_per_replica,
             "down_load_per_replica": self.down_load_per_replica,
             "up_headroom_floor": self.up_headroom_floor,
+            "up_on_brownout": self.up_on_brownout,
             "scale_up_wait_s": self.scale_up_wait_s,
             "scale_down_wait_s": self.scale_down_wait_s,
             "spawned": [r.name for r in self._spawned],

@@ -246,10 +246,20 @@ class Replica:
 
     def slo_compliant(self) -> Optional[bool]:
         """Whether the replica's configured SLOs are currently within
-        budget (every burn rate ≤ 1), ``None`` when unknown or no SLOs
-        are configured — in-proc replicas read their engine's SLO
-        engine, remote ones cache the health payload's ``slo`` detail
-        from the last probe."""
+        budget (every burn rate ≤ 1) AND its brownout ladder is below
+        L3, ``None`` when unknown or no SLOs are configured — in-proc
+        replicas read their engine's SLO engine, remote ones cache the
+        health payload's ``slo`` detail from the last probe. ``pick()``
+        deprioritizes ``False`` the way tier routing prefers roles."""
+        return None
+
+    def brownout_level(self) -> Optional[int]:
+        """The replica's brownout-ladder level (``serving/brownout.py``)
+        or ``None`` when unknown / the layer is off — in-proc replicas
+        read their engine's controller, remote ones cache the health
+        payload's ``brownout`` detail from the last probe. At L1+ the
+        pool suppresses latency hedges and synthetic-probe generations
+        against this replica; the scaler counts L2+ as pressure."""
         return None
 
     def describe(self) -> dict:
@@ -265,6 +275,7 @@ class Replica:
             "mesh": self.mesh_topology(),
             "hbm_headroom": self.headroom(),
             "slo_compliant": self.slo_compliant(),
+            "brownout_level": self.brownout_level(),
         }
 
     def close(self) -> None:
@@ -338,6 +349,15 @@ class EngineReplica(Replica):
             return None
 
     def slo_compliant(self) -> Optional[bool]:
+        # engine.slo_compliant folds the brownout ladder in (L3 =
+        # non-compliant) — the ONE routing signal pick() reads.
+        check = getattr(self.engine, "slo_compliant", None)
+        if callable(check):
+            try:
+                result = check()
+            except Exception:  # noqa: BLE001 — advertisement is a debug hint only
+                return None
+            return None if result is None else bool(result)
         slo = getattr(self.engine, "_slo", None)
         if slo is None:
             return None
@@ -345,6 +365,16 @@ class EngineReplica(Replica):
             return bool(slo.compliant())
         except Exception:  # noqa: BLE001 — advertisement is a debug hint only
             return None
+
+    def brownout_level(self) -> Optional[int]:
+        level = getattr(self.engine, "brownout_level", None)
+        if not callable(level):
+            return None
+        try:
+            n = level()
+        except Exception:  # noqa: BLE001 — advertisement is a routing hint only
+            return None
+        return None if n is None else int(n)
 
     def load_adapter(self, name: str, source: Any) -> bool:
         try:
@@ -390,6 +420,10 @@ class EngineReplica(Replica):
                 req.timeline = obs.begin(
                     prompt_tokens=len(req.prompt_ids),
                     traceparent=req.traceparent,
+                    # Per-tenant SLO overrides judge at retirement from
+                    # the timeline's tenant — an adopted request must
+                    # not vanish from its tenant's burn windows.
+                    tenant=str(getattr(req, "tenant", "") or ""),
                 )
         return bool(self.engine.requeue_replay(req))
 
@@ -509,6 +543,7 @@ class HTTPReplica(Replica):
         self._mesh: Optional[dict] = None
         self._hbm_headroom: Optional[float] = None
         self._slo_compliant: Optional[bool] = None
+        self._brownout_level: Optional[int] = None
         self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
@@ -529,6 +564,9 @@ class HTTPReplica(Replica):
 
     def slo_compliant(self) -> Optional[bool]:
         return self._slo_compliant
+
+    def brownout_level(self) -> Optional[int]:
+        return self._brownout_level
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -566,6 +604,7 @@ class HTTPReplica(Replica):
             else 0,
             adapter=str(kw.get("adapter") or ""),
             tenant=str(kw.get("tenant") or ""),
+            slo_class=str(kw.get("slo_class") or "standard"),
             pin_replica=bool(kw.get("pin_replica", False)),
             # The FULL sampling contract rides the local handle too, not
             # just the wire body: a failover adoption (in-proc
@@ -648,6 +687,10 @@ class HTTPReplica(Replica):
             )
         if kw.get("tenant"):
             headers["X-Tenant-Id"] = str(kw["tenant"])
+        if kw.get("slo_class"):
+            # Brownout priority class rides the wire so the remote's
+            # OWN controller sheds batch-first there too.
+            headers["X-SLO-Class"] = str(kw["slo_class"])
         if kw.get("traceparent"):
             # Cross-replica trace stitching: the remote replica's server
             # middleware adopts this trace id, so its spans land in the
@@ -679,6 +722,7 @@ class HTTPReplica(Replica):
         text_parts: list[str] = []
         done_seen = False
         finish_seen = False
+        remote_brownout = False
         try:
             with self.service.stream_lines(
                 "POST", self.generate_path, json=body, headers=headers,
@@ -725,6 +769,10 @@ class HTTPReplica(Replica):
                     if choice.get("finish_reason"):
                         reason = str(choice["finish_reason"])
                         finish_seen = True
+                        # The remote's brownout-clamp advertisement
+                        # (finish-chunk field) survives the hop.
+                        if choice.get("brownout"):
+                            remote_brownout = True
                         # On an ADOPTED continuation the upstream's
                         # prompt was prompt+delivered, so its reported
                         # prompt_tokens would double-count the delivered
@@ -768,6 +816,7 @@ class HTTPReplica(Replica):
             ttft_s=(first_at - start) if first_at is not None else 0.0,
             duration_s=time.monotonic() - start,
             finish_reason=reason,
+            brownout=remote_brownout,
         )
         self._finish_stream(req, result)
 
@@ -979,6 +1028,10 @@ class HTTPReplica(Replica):
                 ttft_s=0.0,
                 duration_s=time.monotonic() - start,
                 finish_reason=str(choice.get("finish_reason", "stop")),
+                # The remote's brownout-clamp advertisement rides
+                # through: clients of a multi-host pool must still see
+                # that the truncation was policy, not a bug.
+                brownout=bool(choice.get("brownout", False)),
             )
             if not req.future.done():
                 req.future.set_result(result)
@@ -1043,6 +1096,25 @@ class HTTPReplica(Replica):
         self._slo_compliant = (
             bool(compliant) if isinstance(compliant, bool) else None
         )
+        # Brownout advertisement (serving/brownout.py): the remote's
+        # ladder level, so this pool suppresses hedges/probes against a
+        # browning-out pod and deprioritizes it at L3 — same
+        # unconditional-assign discipline.
+        brownout = details.get("brownout")
+        level = (
+            brownout.get("level") if isinstance(brownout, dict) else None
+        )
+        self._brownout_level = (
+            int(level) if isinstance(level, (int, float)) else None
+        )
+        if (
+            self._brownout_level is not None
+            and self._brownout_level >= 3
+            and self._slo_compliant is not False
+        ):
+            # L3 means the remote marked itself non-routable even if
+            # its own burn gauges momentarily read compliant.
+            self._slo_compliant = False
         if health.get("status") == "UP":
             self._state = "SERVING"
             return "pass", ""
@@ -1179,6 +1251,12 @@ class ReplicaPool:
         self._replicas_lock = threading.Lock()
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
+        # Replicas whose synthetic probe was brownout-skipped LAST
+        # sweep: the skip alternates, so probe cadence halves under a
+        # brownout but restart-on-evidence still fires within two
+        # sweeps (a live-advertising replica with a broken dataplane
+        # must not hide behind its own burn storm forever).
+        self._brownout_probe_skipped: set[int] = set()
         # Optional load-adaptive scaler (service/pool_scaler.py), set by
         # the config seam; started/stopped with the pool lifecycle.
         self.scaler: Optional[Any] = None
@@ -1341,6 +1419,18 @@ class ReplicaPool:
             preferred = [r for r in candidates if r.role in prefer_roles]
             if preferred:
                 candidates = preferred
+        # SLO-compliance routing (the ROADMAP "route on slo_compliant"
+        # item, closed by the brownout PR): replicas advertising
+        # non-compliance — burn over budget, or brownout L3 — are
+        # deprioritized with the same preference-never-partition
+        # discipline as tier roles. None (no SLOs / unknown) counts as
+        # compliant: absence of the signal must not starve a replica.
+        if len(candidates) > 1:
+            compliant = [
+                r for r in candidates if r.slo_compliant() is not False
+            ]
+            if compliant and len(compliant) < len(candidates):
+                candidates = compliant
         if candidates:
             with self._rr_lock:
                 start = self._rr % len(candidates)
@@ -1573,13 +1663,22 @@ class ReplicaPool:
             delay = min(delay, max(deadline.remaining(), 0.0))
         return delay
 
+    def _hedge_eligible(self, deadline: Optional[Deadline]) -> bool:
+        """Non-consuming eligibility twin of :meth:`should_hedge`:
+        deadline still live and budget available. Shared by the
+        brownout suppress-hedge counter so 'what we suppressed' can
+        never drift from 'what would have fired'."""
+        if deadline is not None and deadline.remaining() <= 0:
+            return False
+        return self.hedge_budget.available() >= 1.0
+
     def should_hedge(self, deadline: Optional[Deadline]) -> bool:
         """Deadline-aware, budgeted second-attempt decision (latency
         hedges AND fast-fail retries): never hedge work whose deadline
         already passed, and never without budget — an exhausted bucket
         means the tier is slow EVERYWHERE and doubling load would dig
         the hole deeper."""
-        if deadline is not None and deadline.remaining() <= 0:
+        if not self._hedge_eligible(deadline):
             return False
         return self.hedge_budget.try_acquire()
 
@@ -1628,10 +1727,22 @@ class ReplicaPool:
         # check comes FIRST (short-circuit) so a pool with no routable
         # second replica never burns tokens it cannot use — draining the
         # bucket on impossible hedges would starve real ones the moment
-        # a sibling recovers.
-        if self._routable_sibling_exists(
-            tried, adapter=str(kw.get("adapter") or "")
-        ) and self.should_hedge(deadline):
+        # a sibling recovers. A browned-out primary (L1+) suppresses the
+        # LATENCY hedge — its slowness is managed degradation, and a
+        # duplicate would land the exact optional load the brownout is
+        # shedding on a sibling that is likely storming too. Fast-fail
+        # retries (primary_exc set) still reroute: the request NEEDS a
+        # server.
+        if (
+            self._routable_sibling_exists(
+                tried, adapter=str(kw.get("adapter") or "")
+            )
+            and not (
+                primary_exc is None
+                and self._hedge_suppressed(tried, deadline)
+            )
+            and self.should_hedge(deadline)
+        ):
             try:
                 _, second = self._submit_routed(
                     prompt, kw, tried, require_stream=False
@@ -1650,6 +1761,41 @@ class ReplicaPool:
             assert primary_exc is not None
             raise primary_exc
         return self._first_result(live, timeout, primary_exc)
+
+    def _note_brownout_action(self, replica: Replica, action: str) -> None:
+        """Count a pool-side ladder action. Routed through the in-proc
+        engine's controller when reachable so the Prometheus counter
+        AND /debug/brownout's per-action table agree; remote replicas
+        (level-only advertisement, no controller here) count straight
+        to the metric."""
+        bc = getattr(getattr(replica, "engine", None), "_brownout", None)
+        if bc is not None:
+            bc.note_action(action)
+            return
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_brownout_actions_total",
+                "model", self.model_name, "action", action,
+            )
+
+    def _hedge_suppressed(
+        self, tried: list[Replica], deadline: Optional[Deadline] = None
+    ) -> bool:
+        """True when the primary replica advertises brownout L1+ —
+        hedging against managed degradation is the optional load the
+        ladder exists to shed (serving/brownout.py). The action counter
+        only increments when a hedge was otherwise ELIGIBLE (live
+        deadline, budget available): counting every slow request under
+        a storm would overstate what the ladder actually suppressed."""
+        primary = tried[0] if tried else None
+        if primary is None:
+            return False
+        level = primary.brownout_level()
+        if level is None or level < 1:
+            return False
+        if self._hedge_eligible(deadline):
+            self._note_brownout_action(primary, "suppress_hedge")
+        return True
 
     def _routable_sibling_exists(
         self, tried: list[Replica], adapter: str = ""
@@ -2167,6 +2313,8 @@ class ReplicaPool:
           supervisor restart (restart on evidence, not just on crash).
         """
         results: dict[str, str] = {}
+        skipped_last = self._brownout_probe_skipped
+        self._brownout_probe_skipped = set()
         for replica in self._replicas:
             state = replica.state()
             if state == "RESTARTING":
@@ -2180,6 +2328,30 @@ class ReplicaPool:
                     self._probe_replica(replica)
                     if replica.revive(self.probe_timeout_s) else "down"
                 )
+            elif (
+                not replica.probe_failed
+                and not replica.remote
+                and id(replica) not in skipped_last
+                and (replica.brownout_level() or 0) >= 1
+            ):
+                # Brownout L1 sheds optional work, and an IN-PROC
+                # synthetic probe is a whole greedy generation through
+                # the dataplane. A routable local replica advertising
+                # L1+ skips the token-generating probe on ALTERNATING
+                # sweeps — half the optional probe load, but a broken
+                # dataplane whose failures ARE the burn still produces
+                # probe evidence (demotion + supervisor restart) within
+                # two sweeps. A DEMOTED replica always probes —
+                # re-admission still requires a clean pass through the
+                # full dataplane. REMOTE replicas always probe too:
+                # their probe is a cheap health GET, not a generation,
+                # and it is the ONLY path that refreshes the cached
+                # brownout/compliance advertisement — skipping it would
+                # freeze a recovered pod at its last advertised level
+                # forever.
+                self._brownout_probe_skipped.add(id(replica))
+                self._note_brownout_action(replica, "skip_probe")
+                results[replica.name] = "skipped: brownout"
             else:
                 results[replica.name] = self._probe_replica(replica)
             self._publish_state(replica)
@@ -2315,6 +2487,7 @@ class ReplicaPool:
             # next to its timelines — and whether its SLOs are burning.
             entry["hbm_headroom"] = replica.headroom()
             entry["slo_compliant"] = replica.slo_compliant()
+            entry["brownout_level"] = replica.brownout_level()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 
@@ -2342,6 +2515,7 @@ class ReplicaPool:
             entry["role"] = replica.role
             entry["hbm_headroom"] = replica.headroom()
             entry["slo_compliant"] = replica.slo_compliant()
+            entry["brownout_level"] = replica.brownout_level()
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 
@@ -2385,6 +2559,27 @@ class ReplicaPool:
                 entry = {
                     "remote": True,
                     "compliant": replica.slo_compliant(),
+                }
+            replicas[replica.name] = entry
+        return {"replicas": replicas}
+
+    def brownout_report(self) -> dict:
+        """Aggregate ``/debug/brownout`` view: each in-proc replica's
+        ladder state keyed by replica name; remote replicas contribute
+        their probe-cached level."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            engine = getattr(replica, "engine", None)
+            report_fn = getattr(engine, "brownout_report", None)
+            if callable(report_fn):
+                try:
+                    entry = dict(report_fn())
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    entry = {"error": str(exc)}
+            else:
+                entry = {
+                    "remote": True,
+                    "level": replica.brownout_level(),
                 }
             replicas[replica.name] = entry
         return {"replicas": replicas}

@@ -308,6 +308,13 @@ def _new_tpu_pool_from_config(
             up_headroom_floor=float(config.get_or_default(
                 "TPU_SCALE_UP_HEADROOM", "0"
             )),
+            # Brownout-aware scale-up (serving/brownout.py): a replica
+            # holding L2+ is shedding admissions — that is demand, not
+            # idleness. Default on; the signal only exists when the
+            # brownout layer is armed.
+            up_on_brownout=config.get_or_default(
+                "TPU_SCALE_UP_BROWNOUT", "1"
+            ).lower() not in ("0", "false", "no"),
             scale_up_wait_s=float(config.get_or_default(
                 "TPU_SCALE_UP_WAIT_S", "10"
             )),

@@ -66,6 +66,11 @@ class DynamicBatcher:
         self._queue: queue.Queue[_Pending] = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # EWMA of flush (execute) wall time — the load-sensitive basis
+        # for the queue-full Retry-After (ISSUE 13: a constant 1s told
+        # clients to hammer an overloaded batcher at 1 Hz regardless of
+        # how deep the backlog actually was).
+        self._flush_ewma_s = 0.0
 
     def start(self) -> None:
         if self._thread is None:
@@ -98,13 +103,22 @@ class DynamicBatcher:
             raise ErrorTooManyRequests(
                 f"{self._name} batch queue full "
                 f"({self._queue.maxsize} pending)",
-                retry_after_s=1.0,
+                retry_after_s=self._retry_after_s(),
             ) from None
         if self._metrics is not None:
             self._metrics.set_gauge(
                 "app_tpu_queue_depth", self._queue.qsize(), "batcher", self._name
             )
         return pending.future
+
+    def _retry_after_s(self) -> float:
+        """Load-sensitive Retry-After for a queue-full shed: the
+        backlog in flush units times the measured flush time (the wait
+        window floors it while the EWMA is cold). Always ≥ 1s (the wire
+        form ceils)."""
+        flushes = -(-self._queue.qsize() // max(1, self.max_batch))
+        per_flush = max(self._flush_ewma_s, self.max_wait_s)
+        return max(1.0, flushes * per_flush)
 
     # -- worker -----------------------------------------------------------
 
@@ -120,6 +134,7 @@ class DynamicBatcher:
                 self._metrics.set_gauge(
                     "app_tpu_queue_depth", self._queue.qsize(), "batcher", self._name
                 )
+            t0 = time.monotonic()
             try:
                 results = self._execute([p.payload for p in batch])
                 for pending, result in zip(batch, results):
@@ -128,6 +143,13 @@ class DynamicBatcher:
                 for pending in batch:
                     if not pending.future.done():
                         pending.future.set_exception(exc)
+            # Flush-time EWMA (shed Retry-After basis): failures count
+            # too — a flush that burns time burns it either way.
+            elapsed = time.monotonic() - t0
+            self._flush_ewma_s = (
+                elapsed if self._flush_ewma_s == 0.0
+                else 0.8 * self._flush_ewma_s + 0.2 * elapsed
+            )
 
     def _collect(self) -> list[_Pending]:
         """Block for the first request, then drain until size or deadline."""

@@ -1,0 +1,398 @@
+"""Closed-loop overload control: the brownout ladder (ISSUE 13).
+
+PR 11 made the pod *measure* its promises — ``app_tpu_slo_burn_rate``
+and ``app_tpu_slo_compliant`` — but nothing *acted* on them: under a
+sustained overload storm the fleet served every admitted request at
+full quality until the static admission budgets tripped, so tail
+latency collapsed for everyone before anyone was degraded. This module
+is the runtime twin of the multi-window burn-rate alert: it sheds
+**quality** in graded steps before shedding **requests**, and sheds the
+right requests first.
+
+A :class:`BrownoutController` maps the :class:`~gofr_tpu.serving.slo.
+SLOEngine`'s fast-window (5m) burn rate — plus, optionally, the PR 10
+HBM headroom signal — onto a small ladder of degradation levels:
+
+* **L0** — nominal. Every action below is byte-identically off.
+* **L1** — shed *optional* work: the replica pool suppresses latency
+  hedges against this replica and skips an in-proc replica's
+  token-generating synthetic probes on alternating sweeps (half the
+  probe load, but restart-on-evidence still fires within two sweeps;
+  remote replicas always probe — their probe is a cheap health fetch
+  and the only path that refreshes the cached advertisement), and new
+  admits
+  have ``max_new_tokens`` clamped to ``TPU_BROWNOUT_MAX_NEW``. The
+  clamp is *advertised*: the response carries
+  ``finish_reason="length"`` plus a ``brownout`` field so clients see
+  the truncation was deliberate, not a bug.
+* **L2** — AIMD on the effective admission budget: a multiplicative
+  cut (``TPU_BROWNOUT_AIMD_CUT``) of the ``TPU_QUEUE_TOKENS`` /
+  ``TPU_QUEUE_MAX`` budget on entry, additive recovery
+  (``TPU_BROWNOUT_RECOVER_PER_S`` of the budget per second) while the
+  signal is below the enter threshold. Shedding is **priority-aware**:
+  requests carry an SLO class (``X-SLO-Class`` header / ``x-slo-class``
+  gRPC metadata: ``interactive`` | ``standard`` | ``batch``, default
+  ``standard``, per-tenant default via ``TPU_TENANT_SLO_CLASS``) and
+  each class may only fill a fraction of the cut budget
+  (:data:`CLASS_ADMIT_FRACTION`) — batch is consumed first,
+  interactive last. Every 429 is stamped ``reason=brownout`` with a
+  ``Retry-After`` derived from the controller's projected recovery.
+* **L3** — the replica marks itself non-compliant:
+  ``ReplicaPool.pick()`` deprioritizes it exactly like the tier-role
+  preference (never a partition — an all-L3 pool still serves), and
+  ``PoolScaler`` treats sustained L2+ as scale-up pressure.
+
+Discipline:
+
+* **Hysteresis everywhere** (graftlint GL017 is the static twin): a
+  level is entered only after the 5m burn holds at or above
+  ``TPU_BROWNOUT_ENTER`` for ``TPU_BROWNOUT_SUSTAIN_S`` — one bad tick
+  never flips a level — and exited only after it holds at or below
+  ``TPU_BROWNOUT_EXIT`` for ``TPU_BROWNOUT_EXIT_SUSTAIN_S``. Between
+  the thresholds the ladder holds.
+* **Window granularity** (GL011): the scheduler evaluates the
+  controller once per loop pass with one clock read; nothing here is
+  per-token or per-request.
+* **Determinism**: the clock is injectable; tests state time instead
+  of sleeping.
+* **Off is off**: ``TPU_BROWNOUT=0`` builds no controller — every hook
+  is one ``is not None`` — and at L0 an armed controller changes no
+  behavior (the AIMD factor snaps back to exactly 1.0 on reaching L0).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Mapping, Optional
+
+#: The SLO-class vocabulary (bounded: it appears in metric labels).
+SLO_CLASSES = ("interactive", "standard", "batch")
+
+#: Fraction of the (already AIMD-cut) admission budget each class may
+#: fill at L2+. Batch fills its smaller allowance first and sheds
+#: first; interactive keeps the whole cut budget and sheds last.
+CLASS_ADMIT_FRACTION: Mapping[str, float] = {
+    "batch": 0.5,
+    "standard": 0.8,
+    "interactive": 1.0,
+}
+
+#: Highest ladder rung.
+MAX_LEVEL = 3
+
+
+def normalize_slo_class(value: str) -> str:
+    """Clamp a request-controlled class string to the bounded
+    vocabulary ("" when it names no known class — the caller falls back
+    to the tenant default, then ``standard``)."""
+    v = str(value or "").strip().lower()
+    return v if v in SLO_CLASSES else ""
+
+
+def parse_tenant_class_map(spec: str) -> dict[str, str]:
+    """``TPU_TENANT_SLO_CLASS="acme=batch,ops=interactive"`` → per-
+    tenant default SLO class. Unknown class names are dropped (the
+    request falls back to ``standard``) rather than failing boot.
+    Tenant keys are lower-cased: the lookup matches ``X-Tenant-Id``
+    case-insensitively, the same contract as the
+    ``TPU_SLO_TENANT_<NAME>_*`` per-tenant SLO overrides (whose env-key
+    segment is conventionally upper-case)."""
+    out: dict[str, str] = {}
+    for entry in str(spec or "").replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        tenant, cls = entry.split("=", 1)
+        cls = normalize_slo_class(cls)
+        if tenant.strip() and cls:
+            out[tenant.strip().lower()] = cls
+    return out
+
+
+class BrownoutController:
+    """Burn-rate-driven degradation ladder (see the module docstring).
+
+    One instance per engine. ``evaluate`` runs on the scheduler thread
+    once per loop pass; the action reads (``level``, ``clamp_max_new``,
+    ``admission_fraction``, ``routable``) run on submit/probe threads —
+    all state is mutated under one lock and the hot reads are single
+    attribute loads."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        enter_burn: float = 2.0,
+        exit_burn: float = 1.0,
+        sustain_s: float = 10.0,
+        exit_sustain_s: float = 30.0,
+        max_new_tokens: int = 256,
+        aimd_cut: float = 0.5,
+        recover_per_s: float = 0.02,
+        min_headroom: float = 0.0,
+        metrics: Any = None,
+        logger: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.model_name = model_name
+        # Hysteresis pair: exit must sit at or below enter or the
+        # ladder would oscillate inside the dead band it is meant to
+        # create.
+        self.enter_burn = max(0.0, float(enter_burn))
+        self.exit_burn = min(self.enter_burn, max(0.0, float(exit_burn)))
+        self.sustain_s = max(0.0, float(sustain_s))
+        self.exit_sustain_s = max(0.0, float(exit_sustain_s))
+        self.max_new_tokens = max(0, int(max_new_tokens))
+        self.aimd_cut = min(1.0, max(0.05, float(aimd_cut)))
+        self.recover_per_s = max(1e-4, float(recover_per_s))
+        self.min_headroom = max(0.0, float(min_headroom))
+        self._metrics = metrics
+        self._logger = logger
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.level = 0
+        #: AIMD multiplier on the admission budget: 1.0 nominal, cut
+        #: multiplicatively on each climb into L2+, recovered
+        #: additively, snapped to exactly 1.0 at L0 (byte-identity).
+        self.budget_factor = 1.0
+        # Sustain anchors (GL017 discipline): the first evaluation that
+        # saw the signal continuously over (resp. under) its threshold.
+        self._over_since: Optional[float] = None
+        self._clear_since: Optional[float] = None
+        self._last_eval: Optional[float] = None
+        # Last inputs, for /debug/brownout.
+        self._last_burn = 0.0
+        self._last_headroom: Optional[float] = None
+        self._transitions = {"up": 0, "down": 0}
+        self._actions: dict[str, int] = {}
+        self._publish_level()
+
+    # -- control loop (scheduler thread, once per window) ----------------
+
+    def evaluate(
+        self,
+        burn_5m: float,
+        headroom: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> int:
+        """One control decision from the 5m burn rate (and, when the
+        headroom floor is armed, the HBM headroom ratio). Returns the
+        level after the decision. Climbs one rung per sustained-over
+        period, descends one rung per sustained-clear period — exit is
+        confirmed on the 5m window actually recovering, never on mere
+        time passing at a lower level."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            dt = (
+                max(0.0, t - self._last_eval)
+                if self._last_eval is not None else 0.0
+            )
+            self._last_eval = t
+            self._last_burn = float(burn_5m)
+            self._last_headroom = headroom
+            headroom_pressure = (
+                self.min_headroom > 0.0
+                and headroom is not None
+                and headroom < self.min_headroom
+            )
+            over = burn_5m >= self.enter_burn or headroom_pressure
+            clear = burn_5m <= self.exit_burn and not headroom_pressure
+            # Additive recovery while the signal is not over: the
+            # budget creeps back toward nominal even before the ladder
+            # steps down (slow-start after the cut). At ANY level above
+            # 0 — a factor frozen at L1 would keep inflating every
+            # Retry-After's recovery floor and compound the next L2
+            # entry's cut. (At L0 the factor is already snapped to 1.)
+            if not over and self.budget_factor < 1.0:
+                self.budget_factor = min(
+                    1.0, self.budget_factor + self.recover_per_s * dt
+                )
+            if over:
+                self._clear_since = None
+                if self._over_since is None:
+                    self._over_since = t
+                elif (
+                    t - self._over_since >= self.sustain_s
+                    and self.level < MAX_LEVEL
+                ):
+                    self._step(+1, t)
+                    self._over_since = t  # re-arm for the next rung
+            elif clear:
+                self._over_since = None
+                if self._clear_since is None:
+                    self._clear_since = t
+                elif (
+                    t - self._clear_since >= self.exit_sustain_s
+                    and self.level > 0
+                ):
+                    self._step(-1, t)
+                    self._clear_since = t  # one rung per clear period
+            else:
+                # Inside the hysteresis band: hold the level, reset
+                # both anchors — neither climb nor descent may count
+                # band time toward its sustain window.
+                self._over_since = None
+                self._clear_since = None
+            return self.level
+
+    def _step(self, direction: int, now: float) -> None:
+        """One ladder transition (call under the lock)."""
+        prev = self.level
+        self.level = min(MAX_LEVEL, max(0, self.level + direction))
+        if self.level == prev:
+            return
+        if direction > 0 and self.level >= 2:
+            # Multiplicative cut on entering (or climbing within) the
+            # admission-shedding rungs.
+            self.budget_factor = max(0.01, self.budget_factor * self.aimd_cut)
+        if self.level == 0:
+            # Byte-identity contract: at L0 every action is exactly
+            # off, so the budget snaps back to nominal.
+            self.budget_factor = 1.0
+        key = "up" if direction > 0 else "down"
+        self._transitions[key] += 1
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_brownout_transitions_total",
+                "model", self.model_name, "direction", key,
+            )
+        self._publish_level()
+        if self._logger is not None:
+            self._logger.warnf(
+                "brownout level %d -> %d (burn_5m=%.2f, headroom=%s, "
+                "budget_factor=%.3f)", prev, self.level, self._last_burn,
+                "n/a" if self._last_headroom is None
+                else f"{self._last_headroom:.3f}",
+                self.budget_factor,
+            )
+
+    def _publish_level(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "app_tpu_brownout_level", float(self.level),
+                "model", self.model_name,
+            )
+
+    def force_level(self, level: int, now: Optional[float] = None) -> None:
+        """Jump the ladder to ``level`` (ops drills and deterministic
+        tests; the next ``evaluate`` resumes normal hysteresis from
+        here). Out-of-range targets clamp — ``_step`` clamps too, so an
+        unclamped loop target could never be reached and would spin
+        forever holding the lock."""
+        level = min(MAX_LEVEL, max(0, int(level)))
+        t = self._clock() if now is None else now
+        with self._lock:
+            while self.level < level:
+                self._step(+1, t)
+            while self.level > level:
+                self._step(-1, t)
+            self._over_since = None
+            self._clear_since = None
+
+    # -- action surface ---------------------------------------------------
+
+    @property
+    def shedding(self) -> bool:
+        """L2+ — the admission budget is cut. (Pool-side actions —
+        hedge suppression, probe skipping, scaler pressure — work on
+        the ADVERTISED level instead: remote replicas only ship an
+        integer over the health wire, so the pool compares levels, not
+        controller predicates.)"""
+        return self.level >= 2
+
+    def routable(self) -> bool:
+        """False at L3: the replica advertises non-compliance so the
+        pool deprioritizes it exactly like the SLO burn signal."""
+        return self.level < MAX_LEVEL
+
+    def clamp_max_new(self, requested: int) -> int:
+        """L1+ clamp on a new admit's generation budget (0 = no clamp
+        configured)."""
+        if self.level >= 1 and self.max_new_tokens > 0:
+            return min(int(requested), self.max_new_tokens)
+        return int(requested)
+
+    def admission_fraction(self, slo_class: str) -> float:
+        """The fraction of the nominal admission budget ``slo_class``
+        may fill right now: 1.0 below L2 (byte-identical admission),
+        else the AIMD factor scaled by the class allowance — batch
+        first into the cut, interactive last."""
+        if self.level < 2:
+            return 1.0
+        frac = CLASS_ADMIT_FRACTION.get(slo_class, CLASS_ADMIT_FRACTION["standard"])
+        return self.budget_factor * frac
+
+    def projected_recovery_s(self, now: Optional[float] = None) -> float:
+        """Deterministic Retry-After basis for brownout sheds: the time
+        for the ladder to descend to L1 (one exit-sustain period per
+        rung above it, less any clear time already banked) plus the
+        AIMD budget's additive recovery to nominal. Always positive —
+        a 429 must never tell the client "retry immediately" while the
+        controller is still degraded."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            rungs = max(0, self.level - 1)
+            wait = rungs * self.exit_sustain_s
+            if self._clear_since is not None and rungs > 0:
+                wait -= min(
+                    self.exit_sustain_s, max(0.0, t - self._clear_since)
+                )
+            wait += (1.0 - self.budget_factor) / self.recover_per_s
+            return max(1.0, wait)
+
+    def note_action(self, action: str) -> None:
+        """Count one ladder action (``clamp_tokens``, ``suppress_hedge``,
+        ``skip_probe``, ``shed_<class>``) — the per-action counters the
+        storm suite and the bench A/B read."""
+        with self._lock:
+            self._actions[action] = self._actions.get(action, 0) + 1
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_brownout_actions_total",
+                "model", self.model_name, "action", action,
+            )
+
+    def shed_count(self, slo_class: str) -> int:
+        with self._lock:
+            return self._actions.get(f"shed_{slo_class}", 0)
+
+    # -- rendering --------------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """The compact health-detail form (rides probes, like the HBM
+        headroom and SLO compliance)."""
+        with self._lock:
+            return {
+                "level": self.level,
+                "budget_factor": round(self.budget_factor, 6),
+                "routable": self.level < MAX_LEVEL,
+            }
+
+    def snapshot(self) -> dict[str, Any]:
+        """The full ``/debug/brownout`` form: ladder state, thresholds,
+        last control inputs, per-action counters, projected recovery."""
+        with self._lock:
+            state = {
+                "enabled": True,
+                "level": self.level,
+                "budget_factor": round(self.budget_factor, 6),
+                "enter_burn": self.enter_burn,
+                "exit_burn": self.exit_burn,
+                "sustain_s": self.sustain_s,
+                "exit_sustain_s": self.exit_sustain_s,
+                "max_new_tokens": self.max_new_tokens,
+                "aimd_cut": self.aimd_cut,
+                "recover_per_s": self.recover_per_s,
+                "min_headroom": self.min_headroom,
+                "last_burn_5m": round(self._last_burn, 6),
+                "last_headroom": (
+                    None if self._last_headroom is None
+                    else round(self._last_headroom, 6)
+                ),
+                "class_admit_fraction": dict(CLASS_ADMIT_FRACTION),
+                "transitions": dict(self._transitions),
+                "actions": dict(self._actions),
+            }
+        state["projected_recovery_s"] = round(self.projected_recovery_s(), 3)
+        return state

@@ -110,6 +110,17 @@ class InferenceEngine(
         slo_ttft_ms: float = 0.0,
         slo_e2e_ms: float = 0.0,
         slo_availability: float = 0.0,
+        slo_tenant_objectives: Optional[dict] = None,
+        brownout: Optional[bool] = None,
+        brownout_enter: float = 2.0,
+        brownout_exit: float = 1.0,
+        brownout_sustain_s: float = 10.0,
+        brownout_exit_sustain_s: float = 30.0,
+        brownout_max_new: int = 256,
+        brownout_aimd_cut: float = 0.5,
+        brownout_recover_per_s: float = 0.02,
+        brownout_min_headroom: float = 0.0,
+        tenant_slo_class: str = "",
         compile_cache_dir: str = "",
         expected_tps: float = 0.0,
         watchdog_s: float = 0.0,
@@ -436,12 +447,16 @@ class InferenceEngine(
         from gofr_tpu.serving.slo import SLOEngine
 
         self._slo: Optional[SLOEngine] = None
-        if slo_ttft_ms > 0 or slo_e2e_ms > 0 or slo_availability > 0:
+        if (
+            slo_ttft_ms > 0 or slo_e2e_ms > 0 or slo_availability > 0
+            or slo_tenant_objectives
+        ):
             self._slo = SLOEngine(
                 model_name,
                 ttft_ms=slo_ttft_ms,
                 e2e_ms=slo_e2e_ms,
                 availability=slo_availability,
+                tenant_objectives=slo_tenant_objectives,
                 metrics=metrics,
             )
         # The observability hub feeds every retired timeline's phases
@@ -449,6 +464,40 @@ class InferenceEngine(
         # when recorder/metrics/exporter are all off, so SLOs alone
         # still see every request).
         self._obs.slo = self._slo
+        # Closed-loop overload control (serving/brownout.py; docs/
+        # advanced-guide/resilience.md "Brownout & overload control"):
+        # the burn-rate-driven degradation ladder. Needs the SLOEngine
+        # (the burn rate IS the control signal); TPU_BROWNOUT=0 builds
+        # no controller — every hook degrades to one `is not None` and
+        # today's behavior is byte-identical.
+        from gofr_tpu.serving.brownout import (
+            BrownoutController,
+            normalize_slo_class,
+            parse_tenant_class_map,
+        )
+
+        self._normalize_slo_class = normalize_slo_class
+        self._tenant_class_map = parse_tenant_class_map(tenant_slo_class)
+        if brownout is None:
+            brownout = os.environ.get(
+                "TPU_BROWNOUT", "1"
+            ).lower() not in ("0", "false", "no")
+        self._brownout: Optional[BrownoutController] = (
+            BrownoutController(
+                model_name,
+                enter_burn=brownout_enter,
+                exit_burn=brownout_exit,
+                sustain_s=brownout_sustain_s,
+                exit_sustain_s=brownout_exit_sustain_s,
+                max_new_tokens=brownout_max_new,
+                aimd_cut=brownout_aimd_cut,
+                recover_per_s=brownout_recover_per_s,
+                min_headroom=brownout_min_headroom,
+                metrics=metrics,
+                logger=logger,
+            )
+            if brownout and self._slo is not None else None
+        )
 
         # Device-resource observability (serving/device_telemetry.py):
         # the compile tracker wraps every jitted serving program built
@@ -715,6 +764,8 @@ class InferenceEngine(
         ``devices`` slice (dp across replicas, tp within; see
         ``serving/backend.py``).
         """
+        from gofr_tpu.serving.slo import tenant_objectives_from_config
+
         mesh = None
         tp = int(
             config.get_or_default(
@@ -872,6 +923,43 @@ class InferenceEngine(
             ),
             slo_availability=float(
                 config.get_or_default("TPU_SLO_AVAILABILITY", "0")
+            ),
+            # Per-tenant SLO overrides (TPU_SLO_TENANT_<NAME>_TTFT_MS
+            # and kin) and the brownout ladder (docs/advanced-guide/
+            # resilience.md "Brownout & overload control"): thresholds
+            # on the 5m burn with sustain windows for hysteresis, the
+            # L1 generation clamp, the L2 AIMD parameters, and the
+            # optional headroom floor that also counts as pressure.
+            slo_tenant_objectives=tenant_objectives_from_config(config),
+            brownout=config.get_or_default(
+                "TPU_BROWNOUT", "1"
+            ).lower() not in ("0", "false", "no"),
+            brownout_enter=float(
+                config.get_or_default("TPU_BROWNOUT_ENTER", "2")
+            ),
+            brownout_exit=float(
+                config.get_or_default("TPU_BROWNOUT_EXIT", "1")
+            ),
+            brownout_sustain_s=float(
+                config.get_or_default("TPU_BROWNOUT_SUSTAIN_S", "10")
+            ),
+            brownout_exit_sustain_s=float(
+                config.get_or_default("TPU_BROWNOUT_EXIT_SUSTAIN_S", "30")
+            ),
+            brownout_max_new=int(
+                config.get_or_default("TPU_BROWNOUT_MAX_NEW", "256")
+            ),
+            brownout_aimd_cut=float(
+                config.get_or_default("TPU_BROWNOUT_AIMD_CUT", "0.5")
+            ),
+            brownout_recover_per_s=float(
+                config.get_or_default("TPU_BROWNOUT_RECOVER_PER_S", "0.02")
+            ),
+            brownout_min_headroom=float(
+                config.get_or_default("TPU_BROWNOUT_MIN_HEADROOM", "0")
+            ),
+            tenant_slo_class=config.get_or_default(
+                "TPU_TENANT_SLO_CLASS", ""
             ),
             compile_cache_dir=config.get_or_default(
                 "TPU_COMPILE_CACHE_DIR", ""
@@ -1714,6 +1802,56 @@ class InferenceEngine(
         if self._tenant_ledger is not None:
             self._tenant_ledger.note_dequeued(req)
 
+    def shed_retry_after_s(
+        self, reason: str, cost: int = 0, tenant: str = ""
+    ) -> float:
+        """THE Retry-After for every admission shed (ISSUE 13 bugfix:
+        several 429 paths answered a near-constant projected wait that
+        ignored what actually has to recover). One shared, load-
+        sensitive estimate:
+
+        * every reason starts from the queue-drain projection
+          (backlog + this request over measured throughput);
+        * ``hbm_headroom`` / ``brownout`` add the IN-FLIGHT decode
+          backlog — headroom and burn recover as live work retires,
+          not merely as the queue drains;
+        * ``tenant_quota`` / ``tenant_fair_share`` are floored at the
+          TENANT's own queued backlog drain (its seats free as its own
+          work completes, however empty the global queue is);
+        * with the brownout ladder above L0, the controller's projected
+          recovery is the floor — a 429 must not invite a retry into a
+          still-degraded pod.
+
+        Always positive (the wire form ceils to an integer ≥ 1).
+        Called under the submit lock; every read is host arithmetic."""
+        tps = self._throughput_tps()
+        # THE queue-drain projection (shared with the deadline check):
+        # one formula, one place to change it.
+        wait = self._projected_wait_s(max(0, cost))
+        if reason in ("hbm_headroom", "brownout"):
+            inflight = 0
+            for seq in self._slots:
+                if seq is not None:
+                    inflight += max(
+                        0,
+                        seq.request.remaining_new_tokens
+                        - seq.n_generated,
+                    )
+            wait += inflight / tps
+        if (
+            reason in ("tenant_quota", "tenant_fair_share")
+            and tenant
+            and self._tenant_ledger is not None
+        ):
+            wait = max(
+                wait,
+                self._tenant_ledger.tenant_queued_tokens(tenant) / tps,
+            )
+        bc = self._brownout
+        if bc is not None and bc.level > 0:
+            wait = max(wait, bc.projected_recovery_s())
+        return max(wait, 0.5)
+
     def _shed(self, reason: str, retry_after_s: float) -> None:
         if self._metrics is not None:
             self._metrics.increment_counter(
@@ -1773,12 +1911,15 @@ class InferenceEngine(
                 and self._tenant_queued.get(req.tenant, 0)
                 >= self.tenant_queue_max
             ):
-                self._shed("tenant_quota", wait_s)
+                retry = self.shed_retry_after_s(
+                    "tenant_quota", cost, req.tenant
+                )
+                self._shed("tenant_quota", retry)
                 raise ErrorTooManyRequests(
                     f"tenant {req.tenant!r} has "
                     f"{self._tenant_queued[req.tenant]} queued request(s) "
                     f"(TPU_TENANT_QUEUE_MAX={self.tenant_queue_max})",
-                    retry_after_s=wait_s,
+                    retry_after_s=retry,
                 )
             # Fairness-aware shedding (TPU_TENANT_FAIR_SHARE, ledger-
             # derived, off by default): a tenant already holding more
@@ -1795,13 +1936,16 @@ class InferenceEngine(
                     self.queue_max_tokens, self.queue_max,
                 )
             ):
-                self._shed("tenant_fair_share", wait_s)
+                retry = self.shed_retry_after_s(
+                    "tenant_fair_share", cost, req.tenant
+                )
+                self._shed("tenant_fair_share", retry)
                 raise ErrorTooManyRequests(
                     f"tenant {req.tenant!r} is over its fair share of "
                     f"the queue budget "
                     f"(TPU_TENANT_FAIR_SHARE={self.tenant_fair_share}); "
                     f"reason=tenant_fair_share",
-                    retry_after_s=wait_s,
+                    retry_after_s=retry,
                 )
             if self.admit_min_headroom > 0:
                 # Saturation-aware admission (TPU_ADMIT_MIN_HEADROOM):
@@ -1811,24 +1955,54 @@ class InferenceEngine(
                 # kv_pool_exhausted failure after a slot was burned.
                 headroom = self.hbm_headroom_ratio()
                 if headroom < self.admit_min_headroom:
-                    self._shed("hbm_headroom", wait_s)
+                    retry = self.shed_retry_after_s("hbm_headroom", cost)
+                    self._shed("hbm_headroom", retry)
                     raise ErrorTooManyRequests(
                         f"HBM headroom {headroom:.3f} below the "
                         f"admission floor {self.admit_min_headroom:.3f} "
                         f"(TPU_ADMIT_MIN_HEADROOM); retry against "
                         f"another replica",
-                        retry_after_s=wait_s,
+                        retry_after_s=retry,
+                    )
+            # Brownout L2+ (serving/brownout.py): the effective
+            # admission budget is the AIMD-cut fraction of the nominal
+            # one, consumed priority-aware — batch may only fill its
+            # smaller allowance (it sheds first), interactive keeps the
+            # whole cut budget (it sheds last). Below L2 the fraction
+            # is exactly 1.0, so this block admits byte-identically.
+            bc = self._brownout
+            if bc is not None and bc.shedding:
+                frac = bc.admission_fraction(req.slo_class)
+                if self.queue_max_tokens:
+                    over = (
+                        self._queued_tokens + cost
+                        > frac * self.queue_max_tokens
+                    )
+                else:
+                    over = self._pending.qsize() + 1 > frac * self.queue_max
+                if over:
+                    retry = self.shed_retry_after_s(
+                        "brownout", cost, req.tenant
+                    )
+                    bc.note_action(f"shed_{req.slo_class}")
+                    self._shed("brownout", retry)
+                    raise ErrorTooManyRequests(
+                        f"brownout level {bc.level}: admission budget "
+                        f"cut to {frac:.2f} of nominal for SLO class "
+                        f"{req.slo_class!r}; reason=brownout",
+                        retry_after_s=retry,
                     )
             if (
                 self.queue_max_tokens
                 and self._queued_tokens + cost > self.queue_max_tokens
             ):
-                self._shed("queue_tokens", wait_s)
+                retry = self.shed_retry_after_s("queue_tokens", cost)
+                self._shed("queue_tokens", retry)
                 raise ErrorTooManyRequests(
                     f"submit queue token budget exhausted "
                     f"({self._queued_tokens} queued + {cost} requested > "
                     f"{self.queue_max_tokens}; TPU_QUEUE_TOKENS)",
-                    retry_after_s=wait_s,
+                    retry_after_s=retry,
                 )
             if req.deadline is not None and (
                 req.deadline.expired()
@@ -1843,11 +2017,12 @@ class InferenceEngine(
             try:
                 self._pending.put_nowait(req)
             except queue.Full:
-                self._shed("queue_full", wait_s)
+                retry = self.shed_retry_after_s("queue_full", cost)
+                self._shed("queue_full", retry)
                 raise ErrorTooManyRequests(
                     f"submit queue full ({self._pending.maxsize} requests; "
                     f"TPU_QUEUE_MAX)",
-                    retry_after_s=wait_s,
+                    retry_after_s=retry,
                 ) from None
             self._queued_tokens += cost
             if self.tenant_queue_max and req.tenant:
@@ -1877,6 +2052,7 @@ class InferenceEngine(
         deadline_s: "Optional[float]" = None,
         cancel: "Optional[CancelToken]" = None,
         tenant: str = "",
+        slo_class: str = "",
         pin_replica: bool = False,
         traceparent: "Optional[str]" = None,
     ) -> _GenRequest:
@@ -1991,6 +2167,29 @@ class InferenceEngine(
                     "prompt truncated to its last %d tokens "
                     "(TPU_TRUNCATE_PROMPTS)", max_prompt,
                 )
+        # Brownout SLO class: an explicit, valid X-SLO-Class wins, then
+        # the tenant's configured default (TPU_TENANT_SLO_CLASS), then
+        # "standard". Request-controlled, so it is clamped to the
+        # bounded vocabulary before it can reach shed metrics.
+        cls = self._normalize_slo_class(slo_class)
+        if not cls:
+            # Case-insensitive tenant match, like the per-tenant SLO
+            # override keys (the map stores lower-cased keys).
+            cls = self._tenant_class_map.get(
+                str(tenant or "").lower(), "standard"
+            )
+        # L1+ generation clamp (TPU_BROWNOUT_MAX_NEW): trade answer
+        # LENGTH for admission capacity before trading admissions. The
+        # result advertises the deliberate truncation (`brownout` field
+        # + finish_reason="length") so clients see policy, not a bug.
+        brownout_clamped = False
+        bc = self._brownout
+        if bc is not None:
+            clamped = bc.clamp_max_new(int(max_new_tokens))
+            if clamped < int(max_new_tokens):
+                max_new_tokens = clamped
+                brownout_clamped = True
+                bc.note_action("clamp_tokens")
         req = _GenRequest(
             prompt_ids=ids,
             max_new_tokens=max_new_tokens,
@@ -2017,6 +2216,8 @@ class InferenceEngine(
             lora_gen=self._lora_gen[aid] if aid else 0,
             deadline=coalesce_deadline(deadline, deadline_s),
             tenant=str(tenant or ""),
+            slo_class=cls,
+            brownout_clamped=brownout_clamped,
             pin_replica=pin_replica,
         )
         if cancel is not None:
@@ -2028,7 +2229,8 @@ class InferenceEngine(
         # HTTP/gRPC edge, else the submitting task's current span). None
         # when the whole layer is off — the scheduler hooks all guard.
         req.timeline = self._obs.begin(
-            prompt_tokens=len(ids), traceparent=traceparent
+            prompt_tokens=len(ids), traceparent=traceparent,
+            tenant=str(tenant or ""),
         )
         try:
             self._enqueue(req)
@@ -2267,6 +2469,39 @@ class InferenceEngine(
             return {"enabled": False}
         return dict(self._slo.snapshot())
 
+    def brownout_report(self) -> dict:
+        """The brownout controller's full state (``/debug/brownout`` on
+        the ops port): ladder level, AIMD budget factor, thresholds,
+        last control inputs, per-action counters. ``{"enabled": False}``
+        with the layer off (``TPU_BROWNOUT=0`` or no SLOs configured —
+        the burn rate is the control signal)."""
+        if self._brownout is None:
+            return {"enabled": False}
+        return dict(self._brownout.snapshot())
+
+    def brownout_level(self) -> Optional[int]:
+        """The current degradation level, ``None`` when the layer is
+        off (``TPU_BROWNOUT=0`` / no SLOs) — the distinction matters to
+        the pool, where None means "signal absent" (never suppress
+        hedges/probes or count scaler pressure) while 0 means "armed
+        and nominal"."""
+        return None if self._brownout is None else self._brownout.level
+
+    def slo_compliant(self) -> Optional[bool]:
+        """THE routing signal (ReplicaPool.pick deprioritizes on it,
+        closing the ROADMAP item): the SLO engine's compliance bit AND
+        the brownout ladder below L3. None when no SLOs are
+        configured. Reads the CACHED bit — pick() calls this per
+        candidate per request, and a full ring scan there would contend
+        with the retirement path under exactly the overload this signal
+        exists for; every observation and health/probe pass refreshes
+        the cache."""
+        if self._brownout is not None and not self._brownout.routable():
+            return False
+        if self._slo is None:
+            return None
+        return bool(self._slo.compliant_cached())
+
     def capacity_report(self) -> dict:
         """``/debug/capacity``'s per-engine record: the HBM ledger,
         compile counts, paged-pool pressure, and the heaviest tenants
@@ -2282,6 +2517,10 @@ class InferenceEngine(
             report["tenants"] = self._tenant_ledger.top_tenants()
         if self._slo is not None:
             report["slo"] = self._slo.describe()
+        if self._brownout is not None:
+            # "Is this pod browning out" next to "is it breaking its
+            # promise" — the actuator's state beside its signal.
+            report["brownout"] = self._brownout.describe()
         if self.family == "llm" and self.kv_block:
             total, used, cached = self._kv_pool_counts()
             pool: dict[str, Any] = {
@@ -2425,6 +2664,11 @@ class InferenceEngine(
             # lift compliance + fast-window burn into their replica
             # descriptors, the same path the HBM headroom rides.
             details["slo"] = self._slo.describe()
+        if self._brownout is not None:
+            # Brownout advertisement rides the same probe path: remote
+            # pools lift the level to suppress hedges/probes against a
+            # browning-out replica and to deprioritize it at L3.
+            details["brownout"] = self._brownout.describe()
         if self._tenant_ledger is not None:
             details["tenant_ledger"] = {
                 "tenants": len(self._tenant_ledger.snapshot()["tenants"]),

@@ -146,8 +146,8 @@ class RequestTimeline:
         "wall_ns_base", "mono_base", "admitted", "admissions",
         "prefill_done", "first_token", "done", "outcome", "finish_reason",
         "chunks", "annotations", "transfers", "prompt_tokens",
-        "output_tokens", "prefix_hit_tokens", "replays", "_lock",
-        "_finished",
+        "output_tokens", "prefix_hit_tokens", "replays", "tenant",
+        "_lock", "_finished",
     )
 
     def __init__(
@@ -159,6 +159,7 @@ class RequestTimeline:
         enqueued: float,
         wall_ns_base: int,
         prompt_tokens: int,
+        tenant: str = "",
     ) -> None:
         self.hub = hub
         self.rid = rid
@@ -189,6 +190,10 @@ class RequestTimeline:
         self.output_tokens = 0
         self.prefix_hit_tokens = 0
         self.replays = 0
+        # The submitting tenant (X-Tenant-Id), carried so finalize can
+        # feed per-tenant SLO overrides (serving/slo.py) without a
+        # second measurement path.
+        self.tenant = tenant
         self._lock = threading.Lock()
         self._finished = False
 
@@ -408,6 +413,7 @@ class RequestObservability:
         self,
         prompt_tokens: int,
         traceparent: Optional[str] = None,
+        tenant: str = "",
     ) -> Optional[RequestTimeline]:
         """Mint a timeline for a submitting request, adopting the trace
         context from ``traceparent``, then from the calling task's
@@ -439,6 +445,7 @@ class RequestObservability:
             enqueued=self._clock(),
             wall_ns_base=self._wall_ns(),
             prompt_tokens=prompt_tokens,
+            tenant=tenant,
         )
 
     def note_shed(
@@ -466,8 +473,11 @@ class RequestObservability:
                     )
         if self.slo is not None:
             # Burn-rate input (serving/slo.py): the retired request's
-            # outcome + phases, judged at request granularity.
-            self.slo.observe(timeline.outcome, phases)
+            # outcome + phases, judged at request granularity — with
+            # the tenant so per-tenant overrides see it too.
+            self.slo.observe(
+                timeline.outcome, phases, tenant=timeline.tenant
+            )
         tracer = get_tracer()
         if tracer_active(tracer):
             self._emit_spans(tracer, timeline, phases)

@@ -173,8 +173,16 @@ def add_openai_routes(
         and across replicas: a pool forwards it on HTTPReplica calls)."""
         header = getattr(ctx, "header", None)
         tenant = (header("x-tenant-id") if header is not None else "") or ""
+        # Brownout SLO class (X-SLO-Class: interactive|standard|batch):
+        # under overload the engine sheds batch-class admissions first
+        # and interactive last (serving/brownout.py). Unknown values
+        # fall back to the tenant default, then "standard" — never 400.
+        slo_class = (
+            header("x-slo-class") if header is not None else ""
+        ) or ""
         out = dict(
             deadline=ctx.deadline, cancel=ctx.cancel_token, tenant=tenant,
+            slo_class=slo_class,
         )
         span = ctx.get("span") if hasattr(ctx, "get") else None
         if span is not None and hasattr(span, "traceparent"):
@@ -311,6 +319,7 @@ def add_openai_routes(
                     elif include_tokens:
                         yield _sse(rid, object_name, model, created,
                                    payload_of(""))
+                brownout_flag = False
                 if stopped:
                     reason = "stop"
                 else:
@@ -336,6 +345,16 @@ def add_openai_routes(
                         yield "data: [DONE]\n\n"
                         return
                     reason = result.finish_reason
+                    # The retired result is the brownout-clamp
+                    # authority too: set only when the clamp actually
+                    # cut the answer, and carried across replicas (a
+                    # pool fronting a REMOTE engine gets the flag from
+                    # the remote's finish chunk via GenerationResult,
+                    # where the local handle's brownout_clamped is
+                    # never stamped).
+                    brownout_flag = bool(
+                        getattr(result, "brownout", False)
+                    )
                     if (
                         engine.tokenizer is not None
                         and len(result.text) > len(printed)
@@ -347,6 +366,10 @@ def add_openai_routes(
                     if chat else
                     {"text": "", "index": 0, "finish_reason": reason}
                 )
+                if brownout_flag:
+                    # Deliberate policy truncation rides the finish
+                    # chunk.
+                    done["brownout"] = True
                 if include_tokens:
                     # Any ids still unattached (final flush) ride the
                     # finish chunk, plus the prompt length so the
@@ -484,13 +507,20 @@ def add_openai_routes(
                         )
                     pr = engine.tokenizer.decode(pr)
                 text = pr + text
-            choices.append({
+            choice = {
                 "text": text,
                 "index": i,
                 "logprobs": _completion_logprobs(engine, r)
                 if want_logprobs else None,
                 "finish_reason": r.finish_reason,
-            })
+            }
+            if getattr(r, "brownout", False):
+                # Deliberate overload truncation (brownout L1 clamp):
+                # advertised so clients can distinguish policy from a
+                # short completion. Absent entirely outside a brownout
+                # — the nominal wire shape is byte-identical.
+                choice["brownout"] = True
+            choices.append(choice)
         return Raw({
             "id": rid,
             "object": "text_completion",
@@ -562,6 +592,9 @@ def add_openai_routes(
                 "message": {"role": "assistant", "content": r.text},
                 "finish_reason": r.finish_reason,
             }
+            if getattr(r, "brownout", False):
+                # Deliberate overload truncation (brownout L1 clamp).
+                choice["brownout"] = True
             if want_logprobs:
                 dec = _decoder(engine)
                 tops = r.token_top_logprobs or [None] * len(r.token_ids)

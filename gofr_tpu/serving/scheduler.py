@@ -102,6 +102,8 @@ class SchedulerMixin:
     _obs: Any  # serving.observability.RequestObservability
     _tenant_ledger: Any  # Optional[serving.tenant_ledger.TenantLedger]
     _ledger: Any  # Optional[serving.device_telemetry.HBMLedger]
+    _slo: Any  # Optional[serving.slo.SLOEngine]
+    _brownout: Any  # Optional[serving.brownout.BrownoutController]
     _compiles: Any  # serving.device_telemetry.CompileTracker
     _logger: Any
     _tput: Any  # lifecycle.AggregateThroughput
@@ -203,6 +205,12 @@ class SchedulerMixin:
                 # token. Off (TPU_TENANT_LEDGER=0) = this one check.
                 if self._tenant_ledger is not None:
                     self._ledger_tick()
+                # Brownout control loop (serving/brownout.py): ONE
+                # evaluation per scheduler pass — the GL011-disciplined
+                # cadence the ladder's sustain windows assume. Off
+                # (TPU_BROWNOUT=0) = this one check.
+                if self._brownout is not None:
+                    self._brownout_tick()
                 if self.kv_block:
                     # Proactive prefix-eviction sweep: keep the free
                     # list above the watermark so admission finds free
@@ -440,6 +448,19 @@ class SchedulerMixin:
                         (st.request.tenant, len(self._slot_blocks[slot]))
                     )
         led.tick(self._obs.now(), rows)
+
+    def _brownout_tick(self) -> None:
+        """Feed the controller its two inputs — the worst 5m burn rate
+        and the HBM headroom ratio — once per scheduler pass. Both are
+        host arithmetic already in hand (one locked ring read, one
+        allocator-count division); the controller reads its own clock
+        once inside ``evaluate``."""
+        slo = self._slo
+        burn = slo.worst_burn("5m") if slo is not None else 0.0
+        headroom = (
+            self.hbm_headroom_ratio() if self._ledger is not None else None
+        )
+        self._brownout.evaluate(burn, headroom)
 
     # ------------------------------------------------------------------
     # request-lifecycle reap (cancellation + deadlines)
@@ -1984,6 +2005,11 @@ class SchedulerMixin:
             token_logprobs=lps,
             finish_reason=reason,
             token_top_logprobs=tops,
+            # Deliberate brownout truncation: advertised ONLY when the
+            # clamp actually cut the answer short (finish_reason
+            # "length") — a stream that hit EOS inside the clamped
+            # budget was not truncated by policy.
+            brownout=req.brownout_clamped and reason == "length",
         )
         # Summarize BEFORE resolving: a caller that sees the result is
         # guaranteed the flight-recorder entry, histogram records, and

@@ -16,6 +16,16 @@ declarative objectives into that signal:
   the latency SLOs' compliance fraction — one error budget discipline
   across all three (``0.99`` when unset but a latency SLO is).
 
+**Per-tenant objectives** (ISSUE 13): ``TPU_SLO_TENANT_<NAME>_TTFT_MS``
+/ ``_E2E_MS`` / ``_AVAILABILITY`` overrides give a tenant its own
+thresholds on top of the global ones. The ``<NAME>`` env segment
+matches the request's ``X-Tenant-Id`` case-insensitively (env keys are
+conventionally upper-case). Per-tenant burn is exported as
+``app_tpu_slo_tenant_burn_rate{tenant,slo,window}`` — the label set is
+bounded by *configuration* (only tenants with an override export), and
+the value still routes through the ``label_for``-style clamp discipline
+(graftlint GL016). ``/debug/slo`` gains a per-tenant section.
+
 **Burn rate** is the SRE-workbook form: over a window, the fraction of
 bad requests divided by the error budget (``1 − target``). 1.0 means
 the budget is being spent exactly as fast as it accrues; 10 means ten
@@ -25,12 +35,14 @@ and old samples age out without timers. Exported as
 ``app_tpu_slo_burn_rate{slo,window}`` gauges plus an
 ``app_tpu_slo_compliant`` 0/1 gauge (every burn rate ≤ 1) that rides
 health details and replica probes; the full state serves on
-``/debug/slo``.
+``/debug/slo``. The fast window is also the brownout controller's
+control signal (``serving/brownout.py``: the runtime actuator this
+module's gauges page on).
 
 Observations arrive from the PR 6 phase records: the observability
-hub's ``finalize`` feeds every retired timeline's outcome and phases
-here — request granularity, zero work on the dispatch path, and the
-layer shares the flight recorder's off-switch semantics (no SLOs
+hub's ``finalize`` feeds every retired timeline's outcome, phases, and
+tenant here — request granularity, zero work on the dispatch path, and
+the layer shares the flight recorder's off-switch semantics (no SLOs
 configured → the engine holds no :class:`SLOEngine` at all).
 
 Determinism: the clock is injectable and bucket boundaries are pure
@@ -39,8 +51,8 @@ arithmetic — tests state time instead of sleeping.
 
 from __future__ import annotations
 
-import threading
 import time
+import threading
 from typing import Any, Callable, Mapping, Optional
 
 #: (window label, window seconds, ring buckets) — 10 s buckets for the
@@ -53,6 +65,47 @@ WINDOWS: tuple[tuple[str, float, int], ...] = (
 #: Default compliance target when TPU_SLO_AVAILABILITY is unset but a
 #: latency SLO is configured.
 DEFAULT_TARGET = 0.99
+
+#: The global objectives' scope key in the (scope, slo, window) counts
+#: map — "" so it can never collide with a tenant id.
+GLOBAL = ""
+
+
+def tenant_objectives_from_config(config: Any) -> dict[str, dict[str, float]]:
+    """Collect ``TPU_SLO_TENANT_<NAME>_{TTFT_MS,E2E_MS,AVAILABILITY}``
+    overrides into ``{tenant: {field: value}}``. Keys are read from the
+    process environment (the ``EnvLoader`` writes dotenv files there)
+    plus a ``MockConfig``'s static map, so tests configure overrides
+    the same way operators do. The ``<NAME>`` segment is lower-cased:
+    tenant ids match case-insensitively."""
+    import os
+
+    keys: dict[str, str] = dict(os.environ)
+    mock_values = getattr(config, "_values", None)
+    if isinstance(mock_values, dict):
+        keys.update(mock_values)
+    prefix = "TPU_SLO_TENANT_"
+    suffixes = (
+        ("_TTFT_MS", "ttft_ms"),
+        ("_E2E_MS", "e2e_ms"),
+        ("_AVAILABILITY", "availability"),
+    )
+    out: dict[str, dict[str, float]] = {}
+    for key, raw in keys.items():
+        if not key.startswith(prefix):
+            continue
+        rest = key[len(prefix):]
+        for suffix, field in suffixes:
+            if rest.endswith(suffix) and len(rest) > len(suffix):
+                name = rest[: -len(suffix)].lower()
+                try:
+                    value = float(raw)
+                except (TypeError, ValueError):
+                    break
+                if value > 0:
+                    out.setdefault(name, {})[field] = value
+                break
+    return out
 
 
 class _Ring:
@@ -113,6 +166,19 @@ class _SLO:
         }
 
 
+def _build_slos(
+    ttft_ms: float, e2e_ms: float, availability: float
+) -> dict[str, _SLO]:
+    slos: dict[str, _SLO] = {}
+    if ttft_ms > 0:
+        slos["ttft"] = _SLO("ttft", float(ttft_ms))
+    if e2e_ms > 0:
+        slos["e2e"] = _SLO("e2e", float(e2e_ms))
+    if availability > 0:
+        slos["availability"] = _SLO("availability", 0.0)
+    return slos
+
+
 class SLOEngine:
     """Burn-rate evaluation over the configured objectives (see the
     module docstring). All mutation happens under one lock at request
@@ -125,6 +191,9 @@ class SLOEngine:
         ttft_ms: float = 0.0,
         e2e_ms: float = 0.0,
         availability: float = 0.0,
+        tenant_objectives: Optional[
+            Mapping[str, Mapping[str, float]]
+        ] = None,
         metrics: Any = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -137,112 +206,226 @@ class SLOEngine:
             if availability > 0 else DEFAULT_TARGET
         )
         self.error_budget = max(1e-7, 1.0 - self.target)
-        self._slos: dict[str, _SLO] = {}
-        if ttft_ms > 0:
-            self._slos["ttft"] = _SLO("ttft", float(ttft_ms))
-        if e2e_ms > 0:
-            self._slos["e2e"] = _SLO("e2e", float(e2e_ms))
-        if availability > 0:
-            self._slos["availability"] = _SLO("availability", 0.0)
+        self._slos: dict[str, _SLO] = _build_slos(
+            ttft_ms, e2e_ms, availability
+        )
+        # Per-tenant overrides (ISSUE 13): each override tenant gets
+        # its OWN ring set and error budget, evaluated from the same
+        # retirement feed. Keys are lower-cased (case-insensitive
+        # tenant match); the label set is configuration-bounded.
+        self._tenant_slos: dict[str, dict[str, _SLO]] = {}
+        self._tenant_budget: dict[str, float] = {}
+        for name, spec in (tenant_objectives or {}).items():
+            key = str(name).lower()
+            slos = _build_slos(
+                float(spec.get("ttft_ms", 0.0)),
+                float(spec.get("e2e_ms", 0.0)),
+                float(spec.get("availability", 0.0)),
+            )
+            if not slos:
+                continue
+            self._tenant_slos[key] = slos
+            avail = float(spec.get("availability", 0.0))
+            target = (
+                min(max(avail, 0.0), 0.9999999) if avail > 0
+                else self.target
+            )
+            self._tenant_budget[key] = max(1e-7, 1.0 - target)
+        # Cached GLOBAL compliance bit, refreshed by every
+        # observation/health/describe pass (_publish_counts): the
+        # routing hot path (ReplicaPool.pick via engine.slo_compliant)
+        # reads THIS instead of rescanning every ring per request.
+        self._last_compliant = True
 
     @property
     def enabled(self) -> bool:
-        return bool(self._slos)
+        return bool(self._slos or self._tenant_slos)
+
+    def _tenant_label(self, tenant: str) -> str:
+        """Bounded label mapper (GL016 discipline): only tenants with a
+        configured override ever reach this, so the label set is fixed
+        at boot by configuration, not by request traffic."""
+        return tenant
 
     # -- ingestion (request granularity, from the observability hub) ---
+
+    @staticmethod
+    def _judge(
+        slos: dict[str, _SLO],
+        outcome: str,
+        phases: Mapping[str, float],
+        t: float,
+    ) -> None:
+        """Land one retired request in one scope's rings (call under
+        the lock). Latency SLOs only see requests that reached the
+        phase (a shed never had a TTFT — availability is the SLO that
+        charges it)."""
+        slo = slos.get("ttft")
+        if slo is not None and "ttft_s" in phases:
+            good = phases["ttft_s"] * 1e3 <= slo.threshold_ms
+            for ring in slo.rings.values():
+                ring.observe(t, good)
+        slo = slos.get("e2e")
+        if slo is not None and "e2e_s" in phases:
+            good = phases["e2e_s"] * 1e3 <= slo.threshold_ms
+            for ring in slo.rings.values():
+                ring.observe(t, good)
+        slo = slos.get("availability")
+        if slo is not None:
+            for ring in slo.rings.values():
+                ring.observe(t, outcome == "ok")
 
     def observe(
         self,
         outcome: str,
         phases: Mapping[str, float],
         now: Optional[float] = None,
+        tenant: str = "",
     ) -> None:
-        """One retired request: judge it against every configured SLO.
-        Latency SLOs only see requests that reached the phase (a shed
-        never had a TTFT — availability is the SLO that charges it);
-        cancelled requests are the client's choice and count nowhere."""
-        if not self._slos or outcome == "cancelled":
+        """One retired request: judge it against every configured SLO —
+        the global objectives, plus the tenant's own when an override is
+        configured for it. Cancelled requests are the client's choice
+        and count nowhere."""
+        if (not self._slos and not self._tenant_slos) or outcome == "cancelled":
             return
         t = self._clock() if now is None else now
+        tkey = str(tenant or "").lower()
         with self._lock:
-            slo = self._slos.get("ttft")
-            if slo is not None and "ttft_s" in phases:
-                good = phases["ttft_s"] * 1e3 <= slo.threshold_ms
-                for ring in slo.rings.values():
-                    ring.observe(t, good)
-            slo = self._slos.get("e2e")
-            if slo is not None and "e2e_s" in phases:
-                good = phases["e2e_s"] * 1e3 <= slo.threshold_ms
-                for ring in slo.rings.values():
-                    ring.observe(t, good)
-            slo = self._slos.get("availability")
-            if slo is not None:
-                for ring in slo.rings.values():
-                    ring.observe(t, outcome == "ok")
+            self._judge(self._slos, outcome, phases, t)
+            tslos = self._tenant_slos.get(tkey) if tkey else None
+            if tslos is not None:
+                self._judge(tslos, outcome, phases, t)
         self._publish(t)
 
     # -- evaluation -----------------------------------------------------
 
     def _window_counts(
         self, now: float
-    ) -> dict[tuple[str, str], tuple[int, int]]:
-        """(slo, window) → (good, total) for every ring, read under ONE
-        lock pass — burn rates, compliance, gauges, and the debug
-        snapshot all derive from this single read (no repeated ring
-        scans contending with the retirement-path ``observe``)."""
+    ) -> dict[tuple[str, str, str], tuple[int, int]]:
+        """(scope, slo, window) → (good, total) for every ring — scope
+        :data:`GLOBAL` for the global objectives, the tenant key for
+        overrides — read under ONE lock pass: burn rates, compliance,
+        gauges, and the debug snapshot all derive from this single read
+        (no repeated ring scans contending with the retirement-path
+        ``observe``)."""
         with self._lock:
-            return {
-                (name, label): ring.counts(now)
+            counts = {
+                (GLOBAL, name, label): ring.counts(now)
                 for name, obj in self._slos.items()
                 for label, ring in obj.rings.items()
             }
+            for tenant, slos in self._tenant_slos.items():
+                for name, obj in slos.items():
+                    for label, ring in obj.rings.items():
+                        counts[(tenant, name, label)] = ring.counts(now)
+            return counts
 
-    def _burn(self, counts: tuple[int, int]) -> float:
+    def _budget_of(self, scope: str) -> float:
+        if scope == GLOBAL:
+            return self.error_budget
+        return self._tenant_budget.get(scope, self.error_budget)
+
+    def _burn(
+        self, counts: tuple[int, int], scope: str = GLOBAL
+    ) -> float:
         good, total = counts
         if total == 0:
             return 0.0  # an idle window burns nothing
-        return ((total - good) / total) / self.error_budget
+        return ((total - good) / total) / self._budget_of(scope)
 
     def burn_rate(
-        self, slo: str, window: str, now: Optional[float] = None
+        self,
+        slo: str,
+        window: str,
+        now: Optional[float] = None,
+        tenant: str = "",
     ) -> float:
         """Bad fraction over the window divided by the error budget;
-        0.0 with no samples (an idle service burns nothing)."""
+        0.0 with no samples (an idle service burns nothing). With
+        ``tenant``, reads that tenant's override rings."""
         t = self._clock() if now is None else now
+        scope = str(tenant or "").lower() or GLOBAL
         with self._lock:
-            obj = self._slos.get(slo)
+            slos = (
+                self._slos if scope == GLOBAL
+                else self._tenant_slos.get(scope, {})
+            )
+            obj = slos.get(slo)
             ring = obj.rings.get(window) if obj is not None else None
             if ring is None:
                 return 0.0
             counts = ring.counts(t)
-        return self._burn(counts)
+        return self._burn(counts, scope)
+
+    def worst_burn(
+        self, window: str = "5m", now: Optional[float] = None
+    ) -> float:
+        """The maximum GLOBAL burn rate over the window — the brownout
+        controller's control signal (one locked read per scheduler
+        pass; per-tenant overrides page, they don't brown the pod
+        out)."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            counts = [
+                obj.rings[window].counts(t)
+                for obj in self._slos.values()
+                if window in obj.rings
+            ]
+        if not counts:
+            return 0.0
+        return max(self._burn(c) for c in counts)
 
     def compliant(self, now: Optional[float] = None) -> bool:
-        """True while every (slo, window) burn rate is ≤ 1 — spending
-        the error budget no faster than it accrues."""
+        """True while every GLOBAL (slo, window) burn rate is ≤ 1 —
+        spending the error budget no faster than it accrues. Tenant
+        overrides alert per tenant but do not flip the replica-level
+        routing bit."""
         t = self._clock() if now is None else now
         return all(
             self._burn(c) <= 1.0
-            for c in self._window_counts(t).values()
+            for (scope, _, _), c in self._window_counts(t).items()
+            if scope == GLOBAL
         )
 
+    def compliant_cached(self) -> bool:
+        """The compliance bit as of the last observation or
+        health/describe pass — an O(1) read for the per-request routing
+        path. Staleness is bounded by traffic and probe cadence (both
+        refresh it); use :meth:`compliant` for an exact read."""
+        return self._last_compliant
+
     def _publish_counts(
-        self, counts: dict[tuple[str, str], tuple[int, int]]
+        self, counts: dict[tuple[str, str, str], tuple[int, int]]
     ) -> bool:
         """Refresh the burn-rate and compliance gauges from one counts
-        read; returns the compliance bit. Called on every observation
-        AND every health/describe/snapshot read, so recovery (an empty
-        window) reaches Prometheus through the periodic health probes
-        even when no new request arrives to trigger it."""
-        burns = {key: self._burn(c) for key, c in counts.items()}
-        ok = all(b <= 1.0 for b in burns.values())
+        read; returns the GLOBAL compliance bit. Called on every
+        observation AND every health/describe/snapshot read, so
+        recovery (an empty window) reaches Prometheus through the
+        periodic health probes even when no new request arrives to
+        trigger it."""
+        burns = {
+            key: self._burn(c, key[0]) for key, c in counts.items()
+        }
+        ok = all(
+            b <= 1.0 for (scope, _, _), b in burns.items()
+            if scope == GLOBAL
+        )
+        self._last_compliant = ok
         if self._metrics is not None:
-            for (name, label), burn in burns.items():
-                self._metrics.set_gauge(
-                    "app_tpu_slo_burn_rate", round(burn, 6),
-                    "model", self.model_name,
-                    "slo", name, "window", label,
-                )
+            for (scope, name, label), burn in burns.items():
+                if scope == GLOBAL:
+                    self._metrics.set_gauge(
+                        "app_tpu_slo_burn_rate", round(burn, 6),
+                        "model", self.model_name,
+                        "slo", name, "window", label,
+                    )
+                else:
+                    self._metrics.set_gauge(
+                        "app_tpu_slo_tenant_burn_rate", round(burn, 6),
+                        "model", self.model_name,
+                        "tenant", self._tenant_label(scope),
+                        "slo", name, "window", label,
+                    )
             self._metrics.set_gauge(
                 "app_tpu_slo_compliant", 1.0 if ok else 0.0,
                 "model", self.model_name,
@@ -254,38 +437,53 @@ class SLOEngine:
 
     # -- rendering -------------------------------------------------------
 
-    def snapshot(self) -> dict[str, Any]:
-        """The ``/debug/slo`` form: objective, target, and per-window
-        burn state for every configured SLO. One ring read serves the
-        snapshot AND refreshes the gauges."""
-        t = self._clock()
-        counts = self._window_counts(t)
-        ok = self._publish_counts(counts)
-        slos: dict[str, Any] = {}
-        for name, obj in self._slos.items():
+    def _scope_section(
+        self,
+        scope: str,
+        slos: dict[str, _SLO],
+        counts: dict[tuple[str, str, str], tuple[int, int]],
+    ) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for name, obj in slos.items():
             windows: dict[str, Any] = {}
             for label, seconds, _ in WINDOWS:
-                good, total = counts[(name, label)]
+                good, total = counts[(scope, name, label)]
                 windows[label] = {
                     "window_s": seconds,
                     "good": good,
                     "total": total,
                     "burn_rate": round(
-                        self._burn((good, total)), 6
+                        self._burn((good, total), scope), 6
                     ),
                 }
-            slos[name] = {
+            out[name] = {
                 "threshold_ms": obj.threshold_ms,
-                "target": self.target,
+                "target": round(1.0 - self._budget_of(scope), 7),
                 "windows": windows,
             }
-        return {
+        return out
+
+    def snapshot(self) -> dict[str, Any]:
+        """The ``/debug/slo`` form: objective, target, and per-window
+        burn state for every configured SLO — global plus the
+        per-tenant override section. One ring read serves the snapshot
+        AND refreshes the gauges."""
+        t = self._clock()
+        counts = self._window_counts(t)
+        ok = self._publish_counts(counts)
+        out: dict[str, Any] = {
             "enabled": True,
             "target": self.target,
             "error_budget": round(self.error_budget, 7),
             "compliant": ok,
-            "slos": slos,
+            "slos": self._scope_section(GLOBAL, self._slos, counts),
         }
+        if self._tenant_slos:
+            out["tenants"] = {
+                tenant: self._scope_section(tenant, slos, counts)
+                for tenant, slos in self._tenant_slos.items()
+            }
+        return out
 
     def describe(self) -> dict[str, Any]:
         """The compact health-detail form (rides probes): compliance
@@ -296,11 +494,24 @@ class SLOEngine:
         t = self._clock()
         counts = self._window_counts(t)
         ok = self._publish_counts(counts)
-        return {
+        out: dict[str, Any] = {
             "compliant": ok,
             "target": self.target,
             "burn_rate_5m": {
-                name: round(self._burn(counts[(name, "5m")]), 6)
+                name: round(self._burn(counts[(GLOBAL, name, "5m")]), 6)
                 for name in self._slos
             },
         }
+        if self._tenant_slos:
+            out["tenants"] = {
+                tenant: {
+                    "compliant": all(
+                        self._burn(counts[(tenant, name, label)], tenant)
+                        <= 1.0
+                        for name in slos
+                        for label, _, _ in WINDOWS
+                    ),
+                }
+                for tenant, slos in self._tenant_slos.items()
+            }
+        return out

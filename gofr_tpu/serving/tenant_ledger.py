@@ -248,6 +248,14 @@ class TenantLedger:
             return queued_tokens + cost > fair_share * budget_tokens
         return queued_requests + 1 > fair_share * max(1, budget_requests)
 
+    def tenant_queued_tokens(self, tenant: str) -> int:
+        """The tenant's live queued token cost — the load-sensitive
+        Retry-After basis for its quota/fair-share sheds (a folded
+        tenant reads the OVERFLOW aggregate, same as the shed check)."""
+        with self._lock:
+            st = self._lookup(str(tenant or "") or UNTENANTED)
+            return st.queued_tokens if st is not None else 0
+
     # -- scheduler hooks (window granularity) ---------------------------
 
     def note_admitted(self, req: Any, now: float) -> None:

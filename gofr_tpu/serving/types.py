@@ -38,6 +38,12 @@ class GenerationResult:
     # "stop" (eos or a stop sequence matched) | "length" (token budget or
     # context window exhausted).
     finish_reason: str = "stop"
+    # True when the generation budget was clamped by the brownout
+    # controller (serving/brownout.py, L1+): the truncation was a
+    # deliberate overload response, not the client's max_tokens — the
+    # OpenAI surface advertises it as a `brownout` field next to
+    # finish_reason="length".
+    brownout: bool = False
     # Per-token [(token_id, logprob), ...] alternatives when the request
     # asked for top_logprobs (None otherwise).
     token_top_logprobs: Optional[list[Optional[list[tuple[int, float]]]]] = None
@@ -159,6 +165,14 @@ class _GenRequest:
     # Admission-quota tenant (X-Tenant-Id header / gRPC metadata); ""
     # means untenanted — only the global budgets apply.
     tenant: str = ""
+    # Brownout SLO class (X-SLO-Class header / x-slo-class gRPC
+    # metadata, per-tenant default via TPU_TENANT_SLO_CLASS): under a
+    # brownout the admission budget is consumed batch-first,
+    # interactive-last (serving/brownout.py CLASS_ADMIT_FRACTION).
+    slo_class: str = "standard"
+    # The brownout controller clamped this request's max_new_tokens at
+    # submit (L1+): the result advertises the deliberate truncation.
+    brownout_clamped: bool = False
     # Times the supervisor carried this request across an engine restart,
     # and how many tokens had been delivered at the LAST replay (those
     # ride inside the re-prefilled context, so window accounting and the

@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL016 against the committed
+#   graftlint — TPU-correctness rules GL001–GL017 against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
 #
@@ -30,7 +30,8 @@ if command -v mypy >/dev/null 2>&1; then
     gofr_tpu/service \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
     gofr_tpu/serving/engine.py gofr_tpu/serving/backend.py \
-    gofr_tpu/serving/batcher.py gofr_tpu/serving/supervisor.py \
+    gofr_tpu/serving/batcher.py gofr_tpu/serving/brownout.py \
+    gofr_tpu/serving/supervisor.py \
     gofr_tpu/serving/watchdog.py gofr_tpu/serving/scheduler.py \
     gofr_tpu/serving/observability.py gofr_tpu/serving/radix_cache.py \
     gofr_tpu/serving/prefix_cache.py gofr_tpu/serving/programs.py \

@@ -1,0 +1,930 @@
+"""Closed-loop overload control: the brownout ladder
+(serving/brownout.py; docs/advanced-guide/resilience.md "Brownout &
+overload control").
+
+Deterministic throughout: controller/SLO clocks are injectable (tests
+state time instead of sleeping — real time only bounds the polls that
+wait for the scheduler thread to observe stated time), greedy streams
+are byte-compared for the off-switch contract, and the storm acceptance
+path drives the ladder L0→L2 and back with zero 5xx."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from gofr_tpu.config import MockConfig
+from gofr_tpu.errors import ErrorTooManyRequests
+from gofr_tpu.metrics.manager import Manager
+from gofr_tpu.serving.brownout import (
+    BrownoutController,
+    normalize_slo_class,
+    parse_tenant_class_map,
+)
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.slo import SLOEngine, tenant_objectives_from_config
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def brownout_metrics() -> Manager:
+    m = Manager()
+    for name in (
+        "app_tpu_brownout_transitions_total",
+        "app_tpu_brownout_actions_total",
+        "app_tpu_requests_shed_total",
+    ):
+        m.new_counter(name)
+    for name in (
+        "app_tpu_brownout_level",
+        "app_tpu_slo_burn_rate",
+        "app_tpu_slo_tenant_burn_rate",
+        "app_tpu_slo_compliant",
+    ):
+        m.new_gauge(name)
+    return m
+
+
+def counter_value(m: Manager, name: str, **labels: str) -> float:
+    inst = [i for i in m.instruments() if i.name == name]
+    if not inst:
+        return 0.0
+    want = set(labels.items())
+    return sum(
+        v for k, v in inst[0].collect().items() if want <= set(k)
+    )
+
+
+def make_controller(**kw) -> tuple[BrownoutController, FakeClock]:
+    clock = FakeClock(1000.0)
+    defaults = dict(
+        enter_burn=2.0, exit_burn=1.0, sustain_s=10.0,
+        exit_sustain_s=20.0, max_new_tokens=8, aimd_cut=0.5,
+        recover_per_s=0.05, clock=clock,
+    )
+    defaults.update(kw)
+    return BrownoutController("m", **defaults), clock
+
+
+def make_engine(**kw):
+    defaults = dict(
+        n_slots=2, max_len=128, kv_block=16,
+        tokenizer=ByteTokenizer(), seed=0,
+        slo_availability=0.999,
+        # Force tests hold a level against the scheduler's continuous
+        # re-evaluation: with burn 0 the ladder would descend after the
+        # exit sustain, so park it out of reach unless a test says
+        # otherwise.
+        brownout_exit_sustain_s=100_000.0,
+    )
+    defaults.update(kw)
+    eng = InferenceEngine("llama-tiny", **defaults)
+    eng.start_sync()
+    return eng
+
+
+def wait_for(predicate, timeout_s: float = 30.0) -> None:
+    """Bound a poll on the scheduler thread observing stated time —
+    the OUTCOME is deterministic, only the thread interleaving isn't."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    assert predicate(), "condition never became true"
+
+
+# ----------------------------------------------------------------------
+# controller units: ladder math, hysteresis, AIMD
+# ----------------------------------------------------------------------
+
+
+def test_one_bad_tick_never_flips_a_level():
+    bc, clock = make_controller()
+    assert bc.evaluate(50.0) == 0          # over, but not sustained
+    clock.advance(9.9)
+    assert bc.evaluate(50.0) == 0          # still inside the sustain
+    clock.advance(0.2)
+    assert bc.evaluate(50.0) == 1          # sustained past 10s → L1
+    # A single clean tick does NOT descend either (exit sustain).
+    clock.advance(1.0)
+    assert bc.evaluate(0.0) == 1
+
+
+def test_ladder_climbs_one_rung_per_sustain_period_and_caps_at_l3():
+    bc, clock = make_controller(sustain_s=5.0)
+    bc.evaluate(10.0)
+    for expected in (1, 2, 3, 3):           # re-armed per rung; caps
+        clock.advance(5.1)
+        assert bc.evaluate(10.0) == expected
+    assert bc.describe()["routable"] is False
+    assert not bc.routable()
+
+
+def test_hysteresis_band_holds_and_exit_requires_sustained_recovery():
+    bc, clock = make_controller(sustain_s=5.0, exit_sustain_s=20.0)
+    bc.evaluate(10.0)
+    clock.advance(5.1)
+    assert bc.evaluate(10.0) == 1
+    # Between exit (1.0) and enter (2.0): the band holds the level and
+    # resets BOTH anchors — band time counts toward neither sustain.
+    for _ in range(5):
+        clock.advance(30.0)
+        assert bc.evaluate(1.5) == 1
+    # Clean signal: one rung only after a full exit-sustain period...
+    assert bc.evaluate(0.2) == 1
+    clock.advance(19.9)
+    assert bc.evaluate(0.2) == 1
+    clock.advance(0.2)
+    assert bc.evaluate(0.2) == 0
+    # ...and a recovery interrupted by the band restarts the clock.
+    clock.advance(5.1)
+    bc.evaluate(10.0)
+    clock.advance(5.1)
+    assert bc.evaluate(10.0) == 1
+    bc.evaluate(0.5)
+    clock.advance(10.0)
+    bc.evaluate(1.5)                        # band tick resets the anchor
+    clock.advance(15.0)
+    assert bc.evaluate(0.5) == 1            # 15s < full 20s since reset
+
+
+def test_aimd_cut_recovery_curve_and_l0_snap():
+    m = brownout_metrics()
+    bc, clock = make_controller(
+        sustain_s=5.0, exit_sustain_s=40.0, aimd_cut=0.5,
+        recover_per_s=0.01, metrics=m,
+    )
+    bc.evaluate(10.0)
+    clock.advance(5.1)
+    bc.evaluate(10.0)                       # L1: no budget action yet
+    assert bc.budget_factor == 1.0
+    assert bc.admission_fraction("interactive") == 1.0
+    clock.advance(5.1)
+    bc.evaluate(10.0)                       # L2: multiplicative cut
+    assert bc.budget_factor == pytest.approx(0.5)
+    # Priority-aware fractions: batch fills least, interactive most.
+    assert bc.admission_fraction("batch") == pytest.approx(0.25)
+    assert bc.admission_fraction("standard") == pytest.approx(0.4)
+    assert bc.admission_fraction("interactive") == pytest.approx(0.5)
+    # Additive recovery while the signal is below enter: 10s at
+    # 0.01/s → +0.1.
+    clock.advance(10.0)
+    bc.evaluate(0.0)
+    assert bc.budget_factor == pytest.approx(0.6)
+    # Climbing again cuts multiplicatively from the recovered value.
+    bc.evaluate(10.0)
+    clock.advance(5.1)
+    bc.evaluate(10.0)                       # L3 (still cuts at 2+)
+    assert bc.budget_factor == pytest.approx(0.3)
+    # Descend all the way: at L0 the factor SNAPS to exactly 1.0 — the
+    # byte-identity contract.
+    bc.force_level(0)
+    assert bc.budget_factor == 1.0
+    assert bc.admission_fraction("batch") == 1.0
+    assert counter_value(
+        m, "app_tpu_brownout_transitions_total", direction="up"
+    ) == 3.0
+    assert counter_value(
+        m, "app_tpu_brownout_transitions_total", direction="down"
+    ) == 3.0
+
+
+def test_recovery_continues_at_l1_and_force_level_clamps():
+    """The AIMD factor keeps recovering below L2 (a factor frozen at
+    L1 would inflate every Retry-After's recovery floor and compound
+    the next climb's cut), and force_level clamps out-of-range targets
+    instead of spinning forever against _step's own clamp."""
+    bc, clock = make_controller(aimd_cut=0.5, recover_per_s=0.01)
+    bc.force_level(2)
+    assert bc.budget_factor == pytest.approx(0.5)
+    bc.force_level(1)           # descend: no cut, factor carried
+    clock.advance(0.0)
+    bc.evaluate(0.0)            # anchor the eval clock
+    clock.advance(10.0)
+    bc.evaluate(0.0)
+    assert bc.budget_factor == pytest.approx(0.6)
+    # Out-of-range targets clamp (and return promptly — an unclamped
+    # loop target could never be reached).
+    bc.force_level(99)
+    assert bc.level == 3
+    bc.force_level(-5)
+    assert bc.level == 0
+    assert bc.budget_factor == 1.0
+
+
+def test_headroom_pressure_counts_like_burn():
+    bc, clock = make_controller(min_headroom=0.1, sustain_s=5.0)
+    bc.evaluate(0.0, headroom=0.05)         # burn clean, headroom low
+    clock.advance(5.1)
+    assert bc.evaluate(0.0, headroom=0.05) == 1
+    # With the floor unset (default), low headroom is NOT pressure.
+    bc2, clock2 = make_controller(sustain_s=5.0)
+    bc2.evaluate(0.0, headroom=0.01)
+    clock2.advance(5.1)
+    assert bc2.evaluate(0.0, headroom=0.01) == 0
+
+
+def test_projected_recovery_is_positive_and_scales_with_depth():
+    bc, clock = make_controller(sustain_s=5.0, exit_sustain_s=20.0)
+    assert bc.projected_recovery_s() >= 1.0
+    bc.force_level(2)
+    at_l2 = bc.projected_recovery_s()
+    bc.force_level(3)
+    at_l3 = bc.projected_recovery_s()
+    assert at_l3 > at_l2 >= 1.0
+
+
+def test_slo_class_parsing():
+    assert normalize_slo_class(" Batch ") == "batch"
+    assert normalize_slo_class("interactive") == "interactive"
+    assert normalize_slo_class("gold") == ""
+    assert normalize_slo_class("") == ""
+    # Tenant keys lower-case: the lookup matches X-Tenant-Id
+    # case-insensitively, same as the TPU_SLO_TENANT_<NAME>_* keys.
+    assert parse_tenant_class_map(
+        "ACME=batch, ops=interactive; bad=gold,=batch, x"
+    ) == {"acme": "batch", "ops": "interactive"}
+
+
+# ----------------------------------------------------------------------
+# per-tenant SLO objectives (satellite)
+# ----------------------------------------------------------------------
+
+
+def test_tenant_objectives_from_config_parses_override_keys():
+    cfg = MockConfig({
+        "TPU_SLO_TENANT_ACME_TTFT_MS": "250",
+        "TPU_SLO_TENANT_ACME_AVAILABILITY": "0.9995",
+        "TPU_SLO_TENANT_BULK_JOBS_E2E_MS": "90000",
+        "TPU_SLO_TENANT_BAD_TTFT_MS": "nope",  # unparseable: dropped
+        "TPU_SLO_TTFT_MS": "500",               # global key: not a tenant
+    })
+    out = tenant_objectives_from_config(cfg)
+    assert out["acme"] == {"ttft_ms": 250.0, "availability": 0.9995}
+    # Tenant names may contain underscores: the suffix anchors parsing.
+    assert out["bulk_jobs"] == {"e2e_ms": 90000.0}
+    assert "bad" not in out
+
+
+def test_slo_engine_evaluates_and_exports_per_tenant_burn():
+    clock = FakeClock(10_000.0)
+    m = brownout_metrics()
+    slo = SLOEngine(
+        "m", ttft_ms=60_000.0,
+        tenant_objectives={"acme": {"ttft_ms": 50.0}},
+        metrics=m, clock=clock,
+    )
+    # 120ms TTFT: good globally (60s threshold), bad for acme (50ms) —
+    # and the tenant match is case-insensitive.
+    slo.observe("ok", {"ttft_s": 0.12}, tenant="ACME")
+    slo.observe("ok", {"ttft_s": 0.12}, tenant="other")
+    assert slo.burn_rate("ttft", "5m") == 0.0
+    assert slo.burn_rate("ttft", "5m", tenant="acme") == pytest.approx(
+        1.0 / 0.01
+    )
+    gauge = [
+        i for i in m.instruments()
+        if i.name == "app_tpu_slo_tenant_burn_rate"
+    ][0]
+    labels = {dict(k).get("tenant") for k in gauge.collect()}
+    assert labels == {"acme"}
+    snap = slo.snapshot()
+    assert snap["tenants"]["acme"]["ttft"]["threshold_ms"] == 50.0
+    assert (
+        snap["tenants"]["acme"]["ttft"]["windows"]["5m"]["total"] == 1
+    )
+    desc = slo.describe()
+    assert desc["tenants"]["acme"]["compliant"] is False
+    assert desc["compliant"] is True  # global objectives unaffected
+
+
+def test_engine_serves_per_tenant_slo_section():
+    eng = make_engine(
+        slo_ttft_ms=60_000.0,
+        slo_tenant_objectives={"acme": {"ttft_ms": 0.001}},
+    )
+    try:
+        eng.generate_sync(
+            "tenant slo", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False, tenant="acme", timeout=300,
+        )
+        rep = eng.slo_report()
+        acme = rep["tenants"]["acme"]["ttft"]
+        assert acme["windows"]["5m"]["total"] >= 1
+        # No real TTFT beats a 1µs threshold: the override burns.
+        assert acme["windows"]["5m"]["burn_rate"] > 1.0
+        assert rep["compliant"] is True
+        assert eng.health_check()["details"]["slo"]["tenants"][
+            "acme"
+        ]["compliant"] is False
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# engine integration: off-switch byte-identity, L1 clamp, L2 ordering
+# ----------------------------------------------------------------------
+
+
+def _greedy(eng, prompt: str = "byte identical"):
+    return eng.generate_sync(
+        prompt, max_new_tokens=8, temperature=0.0, stop_on_eos=False,
+        timeout=300,
+    ).token_ids
+
+
+def test_off_switch_and_l0_are_byte_identical():
+    """TPU_BROWNOUT=0 builds no controller; an ARMED controller at L0
+    changes nothing either — both streams match a no-SLO baseline."""
+    base = make_engine(slo_availability=0.0, brownout=False)
+    try:
+        assert base._brownout is None and base._slo is None
+        reference = _greedy(base)
+    finally:
+        base.close()
+    off = make_engine(brownout=False)
+    try:
+        assert off._brownout is None and off._slo is not None
+        # Layer off = signal ABSENT (None), not "armed at 0": the pool
+        # must never suppress hedges/probes on an absent signal.
+        assert off.brownout_level() is None
+        assert _greedy(off) == reference
+    finally:
+        off.close()
+    armed = make_engine()
+    try:
+        assert armed._brownout is not None
+        assert armed.brownout_level() == 0
+        assert _greedy(armed) == reference
+        # L0 admission math is exactly nominal.
+        assert armed._brownout.admission_fraction("batch") == 1.0
+    finally:
+        armed.close()
+
+
+def test_l1_clamps_max_new_and_advertises_brownout():
+    eng = make_engine(brownout_max_new=4)
+    try:
+        bc = eng._brownout
+        bc.force_level(1)
+        result = eng.generate_sync(
+            "clamp me", max_new_tokens=32, temperature=0.0,
+            stop_on_eos=False, timeout=300,
+        )
+        assert len(result.token_ids) == 4
+        assert result.finish_reason == "length"
+        assert result.brownout is True        # deliberate, advertised
+        assert bc.snapshot()["actions"]["clamp_tokens"] >= 1
+        # Back at L0 the clamp is gone and the field stays absent.
+        bc.force_level(0)
+        result = eng.generate_sync(
+            "clamp me", max_new_tokens=32, temperature=0.0,
+            stop_on_eos=False, timeout=300,
+        )
+        assert len(result.token_ids) == 32
+        assert result.brownout is False
+    finally:
+        eng.close()
+
+
+def test_l2_sheds_batch_first_interactive_last():
+    m = brownout_metrics()
+    eng = make_engine(metrics=m, queue_max_tokens=400)
+    try:
+        eng._brownout.force_level(2)   # budget_factor 0.5
+        # Cost ~ prompt + max_new ≈ 150: over batch's 0.25×400=100,
+        # within standard's 0.8×0.5×400=160 and interactive's 200.
+        kw = dict(
+            max_new_tokens=140, temperature=0.0, stop_on_eos=False,
+        )
+        with pytest.raises(ErrorTooManyRequests) as exc:
+            eng.submit_generate("B" * 10, slo_class="batch", **kw)
+        assert "brownout" in str(exc.value)
+        assert exc.value.retry_after_s >= 1
+        h = eng.submit_generate("I" * 10, slo_class="interactive", **kw)
+        h.future.result(timeout=300)
+        h = eng.submit_generate("S" * 10, slo_class="standard", **kw)
+        h.future.result(timeout=300)
+        assert eng._brownout.shed_count("batch") == 1
+        assert eng._brownout.shed_count("interactive") == 0
+        assert counter_value(
+            m, "app_tpu_requests_shed_total", reason="brownout"
+        ) == 1.0
+    finally:
+        eng.close()
+
+
+def test_tenant_default_class_and_header_priority():
+    eng = make_engine(tenant_slo_class="BULK=batch")
+    try:
+        # Case-insensitive tenant match (the SLO-override convention).
+        h = eng.submit_generate(
+            "via tenant", max_new_tokens=2, temperature=0.0,
+            stop_on_eos=False, tenant="bulk",
+        )
+        assert h.slo_class == "batch"
+        h.future.result(timeout=300)
+        h = eng.submit_generate(
+            "explicit wins", max_new_tokens=2, temperature=0.0,
+            stop_on_eos=False, tenant="bulk", slo_class="interactive",
+        )
+        assert h.slo_class == "interactive"
+        h.future.result(timeout=300)
+        h = eng.submit_generate(
+            "unknown falls back", max_new_tokens=2, temperature=0.0,
+            stop_on_eos=False, slo_class="gold",
+        )
+        assert h.slo_class == "standard"
+        h.future.result(timeout=300)
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# Retry-After: positive and load-sensitive on EVERY 429 path (satellite)
+# ----------------------------------------------------------------------
+
+
+def test_every_429_carries_positive_load_sensitive_retry_after():
+    eng = make_engine(
+        queue_max_tokens=64, tenant_fair_share=0.3, expected_tps=10.0,
+    )
+    try:
+        sheds = []
+        # queue_tokens: a request bigger than the whole budget.
+        with pytest.raises(ErrorTooManyRequests) as exc:
+            eng.submit_generate(
+                "Q" * 40, max_new_tokens=60, temperature=0.0,
+                stop_on_eos=False,
+            )
+        sheds.append(exc.value)
+        # tenant_fair_share: the hog over its 0.3 × 64 token share.
+        with pytest.raises(ErrorTooManyRequests) as exc:
+            eng.submit_generate(
+                "H" * 20, max_new_tokens=10, temperature=0.0,
+                stop_on_eos=False, tenant="hog",
+            )
+        sheds.append(exc.value)
+        # hbm_headroom: an impossible floor sheds every admit.
+        eng.admit_min_headroom = 2.0
+        with pytest.raises(ErrorTooManyRequests) as exc:
+            eng.submit_generate(
+                "M" * 4, max_new_tokens=4, temperature=0.0,
+                stop_on_eos=False,
+            )
+        sheds.append(exc.value)
+        for shed in sheds:
+            assert shed.retry_after_s >= 1
+            assert int(shed.headers["Retry-After"]) >= 1
+        # Load sensitivity: the same shed under a deeper backlog quotes
+        # a LONGER wait (the regression this satellite pins — several
+        # paths used to answer a near-constant projected wait).
+        idle_wait = eng.shed_retry_after_s("hbm_headroom", 10)
+        eng._queued_tokens += 500
+        assert eng.shed_retry_after_s("hbm_headroom", 10) > idle_wait
+        ledger = eng._tenant_ledger
+
+        class Req:
+            prompt_ids = [1] * 100
+            max_new_tokens = 100
+            tenant = "hog"
+            ledger_t0 = 0.0
+            ledger_admitted = 0.0
+            ledger_done = False
+
+        idle_wait = eng.shed_retry_after_s("tenant_fair_share", 10, "hog")
+        for _ in range(5):
+            ledger.note_enqueued(Req())
+        assert (
+            eng.shed_retry_after_s("tenant_fair_share", 10, "hog")
+            > idle_wait
+        )
+    finally:
+        eng.close()
+
+
+def test_batcher_queue_full_retry_after_scales_with_backlog():
+    from gofr_tpu.serving.batcher import DynamicBatcher
+
+    b = DynamicBatcher(lambda xs: xs, max_batch=2, max_queue=4)
+    # Never started: the queue only fills. 4 seats, then sheds.
+    for i in range(4):
+        b.submit(i)
+    with pytest.raises(ErrorTooManyRequests) as exc:
+        b.submit(99)
+    assert exc.value.retry_after_s >= 1
+    # A measured 2s flush time quotes the 2-flush backlog honestly
+    # (the regression: a constant 1s regardless of backlog).
+    b._flush_ewma_s = 2.0
+    with pytest.raises(ErrorTooManyRequests) as exc:
+        b.submit(99)
+    assert exc.value.retry_after_s >= 4
+
+
+# ----------------------------------------------------------------------
+# pool: routing, hedges, probes, scaler (tentpole wiring)
+# ----------------------------------------------------------------------
+
+
+class FakeReplica:
+    """Minimal routable replica for pool policy tests."""
+
+    def __init__(self, name, load=0, compliant=None, level=None):
+        self.name = name
+        self.role = "fused"
+        self.probe_failed = False
+        self.draining = False
+        self.supports_stream = True
+        self.remote = False
+        self._load = load
+        self._compliant = compliant
+        self._level = level
+        self.probes = 0
+
+    def state(self):
+        return "SERVING"
+
+    def load(self):
+        return self._load
+
+    def throughput(self):
+        return 0.0
+
+    def adapters(self):
+        return frozenset()
+
+    def mesh_topology(self):
+        return None
+
+    def headroom(self):
+        return None
+
+    def slo_compliant(self):
+        return self._compliant
+
+    def brownout_level(self):
+        return self._level
+
+    def set_handoff(self, handoff):
+        pass
+
+    def set_tier_exporter(self, exporter):
+        pass
+
+    def probe(self, timeout_s):
+        self.probes += 1
+        return "pass", ""
+
+    def note_probe_success(self):
+        pass
+
+    def notify_probe_failure(self, reason):
+        pass
+
+    def revive(self, probe_timeout_s=5.0):
+        return False
+
+    def describe(self):
+        return {"state": "SERVING"}
+
+    def close(self):
+        pass
+
+
+def test_pick_deprioritizes_non_compliant_replicas():
+    from gofr_tpu.service.replica_pool import ReplicaPool
+
+    burned = FakeReplica("burned", load=0, compliant=False, level=3)
+    healthy = FakeReplica("healthy", load=50, compliant=True)
+    pool = ReplicaPool([burned, healthy], probe_interval_s=0)
+    try:
+        # The compliant replica wins despite 50× the load — compliance
+        # outranks least-loaded, exactly like the tier preference.
+        for _ in range(4):
+            assert pool.pick().name == "healthy"
+        # Preference, never a partition: an all-non-compliant pool
+        # still serves.
+        healthy._compliant = False
+        assert pool.pick().name in ("burned", "healthy")
+        # None (no SLOs configured) counts as compliant.
+        unknown = FakeReplica("unknown", load=9)
+        pool2 = ReplicaPool([burned, unknown], probe_interval_s=0)
+        try:
+            assert pool2.pick().name == "unknown"
+        finally:
+            pool2.close()
+    finally:
+        pool.close()
+
+
+def test_prober_skips_browned_out_replica_but_probes_demoted():
+    from gofr_tpu.service.replica_pool import ReplicaPool
+
+    m = brownout_metrics()
+    nominal = FakeReplica("nominal")
+    browned = FakeReplica("browned", level=1)
+    pool = ReplicaPool([nominal, browned], probe_interval_s=0, metrics=m)
+    try:
+        results = pool.probe_once()
+        assert nominal.probes == 1
+        assert browned.probes == 0
+        assert results["browned"] == "skipped: brownout"
+        assert counter_value(
+            m, "app_tpu_brownout_actions_total", action="skip_probe"
+        ) == 1.0
+        # The skip ALTERNATES: the next sweep probes, so a broken
+        # dataplane hiding behind its own burn storm still produces
+        # restart-on-evidence within two sweeps.
+        assert pool.probe_once()["browned"] == "pass"
+        assert browned.probes == 1
+        assert pool.probe_once()["browned"] == "skipped: brownout"
+        assert browned.probes == 1
+        # A DEMOTED replica always probes — re-admission requires a
+        # clean pass through the dataplane, brownout or not.
+        browned.probe_failed = True
+        pool.probe_once()
+        assert browned.probes == 2
+        # A REMOTE replica always probes too: its probe is a health
+        # GET (no generation) and the ONLY path that refreshes its
+        # cached brownout/compliance advertisement — skipping it would
+        # freeze a recovered pod at its last advertised level.
+        remote = FakeReplica("remote", level=3)
+        remote.remote = True
+        pool2 = ReplicaPool([nominal, remote], probe_interval_s=0)
+        try:
+            pool2.probe_once()
+            assert remote.probes == 1
+        finally:
+            pool2.close()
+    finally:
+        pool.close()
+
+
+def test_hedge_suppressed_against_browned_out_primary():
+    from gofr_tpu.service.replica_pool import ReplicaPool
+
+    m = brownout_metrics()
+    primary = FakeReplica("primary", level=1)
+    pool = ReplicaPool([primary], probe_interval_s=0, metrics=m)
+    try:
+        assert pool._hedge_suppressed([primary]) is True
+        assert counter_value(
+            m, "app_tpu_brownout_actions_total", action="suppress_hedge"
+        ) == 1.0
+        primary._level = 0
+        assert pool._hedge_suppressed([primary]) is False
+        primary._level = None
+        assert pool._hedge_suppressed([primary]) is False
+    finally:
+        pool.close()
+
+
+def test_scaler_treats_sustained_l2_as_pressure():
+    from gofr_tpu.service.pool_scaler import PoolScaler
+    from gofr_tpu.service.replica_pool import ReplicaPool
+
+    clock = FakeClock(0.0)
+    browned = FakeReplica("browned", level=2)
+    pool = ReplicaPool([browned], probe_interval_s=0)
+    try:
+        spawned = []
+
+        def spawn():
+            replica = FakeReplica(f"spawned-{len(spawned)}")
+            spawned.append(replica)
+            return replica
+
+        scaler = PoolScaler(
+            pool, spawn, max_replicas=2, scale_up_wait_s=10.0,
+            interval_s=0, clock=clock,
+        )
+        assert scaler.evaluate() == "steady"   # pressure noted, not acted
+        clock.advance(10.1)
+        assert scaler.evaluate() == "up"       # sustained L2+ → spawn
+        assert len(spawned) == 1
+        # The knob off: L2 alone is not pressure.
+        browned2 = FakeReplica("b2", level=2)
+        pool2 = ReplicaPool([browned2], probe_interval_s=0)
+        try:
+            scaler2 = PoolScaler(
+                pool2, spawn, max_replicas=2, scale_up_wait_s=10.0,
+                interval_s=0, clock=clock, up_on_brownout=False,
+            )
+            assert scaler2.evaluate() == "steady"
+            clock.advance(60.0)
+            assert scaler2.evaluate() == "steady"
+        finally:
+            pool2.close()
+    finally:
+        pool.close()
+
+
+def test_advertisement_through_engine_replica_and_http_probe():
+    from gofr_tpu.service.replica_pool import EngineReplica, HTTPReplica
+
+    eng = make_engine()
+    try:
+        replica = EngineReplica("r0", eng)
+        assert replica.brownout_level() == 0
+        assert replica.slo_compliant() is True
+        eng._brownout.force_level(3)
+        assert replica.brownout_level() == 3
+        # L3 folds into the routing bit even while the burn gauges are
+        # momentarily clean.
+        assert replica.slo_compliant() is False
+        desc = replica.describe()
+        assert desc["brownout_level"] == 3
+        health = eng.health_check()
+        assert health["details"]["brownout"]["level"] == 3
+        assert health["details"]["brownout"]["routable"] is False
+        assert eng.capacity_report()["brownout"]["level"] == 3
+        assert eng.brownout_report()["level"] == 3
+        eng._brownout.force_level(0)
+    finally:
+        eng.close()
+
+    class FakeService:
+        def health_check(self):
+            return {
+                "status": "UP",
+                "details": {
+                    "slo": {"compliant": True},
+                    "brownout": {"level": 3, "routable": False},
+                },
+            }
+
+    remote = HTTPReplica("remote", FakeService(), stream=False)
+    verdict, _ = remote.probe(timeout_s=1.0)
+    assert verdict == "pass"
+    assert remote.brownout_level() == 3
+    assert remote.slo_compliant() is False  # L3 folds in over the wire
+
+
+def test_remote_brownout_clamp_field_survives_the_hop():
+    """A remote replica's clamp advertisement ("brownout": true on the
+    OpenAI wire) must reach the routing pool's client — multi-host
+    pools keep the 'truncation was deliberate' contract."""
+    from gofr_tpu.service.replica_pool import HTTPReplica
+
+    class FakeResp:
+        status_code = 200
+        body = b""
+
+        def json(self):
+            return {
+                "choices": [{
+                    "text": "cut", "finish_reason": "length",
+                    "brownout": True,
+                }],
+                "usage": {"prompt_tokens": 3},
+            }
+
+        def get_header(self, name):
+            return None
+
+    class FakeSvc:
+        def post(self, path, json=None, headers=None):
+            return FakeResp()
+
+    remote = HTTPReplica("r", FakeSvc(), stream=False)
+    req = remote.submit("hi", max_new_tokens=4)
+    result = req.future.result(timeout=10)
+    assert result.finish_reason == "length"
+    assert result.brownout is True
+
+
+# ----------------------------------------------------------------------
+# THE storm acceptance path
+# ----------------------------------------------------------------------
+
+
+def test_overload_storm_climbs_sheds_batch_first_and_descends():
+    """The deterministic overload storm (acceptance criteria): a
+    fault-injected slow-decode storm — modeled as sustained
+    SLO-breaching observations under stated clocks — climbs the ladder
+    L0→L1→L2; at L2 batch traffic is shed (429 reason=brownout, positive
+    Retry-After) while interactive goodput continues; when the storm
+    stops, the TTFT burn recovers below the exit threshold, the ladder
+    descends with hysteresis back to L0, and no admitted request saw a
+    5xx anywhere."""
+    m = brownout_metrics()
+    clock = FakeClock(100_000.0)
+    eng = make_engine(
+        metrics=m,
+        queue_max_tokens=200,
+        slo_ttft_ms=60_000.0,          # real traffic is always good
+        brownout_enter=2.0,
+        brownout_exit=1.0,
+        brownout_sustain_s=5.0,
+        brownout_exit_sustain_s=5.0,
+        brownout_max_new=64,
+    )
+    errors_5xx = []
+    try:
+        # Stated time for the burn windows AND the ladder.
+        eng._slo._clock = clock
+        eng._brownout._clock = clock
+
+        def storm(n=30):
+            # The slow-decode fault: every observation misses the TTFT
+            # objective by 100×, so the 5m burn pegs far above enter.
+            for _ in range(n):
+                eng._slo.observe("ok", {"ttft_s": 6_000.0})
+
+        def interactive(prompt):
+            try:
+                return eng.generate_sync(
+                    prompt, max_new_tokens=8, temperature=0.0,
+                    stop_on_eos=False, slo_class="interactive",
+                    timeout=300,
+                ).token_ids
+            except ErrorTooManyRequests:
+                return None
+            except Exception as exc:  # noqa: BLE001 — the zero-5xx assertion
+                errors_5xx.append(exc)
+                raise
+
+        assert eng.brownout_level() == 0
+        reference = interactive("storm baseline")
+        assert reference
+
+        # -- climb: L0 → L1 → L2, one sustained rung at a time --------
+        storm()
+        # The scheduler must anchor the over-signal at the CURRENT
+        # stated time before it advances — one bad tick alone flips
+        # nothing (the sustain window is the point).
+        wait_for(lambda: eng._brownout._over_since is not None)
+        assert eng.brownout_level() == 0
+        clock.advance(6.0)
+        wait_for(lambda: eng.brownout_level() >= 1)
+        clock.advance(6.0)
+        wait_for(lambda: eng.brownout_level() >= 2)
+        assert eng._slo.worst_burn("5m") > 2.0
+
+        # -- at L2: batch shed first, interactive keeps flowing -------
+        # Batch cost ~120 tokens: over batch's 0.25 × 200 = 50-token
+        # allowance, so the hog's batch burst sheds...
+        batch_sheds = 0
+        for i in range(3):
+            try:
+                eng.submit_generate(
+                    "B" * 60, max_new_tokens=60,
+                    temperature=0.0, stop_on_eos=False,
+                    slo_class="batch", tenant="hog",
+                )
+            except ErrorTooManyRequests as exc:
+                batch_sheds += 1
+                assert "brownout" in str(exc)
+                assert exc.retry_after_s >= 1
+        assert batch_sheds == 3
+        # ...while interactive goodput keeps flowing through the storm
+        # (cost ~46, inside interactive's 0.5 × 200 = 100 allowance).
+        assert interactive("interactive storm " + "I" * 20)
+        assert eng._brownout.shed_count("batch") == 3
+        assert eng._brownout.shed_count("interactive") == 0
+        assert counter_value(
+            m, "app_tpu_requests_shed_total", reason="brownout"
+        ) == 3.0
+
+        # -- recovery: storm ends, the 5m window ages out -------------
+        clock.advance(360.0)
+        assert eng._slo.worst_burn("5m") == 0.0   # below exit
+        # Hysteresis on the way down too: the first clear tick only
+        # anchors the exit-sustain window; each further sustained-clear
+        # period descends ONE rung.
+        wait_for(lambda: eng._brownout._clear_since is not None)
+        assert eng.brownout_level() == 2
+        clock.advance(6.0)
+        wait_for(lambda: eng.brownout_level() == 1)
+        clock.advance(6.0)
+        wait_for(lambda: eng.brownout_level() == 0)
+        assert eng._brownout.budget_factor == 1.0
+        # Clean descent shows in the transition counters: two up, two
+        # down, and the ladder is exactly where it started.
+        assert counter_value(
+            m, "app_tpu_brownout_transitions_total", direction="up"
+        ) == 2.0
+        assert counter_value(
+            m, "app_tpu_brownout_transitions_total", direction="down"
+        ) == 2.0
+        # Post-storm interactive streams are byte-identical to the
+        # pre-storm baseline (L0 is byte-identically off).
+        assert interactive("storm baseline") == reference
+        # Zero 5xx throughout: every admitted request resolved, every
+        # rejection was a 429.
+        assert errors_5xx == []
+    finally:
+        eng.close()

@@ -152,6 +152,26 @@ def test_debug_slo_serves_burn_state(debug_app):
             assert w["total"] >= 1 and w["burn_rate"] == 0.0
 
 
+def test_debug_brownout_serves_ladder_state(debug_app):
+    """/debug/brownout (docs/advanced-guide/resilience.md "Brownout &
+    overload control"): the degradation-ladder level, AIMD budget
+    factor, thresholds, and per-action counters on the ops port — the
+    actuator's state next to /debug/slo's signal."""
+    st, body = _metrics_get(debug_app, "/debug/brownout")
+    assert st == 200
+    report = json.loads(body)["tpu"]
+    assert report["enabled"] is True
+    assert report["level"] == 0
+    assert report["budget_factor"] == 1.0
+    assert report["enter_burn"] > report["exit_burn"]
+    assert report["sustain_s"] > 0 and report["exit_sustain_s"] > 0
+    assert report["projected_recovery_s"] >= 1.0
+    assert set(report["class_admit_fraction"]) == {
+        "interactive", "standard", "batch"
+    }
+    assert report["transitions"] == {"up": 0, "down": 0}
+
+
 def test_debug_tpu_trace_validates_and_captures(debug_app):
     st, body = _metrics_get(debug_app, "/debug/tpu-trace?ms=nope")
     assert st == 400 and b"integer" in body

@@ -1081,6 +1081,72 @@ def test_gl016_accepts_clamped_and_engine_owned_labels(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL017 — control-loop threshold comparisons without hysteresis
+# ----------------------------------------------------------------------
+
+
+def test_gl017_flags_threshold_state_flip_without_hysteresis(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/controller.py",
+        """
+        class Controller:
+            def tick(self):
+                if self.burn_rate > self.enter_threshold:
+                    self.level = 1  # flips on one noisy tick
+                if self.pool_headroom() < self.headroom_floor:
+                    self.degraded = True
+        """,
+        select=["GL017"],
+    )
+    assert ids == ["GL017", "GL017"]
+    assert "sustain" in findings[0].message
+
+
+def test_gl017_accepts_sustain_windows_and_shed_decisions(tmp_path):
+    # A sustain anchor (the *_since idiom) or any hysteresis/budget
+    # guard evidence in the function exempts it; shedding/raising in
+    # the branch is a per-request decision, not controller state; and
+    # files outside serving//service/ are out of scope.
+    ids, _ = _lint(
+        tmp_path, "serving/controller.py",
+        """
+        class Controller:
+            def tick(self, now):
+                if self.burn_rate > self.enter_threshold:
+                    if self._over_since is None:
+                        self._over_since = now
+                    elif now - self._over_since >= self.sustain_s:
+                        self.level += 1
+        """,
+        select=["GL017"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "serving/admission.py",
+        """
+        class Admission:
+            def check(self, req):
+                if self.pool_headroom() < self.admit_floor:
+                    self._shed("hbm_headroom")
+                    raise TooManyRequests("retry elsewhere")
+        """,
+        select=["GL017"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "ops/controller.py",
+        """
+        class Controller:
+            def tick(self):
+                if self.burn_rate > self.enter_threshold:
+                    self.level = 1  # outside serving//service/
+        """,
+        select=["GL017"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
