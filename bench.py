@@ -44,7 +44,17 @@ BENCH_OVERLOAD_WORKLOAD=1 (overload-storm A/B: batch-class flood +
 interactive arrivals under an always-breaching TTFT SLO, run with the
 brownout ladder off then on — the JSON line carries
 interactive_goodput_{off,on}, ttft_p99_{off,on}_ms,
-shed_{batch,interactive}_total, and max_brownout_level).
+shed_{batch,interactive}_total, and max_brownout_level),
+BENCH_TIER_WORKLOAD=1 (disaggregated-tier transfer-leg A/B: the same
+prefill-heavy burst through a prefill+decode pool with the transfer leg
+pinned to host-bounce then to the device leg — the JSON line carries
+transfer_ms_{host,device} p50/p95, per-leg decode-tier cold TTFT, and
+tier_transfers_total{leg,result}; acceptance = device p50 strictly
+below host),
+BENCH_SPEC_WORKLOAD=1 (n-gram speculation A/B: a repeated-text burst
+on spec=0 vs spec=BENCH_SPEC_G=2 engines, emitting plain/spec tok/s,
+the measured app_tpu_spec_tokens_per_step acceptance, and the
+per-request greedy-identity verdict — the default-on decision data).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
 steady-state; the reported value is then the mid-window sustained rate,
 with the end-to-end rate in e2e_tps; set both to 0 for the synchronized
@@ -926,6 +936,268 @@ def _tp_workload(on_tpu: bool) -> None:
     os._exit(0)
 
 
+def _tier_workload(on_tpu: bool) -> None:
+    """BENCH_TIER_WORKLOAD=1: disaggregated-tier transfer-leg A/B — the
+    SAME prefill-heavy burst served through a 1-prefill + 1-decode
+    in-proc pool with the transfer leg pinned to host-bounce, then to
+    the device leg. One JSON line carries per-leg transfer latency
+    (p50/p95 ms, from the request timelines' tpu.transfer hops), the
+    decode-tier cold TTFT per leg, streamed-token identity across legs,
+    and the pool's tier_transfers_total{leg,result} counters. The
+    acceptance bar: the device leg's transfer p50 strictly below the
+    host bounce's on the same workload (CPU fallback rows are marked
+    degraded as usual — PCIe/ICI asymmetry only exists on real
+    hardware, but the zero-host-copy path must already win on CPU
+    because it skips two full plane materializations)."""
+    import random
+
+    from gofr_tpu.metrics import new_metrics_manager
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+    from gofr_tpu.service.replica_pool import EngineReplica, ReplicaPool
+
+    model = os.environ.get("BENCH_MODEL", "llama-tiny")
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "8"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "8"))
+    kv_block = int(os.environ.get("BENCH_KV_BLOCK", "32"))
+    prompt_tokens = int(os.environ.get("BENCH_TIER_PROMPT", "96"))
+
+    metrics = new_metrics_manager()
+    metrics.new_counter("app_tpu_tier_transfers_total")
+    metrics.new_counter("app_tpu_tier_transfer_bytes_total")
+    metrics.new_histogram("app_tpu_tier_transfer_seconds")
+    metrics.new_gauge("app_tpu_tier_mode")
+
+    log(f"bench[tier]: model={model} requests={n_requests}/leg "
+        f"prompt={prompt_tokens}tok kv_block={kv_block}")
+    _set_stage("engine-init")
+
+    def mk():
+        eng = InferenceEngine(
+            model, n_slots=4, max_len=256, window_k=4, pipeline_depth=1,
+            prefill_chunk=32, kv_block=kv_block, auto_prefix=True,
+            tokenizer=ByteTokenizer(),
+        )
+        eng.start_sync()
+        return eng
+
+    pf, dc = mk(), mk()
+    pool = ReplicaPool(
+        [
+            EngineReplica("pf", pf, role="prefill"),
+            EngineReplica("dc", dc, role="decode"),
+        ],
+        probe_interval_s=0, hedge_delay_s=300.0,
+        rng=random.Random(7), metrics=metrics,
+    )
+
+    _SALTS = {"host": 0, "device": 101, "warm-host": 53, "warm-device": 157}
+
+    def prompt(leg: str, i: int) -> list:
+        # Distinct per (leg, request): every transfer ships cold
+        # content — a collision would dedupe against the decode tier's
+        # radix and skip the very leg being measured.
+        base = [2 + (i * 7 + _SALTS[leg]) % 200]
+        return (base * prompt_tokens)[:prompt_tokens - 1] + [3 + i]
+
+    def run_leg(leg: str) -> dict:
+        pool.transfer_leg = leg
+        reqs = [
+            pool.submit_generate(
+                prompt(leg, i), max_new_tokens=new_tokens,
+                temperature=0.0,
+            )
+            for i in range(n_requests)
+        ]
+        results = [r.future.result(timeout=600) for r in reqs]
+        hops = [
+            hop
+            for r in reqs if r.timeline is not None
+            for hop in r.timeline.transfers
+        ]
+        xfer_ms = sorted(
+            (end - start) * 1e3
+            for _, _, start, end, result, hop_leg in hops
+            if result == "ok" and hop_leg == leg
+        )
+        ttfts = sorted(r.ttft_s * 1e3 for r in results)
+        return {
+            "tokens": [list(r.token_ids) for r in results],
+            f"transfer_ms_{leg}": {
+                "p50": round(_pct(xfer_ms, 0.50), 3),
+                "p95": round(_pct(xfer_ms, 0.95), 3),
+            },
+            f"cold_ttft_{leg}_p50_ms": round(_pct(ttfts, 0.50), 2),
+            f"transfers_{leg}": len(xfer_ms),
+        }
+
+    _set_stage("warmup")
+    # One transfer per leg compiles extract/move (device) and the
+    # insert path (host) BEFORE the fence — a steady-state transfer
+    # must never hide a recompile (exit 6 below if one does).
+    for warm_leg in ("host", "device"):
+        pool.transfer_leg = warm_leg
+        pool.generate_sync(
+            prompt(f"warm-{warm_leg}", 0), max_new_tokens=new_tokens,
+            temperature=0.0, timeout=600,
+        )
+    pf.mark_steady_state()
+    dc.mark_steady_state()
+
+    _set_stage("measure")
+    t0 = time.time()
+    host = run_leg("host")
+    device = run_leg("device")
+    wall = time.time() - t0
+    # Prompts differ per leg by design (each leg must transfer COLD
+    # content); the legs-move-bytes-not-meaning identity contract is
+    # pinned in CI (tests/test_tier_d2d.py) against a fused reference.
+    host.pop("tokens")
+    device.pop("tokens")
+    counters = {}
+    for inst in metrics.instruments():
+        if inst.name == "app_tpu_tier_transfers_total":
+            for key, value in inst.collect().items():
+                counters["|".join("=".join(p) for p in key)] = value
+    for eng in (pf, dc):
+        _recompile_guard(eng)
+    host_p50 = host["transfer_ms_host"]["p50"]
+    dev_p50 = device["transfer_ms_device"]["p50"]
+    log(f"bench[tier]: transfer p50 host={host_p50}ms "
+        f"device={dev_p50}ms ({wall:.2f}s total); "
+        f"device_wins={dev_p50 < host_p50}")
+    pf.close()
+    dc.close()
+    _set_stage("done")
+    print(json.dumps({
+        "metric": "tier_transfer_ms_p50_device",
+        "value": dev_p50,
+        "unit": "ms",
+        "vs_baseline": round(
+            host_p50 / dev_p50, 3
+        ) if dev_p50 else None,
+        "platform": "tpu" if on_tpu else "cpu",
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "tier_legs",
+        **{k: v for k, v in host.items()},
+        **{k: v for k, v in device.items()},
+        "device_leg_faster": bool(dev_p50 < host_p50),
+        "tier_transfers_total": counters,
+    }), flush=True)
+    os._exit(0)
+
+
+def _spec_workload(on_tpu: bool) -> None:
+    """BENCH_SPEC_WORKLOAD=1: n-gram speculation A/B — the SAME
+    repeated-text burst (the prompt-lookup-friendly shape: the
+    continuation keeps re-walking substrings of the prompt) served by a
+    spec=0 engine and a spec=G (BENCH_SPEC_G=2) engine. The JSON line
+    carries both throughputs, the speedup, the measured
+    ``app_tpu_spec_tokens_per_step`` acceptance, AND the per-request
+    greedy-identity verdict — the default-on evidence ROADMAP asks of
+    the speculation path. Identity is REPORTED rather than enforced:
+    the verify kernel computes G+1 positions in one batched pass whose
+    bf16 reduction order differs from the one-position decode window's,
+    so near-tie argmax flips are a known numeric property of the path
+    (the same class TPU_REPLAY_EXACT exists for) — and exactly the
+    field a default-on decision needs to see, run after run, instead
+    of a refused row."""
+    from gofr_tpu.metrics import new_metrics_manager
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    model = os.environ.get("BENCH_MODEL", "llama-tiny")
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "8"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32"))
+    spec_g = int(os.environ.get("BENCH_SPEC_G", "2"))
+    # Repeated text: "abcabcabc…" with a per-request rotation — the
+    # n-gram draft's best case, and exactly the retrieval/multi-turn
+    # shape the prefix cache already targets.
+    prompts = [
+        ("abcdefgh"[i % 4:] + "abcdefgh" * 12)[:64]
+        for i in range(n_requests)
+    ]
+
+    log(f"bench[spec]: model={model} requests={n_requests} "
+        f"new_tokens={new_tokens} spec_g={spec_g}")
+    _set_stage("engine-init")
+
+    def serve(spec_tokens: int) -> tuple:
+        metrics = new_metrics_manager()
+        metrics.new_histogram("app_tpu_spec_tokens_per_step")
+        eng = InferenceEngine(
+            model, n_slots=8, max_len=256, window_k=4,
+            tokenizer=ByteTokenizer(), spec_tokens=spec_tokens,
+            metrics=metrics,
+        )
+        eng.start_sync()
+        eng.generate_sync(
+            "warm" * 4, max_new_tokens=2, temperature=0.0,
+            stop_on_eos=False,
+        )
+        eng.mark_steady_state()
+        t0 = time.time()
+        reqs = [
+            eng.submit_generate(
+                p, max_new_tokens=new_tokens, temperature=0.0,
+                stop_on_eos=False,
+            )
+            for p in prompts
+        ]
+        results = [r.future.result(timeout=600) for r in reqs]
+        wall = time.time() - t0
+        _recompile_guard(eng)
+        eng.close()
+        total = sum(len(r.token_ids) for r in results)
+        acceptance = None
+        for inst in metrics.instruments():
+            if inst.name == "app_tpu_spec_tokens_per_step":
+                agg_sum = agg_n = 0.0
+                for _, (_, (s_, n_)) in inst.collect().items():
+                    agg_sum += s_
+                    agg_n += n_
+                if agg_n:
+                    acceptance = agg_sum / agg_n
+        return (
+            total / wall,
+            acceptance,
+            [list(r.token_ids) for r in results],
+        )
+
+    _set_stage("measure")
+    plain_tps, _, plain_tokens = serve(0)
+    spec_tps, acceptance, spec_tokens_out = serve(spec_g)
+    diverged = sum(
+        1 for a, b in zip(plain_tokens, spec_tokens_out) if a != b
+    )
+    log(f"bench[spec]: plain={plain_tps:.1f} tok/s "
+        f"spec={spec_tps:.1f} tok/s "
+        f"acceptance={acceptance if acceptance is None else round(acceptance, 3)} "
+        f"diverged={diverged}/{len(plain_tokens)}")
+    _set_stage("done")
+    print(json.dumps({
+        "metric": "spec_decode_tokens_per_sec",
+        "value": round(spec_tps, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(spec_tps / plain_tps, 3) if plain_tps else None,
+        "platform": "tpu" if on_tpu else "cpu",
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "spec_ab",
+        "spec_g": spec_g,
+        "plain_tps": round(plain_tps, 2),
+        "spec_tps": round(spec_tps, 2),
+        "spec_speedup": round(spec_tps / plain_tps, 3) if plain_tps else None,
+        "spec_tokens_per_step": (
+            round(acceptance, 3) if acceptance is not None else None
+        ),
+        "token_identical": diverged == 0,
+        "diverged_requests": diverged,
+    }), flush=True)
+    os._exit(0)
+
+
 def main() -> None:
     # Whole-run watchdog (round-2 lesson: the old init-only watchdog
     # released after jax.devices(), then engine-init remote compiles hung
@@ -995,6 +1267,12 @@ def main() -> None:
         return  # unreachable (os._exit) — keeps the control flow obvious
     if os.environ.get("BENCH_OVERLOAD_WORKLOAD", "") in ("1", "true", "yes"):
         _overload_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_TIER_WORKLOAD", "") in ("1", "true", "yes"):
+        _tier_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_SPEC_WORKLOAD", "") in ("1", "true", "yes"):
+        _spec_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))

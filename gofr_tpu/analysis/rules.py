@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL017).
+"""The graftlint rule set (GL001–GL018).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -2169,6 +2169,97 @@ ALL_RULES = (
 )
 
 
+# ----------------------------------------------------------------------
+# GL018 — host pull inside the device transfer leg
+# ----------------------------------------------------------------------
+
+
+class HostPullInDeviceLegRule(Rule):
+    """The disaggregated-tier DEVICE leg exists to ship KV blocks
+    pool→pool without touching host memory: per-block jitted extraction
+    on the exporter, an explicit sharding-aware ``device_put``, and a
+    donated jitted write on the importer. Its whole value evaporates —
+    silently — if any step materializes a cache plane on host:
+    ``jax.device_get`` or ``np.asarray``/``np.array`` of a cache/plane
+    expression inside device-leg code re-introduces the PCIe round trip
+    the leg was built to remove, and on a GSPMD-sharded pool it
+    all-gathers shard HBM per call. The naming convention IS the
+    contract: functions named ``*_device_leg`` or ``paged_move*`` are
+    the device leg, and a host pull of plane data inside one is always
+    a bug (the deliberate host bounce lives in ``export*`` functions,
+    GL014's documented seam).
+    """
+
+    rule_id = "GL018"
+    name = "host-pull-in-device-leg"
+    rationale = (
+        "the device transfer leg must never bounce cache planes "
+        "through host memory — a device_get/np.asarray inside "
+        "*_device_leg/paged_move* code silently re-adds the PCIe "
+        "round trip (and all-gathers sharded pool HBM) the leg "
+        "exists to remove"
+    )
+
+    _PULLS = ("asarray", "array")
+    _HOST_MODS = ("np", "numpy")
+    #: expression names that identify KV-plane data in transfer code.
+    _PLANE_HINTS = ("cache", "plane", "blk", "block", "payload", "k_s",
+                    "v_s")
+
+    @staticmethod
+    def _is_device_leg_name(name: str) -> bool:
+        low = name.lower()
+        return low.endswith("_device_leg") or low.startswith("paged_move")
+
+    @classmethod
+    def _mentions_plane(cls, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            label = None
+            if isinstance(sub, ast.Attribute):
+                label = sub.attr.lower()
+            elif isinstance(sub, ast.Name):
+                label = sub.id.lower()
+            if label and any(h in label for h in cls._PLANE_HINTS):
+                return True
+        return False
+
+    @classmethod
+    def _is_host_pull(cls, call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        parts = name.split(".")
+        short = parts[-1]
+        if short == "device_get":
+            return True
+        if short in cls._PULLS and len(parts) >= 2:
+            return parts[-2] in cls._HOST_MODS
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        # The device-leg property inherits into nested defs (a helper
+        # closure inside a device-leg function is still the device leg).
+        def visit(node: ast.AST, in_leg: bool) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_leg = in_leg or self._is_device_leg_name(node.name)
+            if (
+                in_leg
+                and isinstance(node, ast.Call)
+                and self._is_host_pull(node)
+                and any(self._mentions_plane(a) for a in node.args)
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "host pull of cache-plane data inside the device "
+                    "transfer leg — this re-adds the host bounce the "
+                    "leg exists to remove; keep planes on device "
+                    "(jitted extract/move + explicit device_put) or "
+                    "route through the export* host-bounce seam",
+                )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_leg)
+
+        yield from visit(tree, False)
+
+
 def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
     config = config or LintConfig()
     return [
@@ -2189,4 +2280,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         JitInRequestPathRule(),
         UnboundedMetricLabelRule(),
         ThresholdNoHysteresisRule(),
+        HostPullInDeviceLegRule(),
     ]

@@ -421,6 +421,49 @@ class App:
                 # per-action counters — what the burn-rate actuator is
                 # DOING about the /debug/slo signal right now.
                 return engine_report("brownout_report")
+            if path == "/ops/tier-import":
+                # Wire-leg tier transfers (docs/advanced-guide/
+                # resilience.md "Disaggregated prefill/decode"): a
+                # remote prefill pod POSTs a finished prompt's KV
+                # blocks here (length-prefixed binary payload) so the
+                # separately-submitted request admission-aliases them
+                # zero-copy. Validation mirrors the in-proc handoff
+                # (geometry fingerprint + re-computed CRC); every
+                # rejection is a 2xx/4xx "the request will re-prefill"
+                # — never a 5xx, never a wrong answer. Lives on the
+                # ops port: block payloads are operator-tier traffic,
+                # not dataplane requests.
+                import json as _json
+
+                if raw.method != "POST":
+                    return Response(
+                        status=405,
+                        headers={"Allow": "POST"},
+                        body=b'{"error": "POST a KVB1 payload"}',
+                    )
+                from gofr_tpu.ops.kv_cache import payload_from_wire
+
+                try:
+                    payload = payload_from_wire(raw.body or b"")
+                except Exception as exc:  # noqa: BLE001 — ANY malformed body is a 400 rejection, never a 5xx
+                    return Response(
+                        status=400,
+                        headers={"Content-Type": "application/json"},
+                        body=_json.dumps({
+                            "result": "rejected", "error": str(exc),
+                        }).encode(),
+                    )
+                eng = container.tpu
+                fn = getattr(eng, "import_payload", None)
+                result = fn(payload) if callable(fn) else "rejected"
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps({
+                        "result": result,
+                        "blocks": payload.n_blocks,
+                    }).encode(),
+                )
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json

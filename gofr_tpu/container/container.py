@@ -301,7 +301,13 @@ class Container:
         m.new_counter(
             "app_tpu_tier_transfers_total",
             "prefill→decode KV-block transfers by outcome (result="
-            "ok|fused|failed_over|local_fused|expired)",
+            "ok|fused|failed_over|local_fused|expired) and leg "
+            "(leg=device|wire|host|none)",
+        )
+        m.new_counter(
+            "app_tpu_tier_transfer_bytes_total",
+            "KV-cache bytes shipped by successful tier transfers, per "
+            "leg (leg=device|wire|host)",
         )
         m.new_histogram(
             "app_tpu_tier_transfer_seconds",

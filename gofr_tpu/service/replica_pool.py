@@ -112,11 +112,16 @@ class Replica:
     #: True for network-backed replicas (remote-stream failover metric).
     remote = False
     #: Can this backend adopt a shipped KV-block payload
-    #: (``import_prefilled``)? In-proc engines only until a wire form
-    #: of the payload exists — an import-incapable decode replica must
-    #: not count toward tiered mode, or every transfer to it is a
-    #: guaranteed-futile retry loop.
+    #: (``import_prefilled``)? In-proc engines always; remote replicas
+    #: when they stream AND carry an ops-port import service (the wire
+    #: leg) — an import-incapable decode replica must not count toward
+    #: tiered mode, or every transfer to it is a guaranteed-futile
+    #: retry loop.
     supports_tier_import = False
+    #: Can this backend receive DEVICE-resident block payloads (the
+    #: zero-host-copy leg)? In-proc paged engines on the shared JAX
+    #: runtime only — device arrays cannot cross a process boundary.
+    supports_device_import = False
     #: Can this backend EXPORT prefilled blocks (honor
     #: ``set_tier_exporter``)? Same asymmetry guard on the prefill
     #: side: a prefill-tagged replica that can never ship blocks must
@@ -295,6 +300,14 @@ class EngineReplica(Replica):
         # The engine's scheduler checks its OWN role at prefill
         # finalize, so the replica's role is mirrored down.
         engine.tier_role = role
+
+    @property
+    def supports_device_import(self) -> bool:  # type: ignore[override]
+        """Device-leg target: a paged in-proc engine on this process's
+        JAX runtime (the transfer ladder falls to host-bounce for
+        unpaged engines — handing them a device payload would only be
+        rejected at validation)."""
+        return bool(getattr(self.engine, "kv_block", 0))
 
     def state(self) -> str:
         return str(self.engine.state)
@@ -519,6 +532,8 @@ class HTTPReplica(Replica):
         tokenizer: Any = None,
         idle_timeout_s: float = 30.0,
         role: str = "fused",
+        import_service: Any = None,
+        import_path: str = "ops/tier-import",
         metrics: Any = None,
         logger: Any = None,
     ) -> None:
@@ -528,6 +543,16 @@ class HTTPReplica(Replica):
         self.health_path = health_path
         self.supports_stream = bool(stream) and hasattr(
             service, "stream_lines"
+        )
+        # Wire-leg tier transfers: an HTTPService pointed at the
+        # remote's OPS port (TPU_REPLICA_OPS_ADDRS — the /ops/
+        # tier-import endpoint lives next to /metrics and /debug/*,
+        # off the serving dataplane). Without one, this replica cannot
+        # adopt shipped blocks and never counts toward tiered mode.
+        self._import_service = import_service
+        self.import_path = import_path
+        self.supports_tier_import = bool(
+            self.supports_stream and import_service is not None
         )
         self.tokenizer = tokenizer
         self.idle_timeout_s = float(idle_timeout_s)
@@ -979,6 +1004,116 @@ class HTTPReplica(Replica):
         worker.start()
         return True
 
+    def import_prefilled(self, req: Any, payload: Any) -> Optional[str]:
+        """Wire-leg tier transfer: ship the exported KV blocks to the
+        remote decode replica's ops-port import endpoint (length-
+        prefixed binary body, the client's separate connect/read
+        budgets — GL012), then drive the ORIGINAL request handle over
+        the ordinary streaming submit so the remote's admission aliases
+        the just-imported blocks zero-copy.
+
+        The two legs fail independently, and every combination degrades
+        without a 5xx or a second trace:
+
+        * import POST rejected (non-2xx / ``"fused"`` reply: corrupt
+          body, stale fingerprint, remote without a paged pool) → the
+          request still streams there and re-prefills — ``"fused"``;
+        * import POST dies mid-wire (read loss) → same ``"fused"``
+          adoption: the stream leg decides whether the remote is
+          actually alive, and a mid-stream death hands the request to
+          the pool handoff like any remote stream loss (one trace id);
+        * nothing listening at the ops port (connect-refused) → None:
+          the remote is gone, the pool excludes it and tries the next
+          target or falls down the ladder.
+
+        Returns None (not adoptable here) for non-streaming replicas,
+        requests that already delivered tokens (transfers ship FRESH
+        prefills), sampled requests without a caller-pinned seed (the
+        remote cannot re-walk an unseeded sample path byte-exactly),
+        adapters this replica does not advertise, and replicas outside
+        routable state — the pool then tries elsewhere."""
+        if not self.supports_tier_import or not req.retryable():
+            return None
+        if req.token_ids or req.pin_replica:
+            return None
+        # The forwarded trace context: an explicit caller traceparent
+        # when the request carried one, else the header form of the
+        # request's own timeline — the remote's spans and flight record
+        # must join THIS trace either way (the one-trace contract).
+        traceparent = getattr(req, "traceparent", None)
+        if not traceparent and getattr(req, "timeline", None) is not None:
+            traceparent = req.timeline.traceparent()
+        if req.temperature != 0.0 and not (
+            req.seed or getattr(req, "remote_seeded", False)
+        ):
+            return None
+        if req.adapter and req.adapter not in self._adapters:
+            return None
+        if self._state != "SERVING" or self.probe_failed or self.draining:
+            return None
+        verdict = "fused"
+        if payload is not None:
+            from gofr_tpu.ops.kv_cache import payload_to_wire
+
+            headers = {"Content-Type": "application/octet-stream"}
+            if traceparent:
+                headers["traceparent"] = str(traceparent)
+            try:
+                resp = self._import_service.post(
+                    self.import_path, body=payload_to_wire(payload),
+                    headers=headers,
+                )
+                if resp.status_code < 400 and (
+                    resp.json().get("result") == "imported"
+                ):
+                    verdict = "imported"
+                elif self._logger is not None:
+                    self._logger.warnf(
+                        "wire tier import to %s rejected (%d); the "
+                        "request will re-prefill there",
+                        self.name, resp.status_code,
+                    )
+            except Exception as exc:  # noqa: BLE001 — every wire failure has a fused/ladder fallback
+                if getattr(exc, "kind", "") == "connect":
+                    # Nothing listening: the remote is dead, not merely
+                    # rejecting — let the pool try another target.
+                    return None
+                if self._logger is not None:
+                    self._logger.warnf(
+                        "wire tier import to %s failed mid-POST (%s); "
+                        "adopting the request fused", self.name, exc,
+                    )
+        # Adopt the request: the same worker-thread SSE consumption as
+        # a fresh submit, driving the caller's existing stream/future —
+        # mid-stream death from here on follows the ordinary remote-
+        # stream failover path (pool handoff, one trace id).
+        kw: dict[str, Any] = {
+            "max_new_tokens": req.max_new_tokens,
+            "temperature": req.temperature,
+            "top_p": req.top_p,
+            "stop": list(req.stop_texts),
+            "adapter": req.adapter,
+            "tenant": req.tenant,
+            "slo_class": req.slo_class,
+            "traceparent": traceparent,
+            "frequency_penalty": req.frequency_penalty,
+            "presence_penalty": req.presence_penalty,
+            "logit_bias": dict(req.logit_bias),
+            "top_logprobs": req.top_logprobs,
+        }
+        if req.seed or getattr(req, "remote_seeded", False):
+            kw["seed"] = req.seed
+        with self._lock:
+            self._inflight += 1
+        worker = threading.Thread(
+            target=self._run_stream,
+            args=(req, list(req.prompt_ids), kw, req.deadline),
+            name=f"http-replica-{self.name}-import",
+            daemon=True,
+        )
+        worker.start()
+        return verdict
+
     def _run_unary(
         self, req: Any, prompt: Any, kw: dict, deadline: Optional[Deadline]
     ) -> None:
@@ -1181,9 +1316,10 @@ class HTTPReplica(Replica):
             svc = getattr(svc, "_inner", None)
 
     def close(self) -> None:
-        close = getattr(self.service, "close", None)
-        if callable(close):
-            close()
+        for svc in (self.service, self._import_service):
+            close = getattr(svc, "close", None)
+            if callable(close):
+                close()
 
 
 class ReplicaPool:
@@ -1210,6 +1346,12 @@ class ReplicaPool:
         transfer_retries: int = 2,
         transfer_timeout_s: float = 10.0,
         transfer_backoff_s: float = 0.05,
+        # Transfer-leg pin (TPU_TRANSFER_LEG): "" = automatic ladder
+        # (device → wire → host-bounce per target), or exactly one of
+        # "device" / "wire" / "host" to pin every transfer to that leg
+        # (targets that cannot serve it are skipped; the fused
+        # degradation rungs below the ladder are unchanged).
+        transfer_leg: str = "",
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
@@ -1236,6 +1378,13 @@ class ReplicaPool:
         self.transfer_retries = max(0, int(transfer_retries))
         self.transfer_timeout_s = max(0.0, float(transfer_timeout_s))
         self.transfer_backoff_s = max(0.0, float(transfer_backoff_s))
+        leg = str(transfer_leg or "").strip().lower()
+        if leg and leg not in ("device", "wire", "host"):
+            raise ValueError(
+                f"transfer_leg must be device|wire|host or empty, "
+                f"got {transfer_leg!r}"
+            )
+        self.transfer_leg = leg
         self._sleep = sleep
         # Last published tier mode (gauge updates only on change).
         self._tier_mode_last: Optional[str] = None
@@ -2003,12 +2152,17 @@ class ReplicaPool:
         return exporter
 
     def _pick_tier_target(
-        self, exclude: Iterable[Replica]
+        self,
+        exclude: Iterable[Replica],
+        leg_for: Optional[Callable[[Replica], Optional[str]]] = None,
     ) -> Optional[Replica]:
         """A routable decode-tier replica for a block transfer, or None
         (the caller then falls back through the degradation ladder).
         Same weighted/least-loaded ranking as :meth:`pick`, restricted
-        to decode-role stream-capable replicas."""
+        to decode-role stream-capable replicas; ``leg_for`` additionally
+        filters to targets some still-permitted transfer leg can reach
+        (a wire-pinned pool must not pick an in-proc sibling it can
+        never ship to)."""
         excluded = {id(r) for r in exclude}
         candidates = [
             r for r in self._replicas
@@ -2019,6 +2173,7 @@ class ReplicaPool:
             and r.supports_stream
             and r.supports_tier_import
             and r.state() in ("SERVING", "DEGRADED")
+            and (leg_for is None or leg_for(r) is not None)
         ]
         if not candidates:
             return None
@@ -2034,11 +2189,39 @@ class ReplicaPool:
         base = self.transfer_backoff_s * (2 ** attempt)
         return base * (0.5 + self._rng.random())
 
-    def _count_transfer(self, result: str) -> None:
+    def _count_transfer(self, result: str, leg: str = "none") -> None:
         if self._metrics is not None:
             self._metrics.increment_counter(
-                "app_tpu_tier_transfers_total", "result", result
+                "app_tpu_tier_transfers_total",
+                "result", result, "leg", leg or "none",
             )
+
+    def _transfer_leg_for(
+        self, target: Replica, banned: "set[str]"
+    ) -> Optional[str]:
+        """The best transfer leg this target can serve, honoring the
+        ``TPU_TRANSFER_LEG`` pin and the legs already ``banned`` by a
+        failure during this transfer — the per-target half of the
+        device → wire → host-bounce ladder. None = unreachable (the
+        pool picks another target or falls to the fused rungs)."""
+        order: "tuple[str, ...]" = (
+            (self.transfer_leg,) if self.transfer_leg
+            else ("device", "wire", "host")
+        )
+        for leg in order:
+            if leg in banned:
+                continue
+            if leg == "device":
+                if not target.remote and getattr(
+                    target, "supports_device_import", False
+                ):
+                    return leg
+            elif leg == "wire":
+                if target.remote and target.supports_tier_import:
+                    return leg
+            elif not target.remote:
+                return leg  # host bounce: any in-proc importer
+        return None
 
     def _tier_transfer(
         self, req: Any, payload_src: Any, source: Replica
@@ -2052,7 +2235,22 @@ class ReplicaPool:
         request's own ``Deadline``/``CancelToken`` plus a transfer-wide
         wall-clock bound (``TPU_TRANSFER_TIMEOUT_S``) and a jittered-
         backoff retry budget (``TPU_TRANSFER_RETRIES``); every exit is
-        a rung of the degradation ladder, never a dropped request:
+        a rung of the degradation ladder, never a dropped request.
+
+        **Leg selection** (the perf half of the ladder): per target the
+        pool ships over the best leg it can serve — ``device``
+        (in-proc paged sibling on the shared JAX runtime: per-block
+        device extraction + shard-to-shard placement, zero host
+        copies), ``wire`` (remote decode replica with an ops-port
+        import service: length-prefixed POST of the host-bounced
+        payload), or ``host`` (the PR 8 host bounce). A leg that FAILS
+        mid-transfer is banned for the rest of this transfer and the
+        same target retries one rung down — any leg failure degrades to
+        the next rung, terminally to fused serving, byte-identically
+        and under ONE trace id. ``TPU_TRANSFER_LEG`` pins a single leg
+        (operators bisecting a transfer problem); payload extraction is
+        lazy PER LEG, so a device-leg transfer never pays the host pull
+        and a collapsed decode tier pays neither.
 
         1. a decode replica imports the blocks → ``result="ok"``
            (zero-copy decode) or ``"fused"`` (it rejected the payload
@@ -2095,10 +2293,33 @@ class ReplicaPool:
             self._count_transfer("expired")
             return False
         # The clock starts BEFORE extraction: the histogram's meaning
-        # is extract→import, and the device→host pull is routinely the
-        # dominant leg.
+        # is extract→import, and on the host leg the device→host pull
+        # is routinely the dominant part.
         start = self._clock()
-        payload = payload_src() if callable(payload_src) else payload_src
+        # Lazy PER-LEG payload materialization, memoized across
+        # attempts: the wire leg ships the host-bounced form, so it
+        # shares the "host" entry; a device-pinned transfer never pulls
+        # a plane to host at all.
+        payloads: dict[str, Any] = {}
+
+        def payload_for(leg: str) -> Any:
+            key = "device" if leg == "device" else "host"
+            if key not in payloads:
+                if callable(payload_src):
+                    try:
+                        payloads[key] = payload_src(key)
+                    except TypeError:
+                        # Legacy zero-arg factories (host form only).
+                        payloads[key] = payload_src()
+                else:
+                    payloads[key] = payload_src
+            return payloads[key]
+
+        banned: set[str] = set()
+
+        def leg_for(target: Replica) -> Optional[str]:
+            return self._transfer_leg_for(target, banned)
+
         bound = Deadline.after(self.transfer_timeout_s, clock=self._clock)
         tried: list[Replica] = []
         result = "abandoned"
@@ -2114,6 +2335,7 @@ class ReplicaPool:
                 break
             verdict: Optional[str] = None
             target: Optional[Replica] = None
+            leg = ""
             try:
                 # Fault seam: the transfer leg itself dying (prefill
                 # replica lost mid-ship, serialization fault).
@@ -2121,38 +2343,69 @@ class ReplicaPool:
                     "tier.transfer", request=req, source=source.name,
                     attempt=attempt,
                 )
-                target = self._pick_tier_target([source, *tried])
+                target = self._pick_tier_target([source, *tried], leg_for)
                 if target is None:
                     result = "no_target"
                     break
+                leg = leg_for(target) or "host"
                 # Excluded from later attempts whether the import
                 # returns None OR raises — re-picking the same broken
-                # replica would skip its healthy siblings.
+                # replica would skip its healthy siblings. (A LEG
+                # failure un-excludes it below: the rung broke, not
+                # the replica.)
                 tried.append(target)
-                verdict = target.import_prefilled(req, payload)
+                verdict = target.import_prefilled(req, payload_for(leg))
             except Exception as exc:  # noqa: BLE001 — every attempt failure is retried or degraded
                 last_exc = exc
                 verdict = None
+                if leg and leg != "host" and not self.transfer_leg:
+                    # The LEG failed (extraction, serialization, a
+                    # device_put across meshes, the import itself):
+                    # ban it for this transfer and let the SAME target
+                    # retry one rung down — device → wire → host-
+                    # bounce → (below) fused.
+                    banned.add(leg)
+                    if target is not None and tried and (
+                        tried[-1] is target
+                    ):
+                        tried.pop()
+                    if self._logger is not None:
+                        self._logger.warnf(
+                            "tier transfer %s leg failed (%s); "
+                            "degrading to the next rung", leg, exc,
+                        )
             if verdict:
                 assert target is not None
                 duration = self._clock() - start
                 outcome = "ok" if verdict == "imported" else "fused"
-                self._count_transfer(outcome)
+                self._count_transfer(outcome, leg)
                 if self._metrics is not None:
                     self._metrics.record_histogram(
                         "app_tpu_tier_transfer_seconds", duration
                     )
+                    payload = payloads.get(
+                        "device" if leg == "device" else "host"
+                    )
+                    nbytes = getattr(payload, "nbytes", None)
+                    if outcome == "ok" and callable(nbytes):
+                        self._metrics.add_counter(
+                            "app_tpu_tier_transfer_bytes_total",
+                            float(nbytes()), "leg", leg,
+                        )
                 timeline = getattr(req, "timeline", None)
                 if timeline is not None:
                     timeline.note_transfer(
                         source.name, target.name, start, self._clock(),
-                        outcome,
+                        outcome, leg,
                     )
                 if self._logger is not None:
+                    payload = payloads.get(
+                        "device" if leg == "device" else "host"
+                    )
                     self._logger.infof(
-                        "tier transfer %s → %s: %s (%d block(s), "
+                        "tier transfer %s → %s [%s]: %s (%d block(s), "
                         "attempt %d)",
-                        source.name, target.name, outcome,
+                        source.name, target.name, leg, outcome,
                         payload.n_blocks if payload is not None else 0,
                         attempt + 1,
                     )
@@ -2186,7 +2439,8 @@ class ReplicaPool:
             timeline = getattr(req, "timeline", None)
             if timeline is not None:
                 timeline.note_transfer(
-                    source.name, "", start, self._clock(), "failed_over"
+                    source.name, "", start, self._clock(), "failed_over",
+                    "none",
                 )
             return True
         self._count_transfer("local_fused")
@@ -2452,6 +2706,38 @@ class ReplicaPool:
         if "DEGRADED" in states or "RESTARTING" in states:
             return "DEGRADED"
         return "DOWN"
+
+    def import_payload(self, payload: Any) -> str:
+        """Wire-leg admission facade: a remote prefill pod POSTed KV
+        blocks at this pod's ops-port import endpoint and
+        ``container.tpu`` is a pool — land them on the in-proc replica
+        the companion request will actually DECODE on. Decode-role
+        replicas are tried first (on a pod that is itself tiered, the
+        prefill replica's radix would be a paid-for warm nobody
+        reads), and a replica that rejects the payload (unpaged
+        engine, stale geometry) does not stop a paged sibling from
+        importing it; each engine validates geometry + checksum
+        exactly like an in-proc handoff, and a rejecting engine queues
+        nothing, so offering the payload down the list is side-effect
+        free. No importer anywhere → ``"rejected"`` (the exporter
+        degrades to the next rung)."""
+        best = "rejected"
+        ranked = sorted(
+            self._replicas, key=lambda r: 0 if r.role == "decode" else 1
+        )
+        for replica in ranked:
+            if replica.draining:
+                continue
+            eng = getattr(replica, "engine", None)
+            fn = getattr(eng, "import_payload", None)
+            if not callable(fn):
+                continue
+            verdict = str(fn(payload))
+            if verdict == "imported":
+                return verdict
+            if best == "rejected":
+                best = verdict
+        return best
 
     def flight_records(self) -> dict:
         """Aggregate ``/debug/flight`` view: each in-proc replica's

@@ -189,7 +189,18 @@ def _new_tpu_pool_from_config(
     shared_tokenizer = next(
         (r.engine.tokenizer for r in replicas), None
     )
+    # Wire-leg tier transfers (TPU_REPLICA_OPS_ADDRS, positional like
+    # TPU_REPLICA_ADDRS): each remote's OPS/metrics address hosts the
+    # POST /ops/tier-import endpoint — with one configured, a remote
+    # decode replica can adopt shipped KV blocks over the wire instead
+    # of forcing the fused fallback. Empty entries leave that replica
+    # wire-import-incapable (unary remotes, older pods).
+    ops_addrs = [
+        a.strip()
+        for a in config.get_or_default("TPU_REPLICA_OPS_ADDRS", "").split(",")
+    ] if config.get_or_default("TPU_REPLICA_OPS_ADDRS", "") else []
     for j, addr in enumerate(remote_addrs):
+        ops_addr = ops_addrs[j] if j < len(ops_addrs) else ""
         replicas.append(
             HTTPReplica(
                 addr,
@@ -200,6 +211,10 @@ def _new_tpu_pool_from_config(
                     config.get_or_default("TPU_REMOTE_STREAM_IDLE_S", "30")
                 ),
                 role=roles[n_replicas + j],
+                import_service=(
+                    new_http_service(ops_addr, logger, metrics)
+                    if ops_addr else None
+                ),
                 metrics=metrics,
                 logger=logger,
             )
@@ -234,6 +249,8 @@ def _new_tpu_pool_from_config(
         transfer_timeout_s=float(
             config.get_or_default("TPU_TRANSFER_TIMEOUT_S", "10")
         ),
+        # Leg pin (default: automatic device → wire → host ladder).
+        transfer_leg=config.get_or_default("TPU_TRANSFER_LEG", ""),
         metrics=metrics,
         logger=logger,
     )

@@ -38,6 +38,7 @@ from gofr_tpu.serving.tokenizer import tokenizer_from_config
 
 from gofr_tpu.serving.lifecycle import (
     AggregateThroughput,
+    ClassPriorityQueue,
     CancelToken,
     Deadline,
     coalesce_deadline,
@@ -102,6 +103,7 @@ class InferenceEngine(
         lora_targets: str = "wq,wk,wv,wo",
         queue_max: int = 1024,
         queue_max_tokens: int = 0,
+        class_promote_s: float = 5.0,
         tenant_queue_max: int = 0,
         tenant_ledger: Optional[bool] = None,
         tenant_label_max: int = 8,
@@ -363,6 +365,11 @@ class InferenceEngine(
         # flag) plus a throughput estimate for projected-wait shedding.
         self.queue_max = max(1, queue_max)
         self.queue_max_tokens = max(0, queue_max_tokens)
+        # Per-SLO-class priority dequeue (TPU_QUEUE_CLASS_PROMOTE_S):
+        # interactive pops ahead of queued standard/batch work, with
+        # the promotion window as the starvation bound. 0 = strict
+        # FIFO, the pre-class order.
+        self.class_promote_s = max(0.0, class_promote_s)
         self._queued_tokens = 0
         self._expected_tps = max(0.0, expected_tps)
         # Sliding-window AGGREGATE tokens/sec across the whole batch —
@@ -894,6 +901,9 @@ class InferenceEngine(
             queue_max_tokens=int(
                 config.get_or_default("TPU_QUEUE_TOKENS", "0")
             ),
+            class_promote_s=float(
+                config.get_or_default("TPU_QUEUE_CLASS_PROMOTE_S", "5")
+            ),
             tenant_queue_max=int(
                 config.get_or_default("TPU_TENANT_QUEUE_MAX", "0")
             ),
@@ -1200,8 +1210,14 @@ class InferenceEngine(
         # found nothing evictable, so the loop skips re-scanning the
         # trie until pressure actually changes.
         self._wm_fruitless: Optional[tuple[int, int]] = None
-        self._pending: "queue.Queue[_GenRequest]" = queue.Queue(
-            maxsize=self.queue_max
+        # SLO-class-aware admission queue (serving/lifecycle.py): the
+        # queue.Queue API subset the scheduler pops through, with
+        # interactive-first dequeue and a max-wait starvation bound.
+        # With class_promote_s=0 (or uniform-class traffic) the pop
+        # order is exactly the old FIFO.
+        self._pending: ClassPriorityQueue = ClassPriorityQueue(
+            maxsize=self.queue_max,
+            promote_after_s=self.class_promote_s,
         )
         self._work = threading.Event()
         self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
@@ -1271,7 +1287,9 @@ class InferenceEngine(
         if self.kv_block:
             from gofr_tpu.ops.kv_cache import (
                 paged_copy_block,
+                paged_extract_block,
                 paged_insert_block,
+                paged_move_block,
             )
 
             # shared=True: these jits' XLA caches span every engine in
@@ -1283,6 +1301,32 @@ class InferenceEngine(
             self._paged_insert_block = self._compiles.wrap(
                 "paged_insert_block", paged_insert_block, shared=True
             )
+            # Device-leg tier transfers (ops/kv_cache.py): fixed-shape
+            # per-block extract on the exporting engine and move on the
+            # importer — one compile per cache-geometry pair, tracked
+            # like every other program so a steady-state transfer can
+            # never hide a recompile.
+            self._paged_extract_block = self._compiles.wrap(
+                "paged_extract_block", paged_extract_block, shared=True
+            )
+            self._paged_move_block = self._compiles.wrap(
+                "paged_move_block", paged_move_block, shared=True
+            )
+            # Placement for INBOUND device-leg block planes
+            # ([L, KV, block, hd] / int8-scale [L, KV, 8, block]): on a
+            # mesh the head axis shards like the pool's own planes, so
+            # a device_put here reshards shard-to-shard; unsharded
+            # engines share the default device and the put is a no-op.
+            self._block_sharding = None
+            if self.mesh is not None:
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec as _P,
+                )
+
+                self._block_sharding = NamedSharding(
+                    self.mesh, _P(None, "tp", None, None)
+                )
         # HBM ledger (serving/device_telemetry.py): every component this
         # boot allocated, rebuilt with the serving state so a warm
         # restart's fresh pool re-accounts exactly. The derived eviction
@@ -1577,6 +1621,43 @@ class InferenceEngine(
                     pass  # the scheduler already consumed it: harmless cache warm
             return None
         return "imported" if usable else "fused"
+
+    def import_payload(self, payload: Any) -> str:
+        """Wire-leg import seam: adopt a KV-block payload WITHOUT a
+        request — the remote decode replica's ops-port import endpoint
+        (``POST /ops/tier-import``) lands here after decoding the
+        length-prefixed body. Validation is exactly
+        :meth:`handoff_prefilled`'s (geometry fingerprint + re-computed
+        CRC over the received bytes); a usable payload queues for the
+        scheduler thread, which imports it into the radix index like
+        any in-proc transfer, and the separately-submitted request then
+        admission-aliases the blocks zero-copy. ``"imported"`` when the
+        blocks queued, ``"fused"`` when they were rejected — the
+        request (which travels the ordinary OpenAI wire) re-prefills
+        here either way, never a wrong answer, never a 5xx."""
+        if self.family != "llm":
+            return "fused"
+        faults.fire("tier.import", engine=self, request=None)
+        usable = bool(
+            payload is not None
+            and self.kv_block
+            and self._radix is not None
+            and payload.compatible_with(self.cache)
+            and payload.verify()
+        )
+        if not usable:
+            if self._logger is not None:
+                self._logger.warnf(
+                    "wire tier import from %s rejected (stale geometry "
+                    "or corrupt payload); the request will re-prefill",
+                    getattr(payload, "src", "?"),
+                )
+            return "fused"
+        self._tier_imports.append(payload)
+        # Wake the scheduler so the import applies ahead of the
+        # companion request's admission when the engine is idle.
+        self._work.set()
+        return "imported"
 
     def synthetic_probe(self, timeout_s: float = 30.0) -> Any:
         """Active health probe: ONE cheap greedy token through the full

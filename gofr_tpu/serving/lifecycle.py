@@ -24,6 +24,7 @@ module's injectable-clock determinism contract).
 
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 from collections import deque
@@ -217,6 +218,100 @@ class HedgeBudget:
         with self._lock:
             self._refill(self._clock())
             return self._tokens
+
+
+#: SLO-class dequeue rank: interactive jumps standard jumps batch.
+#: Unknown classes rank as standard (the same never-400 fallback the
+#: brownout shedder uses).
+_CLASS_RANK = {"interactive": 0, "standard": 1, "batch": 2}
+
+
+class ClassPriorityQueue:
+    """The admission queue with per-SLO-class priority DEQUEUE.
+
+    PR 12 gave requests an SLO class (``X-SLO-Class``: interactive |
+    standard | batch) but only used it to apportion the brownout-cut
+    admission budget — the queue itself stayed strict FIFO, so one
+    queued batch burst still delayed every interactive request behind
+    it. This queue reorders at POP time instead:
+
+    * pop the head of the highest-priority non-empty class — stable
+      FIFO *within* a class (one deque per class, append/popleft only);
+    * **starvation bound**: a lower-class head that has waited longer
+      than ``promote_after_s`` is promoted — among over-age heads the
+      OLDEST pops first regardless of class, so batch work is delayed
+      by at most the promotion window, never forever;
+    * ``promote_after_s <= 0`` disables classing entirely: a single
+      FIFO deque, byte-identical to the pre-PR ``queue.Queue`` order.
+
+    The API is the ``queue.Queue`` subset the engine/scheduler actually
+    use (``put_nowait``/``get_nowait``/``qsize``/``empty``/``maxsize``),
+    so it drops into ``engine._pending`` unchanged. Put happens on
+    submit threads, get on the scheduler thread — one lock covers the
+    deques. The clock is injectable so the ordering contract (including
+    promotion) is testable with stated times.
+    """
+
+    def __init__(
+        self,
+        maxsize: int = 0,
+        *,
+        promote_after_s: float = 0.0,
+        clock: Callable[[], float] = time.monotonic,
+        classify: Callable[[object], str] = (
+            lambda req: str(getattr(req, "slo_class", "standard"))
+        ),
+    ) -> None:
+        self.maxsize = int(maxsize)
+        self.promote_after_s = float(promote_after_s)
+        self._clock = clock
+        self._classify = classify
+        self._lock = threading.Lock()
+        # rank → FIFO of (enqueued_at, request). Rank 1 doubles as THE
+        # queue when classing is off.
+        self._lanes: dict[int, deque] = {0: deque(), 1: deque(), 2: deque()}
+
+    def qsize(self) -> int:
+        with self._lock:
+            return sum(len(lane) for lane in self._lanes.values())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def put_nowait(self, req: object) -> None:
+        with self._lock:
+            if 0 < self.maxsize <= sum(
+                len(lane) for lane in self._lanes.values()
+            ):
+                raise _queue.Full
+            rank = 1
+            if self.promote_after_s > 0:
+                rank = _CLASS_RANK.get(self._classify(req), 1)
+            self._lanes[rank].append((self._clock(), req))
+
+    def get_nowait(self) -> object:
+        with self._lock:
+            now = self._clock()
+            pick: Optional[int] = None
+            if self.promote_after_s > 0:
+                # Starvation bound first: among heads past the
+                # promotion age, the oldest wins whatever its class.
+                oldest: Optional[float] = None
+                for rank, lane in self._lanes.items():
+                    if not lane:
+                        continue
+                    at = lane[0][0]
+                    if now - at > self.promote_after_s and (
+                        oldest is None or at < oldest
+                    ):
+                        oldest, pick = at, rank
+            if pick is None:
+                pick = next(
+                    (r for r in (0, 1, 2) if self._lanes[r]), None
+                )
+            if pick is None:
+                raise _queue.Empty
+            return self._lanes[pick].popleft()[1]
 
 
 def coalesce_deadline(

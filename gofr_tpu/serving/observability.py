@@ -185,7 +185,7 @@ class RequestTimeline:
         # transfers between the prefill and decode phases; rendered as
         # a `tpu.transfer` span with real duration, unlike the instant
         # annotations above.
-        self.transfers: list[tuple[str, str, float, float, str]] = []
+        self.transfers: list[tuple[str, str, float, float, str, str]] = []
         self.prompt_tokens = prompt_tokens
         self.output_tokens = 0
         self.prefix_hit_tokens = 0
@@ -233,14 +233,34 @@ class RequestTimeline:
         self.annotate("tpu.failover", now, source=src, target=dst)
 
     def note_transfer(
-        self, src: str, dst: str, start: float, end: float, result: str
+        self,
+        src: str,
+        dst: str,
+        start: float,
+        end: float,
+        result: str,
+        leg: str = "host",
     ) -> None:
         """One disaggregated-tier KV transfer hop (prefill replica →
         decode replica), recorded from the pool's transfer thread —
         shows up in /debug/flight and as a `tpu.transfer` child span
         between the prefill and decode phases of the request's ONE
-        trace."""
-        self.transfers.append((src, dst, start, end, result))
+        trace. ``leg`` names the rung that carried the blocks (device /
+        wire / host; "none" for hops that shipped nothing, e.g. a
+        failover fallback)."""
+        self.transfers.append((src, dst, start, end, result, leg))
+
+    def traceparent(self) -> str:
+        """The W3C header a downstream hop (wire-leg tier transfer,
+        remote adoption) forwards so its spans join THIS request's
+        trace. The span-id field names the caller's parent span when
+        one was adopted, else a fresh id — trace-id continuity is the
+        contract; the parent link is best-effort, exactly like any
+        cross-host hop."""
+        return (
+            f"00-{self.trace_id}-"
+            f"{self.parent_span_id or _rand_hex(8)}-01"
+        )
 
     # -- terminal ------------------------------------------------------
 
@@ -315,8 +335,9 @@ class RequestTimeline:
                     "target": dst,
                     "duration_s": round(end - start, 6),
                     "result": result,
+                    "leg": leg,
                 }
-                for src, dst, start, end, result in self.transfers
+                for src, dst, start, end, result, leg in self.transfers
             ],
             "annotations": [
                 {
@@ -542,13 +563,14 @@ class RequestObservability:
             )
         if tl.prefill_done is not None and tl.first_token is not None:
             child("tpu.emit_flush", tl.prefill_done, tl.first_token)
-        for src, dst, start, end, result in tl.transfers:
+        for src, dst, start, end, result, leg in tl.transfers:
             # The disaggregated-tier hop: a real-duration span between
             # the prefill phase (on `src`) and the decode phase (on
-            # `dst`), in the SAME trace.
+            # `dst`), in the SAME trace, tagged with the leg that
+            # carried the blocks (device / wire / host).
             child(
                 "tpu.transfer", start, end,
-                source=src, target=dst, result=result,
+                source=src, target=dst, result=result, leg=leg,
             )
         if tl.first_token is not None:
             child(

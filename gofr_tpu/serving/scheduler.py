@@ -80,7 +80,7 @@ class SchedulerMixin:
     _submit_lock: threading.Lock
     _idle_evt: threading.Event
     _work: threading.Event
-    _pending: "queue.Queue[_GenRequest]"
+    _pending: Any  # lifecycle.ClassPriorityQueue[_GenRequest]
     _wait_kv: Any  # deque[_GenRequest]
     _slots: "list[Optional[_ActiveSeq]]"
     _prefilling: "dict[int, _PrefillState]"
@@ -149,9 +149,13 @@ class SchedulerMixin:
     _mega_window: Any
     _mega_spec_window: Any
     # Compile-tracked paged-pool jits (engine._init_llm_serving_state
-    # wraps ops.kv_cache.paged_{copy,insert}_block per engine).
+    # wraps ops.kv_cache.paged_{copy,insert,extract,move}_block per
+    # engine; extract/move are the device-leg tier-transfer pair).
     _paged_copy_block: Any
     _paged_insert_block: Any
+    _paged_extract_block: Any
+    _paged_move_block: Any
+    _block_sharding: Any  # Optional[NamedSharding] for inbound planes
     _note_dequeued: Any
     _set_state: Any
     hbm_headroom_ratio: Any
@@ -805,22 +809,48 @@ class SchedulerMixin:
         chain, matched = radix.lookup(ids, 0)
         start = matched // B
         imported = 0
+        from gofr_tpu.ops.kv_cache import DeviceKVPayload
+
+        device_leg = isinstance(payload, DeviceKVPayload)
         for j in range(start, payload.n_blocks):
             bid = self._alloc_block()
             if bid is None:
                 break  # pool dry: the un-imported tail re-prefills
-            args = [
-                self.cache,
-                self._up(np.int32(bid)),
-                self._up(payload.k[:, j]),
-                self._up(payload.v[:, j]),
-            ]
-            if self.cache.k_s is not None and payload.k_s is not None:
-                args += [
-                    self._up(payload.k_s[:, j]),
-                    self._up(payload.v_s[:, j]),
+            if device_leg:
+                try:
+                    self._write_block_device_leg(bid, payload, j)
+                except Exception as exc:  # noqa: BLE001 — a failed write degrades to re-prefill, never kills the loop
+                    # The write runs HERE, on the importing scheduler
+                    # thread, after the transfer already returned — a
+                    # cross-mesh device_put against a rebuilt mesh (or
+                    # any placement failure) must degrade exactly like
+                    # a rejected payload: surrender the fresh block,
+                    # keep what already imported, and let the tail
+                    # re-prefill. Escaping would crash the scheduler
+                    # loop over a cache warm.
+                    self._allocator.decref(bid)
+                    if self._logger is not None:
+                        self._logger.warnf(
+                            "device-leg block write failed (%s: %s); "
+                            "%d/%d block(s) imported, tail will "
+                            "re-prefill",
+                            type(exc).__name__, exc, imported,
+                            payload.n_blocks,
+                        )
+                    break
+            else:
+                args = [
+                    self.cache,
+                    self._up(np.int32(bid)),
+                    self._up(payload.k[:, j]),
+                    self._up(payload.v[:, j]),
                 ]
-            self.cache = self._paged_insert_block(*args)
+                if self.cache.k_s is not None and payload.k_s is not None:
+                    args += [
+                        self._up(payload.k_s[:, j]),
+                        self._up(payload.v_s[:, j]),
+                    ]
+                self.cache = self._paged_insert_block(*args)
             chain.append(bid)
             imported += 1
         n = start + imported
@@ -852,6 +882,68 @@ class SchedulerMixin:
             )
         return imported
 
+    def _write_block_device_leg(self, bid: int, payload: Any, j: int) -> None:
+        """Device-leg import of ONE shipped block: place the inbound
+        device planes onto this pool's sharding (an explicit
+        ``device_put`` — shard-to-shard over ICI/DMA when the meshes
+        differ, a no-op when the exporting engine shares them) and
+        write them in with the donated fixed-shape ``paged_move_block``.
+        Never touches host memory — graftlint GL018 pins that (no
+        ``device_get``/``np.asarray`` of cache planes in
+        ``*_device_leg``/``paged_move*`` code)."""
+        jax = self._jax
+        k_blk = payload.k_blocks[j]
+        v_blk = payload.v_blocks[j]
+        if self._block_sharding is not None:
+            k_blk = jax.device_put(k_blk, self._block_sharding)
+            v_blk = jax.device_put(v_blk, self._block_sharding)
+        args = [self.cache, self._up(np.int32(bid)), k_blk, v_blk]
+        if self.cache.k_s is not None and payload.k_s_blocks is not None:
+            k_s_blk = payload.k_s_blocks[j]
+            v_s_blk = payload.v_s_blocks[j]
+            if self._block_sharding is not None:
+                k_s_blk = jax.device_put(k_s_blk, self._block_sharding)
+                v_s_blk = jax.device_put(v_s_blk, self._block_sharding)
+            args += [k_s_blk, v_s_blk]
+        self.cache = self._paged_move_block(*args)
+
+    def _export_payload_device_leg(
+        self, block_ids: "list[int]", token_ids: "list[int]"
+    ) -> Any:
+        """Device-leg extraction: lift each finished block's planes out
+        of this pool as fresh DEVICE arrays (one fixed-shape jitted
+        gather per block — one compile per cache geometry, GSPMD-aware
+        so a tp-sharded pool extracts shard-local slices) and wrap them
+        with the same content keys / geometry fingerprint the
+        host-bounce payload carries. The planes never visit host memory
+        (GL018); everything host-side — keys, fingerprint, radix
+        bookkeeping — is identical to the host leg."""
+        from gofr_tpu.ops.kv_cache import DeviceKVPayload, cache_geometry
+
+        ks: "list[Any]" = []
+        vs: "list[Any]" = []
+        kss: "list[Any]" = []
+        vss: "list[Any]" = []
+        for bid in block_ids:
+            k_blk, v_blk, k_s_blk, v_s_blk = self._paged_extract_block(
+                self.cache, self._up(np.int32(bid))
+            )
+            ks.append(k_blk)
+            vs.append(v_blk)
+            if k_s_blk is not None:
+                kss.append(k_s_blk)
+                vss.append(v_s_blk)
+        return DeviceKVPayload(
+            block=self.kv_block,
+            token_ids=tuple(int(t) for t in token_ids),
+            k_blocks=tuple(ks),
+            v_blocks=tuple(vs),
+            k_s_blocks=tuple(kss) if kss else None,
+            v_s_blocks=tuple(vss) if vss else None,
+            src=self.model_name,
+            geometry=cache_geometry(self.cache),
+        )
+
     def _export_prefilled(self, slot: int, req: _GenRequest) -> bool:
         """Prefill-tier export: offer a just-finalized prefill to the
         pool's transfer exporter instead of decoding locally. True →
@@ -876,12 +968,15 @@ class SchedulerMixin:
         ):
             return False
 
-        def make_payload() -> Any:
+        def make_payload(leg: str = "host") -> Any:
             # Called by the pool AFTER its cheap gates (hop cap, tier
-            # mode, deadline): the device→host pull of every prompt KV
-            # plane is the expensive leg, and a collapsed decode tier
-            # must not pay it per request. Runs synchronously on this
-            # thread while the slot's blocks are still held.
+            # mode, deadline) with the transfer leg it selected: the
+            # extraction is the expensive part, and a collapsed decode
+            # tier must not pay it per request. Runs synchronously on
+            # this thread while the slot's blocks are still held.
+            # ``leg="device"`` extracts device-resident block planes
+            # (zero host copies); anything else is the deliberate host
+            # bounce the wire and host legs ship.
             if not self.kv_block:
                 return None
             B = self.kv_block
@@ -889,6 +984,10 @@ class SchedulerMixin:
             n_full = min(len(req.prompt_ids) // B, len(row))
             if n_full <= 0:
                 return None
+            if leg == "device":
+                return self._export_payload_device_leg(
+                    row[:n_full], req.prompt_ids[: n_full * B]
+                )
             from gofr_tpu.ops.kv_cache import export_blocks
 
             return export_blocks(

@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL017 against the committed
+#   graftlint — TPU-correctness rules GL001–GL018 against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
 #
@@ -27,6 +27,7 @@ if command -v mypy >/dev/null 2>&1; then
   echo "== mypy (scoped) =="
   mypy gofr_tpu/analysis gofr_tpu/config gofr_tpu/logging \
     gofr_tpu/metrics gofr_tpu/tracing gofr_tpu/faults \
+    gofr_tpu/ops/kv_cache.py \
     gofr_tpu/service \
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
     gofr_tpu/serving/engine.py gofr_tpu/serving/backend.py \

@@ -172,6 +172,47 @@ def test_debug_brownout_serves_ladder_state(debug_app):
     assert report["transitions"] == {"up": 0, "down": 0}
 
 
+def test_ops_tier_import_endpoint_shapes(debug_app):
+    """POST /ops/tier-import (docs/advanced-guide/resilience.md
+    "Disaggregated prefill/decode", wire leg): GET is a 405, an
+    unparseable body is a 400 ``rejected``, and a well-framed payload
+    that cannot alias here (this app has no paged pool) is a 200
+    ``fused`` — never a 5xx on any input."""
+    import http.client
+
+    import numpy as np
+
+    from gofr_tpu.ops.kv_cache import KVBlockPayload, payload_checksum, \
+        payload_to_wire
+
+    def _post(body):
+        c = http.client.HTTPConnection(
+            "127.0.0.1", debug_app.metrics_port, timeout=60
+        )
+        c.request("POST", "/ops/tier-import", body=body)
+        r = c.getresponse()
+        out = r.read()
+        c.close()
+        return r.status, out
+
+    st, body = _metrics_get(debug_app, "/ops/tier-import")
+    assert st == 405
+    st, body = _post(b"not a payload")
+    assert st == 400
+    assert json.loads(body)["result"] == "rejected"
+    k = np.zeros((1, 1, 1, 8, 4), dtype=np.float32)
+    payload = KVBlockPayload(
+        block=8, token_ids=tuple(range(8)), k=k, v=k,
+        src="shape-test", checksum=payload_checksum(k, k),
+        geometry=(1, 1, 8, 4, "float32", False),
+    )
+    st, body = _post(payload_to_wire(payload))
+    assert st == 200
+    report = json.loads(body)
+    assert report["result"] == "fused"  # no paged pool on this app
+    assert report["blocks"] == 1
+
+
 def test_debug_tpu_trace_validates_and_captures(debug_app):
     st, body = _metrics_get(debug_app, "/debug/tpu-trace?ms=nope")
     assert st == 400 and b"integer" in body

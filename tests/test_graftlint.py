@@ -1147,6 +1147,77 @@ def test_gl017_accepts_sustain_windows_and_shed_decisions(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL018 — host pull inside the device transfer leg
+# ----------------------------------------------------------------------
+
+
+def test_gl018_flags_host_pulls_in_device_leg_functions(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import jax
+        import numpy as np
+
+        def _export_payload_device_leg(self, block_ids):
+            planes = [
+                np.asarray(self.cache.k[:, b]) for b in block_ids
+            ]  # the bounce the leg exists to remove
+            return planes
+
+        def paged_move_block(cache, dst, k_blk):
+            host = jax.device_get(k_blk)  # never on the device leg
+            return cache
+        """,
+        select=["GL018"],
+    )
+    assert ids == ["GL018", "GL018"]
+    assert "device" in findings[0].message
+
+
+def test_gl018_accepts_device_resident_legs_and_export_seam(tmp_path):
+    # Jitted extraction, explicit sharding-aware device_put, non-plane
+    # host reads, and the documented export* host bounce are the
+    # negative space; device-leg-ness inherits into nested helpers.
+    ids, _ = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import jax
+        import numpy as np
+
+        def _write_block_device_leg(self, bid, payload, j):
+            k_blk = jax.device_put(
+                payload.k_blocks[j], self._block_sharding
+            )  # shard-to-shard, stays on device
+            return self._paged_move_block(
+                self.cache, self._up(np.int32(bid)), k_blk
+            )
+
+        def export_blocks(cache, ids):
+            # the deliberate host bounce: export-named seam (GL014)
+            return np.asarray(jax.device_get(cache.k[:, ids]))
+
+        def _transfer_stats_device_leg(self):
+            return np.asarray(self._timings)  # host data, not a plane
+        """,
+        select=["GL018"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import numpy as np
+
+        def _import_device_leg(self, payload):
+            def helper(j):
+                return np.asarray(payload.k_blocks[j])  # inherited leg
+            return [helper(j) for j in range(payload.n_blocks)]
+        """,
+        select=["GL018"],
+    )
+    assert ids == ["GL018"]
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 

@@ -53,6 +53,7 @@ from gofr_tpu.service.replica_pool import EngineReplica, ReplicaPool
 
 TIER_COUNTERS = (
     "app_tpu_tier_transfers_total",
+    "app_tpu_tier_transfer_bytes_total",
     "app_tpu_failovers_total",
     "app_tpu_requests_replayed_total",
     "app_tpu_requests_cancelled_total",
@@ -249,7 +250,7 @@ def test_tiered_greedy_stream_byte_identical(metrics, engines, tier_pool):
     # to end, with a tpu.transfer hop naming both replicas.
     tl = req.timeline
     assert tl is not None and len(tl.trace_id) == 32
-    assert [(s, d, r) for s, d, _, _, r in tl.transfers] == [
+    assert [(s, d, r) for s, d, _, _, r, _ in tl.transfers] == [
         ("pf", "dc", "ok")
     ]
     # The flight record lands ONCE, in the ORIGIN (prefill) replica's
@@ -264,7 +265,9 @@ def test_tiered_greedy_stream_byte_identical(metrics, engines, tier_pool):
         "source": "pf", "target": "dc",
         "duration_s": entries[0]["transfers"][0]["duration_s"],
         "result": "ok",
+        "leg": entries[0]["transfers"][0]["leg"],
     }]
+    assert entries[0]["transfers"][0]["leg"] in ("device", "host")
     assert entries[0]["outcome"] == "ok"
     # The shipped blocks live in the DECODE replica's radix index now.
     assert dc._radix.n_cached_blocks >= 3
@@ -356,7 +359,7 @@ def test_prefill_death_mid_transfer_fails_over_byte_identically(
     assert tl is not None
     # One trace: the failover annotation and the abandoned transfer ride
     # the same timeline (same trace id) the prefill phase recorded.
-    assert [(s, r) for s, _, _, _, r in tl.transfers] == [
+    assert [(s, r) for s, _, _, _, r, _ in tl.transfers] == [
         ("pf", "failed_over")
     ]
     assert any(name == "tpu.failover" for name, _, _ in tl.annotations)
