@@ -125,6 +125,24 @@ def _device_resource_fields(engine) -> dict:
     }
 
 
+def _loop_fields(engine) -> dict:
+    """Scheduler-loop profiler fields for the JSON result line
+    (ISSUE 15): the loop's busy fraction, the host-bookkeeping share
+    of busy time (THE "is host bookkeeping starving the TPU" number a
+    real-TPU row must carry next to tok/s), stall count, and per-phase
+    rolling p50s. Empty marker when the layer is off — the
+    TPU_LOOP_PROFILE=0 overhead A/B."""
+    prof = getattr(engine, "_loop_prof", None)
+    if prof is None:
+        return {"loop_profile": False}
+    return {
+        "loop_util": round(prof.utilization(), 4),
+        "host_overhead_ratio": round(prof.host_overhead_ratio(), 4),
+        "loop_stalls": int(prof.stalls),
+        "loop_phase_p50_ms": prof.phase_p50_ms(),
+    }
+
+
 def _recompile_guard(engine) -> None:
     """The fixed-shape contract as a bench guard (the compile-tracker
     twin of BENCH_TP_WORKLOAD's token-identity exit): any XLA compile
@@ -511,6 +529,7 @@ def _prefix_workload(on_tpu: bool) -> None:
         f"{latency['ttft_p99']}ms itl p50/p95/p99={latency['itl_p50']}/"
         f"{latency['itl_p95']}/{latency['itl_p99']}ms")
     device_fields = _device_resource_fields(engine)
+    loop_fields = _loop_fields(engine)
     _recompile_guard(engine)
     engine.stop_sync()
     _set_stage("done")
@@ -531,6 +550,98 @@ def _prefix_workload(on_tpu: bool) -> None:
         "warm_ttft_p50_ms": round(warm_p50, 2),
         **latency,
         **device_fields,
+        **loop_fields,
+    }), flush=True)
+    os._exit(0)
+
+
+def _loop_workload(on_tpu: bool) -> None:
+    """BENCH_LOOP_WORKLOAD=1: the scheduler-loop profiler overhead A/B
+    (ISSUE 15) — the identical steady burst with TPU_LOOP_PROFILE off
+    then on, pinning the layer's cost next to the signals it buys
+    (loop utilization, host-overhead ratio, per-phase p50s). The
+    profiler's own measured summarization cost rides the line too.
+    Self-contained: paged engine, no profile phase, CPU-safe."""
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny"
+    )
+    n_requests = int(os.environ.get("BENCH_REQUESTS", "16" if on_tpu else "8"))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32" if on_tpu else "16"))
+    eng_kw = dict(
+        n_slots=int(os.environ.get("BENCH_SLOTS", "8")),
+        max_len=int(os.environ.get("BENCH_MAX_LEN", "1024")),
+        window_k=int(os.environ.get("BENCH_WINDOW", "8")),
+        pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
+        kv_block=int(os.environ.get("BENCH_KV_BLOCK", "128" if on_tpu else "64")),
+        auto_prefix=True,
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "256")),
+        tokenizer=ByteTokenizer(),
+    )
+    quant = os.environ.get("BENCH_QUANT", "int8" if on_tpu else "")
+    if quant.lower() not in ("none", "0", ""):
+        eng_kw["quant"] = quant
+    log(f"bench[loop]: model={model} requests={n_requests} "
+        f"new_tokens={new_tokens} — TPU_LOOP_PROFILE off/on A/B")
+
+    def run(profile: bool) -> tuple[float, object]:
+        _set_stage("engine-init")
+        engine = InferenceEngine(model, loop_profile=profile, **eng_kw)
+        engine.start_sync()
+        _set_stage("warmup")
+        engine.generate_sync(
+            "w" * 8, max_new_tokens=2, temperature=0.0, stop_on_eos=False
+        )
+        engine.mark_steady_state()
+        _set_stage("measure")
+        t0 = time.time()
+        reqs = [
+            engine.submit_generate(
+                f"loop burst request {i:04d}", max_new_tokens=new_tokens,
+                temperature=0.0, stop_on_eos=False,
+            )
+            for i in range(n_requests)
+        ]
+        results = [r.future.result(timeout=1800) for r in reqs]
+        wall = time.time() - t0
+        tokens = sum(len(r.token_ids) for r in results)
+        _recompile_guard(engine)
+        return tokens / wall, engine
+
+    tps_off, eng_off = run(False)
+    eng_off.stop_sync()
+    tps_on, eng_on = run(True)
+    loop_fields = _loop_fields(eng_on)
+    prof = eng_on._loop_prof
+    self_overhead_s = float(prof.self_overhead_s) if prof is not None else 0.0
+    passes = int(prof.passes) if prof is not None else 0
+    eng_on.stop_sync()
+    _set_stage("done")
+    overhead_pct = (
+        (tps_off - tps_on) / tps_off * 100.0 if tps_off > 0 else 0.0
+    )
+    log(f"bench[loop]: off={tps_off:.1f} on={tps_on:.1f} tok/s "
+        f"({overhead_pct:+.2f}% overhead); loop_util="
+        f"{loop_fields.get('loop_util')} host_overhead_ratio="
+        f"{loop_fields.get('host_overhead_ratio')}; profiler self-cost "
+        f"{self_overhead_s * 1e3:.2f}ms over {passes} passes")
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": round(tps_on, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tps_on / 1000.0, 4),
+        "platform": "tpu" if on_tpu else "cpu",
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "loop-profile",
+        "tps_profile_off": round(tps_off, 2),
+        "tps_profile_on": round(tps_on, 2),
+        "loop_profile_overhead_pct": round(overhead_pct, 2),
+        "loop_self_overhead_ms": round(self_overhead_s * 1e3, 3),
+        "loop_passes": passes,
+        **loop_fields,
     }), flush=True)
     os._exit(0)
 
@@ -1262,6 +1373,9 @@ def main() -> None:
     if os.environ.get("BENCH_TP_WORKLOAD", "") in ("1", "true", "yes"):
         _tp_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_LOOP_WORKLOAD", "") in ("1", "true", "yes"):
+        _loop_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
     if os.environ.get("BENCH_TENANT_WORKLOAD", "") in ("1", "true", "yes"):
         _tenant_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
@@ -1497,6 +1611,7 @@ def main() -> None:
         f"short prompt, empty queue)")
 
     device_fields = _device_resource_fields(engine)
+    loop_fields = _loop_fields(engine)
     _recompile_guard(engine)
     engine.stop_sync()
     _set_stage("done")
@@ -1516,6 +1631,7 @@ def main() -> None:
         "e2e_tps": round(tps, 2),
         **latency,
         **device_fields,
+        **loop_fields,
         **({"lora": n_lora} if n_lora else {}),
     }), flush=True)
 

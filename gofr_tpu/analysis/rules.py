@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL018).
+"""The graftlint rule set (GL001–GL019).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -2148,7 +2148,7 @@ class ThresholdNoHysteresisRule(Rule):
 # registry
 # ----------------------------------------------------------------------
 
-ALL_RULES = (
+ALL_RULES: "tuple[type[Rule], ...]" = (
     HostDeviceSyncRule,
     TracerBranchRule,
     RecompilationHazardRule,
@@ -2167,6 +2167,8 @@ ALL_RULES = (
     UnboundedMetricLabelRule,
     ThresholdNoHysteresisRule,
 )
+# (GL018/GL019 are appended to ALL_RULES below their definitions — the
+# tuple predates them and later rules are defined after the registry.)
 
 
 # ----------------------------------------------------------------------
@@ -2260,6 +2262,126 @@ class HostPullInDeviceLegRule(Rule):
         yield from visit(tree, False)
 
 
+ALL_RULES = ALL_RULES + (HostPullInDeviceLegRule,)
+
+
+# ----------------------------------------------------------------------
+# GL019 — device sync outside the designated device-window seam
+# ----------------------------------------------------------------------
+
+
+class SyncOutsideDeviceWaitRule(Rule):
+    """The scheduler loop's phase attribution (``serving/
+    loop_profiler.py``) rests on one structural contract: the loop
+    blocks on the device ONLY inside the designated device-window seam
+    (``_process_window``'s fetch, ``_dispatch_window``'s lockstep
+    barrier). A ``block_until_ready`` / ``.item()`` / ``float()``-on-a-
+    device-value call inside any *other* scheduler-loop-phase function
+    silently converts a host phase into a hidden device wait: the
+    ``host_overhead_ratio`` signal then blames Python for time the
+    device actually took (or vice versa), and the sync serializes the
+    pipelined windows exactly like a GL001 hot-path sync — except
+    invisibly, because the phase gauges say "prefill" or "reap".
+
+    Scope: scheduler files only (``serving/scheduler.py`` — every
+    function there IS loop-phase code). The seam functions are exempt
+    by name; device values are recognized by the codebase's ``*_dev``
+    naming convention, with call results excluded (``float(pull(x_dev)
+    [row])`` is a host read of an already-pulled array, not a sync).
+    Deliberate waits elsewhere (the multi-process lockstep barriers)
+    carry an inline disable — the justification doubles as
+    documentation.
+    """
+
+    rule_id = "GL019"
+    name = "sync-outside-device-wait"
+    rationale = (
+        "a device sync inside a host loop phase hides a device wait "
+        "from the per-phase attribution and serializes the pipelined "
+        "windows; block on the device only inside the designated "
+        "device-window seam (_process_window/_dispatch_window) or "
+        "justify the barrier with an inline disable"
+    )
+
+    #: The designated device-wait seam: the only scheduler functions
+    #: that may legitimately block on the device.
+    _SEAM = frozenset(("_process_window", "_dispatch_window"))
+
+    def __init__(
+        self, scheduler_files: Sequence[str] = ("serving/scheduler.py",)
+    ) -> None:
+        self._files = tuple(scheduler_files)
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(norm.endswith(f) for f in self._files)
+
+    @staticmethod
+    def _dev_root(node: ast.AST) -> bool:
+        """True when the expression is a Name/Attribute/Subscript chain
+        whose ROOT identifier follows the ``*_dev`` device-plane naming
+        convention. Call results are excluded: a pulled host copy of a
+        device array is not a sync."""
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr.endswith("_dev")
+            ):
+                return True
+            node = node.value
+        return isinstance(node, ast.Name) and node.id.endswith("_dev")
+
+    @classmethod
+    def _is_sync(cls, call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        short = name.rsplit(".", 1)[-1]
+        if short == "block_until_ready":
+            return True
+        if (
+            short == "item"
+            and isinstance(call.func, ast.Attribute)
+            and cls._dev_root(call.func.value)
+        ):
+            return True
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id == "float"
+            and len(call.args) == 1
+            and cls._dev_root(call.args[0])
+        ):
+            return True
+        return False
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        # Seam-ness inherits into nested defs (a helper closure inside
+        # _process_window is still the seam); everything else in a
+        # scheduler file is loop-phase code.
+        def visit(node: ast.AST, in_seam: bool) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_seam = in_seam or node.name in self._SEAM
+            if (
+                not in_seam
+                and isinstance(node, ast.Call)
+                and self._is_sync(node)
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "device sync inside a scheduler-loop phase function "
+                    "outside the device-window seam — this hides a "
+                    "device wait from the per-phase attribution "
+                    "(host_overhead_ratio lies) and serializes the "
+                    "pipelined windows; move the wait into "
+                    "_process_window or justify the barrier inline",
+                )
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, in_seam)
+
+        yield from visit(tree, False)
+
+
+ALL_RULES = ALL_RULES + (SyncOutsideDeviceWaitRule,)
+
+
 def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
     config = config or LintConfig()
     return [
@@ -2281,4 +2403,5 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         UnboundedMetricLabelRule(),
         ThresholdNoHysteresisRule(),
         HostPullInDeviceLegRule(),
+        SyncOutsideDeviceWaitRule(),
     ]

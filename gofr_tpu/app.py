@@ -297,8 +297,8 @@ class App:
             """One JSON ops read per engine (`tpu`, `tpu_embed`) — or
             per replica when `container.tpu` is a ReplicaPool — from an
             engine-shaped `method()` report. The shared shape of
-            /debug/flight, /debug/capacity, /debug/tenants, and
-            /debug/slo."""
+            /debug/flight, /debug/capacity, /debug/tenants,
+            /debug/slo, /debug/brownout, and /debug/loop."""
             import json as _json
 
             reports: dict = {}
@@ -421,6 +421,15 @@ class App:
                 # per-action counters — what the burn-rate actuator is
                 # DOING about the /debug/slo signal right now.
                 return engine_report("brownout_report")
+            if path == "/debug/loop":
+                # Scheduler-loop profiler (docs/advanced-guide/
+                # observability.md "Scheduler-loop signals"): per-phase
+                # pass-time attribution, loop utilization, the
+                # host-overhead ratio ("is host bookkeeping starving
+                # the TPU"), and the pinned stall-anomaly records —
+                # where a scheduler pass's wall time goes, without an
+                # operator having to know when to run /debug/tpu-trace.
+                return engine_report("loop_report")
             if path == "/ops/tier-import":
                 # Wire-leg tier transfers (docs/advanced-guide/
                 # resilience.md "Disaggregated prefill/decode"): a
@@ -467,7 +476,6 @@ class App:
             if path == "/debug/tpu-trace":
                 import asyncio as _aio
                 import json as _json
-                import tempfile
                 import urllib.parse
 
                 q = urllib.parse.parse_qs(raw.target.partition("?")[2])
@@ -479,43 +487,46 @@ class App:
                         headers={"Content-Type": "application/json"},
                         body=b'{"error": "ms must be an integer"}',
                     )
-                # ONE reusable trace dir per process (each capture
-                # overwrites the last): an unauthenticated loop of trace
-                # requests must not be able to fill the disk. One trace
-                # at a time — the profiler itself is a singleton.
-                if not hasattr(self, "_trace_dir"):
-                    self._trace_dir = tempfile.mkdtemp(prefix="tpu-trace-")
-                    self._trace_lock = _aio.Lock()
-                if self._trace_lock.locked():
+                # The process-wide capture singleton (serving/
+                # profiler_capture.py): ONE reusable trace dir (each
+                # capture overwrites the last — an unauthenticated loop
+                # of trace requests must not fill the disk) and ONE
+                # lock, both created at singleton construction under a
+                # module lock — the old lazy `hasattr` init here let
+                # two concurrent first requests mint two dirs/locks and
+                # trace concurrently. Shared with the scheduler-loop
+                # profiler's stall-triggered captures, so a manual
+                # capture and an anomaly capture can never overlap.
+                from gofr_tpu.serving.profiler_capture import get_capture
+
+                cap = get_capture()
+                if not cap.try_acquire():
                     return Response(
                         status=409,
                         headers={"Content-Type": "application/json"},
                         body=b'{"error": "a trace capture is already '
                              b'running"}',
                     )
-                async with self._trace_lock:
+                try:
                     loop = _aio.get_running_loop()
                     try:
-                        import jax
-
                         # start/stop serialize trace data to disk — keep
                         # them off the event loop that also serves
                         # /metrics and liveness probes.
-                        await loop.run_in_executor(
-                            None, jax.profiler.start_trace, self._trace_dir
-                        )
+                        await loop.run_in_executor(None, cap.start_trace)
                         await _aio.sleep(ms / 1e3)
-                        await loop.run_in_executor(
-                            None, jax.profiler.stop_trace
-                        )
+                        await loop.run_in_executor(None, cap.stop_trace)
+                        cap.note_manual_capture()
                         body = {
-                            "trace_dir": self._trace_dir,
+                            "trace_dir": cap.trace_dir,
                             "captured_ms": ms,
                         }
                         status = 200
                     except Exception as exc:  # noqa: BLE001 — debug surface
                         body = {"error": str(exc)}
                         status = 500
+                finally:
+                    cap.release()
                 return Response(
                     status=status,
                     headers={"Content-Type": "application/json"},

@@ -425,6 +425,35 @@ class Container:
             "brownout actions taken (action=clamp_tokens|"
             "suppress_hedge|skip_probe|shed_<slo class>)",
         )
+        # Scheduler-loop profiler (serving/loop_profiler.py; docs/
+        # advanced-guide/observability.md "Scheduler-loop signals"):
+        # per-phase wall time of the last scheduler pass (the bounded
+        # phase vocabulary sums to pass wall time), the busy fraction
+        # over a rolling pass window, the host-bookkeeping share of
+        # busy time (THE "is host bookkeeping starving the TPU"
+        # signal), and the hysteretic stall-anomaly counter.
+        m.new_gauge(
+            "app_tpu_loop_phase_seconds",
+            "scheduler-loop pass wall seconds by phase (phase=reap|"
+            "ledger|brownout|sweep|tier_import|prefill|emit_flush|"
+            "dispatch|device_window|idle|other; sums to pass wall "
+            "time)",
+        )
+        m.new_gauge(
+            "app_tpu_loop_utilization",
+            "busy fraction of scheduler-loop wall time over the "
+            "rolling pass window (1 - idle share)",
+        )
+        m.new_gauge(
+            "app_tpu_loop_host_overhead_ratio",
+            "host-bookkeeping share of busy scheduler-loop time "
+            "(busy minus the device-window seam, over busy)",
+        )
+        m.new_counter(
+            "app_tpu_loop_stalls_total",
+            "scheduler-loop stall anomalies (pass over TPU_LOOP_STALL_S "
+            "or TPU_LOOP_STALL_FACTOR x rolling p95; kind=absolute|p95)",
+        )
 
     def push_system_metrics(self) -> None:
         """Per-scrape system gauges (reference ``metrics/handler.go:21-35``)."""

@@ -2805,70 +2805,86 @@ class ReplicaPool:
             replicas[replica.name] = entry
         return {"replicas": replicas, "tier_mode": self.tier_mode}
 
+    def _engine_reports(
+        self,
+        method: str,
+        remote_entry: Callable[[Replica], "dict[str, Any]"],
+        stamp_state: bool = True,
+    ) -> dict:
+        """The shared per-replica engine-report aggregation every
+        ``*_report`` debug view uses: call ``method()`` on each in-proc
+        replica's engine (errors become ``{"error": ...}`` — a debug
+        surface must render a half-broken fleet, not 500), fall back to
+        ``remote_entry(replica)`` for remotes (their full report lives
+        on their own ops port), and optionally stamp routing state.
+        One copy, so error handling and state stamping cannot drift
+        between the five views."""
+        replicas: dict[str, Any] = {}
+        for replica in self._replicas:
+            engine = getattr(replica, "engine", None)
+            report_fn = getattr(engine, method, None)
+            if callable(report_fn):
+                try:
+                    entry = dict(report_fn())
+                except Exception as exc:  # noqa: BLE001 — debug surface
+                    entry = {"error": str(exc)}
+            else:
+                entry = remote_entry(replica)
+            if stamp_state:
+                entry["state"] = (
+                    "DOWN" if replica.probe_failed
+                    else (
+                        "DRAINING" if replica.draining
+                        else replica.state()
+                    )
+                )
+            replicas[replica.name] = entry
+        return {"replicas": replicas}
+
     def tenant_report(self) -> dict:
         """Aggregate ``/debug/tenants`` view: each in-proc replica's
         tenant ledger keyed by replica name (remote replicas contribute
         their descriptor — their full table lives on their own ops
         port), so "which tenant holds the pool" has a fleet answer."""
-        replicas: dict[str, Any] = {}
-        for replica in self._replicas:
-            engine = getattr(replica, "engine", None)
-            report_fn = getattr(engine, "tenant_report", None)
-            if callable(report_fn):
-                try:
-                    entry = dict(report_fn())
-                except Exception as exc:  # noqa: BLE001 — debug surface
-                    entry = {"error": str(exc)}
-            else:
-                entry = {"remote": True}
-            entry["state"] = (
-                "DOWN" if replica.probe_failed
-                else ("DRAINING" if replica.draining else replica.state())
-            )
-            replicas[replica.name] = entry
-        return {"replicas": replicas}
+        return self._engine_reports(
+            "tenant_report", lambda replica: {"remote": True}
+        )
 
     def slo_report(self) -> dict:
         """Aggregate ``/debug/slo`` view: each in-proc replica's
         burn-rate state keyed by replica name; remote replicas
         contribute their probe-cached compliance bit."""
-        replicas: dict[str, Any] = {}
-        for replica in self._replicas:
-            engine = getattr(replica, "engine", None)
-            report_fn = getattr(engine, "slo_report", None)
-            if callable(report_fn):
-                try:
-                    entry = dict(report_fn())
-                except Exception as exc:  # noqa: BLE001 — debug surface
-                    entry = {"error": str(exc)}
-            else:
-                entry = {
-                    "remote": True,
-                    "compliant": replica.slo_compliant(),
-                }
-            replicas[replica.name] = entry
-        return {"replicas": replicas}
+        return self._engine_reports(
+            "slo_report",
+            lambda replica: {
+                "remote": True,
+                "compliant": replica.slo_compliant(),
+            },
+            stamp_state=False,
+        )
 
     def brownout_report(self) -> dict:
         """Aggregate ``/debug/brownout`` view: each in-proc replica's
         ladder state keyed by replica name; remote replicas contribute
         their probe-cached level."""
-        replicas: dict[str, Any] = {}
-        for replica in self._replicas:
-            engine = getattr(replica, "engine", None)
-            report_fn = getattr(engine, "brownout_report", None)
-            if callable(report_fn):
-                try:
-                    entry = dict(report_fn())
-                except Exception as exc:  # noqa: BLE001 — debug surface
-                    entry = {"error": str(exc)}
-            else:
-                entry = {
-                    "remote": True,
-                    "level": replica.brownout_level(),
-                }
-            replicas[replica.name] = entry
-        return {"replicas": replicas}
+        return self._engine_reports(
+            "brownout_report",
+            lambda replica: {
+                "remote": True,
+                "level": replica.brownout_level(),
+            },
+            stamp_state=False,
+        )
+
+    def loop_report(self) -> dict:
+        """Aggregate ``/debug/loop`` view: each in-proc replica's
+        scheduler-loop profiler state keyed by replica name (remote
+        replicas contribute their descriptor — their profiler lives on
+        their own ops port), so "which replica's loop is stalling" has
+        a fleet answer."""
+        return self._engine_reports(
+            "loop_report", lambda replica: {"remote": True}
+        )
 
     def health_check(self) -> dict:
         replicas: dict[str, Any] = {}

@@ -130,6 +130,12 @@ class InferenceEngine(
         flight_recorder: Optional[bool] = None,
         flight_records: int = 256,
         flight_slow_s: float = 5.0,
+        loop_profile: Optional[bool] = None,
+        loop_stall_s: float = 1.0,
+        loop_stall_factor: float = 10.0,
+        loop_anomalies: int = 64,
+        loop_trace_ms: int = 0,
+        loop_trace_cooldown_s: float = 60.0,
         params: Any = None,
         logger: Any = None,
         metrics: Any = None,
@@ -506,6 +512,45 @@ class InferenceEngine(
             if brownout and self._slo is not None else None
         )
 
+        # Continuous scheduler-loop profiler (serving/loop_profiler.py;
+        # docs/advanced-guide/observability.md "Scheduler-loop
+        # signals"): per-phase wall-time attribution for every
+        # scheduler pass, the loop-utilization / host-overhead-ratio
+        # signals, and the hysteretic stall detector whose anomaly
+        # records land on /debug/loop (optionally auto-capturing a
+        # bounded device trace through the profiler_capture singleton).
+        # Lives OUTSIDE _init_llm_serving_state like the flight
+        # recorder — rolling stats and anomaly rings survive supervisor
+        # warm restarts. TPU_LOOP_PROFILE=0 builds no profiler: every
+        # scheduler hook degrades to one `is not None` and the loop is
+        # byte-identical to the pre-profiler scheduler.
+        if loop_profile is None:
+            loop_profile = os.environ.get(
+                "TPU_LOOP_PROFILE", "1"
+            ).lower() not in ("0", "false", "no")
+        self._loop_prof: Any = None
+        if loop_profile and self.family == "llm":
+            from gofr_tpu.serving.loop_profiler import LoopProfiler
+
+            trace_capture = None
+            if loop_trace_ms > 0:
+                from gofr_tpu.serving.profiler_capture import get_capture
+
+                trace_capture = get_capture(
+                    cooldown_s=loop_trace_cooldown_s
+                )
+            self._loop_prof = LoopProfiler(
+                model_name,
+                stall_s=loop_stall_s,
+                stall_factor=loop_stall_factor,
+                anomaly_records=loop_anomalies,
+                trace_ms=loop_trace_ms,
+                capture=trace_capture,
+                metrics=metrics,
+                logger=logger,
+            )
+            self._loop_prof.context = self._loop_context
+
         # Device-resource observability (serving/device_telemetry.py):
         # the compile tracker wraps every jitted serving program built
         # below (so it must exist before the family branch), and the
@@ -522,6 +567,11 @@ class InferenceEngine(
             # Wired at the very top of __init__ (must precede the first
             # jit); recorded here once the tracker exists.
             self._compiles.set_cache_info(self._compile_cache_info)
+        if self._loop_prof is not None:
+            # A pass during which XLA compiled is the compile tracker's
+            # to attribute — the loop profiler's stall detector exempts
+            # it (or every boot would open with a pinned anomaly).
+            self._loop_prof.compiles = lambda: self._compiles.total
         self._ledger: Any = None
         # Saturation-aware control knobs (docs/advanced-guide/
         # observability.md "Device-resource signals"): the HBM-fraction
@@ -992,6 +1042,32 @@ class InferenceEngine(
             ),
             flight_slow_s=float(
                 config.get_or_default("TPU_FLIGHT_SLOW_S", "5")
+            ),
+            # Scheduler-loop profiler (docs/advanced-guide/
+            # observability.md "Scheduler-loop signals"): per-phase
+            # pass attribution + stall anomalies on /debug/loop. The
+            # master switch (0 = byte-identical pre-profiler loop, the
+            # bench overhead A/B), the absolute and p95-relative stall
+            # bounds, the anomaly-ring size, and the optional
+            # stall-triggered device-trace capture (ms; 0 = off) with
+            # its storm cooldown.
+            loop_profile=config.get_or_default(
+                "TPU_LOOP_PROFILE", "1"
+            ).lower() not in ("0", "false", "no"),
+            loop_stall_s=float(
+                config.get_or_default("TPU_LOOP_STALL_S", "1.0")
+            ),
+            loop_stall_factor=float(
+                config.get_or_default("TPU_LOOP_STALL_FACTOR", "10")
+            ),
+            loop_anomalies=int(
+                config.get_or_default("TPU_LOOP_ANOMALIES", "64")
+            ),
+            loop_trace_ms=int(
+                config.get_or_default("TPU_LOOP_TRACE_MS", "0")
+            ),
+            loop_trace_cooldown_s=float(
+                config.get_or_default("TPU_LOOP_TRACE_COOLDOWN_S", "60")
             ),
             logger=logger,
             metrics=metrics,
@@ -2583,6 +2659,35 @@ class InferenceEngine(
             return None
         return bool(self._slo.compliant_cached())
 
+    def _loop_context(self) -> dict[str, Any]:
+        """The serving state a loop-anomaly record freezes at the stall
+        instant (queue depth, occupancy, brownout level, HBM headroom —
+        what an operator needs to tell "overloaded" from "wedged").
+        Called on the scheduler thread only, host values already in
+        hand — no device pulls."""
+        in_use = sum(1 for s in self._slots if s is not None)
+        ctx: dict[str, Any] = {
+            "queue_depth": int(self._pending.qsize()),
+            "wait_kv": len(self._wait_kv),
+            "prefilling": len(self._prefilling),
+            "occupancy": round(in_use / max(1, self.n_slots), 6),
+            "hbm_headroom_ratio": round(self.hbm_headroom_ratio(), 6),
+            "brownout_level": self.brownout_level(),
+        }
+        if self.kv_block:
+            ctx["kv_blocks_free"] = int(self._allocator.n_free)
+        return ctx
+
+    def loop_report(self) -> dict:
+        """The scheduler-loop profiler's full state (``/debug/loop`` on
+        the ops port): per-phase rolling stats, utilization /
+        host-overhead ratio, stall thresholds, anomaly rings, and the
+        profiler's own measured overhead. ``{"enabled": False}`` when
+        the layer is off (``TPU_LOOP_PROFILE=0``)."""
+        if self._loop_prof is None:
+            return {"enabled": False}
+        return dict(self._loop_prof.snapshot())
+
     def capacity_report(self) -> dict:
         """``/debug/capacity``'s per-engine record: the HBM ledger,
         compile counts, paged-pool pressure, and the heaviest tenants
@@ -2593,6 +2698,10 @@ class InferenceEngine(
             "hbm": self.hbm_ledger(),
             "compiles": self.compile_stats(),
         }
+        if self._loop_prof is not None:
+            # "Where do the passes go" next to "how full is the
+            # device" — the loop-time signal beside the byte signal.
+            report["loop"] = self._loop_prof.describe()
         if self._tenant_ledger is not None:
             # "Which tenant filled it" next to "how full is it".
             report["tenants"] = self._tenant_ledger.top_tenants()
@@ -2650,6 +2759,10 @@ class InferenceEngine(
             # The attribution headline: slow-timeline readers see WHO
             # holds the pool without a second request.
             out["tenants"] = self._tenant_ledger.top_tenants()
+        if self._loop_prof is not None:
+            # The loop headline (the headroom idiom): slow timelines
+            # next to "was the scheduler itself stalling".
+            out["loop"] = self._loop_prof.describe()
         return out
 
     def health_check(self) -> dict:
@@ -2750,6 +2863,11 @@ class InferenceEngine(
             # pools lift the level to suppress hedges/probes against a
             # browning-out replica and to deprioritize it at L3.
             details["brownout"] = self._brownout.describe()
+        if self._loop_prof is not None:
+            # Scheduler-loop advertisement (the headroom idiom): probes
+            # and health readers see utilization / host-overhead /
+            # stall counts without the full /debug/loop read.
+            details["loop"] = self._loop_prof.describe()
         if self._tenant_ledger is not None:
             details["tenant_ledger"] = {
                 "tenants": len(self._tenant_ledger.snapshot()["tenants"]),

@@ -1,0 +1,474 @@
+"""Continuous scheduler-loop profiler (ISSUE 15).
+
+The observability stack can say *what* happened to a request (PR 6
+timelines), *what* the device holds (PR 10 HBM ledger + compile
+tracker), and *who* consumed it (PR 11 tenants) — but nothing could say
+where a scheduler *pass's* wall time goes. The loop in
+``serving/scheduler.py:_scheduler_loop`` runs ~10 distinct phases per
+pass (lifecycle reap, ledger tick, brownout tick, radix watermark
+sweep, tier-import apply, prefill dispatch, emit flush, window
+dispatch, the device-window fetch, idle waits), and "is the TPU idle
+because of host bookkeeping?" had no permanent answer — only the manual
+``/debug/tpu-trace`` endpoint, which requires an operator to already
+know when to look. This module is that answer, always on:
+
+* **Per-phase attribution, exact by construction.** The scheduler
+  stamps ONE clock read at each phase boundary of every pass
+  (window granularity — never per row; graftlint GL011's discipline,
+  and GL019 is the new static twin for hidden device waits inside host
+  phases). Each stamp closes the interval since the previous stamp into
+  its phase; the residual between the last stamp and the next pass's
+  first closes into ``other`` — so the per-phase durations of a pass
+  sum to the pass's wall time *exactly* under any clock.
+* **The two derived signals.** ``app_tpu_loop_utilization`` — the busy
+  fraction of loop wall time over a rolling pass window (1 − idle
+  share), and ``app_tpu_loop_host_overhead_ratio`` — the share of
+  *busy* time spent outside the designated device-window seam
+  (``_process_window``, where the loop legitimately blocks on the
+  device). THE "is host bookkeeping starving the TPU" number: high
+  utilization + high host ratio = the device waits on Python; every
+  bench row now carries it.
+* **Stall anomalies, hysteretic.** A pass exceeding ``TPU_LOOP_STALL_S``
+  (absolute) or ``TPU_LOOP_STALL_FACTOR`` × the rolling p95 (relative,
+  floored so micro-benches don't trip on noise) pins a loop-anomaly
+  record — full phase breakdown plus the serving context at that
+  instant (queue depth, occupancy, brownout level, HBM headroom) —
+  into a bounded ring served on ``/debug/loop``. The detector latches:
+  a stall *storm* produces one record per incident, not one per pass,
+  and re-arms only after a clean pass (hysteresis in both directions).
+  Optionally (``TPU_LOOP_TRACE_MS`` > 0) an anomaly auto-triggers a
+  bounded ``jax.profiler`` capture through the
+  :mod:`~gofr_tpu.serving.profiler_capture` singleton, cooldown-gated
+  so the storm can't thrash the profiler.
+* **It measures itself.** Summarization/publication work per pass is
+  accumulated into ``self_overhead_s`` and reported on ``/debug/loop``
+  — the profiler's cost is a number, not a hope. The bench A/B
+  (``TPU_LOOP_PROFILE=0``) pins the whole layer's cost.
+
+Off is off: ``TPU_LOOP_PROFILE=0`` builds no profiler — every scheduler
+hook degrades to one ``is not None`` and the loop is byte-identical to
+the pre-profiler scheduler.
+
+Determinism: every mutation takes the timestamp as an argument (the
+caller reads the clock once per boundary), so tests drive exact phase
+math, stall hysteresis, and ring bounds with stated clocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from itertools import islice
+from typing import Any, Callable, Optional
+
+#: The bounded phase vocabulary (it appears in metric labels — GL016
+#: discipline): the scheduler loop's boundaries, in pass order, plus
+#: ``other`` for the residual between the last stamp and the pass end
+#: (loop overhead, watchdog pet, fault seams).
+PHASES = (
+    "reap",           # lifecycle reap (cancel/deadline retirement)
+    "ledger",         # tenant-ledger occupancy tick
+    "brownout",       # brownout-controller evaluation
+    "sweep",          # radix-eviction watermark sweep
+    "tier_import",    # disaggregated-tier payload apply
+    "prefill",        # admission + chunked-prefill dispatch
+    "emit_flush",     # prefill first-token emit flush
+    "dispatch",       # decode-window dispatch (host-side enqueue)
+    "device_window",  # window processing incl. the device fetch wait
+    "idle",           # verifiably-idle wait for work
+    "other",          # residual: loop overhead between stamps
+)
+
+#: The designated device-wait seam: the only phase whose time counts as
+#: "the device is working / being waited on". Everything else busy is
+#: host overhead. (graftlint GL019 statically pins that no OTHER phase
+#: hides a device sync.)
+DEVICE_PHASES = frozenset(("device_window",))
+
+#: Phases that are waiting for work, not doing it.
+IDLE_PHASES = frozenset(("idle",))
+
+#: Relative (k × p95) stall detection floor: rolling p95s on an idle
+#: CPU loop sit in the tens of microseconds, where a page fault would
+#: "stall" by any multiplier. Below this absolute floor a pass is never
+#: a relative anomaly.
+REL_STALL_FLOOR_S = 0.05
+
+#: Minimum rolling samples before the relative detector arms — a p95
+#: over three passes is noise, not a baseline.
+REL_STALL_MIN_SAMPLES = 16
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (0.0 empty)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(
+        len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1)))
+    )
+    return sorted_vals[idx]
+
+
+class LoopProfiler:
+    """Per-phase time attribution + stall detection for one engine's
+    scheduler loop. Written by the scheduler thread only (``begin_pass``
+    / ``lap``); ``snapshot``/``describe`` read under a lock from ops
+    threads. See the module docstring."""
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        stall_s: float = 1.0,
+        stall_factor: float = 10.0,
+        window: int = 256,
+        anomaly_records: int = 64,
+        trace_ms: int = 0,
+        capture: Any = None,
+        metrics: Any = None,
+        logger: Any = None,
+        perf: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        self.model_name = model_name
+        #: Absolute stall bound (seconds; 0 disables the absolute arm).
+        self.stall_s = max(0.0, float(stall_s))
+        #: Relative stall bound: k × the rolling p95 of pass wall times
+        #: (0 disables the relative arm).
+        self.stall_factor = max(0.0, float(stall_factor))
+        self.trace_ms = max(0, int(trace_ms))
+        self._capture = capture
+        self._metrics = metrics
+        self._logger = logger
+        self._perf = perf
+        #: Serving-context callback for anomaly records (queue depth,
+        #: occupancy, brownout level, HBM headroom) — installed by the
+        #: engine, invoked on the scheduler thread at the stall instant.
+        self.context: Optional[Callable[[], dict[str, Any]]] = None
+        #: Compile-counter callback (the PR 10 tracker's ``total``):
+        #: a pass during which XLA compiled is attributed by the
+        #: compile tracker (warm-up compiles are expected; steady-state
+        #: recompiles already warn and count) — it must not ALSO pin a
+        #: loop-stall anomaly, or every boot would open with one.
+        self.compiles: Optional[Callable[[], int]] = None
+        self._last_compiles = 0
+        self._lock = threading.Lock()
+        # Current-pass accumulation (scheduler thread only — no lock).
+        self._pass_start: Optional[float] = None
+        self._last_stamp = 0.0
+        self._acc: dict[str, float] = {}
+        # Rolling state (under the lock).
+        window = max(8, int(window))
+        self.passes = 0
+        self.stalls = 0
+        self.self_overhead_s = 0.0
+        self._phase_count: dict[str, int] = {p: 0 for p in PHASES}
+        self._phase_total: dict[str, float] = {p: 0.0 for p in PHASES}
+        self._phase_last: dict[str, float] = {p: 0.0 for p in PHASES}
+        self._phase_window: dict[str, deque[float]] = {
+            p: deque(maxlen=window) for p in PHASES
+        }
+        #: Rolling (total, idle, device) per pass — the utilization /
+        #: host-overhead window and the relative detector's baseline.
+        self._pass_window: deque[tuple[float, float, float]] = deque(
+            maxlen=window
+        )
+        # Running window sums, maintained on append/evict so the
+        # per-pass utilization/host-ratio reads are O(1) instead of
+        # re-summing the window inside the lock on the hot loop; they
+        # re-sync exactly from the deque once per window's worth of
+        # passes to bound float drift.
+        self._sum_total = 0.0
+        self._sum_idle = 0.0
+        self._sum_device = 0.0
+        self._since_resync = 0
+        # Anomaly rings: absolute-threshold stalls PIN (they survive a
+        # burst of relative anomalies); relative ones ride the rolling
+        # ring. Both bounded.
+        anomaly_records = max(1, int(anomaly_records))
+        self._anomalies: deque[dict[str, Any]] = deque(
+            maxlen=anomaly_records
+        )
+        self._pinned: deque[dict[str, Any]] = deque(
+            maxlen=max(1, anomaly_records // 4)
+        )
+        # Stall hysteresis latch: an incident records ONE anomaly; the
+        # detector re-arms only after a pass below both thresholds, so
+        # a storm of consecutive stalled passes cannot flood the ring
+        # (the window/latch pair is this detector's hysteresis).
+        self._stall_latched = False
+
+    # -- scheduler-thread stamps (timestamps passed in) -----------------
+
+    def begin_pass(self, now: float) -> None:
+        """Start a pass — and close the previous one (its residual
+        since the last stamp lands in ``other``, so per-phase durations
+        sum to pass wall time exactly)."""
+        if self._pass_start is not None:
+            self._close_pass(now)
+        self._pass_start = now
+        self._last_stamp = now
+        self._acc = {}
+
+    def lap(self, phase: str, now: float) -> None:
+        """Attribute the interval since the previous stamp to
+        ``phase``. One clock read per boundary, shared — never per row."""
+        if self._pass_start is None:
+            return
+        self._acc[phase] = self._acc.get(phase, 0.0) + max(
+            0.0, now - self._last_stamp
+        )
+        self._last_stamp = now
+
+    # -- pass summarization --------------------------------------------
+
+    def _close_pass(self, now: float) -> None:
+        o0 = self._perf()
+        start = self._pass_start
+        assert start is not None
+        total = max(0.0, now - start)
+        residual = max(0.0, now - self._last_stamp)
+        acc = self._acc
+        if residual > 0.0:
+            acc["other"] = acc.get("other", 0.0) + residual
+        idle = acc.get("idle", 0.0)
+        device = sum(acc.get(p, 0.0) for p in DEVICE_PHASES)
+        anomaly: Optional[dict[str, Any]] = None
+        kind = ""
+        threshold = 0.0
+        with self._lock:
+            self.passes += 1
+            for p in PHASES:
+                v = acc.get(p)
+                if v is None:
+                    self._phase_last[p] = 0.0
+                    continue
+                self._phase_count[p] += 1
+                self._phase_total[p] += v
+                self._phase_last[p] = v
+                self._phase_window[p].append(v)
+            # Maintain the running window sums across the append (and
+            # the eviction it causes once the deque is full) — O(1).
+            if len(self._pass_window) == self._pass_window.maxlen:
+                ot, oi, od = self._pass_window[0]
+                self._sum_total -= ot
+                self._sum_idle -= oi
+                self._sum_device -= od
+            self._pass_window.append((total, idle, device))
+            self._sum_total += total
+            self._sum_idle += idle
+            self._sum_device += device
+            self._since_resync += 1
+            if self._since_resync >= (self._pass_window.maxlen or 1):
+                # Exact re-sync once per window of passes: amortized
+                # O(1), bounds subtract-drift on the running sums.
+                self._since_resync = 0
+                self._sum_total = sum(t for t, _, _ in self._pass_window)
+                self._sum_idle = sum(i for _, i, _ in self._pass_window)
+                self._sum_device = sum(
+                    d for _, _, d in self._pass_window
+                )
+            compiled = False
+            if self.compiles is not None:
+                n = int(self.compiles())
+                compiled = n != self._last_compiles
+                self._last_compiles = n
+            if compiled:
+                # XLA compiled during this pass: the time is the compile
+                # tracker's to attribute (app_tpu_compile_seconds, the
+                # steady-state recompile counter) — never a loop stall.
+                pass
+            elif self.stall_s > 0.0 and total >= self.stall_s:
+                kind, threshold = "absolute", self.stall_s
+            elif (
+                self.stall_factor > 0.0
+                and total >= REL_STALL_FLOOR_S
+                and len(self._pass_window) - 1 >= REL_STALL_MIN_SAMPLES
+            ):
+                # The sort is the expensive part — it only runs for
+                # passes already over the relative floor (no sub-floor
+                # pass can be a relative stall), so sub-ms steady-state
+                # passes never pay it. Baseline excludes this pass (the
+                # deque's LAST entry): a stall is judged against the
+                # passes that preceded it.
+                baseline = sorted(
+                    t for t, _, _ in islice(
+                        self._pass_window, len(self._pass_window) - 1
+                    )
+                )
+                rel = max(
+                    self.stall_factor * _pctl(baseline, 0.95),
+                    REL_STALL_FLOOR_S,
+                )
+                if total >= rel:
+                    kind, threshold = "p95", rel
+            if kind and not self._stall_latched:
+                # New incident: latch (one record per incident — a
+                # storm of stalled passes re-arms only after a clean
+                # pass, the hysteresis window in the other direction).
+                self._stall_latched = True
+                self.stalls += 1
+                anomaly = {
+                    "pass": self.passes,
+                    "kind": kind,
+                    "total_s": round(total, 6),
+                    "threshold_s": round(threshold, 6),
+                    "phases": {
+                        p: round(acc[p], 6) for p in PHASES if p in acc
+                    },
+                }
+            elif not kind:
+                self._stall_latched = False
+            util = self._utilization_locked()
+            host = self._host_overhead_locked()
+        if anomaly is not None:
+            self._record_anomaly(anomaly)
+        if self._metrics is not None:
+            self._publish(acc, util, host)
+        self.self_overhead_s += max(0.0, self._perf() - o0)
+
+    def _record_anomaly(self, anomaly: dict[str, Any]) -> None:
+        """Pin the record (context snapshot + optional device-trace
+        trigger run outside the stats lock — the context callback reads
+        engine state and the capture takes its own locks)."""
+        if self.context is not None:
+            try:
+                anomaly["context"] = self.context()
+            except Exception:  # noqa: BLE001  # graftlint: disable=GL006 — diagnostic enrichment; the record must land even when a context read races shutdown
+                pass
+        captured = False
+        if self._capture is not None and self.trace_ms > 0:
+            captured = bool(self._capture.trigger(
+                self.trace_ms, reason=f"loop-stall:{anomaly['kind']}"
+            ))
+        anomaly["trace_captured"] = captured
+        with self._lock:
+            if anomaly["kind"] == "absolute":
+                self._pinned.append(anomaly)
+            else:
+                self._anomalies.append(anomaly)
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_loop_stalls_total",
+                "model", self.model_name, "kind", anomaly["kind"],
+            )
+        if self._logger is not None:
+            self._logger.warnf(
+                "scheduler-loop stall (%s): pass %d took %.3fs "
+                "(threshold %.3fs); phases=%s trace_captured=%s",
+                anomaly["kind"], anomaly["pass"], anomaly["total_s"],
+                anomaly["threshold_s"], anomaly["phases"], captured,
+            )
+
+    def _publish(
+        self, acc: dict[str, float], util: float, host: float
+    ) -> None:
+        """Refresh the loop gauges from the just-closed pass. Every
+        phase publishes (0.0 when absent) so the exported set always
+        sums to the pass wall time."""
+        m = self._metrics
+        for p in PHASES:
+            m.set_gauge(
+                "app_tpu_loop_phase_seconds", acc.get(p, 0.0),
+                "model", self.model_name, "phase", p,
+            )
+        m.set_gauge(
+            "app_tpu_loop_utilization", util, "model", self.model_name
+        )
+        m.set_gauge(
+            "app_tpu_loop_host_overhead_ratio", host,
+            "model", self.model_name,
+        )
+
+    # -- derived signals ------------------------------------------------
+
+    def _utilization_locked(self) -> float:
+        if self._sum_total <= 0.0:
+            return 0.0
+        return max(
+            0.0, min(1.0, 1.0 - self._sum_idle / self._sum_total)
+        )
+
+    def _host_overhead_locked(self) -> float:
+        busy = self._sum_total - self._sum_idle
+        if busy <= 0.0:
+            return 0.0
+        return max(
+            0.0, min(1.0, (busy - self._sum_device) / busy)
+        )
+
+    def utilization(self) -> float:
+        """Busy fraction of loop wall time over the rolling window."""
+        with self._lock:
+            return self._utilization_locked()
+
+    def host_overhead_ratio(self) -> float:
+        """Share of busy time outside the device-window seam — THE
+        "is host bookkeeping starving the TPU" signal."""
+        with self._lock:
+            return self._host_overhead_locked()
+
+    def phase_p50_ms(self) -> dict[str, float]:
+        """Rolling per-phase p50 in ms (present phases only) — the
+        bench JSON field."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for p in PHASES:
+                win = self._phase_window[p]
+                if win:
+                    out[p] = round(_pctl(sorted(win), 0.50) * 1e3, 4)
+            return out
+
+    # -- rendering -----------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """The compact advertisement (health details, capacity_report,
+        the flight-record headline — the headroom idiom)."""
+        with self._lock:
+            return {
+                "passes": self.passes,
+                "stalls": self.stalls,
+                "utilization": round(self._utilization_locked(), 6),
+                "host_overhead_ratio": round(
+                    self._host_overhead_locked(), 6
+                ),
+            }
+
+    def snapshot(self) -> dict[str, Any]:
+        """The full ``/debug/loop`` form: per-phase rolling stats,
+        derived signals, stall thresholds, anomaly rings, the
+        profiler's own measured overhead, and the capture singleton's
+        state when auto-trace is armed."""
+        with self._lock:
+            phases: dict[str, Any] = {}
+            for p in PHASES:
+                if not self._phase_count[p]:
+                    continue
+                win = sorted(self._phase_window[p])
+                phases[p] = {
+                    "count": self._phase_count[p],
+                    "total_s": round(self._phase_total[p], 6),
+                    "last_s": round(self._phase_last[p], 6),
+                    "p50_ms": round(_pctl(win, 0.50) * 1e3, 4),
+                    "p95_ms": round(_pctl(win, 0.95) * 1e3, 4),
+                }
+            out: dict[str, Any] = {
+                "enabled": True,
+                "passes": self.passes,
+                "stalls": self.stalls,
+                "utilization": round(self._utilization_locked(), 6),
+                "host_overhead_ratio": round(
+                    self._host_overhead_locked(), 6
+                ),
+                "stall_s": self.stall_s,
+                "stall_factor": self.stall_factor,
+                "window": len(self._pass_window),
+                "self_overhead_s": round(self.self_overhead_s, 6),
+                "phases": phases,
+                "anomalies": list(self._anomalies),
+                "pinned_anomalies": list(self._pinned),
+            }
+        if self._capture is not None and self.trace_ms > 0:
+            out["trace"] = dict(self._capture.snapshot())
+            out["trace_ms"] = self.trace_ms
+        return out

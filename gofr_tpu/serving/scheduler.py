@@ -100,6 +100,7 @@ class SchedulerMixin:
     _watchdog: Any
     _metrics: Any
     _obs: Any  # serving.observability.RequestObservability
+    _loop_prof: Any  # Optional[serving.loop_profiler.LoopProfiler]
     _tenant_ledger: Any  # Optional[serving.tenant_ledger.TenantLedger]
     _ledger: Any  # Optional[serving.device_telemetry.HBMLedger]
     _slo: Any  # Optional[serving.slo.SLOEngine]
@@ -188,8 +189,19 @@ class SchedulerMixin:
         from collections import deque
 
         inflight: deque = deque()  # _dispatch_window return tuples
+        # Loop profiler (serving/loop_profiler.py): one clock stamp per
+        # PHASE BOUNDARY per pass (window granularity — GL011's
+        # discipline), attributed into per-phase rolling stats, the
+        # utilization / host-overhead gauges, and the stall detector.
+        # Off (TPU_LOOP_PROFILE=0) = one `is not None` per boundary.
+        prof = self._loop_prof
         try:
             while self._running and self._epoch == epoch:
+                # begin_pass also CLOSES the previous pass: residual
+                # time since its last stamp lands in "other", so the
+                # per-phase durations sum to pass wall time exactly.
+                if prof is not None:
+                    prof.begin_pass(self._obs.now())
                 # Progress heartbeat: the watchdog trips when this loop
                 # stalls (hung device step, wedged relay) for longer than
                 # its wall-time bound. Idle iterations pet every ≤20 ms.
@@ -203,27 +215,35 @@ class SchedulerMixin:
                 # sequences retire HERE, once per loop iteration, so a
                 # dead stream's KV blocks free within one decode window.
                 self._reap_lifecycle()
+                if prof is not None:
+                    prof.lap("reap", self._obs.now())
                 # Tenant attribution (serving/tenant_ledger.py): one
                 # KV-occupancy integration pass per loop iteration —
                 # one clock read shared by every live slot, never per
                 # token. Off (TPU_TENANT_LEDGER=0) = this one check.
                 if self._tenant_ledger is not None:
                     self._ledger_tick()
+                    if prof is not None:
+                        prof.lap("ledger", self._obs.now())
                 # Brownout control loop (serving/brownout.py): ONE
                 # evaluation per scheduler pass — the GL011-disciplined
                 # cadence the ladder's sustain windows assume. Off
                 # (TPU_BROWNOUT=0) = this one check.
                 if self._brownout is not None:
                     self._brownout_tick()
+                    if prof is not None:
+                        prof.lap("brownout", self._obs.now())
                 if self.kv_block:
                     # Proactive prefix-eviction sweep: keep the free
                     # list above the watermark so admission finds free
                     # blocks instead of pre-evicting synchronously.
                     self._radix_watermark_sweep()
+                    if prof is not None:
+                        prof.lap("sweep", self._obs.now())
                 # One chunk step per iteration, interleaved 1:1 with decode
                 # windows: a long prompt's prefill proceeds in bounded slices
                 # and never freezes active token streams (VERDICT r1 #9).
-                progressed = self._dispatch_prefill_chunk()
+                progressed = self._dispatch_prefill_chunk(lap_import=True)
                 # Wave admission: on a cold start or a retirement wave the
                 # 1:1 interleave would refill capacity one chunk per window
                 # — at 64 slots that is ~15 windows of a mostly-idle device
@@ -239,7 +259,11 @@ class SchedulerMixin:
                         and self._dispatch_prefill_chunk()
                     ):
                         pass
+                if prof is not None:
+                    prof.lap("prefill", self._obs.now())
                 self._flush_prefill_emits()
+                if prof is not None:
+                    prof.lap("emit_flush", self._obs.now())
                 any_active = any(s is not None for s in self._slots)
                 if not any_active and not inflight:
                     if not progressed and not self._prefill_emits:
@@ -252,6 +276,8 @@ class SchedulerMixin:
                                 self._idle_evt.set()
                         self._work.wait(timeout=0.02)
                         self._work.clear()
+                        if prof is not None:
+                            prof.lap("idle", self._obs.now())
                     continue
                 with self._submit_lock:
                     self._sched_idle = False
@@ -271,8 +297,19 @@ class SchedulerMixin:
                 )
                 if wants_more:
                     inflight.append(self._dispatch_window())
+                    if prof is not None:
+                        prof.lap("dispatch", self._obs.now())
+                processed = False
                 while len(inflight) > (self.pipeline_depth if wants_more else 0):
                     self._process_window(*inflight.popleft())
+                    processed = True
+                if processed and prof is not None:
+                    # The designated device-wait seam: the fetch block
+                    # inside _process_window is where the loop
+                    # legitimately waits on the device — everything
+                    # else busy counts as host overhead (GL019 is the
+                    # static twin of this attribution).
+                    prof.lap("device_window", self._obs.now())
         except SchedulerSuperseded:
             # The supervisor restarted the engine around this wedged
             # thread: a new scheduler owns every structure, and the
@@ -1078,9 +1115,12 @@ class SchedulerMixin:
     def _window_tokens(self) -> int:
         return self.window_k * (self.spec_tokens + 1)
 
-    def _dispatch_prefill_chunk(self) -> bool:
+    def _dispatch_prefill_chunk(self, lap_import: bool = False) -> bool:
         """Admit pending requests into free slots and dispatch ONE
         fixed-shape [prefill_batch, prefill_chunk] chunk step.
+        ``lap_import`` is True only on the scheduler pass's first
+        (seam) call: the loop profiler's tier_import stamp belongs to
+        that one — see the lap site below.
 
         Each row advances one slot's prompt by up to ``prefill_chunk``
         tokens; rows whose prompt completes sample their first token and
@@ -1095,6 +1135,16 @@ class SchedulerMixin:
         # just pays a redundant prefill, never a wrong answer).
         if self.kv_block:
             self._apply_tier_imports()
+            if lap_import and self._loop_prof is not None:
+                # Tier-import apply is its own loop phase: shipped-block
+                # writes are device work that would otherwise hide
+                # inside "prefill" (one stamp per apply, not per block).
+                # Only the PASS-SEAM call laps — re-entries from the
+                # wave-admission loop or _process_window's mega-mode
+                # readiness poll would otherwise attribute prefill work
+                # (or the device-window wait itself) to tier_import and
+                # invert the host-overhead diagnosis.
+                self._loop_prof.lap("tier_import", self._obs.now())
         # Admission is host bookkeeping only — the device work is the
         # chunk steps that follow.
         free = [
@@ -1369,7 +1419,7 @@ class SchedulerMixin:
                 if mhist is not None:
                     self._history_dev = mhist
                 if self._lockstep:
-                    self._jax.block_until_ready(self.cache.lengths)
+                    self._jax.block_until_ready(self.cache.lengths)  # graftlint: disable=GL019 — multi-process CPU lockstep barrier (gloo collective ordering), a deliberate device wait
                 # One clock read per multi-chunk DISPATCH, shared by
                 # every row it advanced (timestamps at window
                 # granularity — graftlint GL011).
@@ -1457,7 +1507,7 @@ class SchedulerMixin:
         if chist is not None:
             self._history_dev = chist
         if self._lockstep:
-            self._jax.block_until_ready(first_dev)
+            self._jax.block_until_ready(first_dev)  # graftlint: disable=GL019 — multi-process CPU lockstep barrier (gloo collective ordering), a deliberate device wait
         if self._metrics is not None:
             self._metrics.record_histogram(
                 "app_tpu_infer_latency", time.time() - t0, "kind", "prefill"

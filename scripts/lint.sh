@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL018 against the committed
+#   graftlint — TPU-correctness rules GL001–GL019 against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
 #
@@ -37,6 +37,8 @@ if command -v mypy >/dev/null 2>&1; then
     gofr_tpu/serving/observability.py gofr_tpu/serving/radix_cache.py \
     gofr_tpu/serving/prefix_cache.py gofr_tpu/serving/programs.py \
     gofr_tpu/serving/device_telemetry.py \
+    gofr_tpu/serving/loop_profiler.py \
+    gofr_tpu/serving/profiler_capture.py \
     gofr_tpu/serving/tenant_ledger.py gofr_tpu/serving/slo.py \
     gofr_tpu/serving/openai_compat.py || failed=1
 else

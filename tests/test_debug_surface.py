@@ -172,6 +172,32 @@ def test_debug_brownout_serves_ladder_state(debug_app):
     assert report["transitions"] == {"up": 0, "down": 0}
 
 
+def test_debug_loop_serves_phase_stats_and_anomalies(debug_app):
+    """/debug/loop (docs/advanced-guide/observability.md
+    "Scheduler-loop signals"): per-phase rolling stats, loop
+    utilization, the host-overhead ratio, and the (bounded) anomaly
+    rings on the ops port."""
+    debug_app.container.tpu.generate_sync(
+        "loop probe", max_new_tokens=4, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    st, body = _metrics_get(debug_app, "/debug/loop")
+    assert st == 200
+    report = json.loads(body)["tpu"]
+    assert report["enabled"] is True
+    assert report["passes"] >= 1
+    assert 0.0 <= report["utilization"] <= 1.0
+    assert 0.0 <= report["host_overhead_ratio"] <= 1.0
+    assert report["stall_s"] > 0 and report["stall_factor"] > 0
+    assert report["self_overhead_s"] >= 0.0
+    for phase in ("reap", "prefill", "emit_flush"):
+        stats = report["phases"][phase]
+        assert stats["count"] >= 1 and stats["total_s"] >= 0.0
+        assert stats["p95_ms"] >= stats["p50_ms"] >= 0.0
+    assert isinstance(report["anomalies"], list)
+    assert isinstance(report["pinned_anomalies"], list)
+
+
 def test_ops_tier_import_endpoint_shapes(debug_app):
     """POST /ops/tier-import (docs/advanced-guide/resilience.md
     "Disaggregated prefill/decode", wire leg): GET is a 405, an

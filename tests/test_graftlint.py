@@ -1218,6 +1218,83 @@ def test_gl018_accepts_device_resident_legs_and_export_seam(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL019 — device sync outside the designated device-window seam
+# ----------------------------------------------------------------------
+
+
+def test_gl019_flags_syncs_in_loop_phase_functions(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import jax
+
+        def _reap_lifecycle(self):
+            jax.block_until_ready(self.cache.lengths)  # hidden wait
+
+        def _ledger_tick(self):
+            n = self._nsteps_dev.item()  # device pull in a host phase
+            return n
+
+        def _dispatch_prefill_chunk(self):
+            lp = float(self._logps_dev)  # sync outside the seam
+            return lp
+        """,
+        select=["GL019"],
+    )
+    assert ids == ["GL019", "GL019", "GL019"]
+    assert "device-window seam" in findings[0].message
+
+
+def test_gl019_accepts_seam_waits_and_host_reads(tmp_path):
+    # The designated seam (incl. nested helpers), float()/.item() of
+    # already-pulled host arrays (call results), and non-device values
+    # are the negative space; inline disables document deliberate
+    # barriers (the lockstep idiom).
+    ids, _ = _lint(
+        tmp_path, "serving/scheduler.py",
+        """
+        import jax
+        import numpy as np
+
+        def _process_window(self, emitted):
+            jax.block_until_ready(emitted)  # THE device-wait seam
+
+            def helper(arr):
+                return float(arr_dev)  # seam-ness inherits
+            return helper(emitted)
+
+        def _dispatch_window(self):
+            self._jax.block_until_ready(self._tokens_dev)  # lockstep seam
+
+        def _flush_prefill_emits(self, pull, lp_dev, row):
+            lp = float(pull(lp_dev)[row])  # pulled host copy, not a sync
+            return lp
+
+        def _retire(self, req):
+            return float(req.ttft_s)  # host value, not a device plane
+
+        def _dispatch_prefill_chunk(self):
+            if self._lockstep:
+                self._jax.block_until_ready(self.cache.lengths)  # graftlint: disable=GL019 — deliberate lockstep barrier
+        """,
+        select=["GL019"],
+    )
+    assert ids == []
+    # Out-of-scope file: the rule is scheduler-loop specific.
+    ids, _ = _lint(
+        tmp_path, "serving/engine.py",
+        """
+        import jax
+
+        def warm_up(self):
+            jax.block_until_ready(self._tokens_dev)
+        """,
+        select=["GL019"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 

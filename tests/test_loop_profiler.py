@@ -1,0 +1,522 @@
+"""Scheduler-loop profiler suite (ISSUE 15 acceptance gate).
+
+Deterministic throughout: the profiler takes every timestamp as an
+argument (the scheduler's one-clock-read-per-boundary contract), so
+phase math, stall hysteresis, and ring bounds are driven with stated
+clocks; the capture singleton's cooldown runs with injected clock /
+start / stop / spawn. Engine-level tests use one small module-scoped
+engine on the CPU backend.
+
+Covered:
+
+* per-phase durations of a pass sum to its wall time EXACTLY under
+  stated clocks (residual in ``other``), and the exported
+  ``app_tpu_loop_phase_seconds{phase}`` gauges sum to it too;
+* utilization (busy fraction) and host-overhead-ratio (busy share
+  outside the device-window seam) arithmetic;
+* stall detection: absolute bound, k×p95 relative bound (floored,
+  armed only past the minimum sample count), hysteresis in BOTH
+  directions — a storm of stalled passes pins exactly one record,
+  re-arming only after a clean pass;
+* compile-pass exemption: a pass during which the compile counter grew
+  is the compile tracker's to attribute, never a loop stall;
+* the anomaly ring is bounded and absolute-stall records are PINNED —
+  they survive a burst of relative anomalies;
+* trace-capture cooldown: a stall storm triggers at most one capture
+  per cooldown (suppressions counted), the capture slot is exclusive,
+  and :func:`get_capture` is a race-free singleton (the /debug/
+  tpu-trace lazy-init fix);
+* layer-off (``TPU_LOOP_PROFILE=0``): no profiler object, no hooks, a
+  byte-identical greedy stream;
+* advertisement: health details / capacity_report / flight_records
+  headline / pool ``loop_report`` all carry the loop stats.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from gofr_tpu.metrics import Manager
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.loop_profiler import (
+    PHASES,
+    REL_STALL_FLOOR_S,
+    REL_STALL_MIN_SAMPLES,
+    LoopProfiler,
+)
+from gofr_tpu.serving.profiler_capture import ProfilerCapture
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+from gofr_tpu.service.replica_pool import EngineReplica, ReplicaPool
+
+
+def loop_metrics() -> Manager:
+    m = Manager()
+    for name in (
+        "app_tpu_loop_phase_seconds",
+        "app_tpu_loop_utilization",
+        "app_tpu_loop_host_overhead_ratio",
+    ):
+        m.new_gauge(name)
+    m.new_counter("app_tpu_loop_stalls_total")
+    return m
+
+
+def gauge_values(m: Manager, name: str) -> dict:
+    inst = [i for i in m.instruments() if i.name == name]
+    return dict(inst[0].collect()) if inst else {}
+
+
+def counter_value(m: Manager, name: str, **labels: str) -> float:
+    inst = [i for i in m.instruments() if i.name == name]
+    if not inst:
+        return 0.0
+    want = set(labels.items())
+    return sum(
+        v for k, v in inst[0].collect().items() if want <= set(k)
+    )
+
+
+def make_prof(**kw) -> LoopProfiler:
+    defaults = dict(stall_s=1.0, stall_factor=0.0, anomaly_records=8)
+    defaults.update(kw)
+    return LoopProfiler("m", **defaults)
+
+
+def drive_pass(
+    prof: LoopProfiler, t0: float, laps: list[tuple[str, float]],
+    t_end: float,
+) -> None:
+    """One full pass under stated clocks: lap each (phase, at) stamp
+    and close the pass by beginning the next at ``t_end`` — exactly
+    the scheduler's shape, where one ``begin_pass`` both closes pass N
+    and opens pass N+1 (calling begin twice would interleave a
+    zero-length pass and re-arm the stall latch)."""
+    if prof._pass_start is None:
+        prof.begin_pass(t0)
+    else:
+        assert prof._pass_start == pytest.approx(t0), (
+            "non-contiguous stated clocks"
+        )
+    for phase, at in laps:
+        prof.lap(phase, at)
+    prof.begin_pass(t_end)
+
+
+# ----------------------------------------------------------------------
+# phase math
+# ----------------------------------------------------------------------
+
+
+def test_phase_durations_sum_to_pass_wall_exactly():
+    m = loop_metrics()
+    prof = make_prof(metrics=m)
+    # Pass wall = 1.0s: reap 0.1, ledger 0.2, prefill 0.3,
+    # device_window 0.25, residual 0.15 → "other".
+    drive_pass(
+        prof, 10.0,
+        [("reap", 10.1), ("ledger", 10.3), ("prefill", 10.6),
+         ("device_window", 10.85)],
+        11.0,
+    )
+    snap = prof.snapshot()
+    assert snap["passes"] == 1
+    phases = snap["phases"]
+    assert phases["reap"]["total_s"] == pytest.approx(0.1)
+    assert phases["ledger"]["total_s"] == pytest.approx(0.2)
+    assert phases["prefill"]["total_s"] == pytest.approx(0.3)
+    assert phases["device_window"]["total_s"] == pytest.approx(0.25)
+    assert phases["other"]["total_s"] == pytest.approx(0.15)
+    assert sum(p["total_s"] for p in phases.values()) == pytest.approx(
+        1.0
+    )
+    # The exported gauges carry the SAME per-pass attribution: the
+    # phase gauges (absent phases publish 0.0) sum to pass wall time.
+    vals = gauge_values(m, "app_tpu_loop_phase_seconds")
+    assert len(vals) == len(PHASES)
+    assert sum(vals.values()) == pytest.approx(1.0)
+
+
+def test_multiple_laps_accumulate_within_a_pass():
+    prof = make_prof()
+    # tier_import laps twice in one pass (the wave-admission loop).
+    drive_pass(
+        prof, 0.0,
+        [("tier_import", 0.1), ("prefill", 0.2), ("tier_import", 0.4)],
+        0.5,
+    )
+    phases = prof.snapshot()["phases"]
+    assert phases["tier_import"]["total_s"] == pytest.approx(0.3)
+    assert phases["tier_import"]["count"] == 1  # one PASS touched it
+    assert sum(p["total_s"] for p in phases.values()) == pytest.approx(
+        0.5
+    )
+
+
+def test_lap_before_begin_is_a_noop():
+    prof = make_prof()
+    prof.lap("reap", 5.0)
+    assert prof.snapshot()["passes"] == 0
+
+
+# ----------------------------------------------------------------------
+# utilization / host-overhead arithmetic
+# ----------------------------------------------------------------------
+
+
+def test_utilization_and_host_overhead_ratio_arithmetic():
+    m = loop_metrics()
+    prof = make_prof(metrics=m, stall_s=0.0)
+    # Pass 1: 1.0s total, 0.4 idle → busy 0.6, of which 0.45 device.
+    drive_pass(
+        prof, 0.0,
+        [("prefill", 0.15), ("device_window", 0.6), ("idle", 1.0)],
+        1.0,
+    )
+    # Pass 2: 1.0s total, fully idle.
+    drive_pass(prof, 1.0, [("idle", 2.0)], 2.0)
+    # Window: total 2.0, idle 1.4 → utilization 0.3;
+    # busy 0.6, device 0.45 → host overhead (0.6-0.45)/0.6 = 0.25.
+    assert prof.utilization() == pytest.approx(0.3)
+    assert prof.host_overhead_ratio() == pytest.approx(0.25)
+    util = gauge_values(m, "app_tpu_loop_utilization")
+    host = gauge_values(m, "app_tpu_loop_host_overhead_ratio")
+    assert list(util.values())[0] == pytest.approx(0.3)
+    assert list(host.values())[0] == pytest.approx(0.25)
+
+
+def test_all_idle_window_reads_zero_utilization_and_host():
+    prof = make_prof(stall_s=0.0)
+    drive_pass(prof, 0.0, [("idle", 1.0)], 1.0)
+    assert prof.utilization() == 0.0
+    assert prof.host_overhead_ratio() == 0.0  # no busy time to blame
+
+
+# ----------------------------------------------------------------------
+# stall detection + hysteresis
+# ----------------------------------------------------------------------
+
+
+def test_absolute_stall_pins_exactly_one_record_per_incident():
+    m = loop_metrics()
+    prof = make_prof(stall_s=1.0, metrics=m)
+    ctx_reads = []
+    prof.context = lambda: (ctx_reads.append(1) or {"queue_depth": 7})
+    # A fast pass, then THE deliberately-stalled pass.
+    drive_pass(prof, 0.0, [("prefill", 0.01)], 0.01)
+    drive_pass(prof, 0.01, [("prefill", 2.0)], 2.01)
+    snap = prof.snapshot()
+    assert snap["stalls"] == 1
+    assert len(snap["pinned_anomalies"]) == 1
+    rec = snap["pinned_anomalies"][0]
+    assert rec["kind"] == "absolute"
+    assert rec["total_s"] == pytest.approx(2.0)
+    assert rec["phases"]["prefill"] == pytest.approx(1.99)
+    assert rec["context"] == {"queue_depth": 7}
+    assert ctx_reads == [1]
+    assert counter_value(
+        m, "app_tpu_loop_stalls_total", kind="absolute"
+    ) == 1
+    # Hysteresis: a STORM of stalled passes is one incident — the
+    # detector stays latched until a clean pass re-arms it.
+    drive_pass(prof, 2.01, [("prefill", 4.5)], 4.51)
+    drive_pass(prof, 4.51, [("prefill", 7.0)], 7.01)
+    assert prof.snapshot()["stalls"] == 1
+    # Clean pass → re-armed → the next stall is a NEW incident.
+    drive_pass(prof, 7.01, [("prefill", 7.02)], 7.02)
+    drive_pass(prof, 7.02, [("prefill", 9.5)], 9.52)
+    snap = prof.snapshot()
+    assert snap["stalls"] == 2
+    assert len(snap["pinned_anomalies"]) == 2
+
+
+def test_relative_p95_stall_needs_samples_and_floor():
+    prof = make_prof(stall_s=0.0, stall_factor=10.0)
+    # Build a rolling baseline of 10ms passes (≥ the minimum samples).
+    t = 0.0
+    for _ in range(REL_STALL_MIN_SAMPLES):
+        drive_pass(prof, t, [("prefill", t + 0.01)], t + 0.01)
+        t += 0.01
+    # 10× p95 = 0.1s but the floor is higher → 0.04s is NOT a stall...
+    drive_pass(prof, t, [("prefill", t + 0.04)], t + 0.04)
+    t += 0.04
+    assert prof.snapshot()["stalls"] == 0
+    assert REL_STALL_FLOOR_S > 0.01 * 10.0 / 10.0
+    # ...while a pass over both k×p95 and the floor is.
+    drive_pass(prof, t, [("prefill", t + 0.5)], t + 0.5)
+    snap = prof.snapshot()
+    assert snap["stalls"] == 1
+    assert snap["anomalies"][0]["kind"] == "p95"
+    assert snap["pinned_anomalies"] == []  # relative → rolling ring
+
+
+def test_compile_pass_is_never_a_stall():
+    prof = make_prof(stall_s=1.0)
+    compiles = [0]
+    prof.compiles = lambda: compiles[0]
+    compiles[0] = 3  # XLA compiled during this (slow) pass
+    drive_pass(prof, 0.0, [("prefill", 5.0)], 5.0)
+    assert prof.snapshot()["stalls"] == 0
+    # Counter stable + still slow → a genuine stall again.
+    drive_pass(prof, 5.0, [("prefill", 10.0)], 10.0)
+    assert prof.snapshot()["stalls"] == 1
+
+
+def test_anomaly_ring_bounded_and_pins_survive_a_burst():
+    # Rolling window just over the minimum sample count (the baseline
+    # excludes the pass under judgment), so a full lap of clean passes
+    # flushes each stall back out of the p95 baseline (a stall
+    # inflating its own detection threshold is by design — the storm
+    # path is the latch's job, not the ring's).
+    prof = make_prof(
+        stall_s=0.0, stall_factor=10.0, anomaly_records=4,
+        window=REL_STALL_MIN_SAMPLES + 1,
+    )
+    t = 0.0
+
+    def clean_laps(n: int) -> None:
+        nonlocal t
+        for _ in range(n):
+            drive_pass(prof, t, [("prefill", t + 0.01)], t + 0.01)
+            t += 0.01
+
+    clean_laps(REL_STALL_MIN_SAMPLES)
+    # One ABSOLUTE stall pins first.
+    prof.stall_s = 1.0
+    drive_pass(prof, t, [("prefill", t + 2.0)], t + 2.0)
+    t += 2.0
+    prof.stall_s = 0.0
+    # A burst of relative anomalies (a clean window between incidents
+    # re-arms the latch AND flushes the p95 baseline) overflows the
+    # bounded rolling ring...
+    for _ in range(6):
+        clean_laps(REL_STALL_MIN_SAMPLES)
+        drive_pass(prof, t, [("prefill", t + 0.5)], t + 0.5)
+        t += 0.5
+    snap = prof.snapshot()
+    assert len(snap["anomalies"]) == 4  # bounded (maxlen) — 6 fired
+    assert all(a["kind"] == "p95" for a in snap["anomalies"])
+    # ...but the pinned absolute record SURVIVED the burst.
+    assert len(snap["pinned_anomalies"]) == 1
+    assert snap["pinned_anomalies"][0]["kind"] == "absolute"
+    assert snap["stalls"] == 7
+
+
+# ----------------------------------------------------------------------
+# trace capture: cooldown + singleton
+# ----------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def make_capture(clock: FakeClock, cooldown_s: float = 60.0):
+    events: list[str] = []
+    cap = ProfilerCapture(
+        cooldown_s=cooldown_s,
+        clock=clock,
+        sleep=lambda s: events.append(f"sleep:{s}"),
+        starter=lambda d: events.append("start"),
+        stopper=lambda: events.append("stop"),
+        spawn=lambda fn: fn(),  # synchronous for determinism
+    )
+    return cap, events
+
+
+def test_trace_capture_cooldown_bounds_a_stall_storm():
+    clock = FakeClock(100.0)
+    cap, events = make_capture(clock, cooldown_s=60.0)
+    prof = make_prof(stall_s=1.0, trace_ms=50, capture=cap)
+    # Stall → one capture; storm inside the cooldown → suppressed.
+    drive_pass(prof, 0.0, [("prefill", 2.0)], 2.0)
+    drive_pass(prof, 2.0, [("prefill", 2.01)], 2.01)  # re-arm
+    clock.t = 130.0  # +30s: inside the cooldown
+    drive_pass(prof, 2.01, [("prefill", 4.5)], 4.5)
+    assert events == ["start", "sleep:0.05", "stop"]
+    assert cap.captures == 1 and cap.suppressed == 1
+    snap = prof.snapshot()
+    assert snap["pinned_anomalies"][0]["trace_captured"] is True
+    assert snap["pinned_anomalies"][1]["trace_captured"] is False
+    assert snap["trace"]["suppressed"] == 1
+    # Past the cooldown the next incident captures again.
+    drive_pass(prof, 4.5, [("prefill", 4.51)], 4.51)  # re-arm
+    clock.t = 200.0
+    drive_pass(prof, 4.51, [("prefill", 7.0)], 7.0)
+    assert cap.captures == 2
+
+
+def test_capture_slot_is_exclusive_and_released_on_failure():
+    clock = FakeClock(0.0)
+    cap, _ = make_capture(clock, cooldown_s=0.0)
+    assert cap.try_acquire()
+    # Busy slot: a trigger is suppressed, never queued.
+    assert cap.trigger(10) is False
+    assert cap.suppressed == 1
+    cap.release()
+    # A failing capture still releases the slot.
+    cap._starter = lambda d: (_ for _ in ()).throw(RuntimeError("boom"))
+    assert cap.trigger(10) is True
+    assert cap.busy is False
+    assert "boom" in cap.snapshot()["last_error"]
+
+
+def test_get_capture_is_a_race_free_singleton():
+    """The /debug/tpu-trace lazy-init fix: concurrent first callers
+    can no longer mint two dirs/locks and trace concurrently."""
+    import gofr_tpu.serving.profiler_capture as pc
+
+    old = pc._capture
+    pc._capture = None
+    try:
+        got: list = []
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            got.append(pc.get_capture())
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len({id(c) for c in got}) == 1
+        assert len({c.trace_dir for c in got}) == 1
+        # The engine's cooldown knob updates the shared instance.
+        assert pc.get_capture(cooldown_s=7.5).cooldown_s == 7.5
+    finally:
+        pc._capture = old
+
+
+# ----------------------------------------------------------------------
+# engine integration: hooks, layer-off, advertisement
+# ----------------------------------------------------------------------
+
+ENG_KW = dict(
+    n_slots=2, max_len=128, window_k=4, pipeline_depth=1,
+    prefill_chunk=32, kv_block=32, auto_prefix=True,
+    # A generous absolute stall bound: a loaded CI runner's scheduling
+    # hiccup must not pin a flaky anomaly into the shared fixture.
+    loop_stall_s=30.0,
+)
+
+
+@pytest.fixture(scope="module")
+def eng():
+    m = loop_metrics()
+    e = InferenceEngine(
+        "llama-tiny", tokenizer=ByteTokenizer(), metrics=m, **ENG_KW
+    )
+    e.start_sync()
+    e.generate_sync(
+        "warm the loop", max_new_tokens=4, temperature=0.0,
+        stop_on_eos=False, timeout=120,
+    )
+    yield e, m
+    e.stop_sync()
+
+
+def _settled_report(e) -> dict:
+    """The loop report once the post-generate passes have CLOSED: a
+    pass's phases land when the next pass begins, and a result future
+    resolves inside the device-window phase — an immediate read races
+    it. Bounded poll, no fixed sleep."""
+    import time as _time
+
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline:
+        rep = e.loop_report()
+        if "device_window" in rep.get("phases", {}):
+            return rep
+        _time.sleep(0.005)
+    return e.loop_report()
+
+
+def test_engine_profiles_every_loop_phase(eng):
+    e, m = eng
+    rep = _settled_report(e)
+    assert rep["enabled"] is True
+    assert rep["passes"] >= 1 and rep["stalls"] == 0
+    for phase in ("reap", "ledger", "sweep", "prefill", "emit_flush",
+                  "dispatch", "device_window", "other"):
+        assert phase in rep["phases"], sorted(rep["phases"])
+    assert 0.0 <= rep["utilization"] <= 1.0
+    assert 0.0 <= rep["host_overhead_ratio"] <= 1.0
+    # The profiler measures itself.
+    assert rep["self_overhead_s"] > 0.0
+    # The exported phase gauges publish the full bounded label set
+    # (GL016 discipline) — one value per phase, absent phases at 0.0.
+    # (The sums-to-pass-wall contract is pinned exactly in the
+    # stated-clock test above; the live gauges refresh per pass, so a
+    # cross-read here would race the still-running loop.)
+    vals = gauge_values(m, "app_tpu_loop_phase_seconds")
+    assert len(vals) == len(PHASES)
+    assert all(v >= 0.0 for v in vals.values())
+    assert sum(vals.values()) > 0.0
+
+
+def test_engine_advertises_loop_stats(eng):
+    e, _ = eng
+    compact = {"passes", "stalls", "utilization", "host_overhead_ratio"}
+    assert set(e.health_check()["details"]["loop"]) == compact
+    assert set(e.capacity_report()["loop"]) == compact
+    assert set(e.flight_records()["loop"]) == compact
+
+
+def test_pool_aggregates_loop_reports(eng):
+    e, _ = eng
+    pool = ReplicaPool([EngineReplica("r0", e)], probe_interval_s=0)
+    try:
+        rep = pool.loop_report()
+        entry = rep["replicas"]["r0"]
+        assert entry["enabled"] is True and entry["passes"] >= 1
+        assert "state" in entry
+    finally:
+        # Detach without pool.close(): closing an EngineReplica stops
+        # its engine, and this one is the shared module fixture.
+        pool.stop_prober()
+        for replica in pool._replicas:
+            replica.set_handoff(None)
+            replica.set_tier_exporter(None)
+
+
+def test_layer_off_mints_nothing_and_streams_identically(eng):
+    e, _ = eng
+    off = InferenceEngine(
+        "llama-tiny", tokenizer=ByteTokenizer(), loop_profile=False,
+        **ENG_KW,
+    )
+    off.start_sync()
+    try:
+        assert off._loop_prof is None
+        assert off.loop_report() == {"enabled": False}
+        assert "loop" not in off.health_check()["details"]
+        assert "loop" not in off.capacity_report()
+        assert "loop" not in off.flight_records()
+        r_off = off.generate_sync(
+            "loop ab prompt", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False, timeout=120,
+        )
+        r_on = e.generate_sync(
+            "loop ab prompt", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False, timeout=120,
+        )
+        # TPU_LOOP_PROFILE=0 is byte-identical: same greedy stream.
+        assert r_off.token_ids == r_on.token_ids
+    finally:
+        off.stop_sync()
+
+
+def test_tier_import_phase_attributes_on_apply(eng):
+    """The tier-import apply stamps its own phase (it would otherwise
+    hide inside prefill): the paged engine laps it every pass."""
+    e, _ = eng
+    rep = e.loop_report()
+    assert "tier_import" in rep["phases"]
+    assert rep["phases"]["tier_import"]["count"] >= 1
