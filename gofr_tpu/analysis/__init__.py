@@ -25,6 +25,21 @@ graftlint is an AST-based rule engine purpose-built for this codebase:
 * ``GL010`` repeated host pulls (``np.asarray``/``jax.device_get``) of
   the same device value inside a loop body.
 
+Rules GL011–GL019 extend the same per-file engine (see the docs); the
+project-wide concurrency rules run a second phase over a cross-file
+index of the serving thread mesh (``gofr_tpu/analysis/project.py``):
+
+* ``GL020`` unguarded shared state (guarded-by declarations +
+  majority-access inference, thread-root reachability);
+* ``GL021`` lock-order inversions over the may-acquire-while-holding
+  graph, including plain-Lock self-cycles through call chains;
+* ``GL022`` blocking calls (device sync, HTTP, sleep, blocking queue
+  gets) transitively reachable under a held lock.
+
+Their dynamic counterpart is ``gofr_tpu/analysis/lockcheck.py``: with
+``TPU_LOCKCHECK=1`` every serving/service lock built through
+``lockcheck.make_lock`` validates the same invariants at runtime.
+
 Run it as ``python -m gofr_tpu.analysis [paths]``; suppress a finding
 in place with ``# graftlint: disable=GL001`` and record pre-existing
 debt in the committed baseline (``--write-baseline`` /
@@ -36,6 +51,7 @@ from gofr_tpu.analysis.core import (
     FileContext,
     Finding,
     LintConfig,
+    ProjectRule,
     Rule,
     run_paths,
 )
@@ -47,6 +63,7 @@ __all__ = [
     "FileContext",
     "Finding",
     "LintConfig",
+    "ProjectRule",
     "Rule",
     "default_rules",
     "run_paths",

@@ -18,12 +18,72 @@ from typing import Optional, Sequence
 
 from gofr_tpu.analysis.core import (
     Baseline,
+    Finding,
+    Rule,
     config_from_pyproject,
     run_paths,
 )
 from gofr_tpu.analysis.rules import default_rules
 
 DEFAULT_BASELINE = "graftlint-baseline.json"
+
+#: SARIF 2.1.0 — the minimal subset GitHub code scanning ingests.
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _sarif_report(
+    findings: Sequence[Finding], rules: Sequence[Rule]
+) -> dict[str, object]:
+    """One-run SARIF log: every registered rule in the driver (so the
+    upload shows rule metadata even for clean runs), one result per
+    finding. Paths are repo-relative already — they become artifact
+    URIs verbatim."""
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "informationUri": "docs/static-analysis.md",
+                        "rules": [
+                            {
+                                "id": r.rule_id,
+                                "name": r.name,
+                                "shortDescription": {"text": r.rationale},
+                            }
+                            for r in rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule_id,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {
+                                        "uri": f.path.replace(os.sep, "/"),
+                                    },
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 def _find_repo_root(start: str) -> str:
@@ -72,8 +132,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="also fail when baseline entries no longer occur (drift)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help="output format (sarif: SARIF 2.1.0, for code-scanning upload)",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -138,7 +198,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             findings, active_rules=active_ids if scoped else None
         )
 
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(_sarif_report(new, rules), indent=2))
+    elif args.format == "json":
         print(json.dumps(
             {
                 "findings": [

@@ -20,7 +20,10 @@ import json
 import os
 import re
 from dataclasses import dataclass, field
-from typing import Iterable, Iterator, Optional, Sequence
+from typing import TYPE_CHECKING, Iterable, Iterator, Optional, Sequence
+
+if TYPE_CHECKING:
+    from gofr_tpu.analysis.project import ProjectIndex
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*(disable|disable-next-line)\s*=\s*"
@@ -109,6 +112,47 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """Base class for project-wide rules (the two-phase engine).
+
+    Per-file :class:`Rule`\\ s see one AST at a time; a ``ProjectRule``
+    runs *after* every file has been parsed, against the
+    :class:`~gofr_tpu.analysis.project.ProjectIndex` the runner builds
+    (symbol table, call graph, lock model, thread roots). GL001–GL019
+    stay per-file; the GL020+ concurrency rules live here.
+
+    Subclasses implement :meth:`check_project`; :meth:`check` is a
+    no-op so a ``ProjectRule`` accidentally passed through the
+    per-file path yields nothing rather than crashing."""
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, index: "ProjectIndex") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def project_finding(
+        self,
+        index: "ProjectIndex",
+        path: str,
+        line: int,
+        message: str,
+        col: int = 0,
+    ) -> Finding:
+        ctx = index.files.get(path)
+        code = ""
+        if ctx is not None and 0 < line <= len(ctx.lines):
+            code = ctx.lines[line - 1].strip()
+        return Finding(
+            rule_id=self.rule_id,
+            path=path,
+            line=line,
+            col=col,
+            message=message,
+            code_line=code,
+        )
+
+
 @dataclass
 class LintConfig:
     """Runtime configuration (CLI flags layered over ``[tool.graftlint]``
@@ -125,6 +169,9 @@ class LintConfig:
         "serving/engine.py",
     )
     request_path_dirs: tuple[str, ...] = ("serving", "ops", "grpc")
+    # Where the thread mesh lives: the project-wide concurrency rules
+    # (GL020–GL022) only report findings under these directories.
+    concurrency_dirs: tuple[str, ...] = ("serving", "service")
 
     def wants(self, rule_id: str) -> bool:
         if rule_id in self.disable:
@@ -207,6 +254,8 @@ def config_from_pyproject(pyproject_path: str) -> LintConfig:
         cfg.hot_path_files = tuple(str(f) for f in raw["hot-path-files"])
     if "request-path-dirs" in raw:
         cfg.request_path_dirs = tuple(str(d) for d in raw["request-path-dirs"])
+    if "concurrency-dirs" in raw:
+        cfg.concurrency_dirs = tuple(str(d) for d in raw["concurrency-dirs"])
     return cfg
 
 
@@ -263,44 +312,68 @@ def _posix(path: str, root: Optional[str] = None) -> str:
     return rel.replace(os.sep, "/")
 
 
-def analyze_file(
-    path: str, rules: Sequence[Rule], config: LintConfig,
-    root: Optional[str] = None,
-) -> list[Finding]:
+def _load_file(
+    path: str, root: Optional[str] = None
+) -> "tuple[FileContext, ast.Module] | Finding | None":
+    """Read and parse one file: ``(ctx, tree)`` on success, a GL000
+    :class:`Finding` on syntax error, ``None`` on I/O failure."""
     rel = _posix(path, root)
     try:
         with open(path, "r", encoding="utf-8") as fp:
             source = fp.read()
     except (OSError, UnicodeDecodeError):
-        return []
+        return None
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
-        return [
-            Finding(
-                rule_id="GL000",
-                path=rel,
-                line=exc.lineno or 1,
-                col=(exc.offset or 1) - 1,
-                message=f"syntax error: {exc.msg}",
-                code_line="",
-            )
-        ]
+        return Finding(
+            rule_id="GL000",
+            path=rel,
+            line=exc.lineno or 1,
+            col=(exc.offset or 1) - 1,
+            message=f"syntax error: {exc.msg}",
+            code_line="",
+        )
     lines = source.splitlines()
-    ctx = FileContext(
-        path=rel,
-        source=source,
-        lines=lines,
-        suppressions=parse_suppressions(lines),
-        abs_path=os.path.abspath(path),
+    return (
+        FileContext(
+            path=rel,
+            source=source,
+            lines=lines,
+            suppressions=parse_suppressions(lines),
+            abs_path=os.path.abspath(path),
+        ),
+        tree,
     )
+
+
+def _run_file_rules(
+    tree: ast.Module,
+    ctx: FileContext,
+    rules: Sequence[Rule],
+    config: LintConfig,
+) -> list[Finding]:
     findings: list[Finding] = []
     for rule in rules:
-        if not config.wants(rule.rule_id) or not rule.applies_to(rel):
+        if not config.wants(rule.rule_id) or not rule.applies_to(ctx.path):
             continue
         for f in rule.check(tree, ctx):
             if not ctx.suppressed(f.rule_id, f.line):
                 findings.append(f)
+    return findings
+
+
+def analyze_file(
+    path: str, rules: Sequence[Rule], config: LintConfig,
+    root: Optional[str] = None,
+) -> list[Finding]:
+    loaded = _load_file(path, root)
+    if loaded is None:
+        return []
+    if isinstance(loaded, Finding):
+        return [loaded]
+    ctx, tree = loaded
+    findings = _run_file_rules(tree, ctx, rules, config)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return findings
 
@@ -313,15 +386,48 @@ def run_paths(
 ) -> list[Finding]:
     """Analyze every Python file under ``paths`` with ``rules``.
 
+    Two-phase: per-file rules run as each file parses; once every file
+    is in, :class:`ProjectRule`\\ s run against the
+    :class:`~gofr_tpu.analysis.project.ProjectIndex` built from the
+    whole parsed set (each file is parsed exactly once for both
+    phases).
+
     ``root`` anchors the reported (and fingerprinted) paths; pass the
     repo root so baselines match regardless of the invocation CWD."""
     from gofr_tpu.analysis.rules import default_rules
 
     config = config or LintConfig()
     rules = list(rules) if rules is not None else default_rules(config)
+    file_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [
+        r for r in rules
+        if isinstance(r, ProjectRule) and config.wants(r.rule_id)
+    ]
     out: list[Finding] = []
+    parsed: list[tuple[FileContext, ast.Module]] = []
     for path in iter_python_files(paths, config.exclude, root):
-        out.extend(analyze_file(path, rules, config, root))
+        loaded = _load_file(path, root)
+        if loaded is None:
+            continue
+        if isinstance(loaded, Finding):
+            out.append(loaded)
+            continue
+        ctx, tree = loaded
+        out.extend(_run_file_rules(tree, ctx, file_rules, config))
+        parsed.append((ctx, tree))
+    if project_rules and parsed:
+        from gofr_tpu.analysis.project import ProjectIndex
+
+        index = ProjectIndex.build(parsed)
+        for rule in project_rules:
+            for f in rule.check_project(index):
+                if not rule.applies_to(f.path):
+                    continue
+                fctx = index.files.get(f.path)
+                if fctx is not None and fctx.suppressed(f.rule_id, f.line):
+                    continue
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
     return out
 
 

@@ -1,0 +1,1032 @@
+"""graftlint's project-wide indexing pass (the two-phase engine).
+
+Phase one of the two-phase analysis: before any :class:`ProjectRule`
+runs, every parsed file is folded into a :class:`ProjectIndex` holding
+
+* a **symbol table** — classes (with their mixin-composition groups,
+  resolved through base-class names: ``InferenceEngine(SchedulerMixin,
+  ...)`` composes into ONE runtime object, so its locks and attributes
+  are modeled per *group*, not per class), methods, module functions;
+* a **call graph** — ``self.m()`` resolves within the composition
+  group, bare names resolve to module functions (or sibling nested
+  defs), and ``obj.m()`` resolves only when exactly one indexed class
+  defines ``m`` (unique-name resolution: sound enough for edges, too
+  conservative to invent false ones);
+* a **lock model** — every ``threading.Lock/RLock/Condition`` (or
+  ``lockcheck.make_lock``) attribute, the ``with self._lock:`` regions
+  that acquire it, manual ``release()``/``acquire()`` windows *inside*
+  those regions (the PR 4 release-around-adoption shape), and every
+  attribute read/write annotated with the set of locks lexically held;
+* **thread roots** — functions handed to ``threading.Thread(target=…)``
+  plus a synthetic ``caller`` root covering the public entry points the
+  HTTP/request threads call into.
+
+Phase two (``rules.py``'s GL020–GL022) consumes the index; the runner
+in ``core.py`` builds it once per invocation.
+
+Lock identity is the pair *(composition group, attribute name)* so the
+engine's ``_submit_lock`` is one lock however many mixins mention it,
+while unrelated classes' ``_lock`` attributes stay distinct. A foreign
+``obj._submit_lock`` acquisition (the supervisor's idiom) resolves when
+exactly one group defines a lock attribute of that name.
+
+Guarded-by declarations bind an attribute to its lock explicitly::
+
+    self._epoch = 0  # graftlint: guarded-by=_submit_lock
+
+and take precedence over GL020's majority-access inference.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Sequence
+
+from gofr_tpu.analysis.core import FileContext
+
+_GUARDED_BY_RE = re.compile(r"#\s*graftlint:\s*guarded-by\s*=\s*(\w+)")
+
+#: Callables whose result is a lock object (attribute leaf names).
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "make_lock"))
+
+#: Identifier substrings that mark a lock-ish attribute even without a
+#: visible constructor (annotations, injected locks) — the GL005 idiom.
+_LOCKISH = ("lock", "cond", "mutex")
+
+#: The synthetic thread root modeling request/caller threads: every
+#: public (non-underscore) function is an entry point for it.
+CALLER_ROOT = "caller"
+
+#: Blocking primitives for GL022 / the lock-model's blocking sets.
+#: Fully-dotted names match exactly; leaf names match any receiver.
+BLOCKING_CALLS = frozenset((
+    "time.sleep",
+    "requests.get", "requests.post", "requests.put", "requests.patch",
+    "requests.delete", "requests.head", "requests.request",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+))
+BLOCKING_LEAVES = frozenset(("block_until_ready", "device_get"))
+#: Leaves that only block when the receiver looks like a thread.
+_JOIN_LEAF = "join"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None (local copy so
+    the index has no import cycle with rules.py)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ----------------------------------------------------------------------
+# lock-region extraction (shared with GL005's per-file check)
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class LockRegion:
+    """One ``with <lock>:`` block: the lock expression's dotted name,
+    its line span, and any manual release windows inside it.
+
+    A release window is the span between ``<lock>.release()`` and the
+    next ``<lock>.acquire()`` (or the region's end): code there runs
+    with the lock **dropped**, however lexically nested it is — the
+    exact shape PR 4's release-around-adoption seam used, and the shape
+    GL005 historically mis-classified as guarded (lock-free writes in
+    the ``except``/``finally`` of the released window were invisible).
+    """
+
+    lock_expr: str  # dotted source expression, e.g. "self._submit_lock"
+    lineno: int
+    end_lineno: int
+    release_windows: list[tuple[int, int]] = field(default_factory=list)
+
+    def holds_at(self, line: int) -> bool:
+        """Is the lock actually held at ``line`` (lexically inside the
+        region and not inside a manual release window)?"""
+        if not (self.lineno <= line <= self.end_lineno):
+            return False
+        return not any(lo < line < hi for lo, hi in self.release_windows)
+
+
+def _is_lockish_expr(expr: ast.AST) -> Optional[str]:
+    """The dotted name of a with-item that acquires a lock, else None.
+    ``with self._lock:`` and ``with self._lock.acquire_timeout(..)``-
+    style calls both count when the name mentions a lock."""
+    name = dotted_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = dotted_name(expr.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if any(marker in leaf for marker in _LOCKISH):
+        return name
+    return None
+
+
+def lock_regions(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> list[LockRegion]:
+    """Every with-lock region in ``fn``'s own body (nested defs
+    excluded — a closure runs on its own schedule), with manual
+    ``release()``/``acquire()`` windows subtracted."""
+    regions: list[LockRegion] = []
+    for node in _walk_own(fn):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            name = _is_lockish_expr(item.context_expr)
+            if name is None:
+                continue
+            region = LockRegion(
+                lock_expr=name,
+                lineno=node.lineno,
+                end_lineno=node.end_lineno or node.lineno,
+            )
+            _collect_release_windows(node, name, region)
+            regions.append(region)
+            break
+    return regions
+
+
+def _collect_release_windows(
+    with_node: ast.AST, lock_name: str, region: LockRegion
+) -> None:
+    """Fill ``region.release_windows`` from ``<lock>.release()`` /
+    ``<lock>.acquire()`` calls lexically inside ``with_node``."""
+    events: list[tuple[int, str]] = []
+    for node in ast.walk(with_node):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr not in ("release", "acquire"):
+            continue
+        if dotted_name(node.func.value) != lock_name:
+            continue
+        events.append((node.lineno, node.func.attr))
+    events.sort()
+    open_at: Optional[int] = None
+    for line, kind in events:
+        if kind == "release" and open_at is None:
+            open_at = line
+        elif kind == "acquire" and open_at is not None:
+            region.release_windows.append((open_at, line))
+            open_at = None
+    if open_at is not None:
+        region.release_windows.append((open_at, region.end_lineno + 1))
+
+
+def _walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``fn``'s body without descending into nested function/
+    class bodies (separate scopes, separate schedules)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ----------------------------------------------------------------------
+# index records
+# ----------------------------------------------------------------------
+
+
+@dataclass
+class LockDef:
+    """One lock object: ``key`` is ``<group>.<attr>`` for instance
+    locks, ``<path>:<name>`` for module-level locks."""
+
+    key: str
+    attr: str
+    owner: str  # composition-group leader class name, or module path
+    kind: str  # "Lock" | "RLock" | "Condition" | "make_lock" | "decl"
+    path: str
+    line: int
+
+
+@dataclass
+class Acquisition:
+    """One static acquisition site of ``lock`` inside ``func``."""
+
+    lock: str  # lock key
+    path: str
+    line: int
+    col: int
+    func: str  # function key
+
+
+@dataclass
+class CallSite:
+    """One call edge candidate: ``callee`` is the resolved function
+    key (None when resolution failed), ``name`` the source spelling."""
+
+    name: str
+    callee: Optional[str]
+    path: str
+    line: int
+    col: int
+    locks_held: frozenset[str] = frozenset()
+
+
+@dataclass
+class AttrAccess:
+    """One ``self.<attr>`` read/write with the lock set lexically held
+    at that line (release windows already subtracted)."""
+
+    attr: str  # bare attribute name
+    group: str  # composition-group leader
+    write: bool
+    path: str
+    line: int
+    col: int
+    func: str  # function key
+    locks_held: frozenset[str] = frozenset()
+    in_init: bool = False
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method (nested defs get their own entry)."""
+
+    key: str  # "<path>::<Class>.<name>" / "<path>::<name>" (+ ".<nested>")
+    name: str
+    path: str
+    line: int
+    group: Optional[str]  # composition-group leader for methods
+    is_public: bool
+    calls: list[CallSite] = field(default_factory=list)
+    acquisitions: list[Acquisition] = field(default_factory=list)
+    regions: list[tuple[str, LockRegion]] = field(default_factory=list)
+    accesses: list[AttrAccess] = field(default_factory=list)
+    blocking: list[tuple[str, int, int]] = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    line: int
+    bases: tuple[str, ...]
+    methods: dict[str, str] = field(default_factory=dict)  # name -> key
+    lock_attrs: dict[str, LockDef] = field(default_factory=dict)
+    guarded_by: dict[str, str] = field(default_factory=dict)  # attr -> lock attr
+
+
+# ----------------------------------------------------------------------
+# the index
+# ----------------------------------------------------------------------
+
+
+class ProjectIndex:
+    """The cross-file model GL020–GL022 run against. Build once per
+    lint invocation via :meth:`build`."""
+
+    def __init__(self) -> None:
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}  # bare class name -> info
+        self.locks: dict[str, LockDef] = {}
+        self.files: dict[str, FileContext] = {}
+        #: thread roots: function key -> human label
+        self.thread_roots: dict[str, str] = {}
+        #: group leader -> member class names
+        self.groups: dict[str, set[str]] = {}
+        #: (group, attr) -> lock key, from guarded-by declarations
+        self.guarded_by: dict[tuple[str, str], str] = {}
+        # memos
+        self._roots_of: Optional[dict[str, frozenset[str]]] = None
+        self._entry_locks: Optional[dict[str, frozenset[str]]] = None
+        self._may_acquire: dict[str, dict[str, tuple[str, ...]]] = {}
+        self._may_block: dict[str, dict[str, tuple[str, ...]]] = {}
+        # resolution helpers (built in _finish)
+        self._group_of_class: dict[str, str] = {}
+        self._group_methods: dict[str, dict[str, str]] = {}
+        self._unique_methods: dict[str, Optional[str]] = {}
+        self._unique_lock_attr: dict[str, Optional[str]] = {}
+        self._module_funcs: dict[str, dict[str, str]] = {}
+        self._module_locks: dict[str, dict[str, str]] = {}
+        self._file_imports: dict[str, set[str]] = {}
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def build(
+        cls, files: Sequence[tuple[FileContext, ast.Module]]
+    ) -> "ProjectIndex":
+        index = cls()
+        # Pass 1: classes, composition groups, lock defs, module funcs.
+        for ctx, tree in files:
+            index.files[ctx.path] = ctx
+            index._index_symbols(ctx, tree)
+        index._build_groups()
+        for ctx, tree in files:
+            index._index_lock_defs(ctx, tree)
+        index._finish_resolution()
+        # Pass 2: per-function bodies (needs lock keys + groups).
+        for ctx, tree in files:
+            index._index_bodies(ctx, tree)
+        index._discover_thread_roots()
+        return index
+
+    def _index_symbols(self, ctx: FileContext, tree: ast.Module) -> None:
+        module_funcs: dict[str, str] = {}
+        # Names bound by imports (anywhere in the file, incl. function-
+        # local imports): a call through one of these is a call into a
+        # library, and must never resolve to a same-named repo method.
+        imported: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imported.add(alias.asname or alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    imported.add(alias.asname or alias.name)
+        self._file_imports[ctx.path] = imported
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                bases = tuple(
+                    n for b in node.bases for n in [dotted_name(b)]
+                    if n is not None
+                )
+                info = ClassInfo(
+                    name=node.name, path=ctx.path, line=node.lineno,
+                    bases=tuple(b.rsplit(".", 1)[-1] for b in bases),
+                )
+                for stmt in node.body:
+                    if isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        key = f"{ctx.path}::{node.name}.{stmt.name}"
+                        info.methods[stmt.name] = key
+                # Last definition wins on bare-name collisions; the
+                # colliding earlier class stays in groups but loses
+                # name-based resolution (conservative: fewer edges).
+                self.classes[node.name] = info
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                module_funcs[node.name] = f"{ctx.path}::{node.name}"
+        self._module_funcs[ctx.path] = module_funcs
+
+    def _build_groups(self) -> None:
+        """Union classes with their (indexed) bases: mixins over one
+        runtime object share locks and attributes."""
+        parent: dict[str, str] = {c: c for c in self.classes}
+
+        def find(x: str) -> str:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a: str, b: str) -> None:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+
+        for name, info in self.classes.items():
+            for base in info.bases:
+                if base in self.classes:
+                    union(name, base)
+        for name in self.classes:
+            leader = find(name)
+            self.groups.setdefault(leader, set()).add(name)
+            self._group_of_class[name] = leader
+        for leader, members in self.groups.items():
+            methods: dict[str, str] = {}
+            # Base-first so derived definitions override.
+            for member in sorted(
+                members, key=lambda m: len(self.classes[m].bases)
+            ):
+                methods.update(self.classes[member].methods)
+            self._group_methods[leader] = methods
+
+    def _index_lock_defs(self, ctx: FileContext, tree: ast.Module) -> None:
+        for node in tree.body:
+            # Module-level locks: X = threading.Lock()
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                kind = self._lock_ctor_kind(node.value)
+                if kind is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            key = f"{ctx.path}:{tgt.id}"
+                            self.locks[key] = LockDef(
+                                key=key, attr=tgt.id, owner=ctx.path,
+                                kind=kind, path=ctx.path,
+                                line=node.lineno,
+                            )
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = self.classes.get(node.name)
+            if info is None or info.path != ctx.path:
+                continue
+            group = self._group_of_class[node.name]
+            for stmt in ast.walk(node):
+                # self.X = threading.Lock() / lockcheck.make_lock(...)
+                if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    kind = self._lock_ctor_kind(stmt.value)
+                    if kind is None:
+                        continue
+                    for tgt in stmt.targets:
+                        attr = self._self_attr(tgt)
+                        if attr is not None:
+                            self._add_lock(
+                                group, attr, kind, ctx.path, stmt.lineno
+                            )
+                # class-level annotation: _submit_lock: threading.Lock
+                elif isinstance(stmt, ast.AnnAssign):
+                    ann = dotted_name(stmt.annotation) or ""
+                    leaf = ann.rsplit(".", 1)[-1]
+                    if leaf in ("Lock", "RLock", "Condition"):
+                        attr = None
+                        if isinstance(stmt.target, ast.Name):
+                            attr = stmt.target.id
+                        else:
+                            attr = self._self_attr(stmt.target)
+                        if attr is not None:
+                            self._add_lock(
+                                group, attr, "decl", ctx.path, stmt.lineno
+                            )
+            # guarded-by declarations anywhere in the class body.
+            lo = node.lineno
+            hi = node.end_lineno or node.lineno
+            for i in range(lo, min(hi, len(ctx.lines)) + 1):
+                m = _GUARDED_BY_RE.search(ctx.lines[i - 1])
+                if not m:
+                    continue
+                attr = self._decl_target_attr(node, i)
+                if attr is not None:
+                    info.guarded_by[attr] = m.group(1)
+
+    def _add_lock(
+        self, group: str, attr: str, kind: str, path: str, line: int
+    ) -> None:
+        key = f"{group}.{attr}"
+        existing = self.locks.get(key)
+        # A real constructor beats a bare annotation.
+        if existing is not None and existing.kind != "decl":
+            return
+        self.locks[key] = LockDef(
+            key=key, attr=attr, owner=group, kind=kind, path=path,
+            line=line,
+        )
+
+    @staticmethod
+    def _decl_target_attr(cls_node: ast.ClassDef, line: int) -> Optional[str]:
+        """The ``self.<attr>`` assigned on ``line`` (a guarded-by
+        comment binds to its own statement's target)."""
+        for stmt in ast.walk(cls_node):
+            if stmt_line := getattr(stmt, "lineno", None):
+                if stmt_line != line:
+                    continue
+                targets: list[ast.AST] = []
+                if isinstance(stmt, ast.Assign):
+                    targets = list(stmt.targets)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [stmt.target]
+                for tgt in targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        return tgt.attr
+        return None
+
+    @staticmethod
+    def _lock_ctor_kind(call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _LOCK_CTORS:
+            # threading.Condition(lock) wraps an existing lock; still a
+            # lock-ish object from the model's perspective.
+            return leaf
+        return None
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _finish_resolution(self) -> None:
+        # Unique method-name map for obj.m() resolution.
+        seen: dict[str, Optional[str]] = {}
+        for leader, methods in self._group_methods.items():
+            for mname, key in methods.items():
+                if mname in seen:
+                    seen[mname] = None  # ambiguous
+                else:
+                    seen[mname] = key
+        self._unique_methods = seen
+        # Unique lock-attr map for foreign obj._submit_lock resolution.
+        lock_attr_owner: dict[str, Optional[str]] = {}
+        for lock in self.locks.values():
+            if ":" in lock.key:
+                continue  # module-level
+            if lock.attr in lock_attr_owner:
+                lock_attr_owner[lock.attr] = None
+            else:
+                lock_attr_owner[lock.attr] = lock.key
+        self._unique_lock_attr = lock_attr_owner
+        # guarded-by: resolve declared lock names to lock keys.
+        for cname, info in self.classes.items():
+            group = self._group_of_class[cname]
+            for attr, lock_attr in info.guarded_by.items():
+                key = self._resolve_lock_key(group, lock_attr)
+                if key is not None:
+                    self.guarded_by[(group, attr)] = key
+        # module-level lock name maps per file.
+        for key, lock in self.locks.items():
+            if ":" in key:
+                self._module_locks.setdefault(lock.path, {})[lock.attr] = key
+
+    def _resolve_lock_key(
+        self, group: Optional[str], attr: str
+    ) -> Optional[str]:
+        if group is not None and f"{group}.{attr}" in self.locks:
+            return f"{group}.{attr}"
+        return self._unique_lock_attr.get(attr) or None
+
+    # -- body indexing -------------------------------------------------
+
+    def _index_bodies(self, ctx: FileContext, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                group = self._group_of_class.get(node.name)
+                for stmt in node.body:
+                    if isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        key = f"{ctx.path}::{node.name}.{stmt.name}"
+                        self._index_function(ctx, stmt, key, group)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{ctx.path}::{node.name}"
+                self._index_function(ctx, node, key, None)
+
+    def _index_function(
+        self,
+        ctx: FileContext,
+        fn: ast.FunctionDef | ast.AsyncFunctionDef,
+        key: str,
+        group: Optional[str],
+    ) -> None:
+        info = FunctionInfo(
+            key=key, name=fn.name, path=ctx.path, line=fn.lineno,
+            group=group, is_public=not fn.name.startswith("_"),
+        )
+        self.functions[key] = info
+        # Lock regions (with release windows), resolved to lock keys
+        # where possible; unresolved lock expressions still participate
+        # under a synthetic per-expression key so discipline checks see
+        # them.
+        regions: list[tuple[str, LockRegion]] = []
+        for region in lock_regions(fn):
+            lock_key = self._region_lock_key(ctx, group, region.lock_expr)
+            regions.append((lock_key, region))
+            info.regions.append((lock_key, region))
+            info.acquisitions.append(
+                Acquisition(
+                    lock=lock_key, path=ctx.path, line=region.lineno,
+                    col=0, func=key,
+                )
+            )
+
+        def held_at(line: int) -> frozenset[str]:
+            return frozenset(
+                lk for lk, region in regions if region.holds_at(line)
+            )
+
+        nested: dict[str, str] = {}
+        for node in _walk_own(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nkey = f"{key}.{node.name}"
+                nested[node.name] = nkey
+                self._index_function(ctx, node, nkey, group)
+                continue
+            if isinstance(node, ast.Call):
+                self._index_call(ctx, info, node, group, nested, held_at)
+            self._index_access(ctx, info, node, group, fn.name, held_at)
+
+    def _region_lock_key(
+        self, ctx: FileContext, group: Optional[str], lock_expr: str
+    ) -> str:
+        parts = lock_expr.split(".")
+        if parts[0] == "self" and len(parts) == 2 and group is not None:
+            resolved = self._resolve_lock_key(group, parts[1])
+            if resolved is not None:
+                return resolved
+            return f"{group}.{parts[1]}"
+        if len(parts) == 1:
+            mod = self._module_locks.get(ctx.path, {})
+            if parts[0] in mod:
+                return mod[parts[0]]
+            return f"{ctx.path}:{parts[0]}"
+        # foreign object: eng._submit_lock — unique-attr resolution.
+        resolved = self._unique_lock_attr.get(parts[-1])
+        if resolved:
+            return resolved
+        return f"?.{parts[-1]}"
+
+    def _index_call(
+        self,
+        ctx: FileContext,
+        info: FunctionInfo,
+        node: ast.Call,
+        group: Optional[str],
+        nested: dict[str, str],
+        held_at: "_HeldAt",
+    ) -> None:
+        name = dotted_name(node.func)
+        if name is None:
+            return
+        held = held_at(node.lineno)
+        parts = name.split(".")
+        leaf = parts[-1]
+        # blocking primitives
+        if name in BLOCKING_CALLS or leaf in BLOCKING_LEAVES:
+            info.blocking.append((name, node.lineno, node.col_offset))
+        elif leaf == _JOIN_LEAF and len(parts) >= 2 and (
+            "thread" in parts[-2].lower() or "_sched" in parts[-2].lower()
+        ):
+            info.blocking.append((name, node.lineno, node.col_offset))
+        elif (
+            leaf == "get"
+            and len(parts) >= 2
+            and self._queue_receiver(parts[-2])
+            and not any(
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords if kw.arg == "block"
+            )
+        ):
+            # queue.get() blocks unless block=False; get_nowait never.
+            info.blocking.append((name, node.lineno, node.col_offset))
+        # manual lock.acquire() outside a with — an acquisition event
+        # (blocking-acquire order edges; `with` regions are collected
+        # separately in lock_regions()).
+        if leaf == "acquire" and len(parts) >= 2 and any(
+            marker in parts[-2].lower() for marker in _LOCKISH
+        ):
+            lock_key = self._region_lock_key(
+                ctx, group, ".".join(parts[:-1])
+            )
+            already = any(
+                r.lineno <= node.lineno <= r.end_lineno
+                for lk, r in info.regions if lk == lock_key
+            )
+            if not already:
+                info.acquisitions.append(
+                    Acquisition(
+                        lock=lock_key, path=ctx.path, line=node.lineno,
+                        col=node.col_offset, func=info.key,
+                    )
+                )
+        callee = self._resolve_call(ctx, group, nested, parts)
+        info.calls.append(
+            CallSite(
+                name=name, callee=callee, path=ctx.path,
+                line=node.lineno, col=node.col_offset, locks_held=held,
+            )
+        )
+
+    @staticmethod
+    def _queue_receiver(name: str) -> bool:
+        """Does ``name`` denote a queue object (whose ``.get`` blocks)?
+        Exact-word matching only: ``self._tenant_queued.get(k, 0)`` is a
+        dict counter, not a queue, and must not count."""
+        low = name.lower()
+        return (
+            low in ("queue", "q")
+            or low.endswith("_queue")
+            or low.endswith("_q")
+        )
+
+    def _resolve_call(
+        self,
+        ctx: FileContext,
+        group: Optional[str],
+        nested: dict[str, str],
+        parts: list[str],
+    ) -> Optional[str]:
+        if len(parts) == 1:
+            if parts[0] in nested:
+                return nested[parts[0]]
+            return self._module_funcs.get(ctx.path, {}).get(parts[0])
+        if parts[0] == "self" and len(parts) == 2 and group is not None:
+            target = self._group_methods.get(group, {}).get(parts[1])
+            if target is not None:
+                return target
+        if len(parts) == 2 and parts[0] in self.classes:
+            # Klass.method(self, ...) — explicit class dispatch.
+            return self.classes[parts[0]].methods.get(parts[1])
+        if parts[0] in self._file_imports.get(ctx.path, ()):
+            # os.path.exists / np.asarray / requests.get — a library
+            # call, however its leaf happens to collide with a method
+            # name somewhere in the repo.
+            return None
+        # obj.m(...) — unique-name resolution across indexed classes.
+        return self._unique_methods.get(parts[-1]) or None
+
+    def _index_access(
+        self,
+        ctx: FileContext,
+        info: FunctionInfo,
+        node: ast.AST,
+        group: Optional[str],
+        fn_name: str,
+        held_at: "_HeldAt",
+    ) -> None:
+        if group is None:
+            return
+        if not (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return
+        attr = node.attr
+        # Locks themselves and group methods are not shared *state*.
+        if f"{group}.{attr}" in self.locks:
+            return
+        if attr in self._group_methods.get(group, {}):
+            return
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        info.accesses.append(
+            AttrAccess(
+                attr=attr, group=group, write=write, path=ctx.path,
+                line=node.lineno, col=node.col_offset, func=info.key,
+                locks_held=held_at(node.lineno),
+                in_init=fn_name == "__init__",
+            )
+        )
+
+    # -- thread roots ----------------------------------------------------
+
+    def _discover_thread_roots(self) -> None:
+        for info in list(self.functions.values()):
+            for call in info.calls:
+                leaf = call.name.rsplit(".", 1)[-1]
+                if leaf != "Thread":
+                    continue
+                target = self._thread_target(info, call)
+                if target is not None and target in self.functions:
+                    label = self.functions[target].name
+                    self.thread_roots[target] = label
+
+    def _thread_target(
+        self, info: FunctionInfo, call: CallSite
+    ) -> Optional[str]:
+        """Resolve the ``target=`` of a Thread(...) call found at
+        ``call``'s site by re-reading the AST is overkill — instead the
+        call-site records of ``info`` already hold every callee name;
+        the Thread target is recovered from the source line span."""
+        ctx = self.files.get(call.path)
+        if ctx is None:
+            return None
+        # Parse just the Thread(...) call's segment for its target kwarg.
+        node = self._call_node_at(ctx, call)
+        if node is None:
+            return None
+        target_expr: Optional[ast.AST] = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+                break
+        if target_expr is None and node.args:
+            # Thread(group, target) positional form (rare).
+            if len(node.args) >= 2:
+                target_expr = node.args[1]
+        if target_expr is None:
+            return None
+        name = dotted_name(target_expr)
+        if name is None:
+            # partial(self._loop, ...) / lambda: self._loop()
+            if isinstance(target_expr, ast.Call) and target_expr.args:
+                name = dotted_name(target_expr.args[0])
+            elif isinstance(target_expr, ast.Lambda) and isinstance(
+                target_expr.body, ast.Call
+            ):
+                name = dotted_name(target_expr.body.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and info.group:
+            return self._group_methods.get(info.group, {}).get(parts[1])
+        if len(parts) == 1:
+            # nested def in the spawning function, or module function.
+            nested_key = f"{info.key}.{parts[0]}"
+            if nested_key in self.functions:
+                return nested_key
+            return self._module_funcs.get(info.path, {}).get(parts[0])
+        return self._unique_methods.get(parts[-1]) or None
+
+    @staticmethod
+    def _call_node_at(ctx: FileContext, call: CallSite) -> Optional[ast.Call]:
+        try:
+            tree = ast.parse(ctx.source)
+        except SyntaxError:
+            return None
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and node.lineno == call.line
+                and node.col_offset == call.col
+                # A chained `Thread(...).start()` puts TWO Call nodes at
+                # the same (line, col) — the outer `.start()` call first
+                # in walk order. Matching the callee name picks the
+                # Thread(...) call itself.
+                and dotted_name(node.func) == call.name
+            ):
+                return node
+        return None
+
+    # -- derived queries -------------------------------------------------
+
+    def roots_of(self, func_key: str) -> frozenset[str]:
+        """The thread roots from which ``func_key`` is reachable
+        through resolved call edges. Public functions (and anything
+        they reach) additionally carry the synthetic ``caller`` root —
+        request/HTTP threads enter there."""
+        if self._roots_of is None:
+            self._roots_of = self._compute_roots()
+        return self._roots_of.get(func_key, frozenset())
+
+    def _compute_roots(self) -> dict[str, frozenset[str]]:
+        adj: dict[str, list[str]] = {}
+        for key, info in self.functions.items():
+            adj[key] = [
+                c.callee for c in info.calls
+                if c.callee is not None and c.callee in self.functions
+            ]
+        result: dict[str, set[str]] = {k: set() for k in self.functions}
+
+        def bfs(starts: list[str], label: str) -> None:
+            queue = list(starts)
+            seen: set[str] = set(queue)
+            while queue:
+                cur = queue.pop()
+                result[cur].add(label)
+                for nxt in adj.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        queue.append(nxt)
+
+        for root_key, label in self.thread_roots.items():
+            bfs([root_key], label)
+        public = [
+            k for k, info in self.functions.items()
+            if info.is_public and k not in self.thread_roots
+        ]
+        bfs(public, CALLER_ROOT)
+        return {k: frozenset(v) for k, v in result.items()}
+
+    def entry_locks(self, func_key: str) -> frozenset[str]:
+        """Locks guaranteed held on *entry* to ``func_key``: the
+        intersection, over every resolved call site, of the locks held
+        at that site plus the caller's own entry locks. Public
+        functions and thread roots can be entered from outside the
+        index, so their entry set is empty. This is the guarded-by
+        inference that makes ``# Callers hold self._lock`` helpers
+        (brownout ``_step``, lifecycle ``_prune``) analyzable."""
+        if self._entry_locks is None:
+            self._entry_locks = self._compute_entry_locks()
+        return self._entry_locks.get(func_key, frozenset())
+
+    def _compute_entry_locks(self) -> dict[str, frozenset[str]]:
+        callers: dict[str, list[tuple[str, frozenset[str]]]] = {}
+        for key, info in self.functions.items():
+            for call in info.calls:
+                if call.callee and call.callee in self.functions:
+                    callers.setdefault(call.callee, []).append(
+                        (key, call.locks_held)
+                    )
+        universe = frozenset(self.locks)
+        entry: dict[str, frozenset[str]] = {}
+        for key, info in self.functions.items():
+            callable_externally = (
+                info.is_public
+                or key in self.thread_roots
+                or key not in callers
+            )
+            entry[key] = frozenset() if callable_externally else universe
+        # Meet-over-call-sites to fixpoint (intersection only shrinks;
+        # terminates). Functions stuck at `universe` sit on caller
+        # cycles unreachable from any externally-callable function —
+        # dead code; the value never matters.
+        changed = True
+        while changed:
+            changed = False
+            for key, sites in callers.items():
+                if not entry[key]:
+                    continue
+                new: Optional[frozenset[str]] = None
+                for caller_key, held in sites:
+                    at_site = held | entry.get(caller_key, frozenset())
+                    new = at_site if new is None else (new & at_site)
+                if new is not None and new != entry[key]:
+                    entry[key] = new
+                    changed = True
+        return entry
+
+    def may_acquire(self, func_key: str) -> dict[str, tuple[str, ...]]:
+        """Locks ``func_key`` may acquire, directly or transitively:
+        lock key -> example call chain (function names, outermost
+        first) ending at the acquiring function."""
+        memo = self._may_acquire
+        if func_key in memo:
+            return memo[func_key]
+        self._fixpoint(
+            func_key, memo,
+            direct=lambda info: {
+                a.lock: (info.name,) for a in info.acquisitions
+            },
+        )
+        return memo[func_key]
+
+    def may_block(self, func_key: str) -> dict[str, tuple[str, ...]]:
+        """Blocking primitives ``func_key`` may hit, directly or
+        transitively: primitive name -> example call chain."""
+        memo = self._may_block
+        if func_key in memo:
+            return memo[func_key]
+        self._fixpoint(
+            func_key, memo,
+            direct=lambda info: {
+                name: (info.name,) for name, _, _ in info.blocking
+            },
+        )
+        return memo[func_key]
+
+    def _fixpoint(
+        self,
+        start: str,
+        memo: dict[str, dict[str, tuple[str, ...]]],
+        direct: "_DirectFn",
+    ) -> None:
+        """Iterative DFS computing the transitive closure of ``direct``
+        over the call graph, cycle-safe (locks/blocking discovered on a
+        cycle propagate through the final stabilization sweep)."""
+        order: list[str] = []
+        seen: set[str] = set()
+        stack = [start]
+        while stack:
+            cur = stack.pop()
+            if cur in seen or cur in memo:
+                continue
+            seen.add(cur)
+            order.append(cur)
+            info = self.functions.get(cur)
+            if info is None:
+                continue
+            for call in info.calls:
+                if call.callee and call.callee in self.functions:
+                    stack.append(call.callee)
+        for cur in seen:
+            info = self.functions.get(cur)
+            memo[cur] = dict(direct(info)) if info is not None else {}
+        # Propagate to fixpoint (small graphs; bounded by #locks).
+        changed = True
+        while changed:
+            changed = False
+            for cur in order:
+                info = self.functions.get(cur)
+                if info is None:
+                    continue
+                mine = memo[cur]
+                for call in info.calls:
+                    sub = memo.get(call.callee or "")
+                    if not sub:
+                        continue
+                    for lock_key, chain in sub.items():
+                        if lock_key not in mine:
+                            mine[lock_key] = (info.name,) + chain
+                            changed = True
+
+
+# typing aliases used above (kept at module end: runtime-irrelevant)
+from typing import Callable  # noqa: E402
+
+_HeldAt = Callable[[int], "frozenset[str]"]
+_DirectFn = Callable[[FunctionInfo], "dict[str, tuple[str, ...]]"]

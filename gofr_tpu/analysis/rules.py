@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL019).
+"""The graftlint rule set (GL001–GL022).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -6,14 +6,27 @@ model, not a syntax smell. The heuristics are deliberately conservative:
 a rule should only fire where a human reviewer would at least pause —
 anything intentional gets an inline ``# graftlint: disable=RULE`` with
 its justification, which doubles as documentation at the call site.
+
+GL001–GL019 are per-file :class:`Rule`\\ s; GL020–GL022 are
+:class:`ProjectRule`\\ s running against the cross-file
+:class:`~gofr_tpu.analysis.project.ProjectIndex` (call graph, lock
+model, thread roots) built by the two-phase runner.
 """
 
 from __future__ import annotations
 
 import ast
+from dataclasses import replace
 from typing import Iterator, Optional, Sequence
 
-from gofr_tpu.analysis.core import FileContext, Finding, LintConfig, Rule
+from gofr_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    LintConfig,
+    ProjectRule,
+    Rule,
+)
+from gofr_tpu.analysis.project import AttrAccess, ProjectIndex, lock_regions
 
 # ----------------------------------------------------------------------
 # shared AST helpers
@@ -587,13 +600,24 @@ class LockDisciplineRule(Rule):
                 continue
             if method.name == "__init__":
                 continue
-            locked_spans = self._lock_spans(method)
+            # Shared with the GL020 index path: lock_regions() subtracts
+            # manual release()/acquire() windows, so a write in the
+            # except/finally of a released window counts as UNLOCKED —
+            # the lexical with-span alone used to mis-classify exactly
+            # that shape as guarded. Nested defs keep their own regions
+            # (lock_regions stops at scope boundaries, so union them).
+            regions = list(lock_regions(method))
+            for sub in ast.walk(method):
+                if isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and sub is not method:
+                    regions.extend(lock_regions(sub))
             for stmt in ast.walk(method):
                 attr = self._self_attr_write(stmt)
                 if attr is None:
                     continue
                 line = stmt.lineno
-                if any(lo <= line <= hi for lo, hi in locked_spans):
+                if any(r.holds_at(line) for r in regions):
                     guarded.add(attr)
                 else:
                     unlocked.append((attr, stmt))
@@ -611,23 +635,6 @@ class LockDisciplineRule(Rule):
                     "the composed serving core but not here; hold the "
                     "same lock (or document why this write cannot race)",
                 )
-
-    @staticmethod
-    def _lock_spans(
-        method: ast.FunctionDef | ast.AsyncFunctionDef,
-    ) -> list[tuple[int, int]]:
-        spans = []
-        for node in ast.walk(method):
-            if not isinstance(node, (ast.With, ast.AsyncWith)):
-                continue
-            for item in node.items:
-                name = dotted_name(item.context_expr) or ""
-                if isinstance(item.context_expr, ast.Call):
-                    name = dotted_name(item.context_expr.func) or ""
-                if "lock" in name.lower():
-                    spans.append((node.lineno, node.end_lineno or node.lineno))
-                    break
-        return spans
 
     @staticmethod
     def _self_attr_write(stmt: ast.AST) -> Optional[str]:
@@ -2382,6 +2389,369 @@ class SyncOutsideDeviceWaitRule(Rule):
 ALL_RULES = ALL_RULES + (SyncOutsideDeviceWaitRule,)
 
 
+# ----------------------------------------------------------------------
+# GL020–GL022 — project-wide concurrency rules (two-phase engine)
+# ----------------------------------------------------------------------
+
+
+class _ConcurrencyRule(ProjectRule):
+    """Shared scoping for the project-wide concurrency rules: findings
+    are only *reported* under the concurrency dirs (serving/, service/
+    by default) — the index itself still spans every scanned file so
+    cross-package call edges resolve."""
+
+    def __init__(
+        self, concurrency_dirs: Sequence[str] = ("serving", "service")
+    ) -> None:
+        self._dirs = tuple(concurrency_dirs)
+
+    def applies_to(self, path: str) -> bool:
+        parts = path.replace("\\", "/").split("/")
+        return any(d in parts[:-1] for d in self._dirs)
+
+
+class UnguardedSharedStateRule(_ConcurrencyRule):
+    """An attribute consistently written under a lock in one method but
+    accessed lock-free in another method reachable from a *different*
+    thread root has no happens-before edge: the scheduler loop, prober,
+    scaler, watchdog, and request threads all run concurrently, and a
+    torn read across that mesh is exactly the bug class PR 14's
+    lazy-init race belonged to.
+
+    Two binding modes, strongest first:
+
+    * **declared** — ``self._epoch = 0  # graftlint: guarded-by=_lock``
+      binds the attribute to the named lock; every lock-free read *or*
+      write outside ``__init__`` is flagged.
+    * **inferred** — majority-access fallback: if a lock is held for
+      at least two accesses (one of them a write) and for strictly more
+      accesses than run lock-free, the attribute is treated as guarded
+      by it and lock-free *writes* are flagged (reads are too noisy to
+      infer without a declaration).
+
+    Either way a finding additionally requires the attribute to be
+    reachable from at least two distinct thread roots — single-thread
+    state cannot race, however inconsistent its locking looks.
+    """
+
+    rule_id = "GL020"
+    name = "unguarded-shared-state"
+    rationale = (
+        "an attribute written under a lock in one thread but accessed "
+        "lock-free from another has no happens-before edge; hold the "
+        "lock everywhere or declare the actual discipline with "
+        "# graftlint: guarded-by=<lock>"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        by_attr: dict[tuple[str, str], list[AttrAccess]] = {}
+        for fn in index.functions.values():
+            # Locks guaranteed held on entry count as held at every
+            # access: `# Callers hold self._lock` helpers are guarded
+            # by their call sites, not their own body.
+            entry = index.entry_locks(fn.key)
+            for acc in fn.accesses:
+                if acc.in_init:
+                    continue
+                if entry:
+                    acc = replace(acc, locks_held=acc.locks_held | entry)
+                by_attr.setdefault((acc.group, acc.attr), []).append(acc)
+        for (group, attr), accesses in sorted(by_attr.items()):
+            declared = index.guarded_by.get((group, attr))
+            lock = declared or self._infer_lock(accesses)
+            if lock is None:
+                continue
+            roots: set[str] = set()
+            for acc in accesses:
+                roots |= index.roots_of(acc.func)
+            if len(roots) < 2:
+                continue
+            lock_name = lock.rsplit(".", 1)[-1]
+            how = "declared" if declared else "inferred"
+            for acc in sorted(accesses, key=lambda a: (a.path, a.line)):
+                if lock in acc.locks_held:
+                    continue
+                if not declared and not acc.write:
+                    continue
+                if not index.roots_of(acc.func):
+                    continue
+                kind = "write to" if acc.write else "read of"
+                yield self.project_finding(
+                    index, acc.path, acc.line,
+                    f"lock-free {kind} `self.{attr}` ({how} guarded by "
+                    f"`{lock_name}`, and reachable from threads: "
+                    f"{', '.join(sorted(roots))}); hold `{lock_name}` "
+                    "here or justify the lock-free access inline",
+                    col=acc.col,
+                )
+
+    @staticmethod
+    def _infer_lock(accesses: list[AttrAccess]) -> Optional[str]:
+        locked: dict[str, int] = {}
+        locked_writes: dict[str, int] = {}
+        unlocked = 0
+        for acc in accesses:
+            if not acc.locks_held:
+                unlocked += 1
+            for lock in acc.locks_held:
+                locked[lock] = locked.get(lock, 0) + 1
+                if acc.write:
+                    locked_writes[lock] = locked_writes.get(lock, 0) + 1
+        best: Optional[str] = None
+        for lock, n in sorted(locked.items()):
+            if locked_writes.get(lock, 0) < 1:
+                continue
+            if n < 2 or n <= unlocked:
+                continue
+            if best is None or n > locked[best]:
+                best = lock
+        return best
+
+
+class LockOrderInversionRule(_ConcurrencyRule):
+    """Two locks acquired in opposite orders on two code paths deadlock
+    the moment both paths run concurrently — the exact hazard PR 4
+    dodged *manually* by releasing the engine submit lock around pool
+    adoption. This rule builds the may-acquire-while-holding graph
+    (nested ``with`` blocks plus transitive acquisitions through the
+    call graph) and flags every edge that participates in a cycle,
+    including a plain-Lock self-cycle (re-acquiring a non-reentrant
+    lock through a call chain is a self-deadlock, not an inversion,
+    but the fix is the same).
+
+    Only locks the index resolved to a concrete owner participate —
+    an unresolved ``obj._lock`` would conflate every class's ``_lock``
+    into one node and invent cycles that cannot happen.
+    """
+
+    rule_id = "GL021"
+    name = "lock-order-inversion"
+    rationale = (
+        "two locks taken in opposite orders on concurrent paths "
+        "deadlock under the wrong interleaving; pick one global order "
+        "or release the outer lock around the foreign acquisition"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        # witness[(L, M)] = (path, line, chain) — one example site where
+        # M is acquired while L is held.
+        witness: dict[tuple[str, str], tuple[str, int, tuple[str, ...]]] = {}
+        for fn in index.functions.values():
+            for held_key, region in fn.regions:
+                if held_key.startswith("?."):
+                    continue
+                # nested acquisitions in the same function body
+                for acq in fn.acquisitions:
+                    if acq.lock == held_key or acq.lock.startswith("?."):
+                        continue
+                    if region.holds_at(acq.line) or (
+                        region.lineno < acq.line <= region.end_lineno
+                    ):
+                        witness.setdefault(
+                            (held_key, acq.lock),
+                            (acq.path, acq.line, (fn.name,)),
+                        )
+                # transitive acquisitions through calls made under the
+                # *lexical* region — deliberately ignoring manual
+                # release windows: a release-around seam still relies
+                # on timing, and the finding's inline disable is where
+                # that reliance gets documented.
+                for call in fn.calls:
+                    if call.callee is None:
+                        continue
+                    if not (
+                        region.lineno < call.line <= region.end_lineno
+                    ):
+                        continue
+                    for lock, chain in index.may_acquire(
+                        call.callee
+                    ).items():
+                        # lock == held_key stays IN: re-acquiring a
+                        # plain Lock through a call chain is a self-
+                        # deadlock (_cycle_findings exempts RLocks).
+                        if lock.startswith("?."):
+                            continue
+                        witness.setdefault(
+                            (held_key, lock),
+                            (call.path, call.line, (fn.name,) + chain),
+                        )
+        yield from self._cycle_findings(index, witness)
+
+    def _cycle_findings(
+        self,
+        index: ProjectIndex,
+        witness: dict[tuple[str, str], tuple[str, int, tuple[str, ...]]],
+    ) -> Iterator[Finding]:
+        adj: dict[str, set[str]] = {}
+        for left, right in witness:
+            adj.setdefault(left, set()).add(right)
+            adj.setdefault(right, set())
+        sccs = _tarjan(adj)
+        in_cycle: dict[str, int] = {}
+        for i, scc in enumerate(sccs):
+            if len(scc) > 1:
+                for node in scc:
+                    in_cycle[node] = i
+        findings: list[Finding] = []
+        for (left, right), (path, line, chain) in sorted(witness.items()):
+            self_cycle = left == right or (
+                (right, left) in witness and left != right
+            )
+            same_scc = (
+                in_cycle.get(left) is not None
+                and in_cycle.get(left) == in_cycle.get(right)
+            )
+            if not (same_scc or self_cycle):
+                continue
+            if left == right:
+                kind = index.locks.get(left)
+                if kind is not None and kind.kind == "RLock":
+                    continue  # re-entrant by design
+                msg = (
+                    f"`{_lock_label(left)}` may be re-acquired while "
+                    f"already held (via {' -> '.join(chain)}); a plain "
+                    "Lock self-deadlocks here"
+                )
+            else:
+                back = witness.get((right, left))
+                where = (
+                    f"; the reverse order is taken at {back[0]}:{back[1]}"
+                    if back is not None else
+                    " (reverse path closes the cycle elsewhere)"
+                )
+                msg = (
+                    "lock-order inversion: acquires "
+                    f"`{_lock_label(right)}` while holding "
+                    f"`{_lock_label(left)}` (via {' -> '.join(chain)})"
+                    f"{where}; pick one global order or release "
+                    f"`{_lock_label(left)}` around the acquisition"
+                )
+            findings.append(
+                self.project_finding(index, path, line, msg)
+            )
+        yield from findings
+
+
+class BlockingUnderLockRule(_ConcurrencyRule):
+    """A ``with <lock>:`` region that reaches a blocking primitive —
+    ``block_until_ready``/``device_get`` (device sync), HTTP, ``sleep``,
+    a blocking ``queue.get`` — stalls every thread contending for that
+    lock for the primitive's full duration: a device sync under the
+    submit lock turns one slow window into a serving-wide convoy. The
+    per-file GL004-era checks only see the direct body; this rule
+    follows the call graph, so a helper three frames down still trips
+    it.
+
+    Condition regions are exempt (waiting is their job), as is code
+    inside a manual release window (the lock is not actually held
+    there).
+    """
+
+    rule_id = "GL022"
+    name = "blocking-under-lock"
+    rationale = (
+        "a blocking call while holding a lock convoys every thread "
+        "contending for it; move the wait outside the critical section "
+        "or justify the hold inline"
+    )
+
+    def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
+        for key in sorted(index.functions):
+            fn = index.functions[key]
+            for held_key, region in fn.regions:
+                lock_def = index.locks.get(held_key)
+                if lock_def is not None and lock_def.kind == "Condition":
+                    continue
+                label = _lock_label(held_key)
+                for name, line, col in fn.blocking:
+                    if region.holds_at(line):
+                        yield self.project_finding(
+                            index, fn.path, line,
+                            f"blocking call `{name}` while holding "
+                            f"`{label}`; every thread contending for "
+                            "the lock stalls behind it",
+                            col=col,
+                        )
+                for call in fn.calls:
+                    if call.callee is None:
+                        continue
+                    if not region.holds_at(call.line):
+                        continue
+                    for name, chain in sorted(
+                        index.may_block(call.callee).items()
+                    ):
+                        yield self.project_finding(
+                            index, fn.path, call.line,
+                            f"call chain {' -> '.join((fn.name,) + chain)} "
+                            f"reaches blocking `{name}` while holding "
+                            f"`{label}`; move the wait outside the "
+                            "critical section",
+                            col=call.col,
+                        )
+
+
+def _lock_label(key: str) -> str:
+    """Human name for a lock key: ``Engine._submit_lock`` for instance
+    locks, the bare name for module-level ones."""
+    if ":" in key:
+        return key.rsplit(":", 1)[-1]
+    return key
+
+
+def _tarjan(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Tarjan's strongly-connected components, iterative (lint inputs
+    are untrusted; no recursion-limit surprises)."""
+    index_of: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = 0
+    for start in sorted(adj):
+        if start in index_of:
+            continue
+        work: list[tuple[str, Optional[str], Iterator[str]]] = [
+            (start, None, iter(sorted(adj[start])))
+        ]
+        while work:
+            node, parent, children = work[-1]
+            if node not in index_of:
+                index_of[node] = low[node] = counter
+                counter += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            for child in children:
+                if child not in index_of:
+                    work.append((child, node, iter(sorted(adj[child]))))
+                    advanced = True
+                    break
+                if child in on_stack:
+                    low[node] = min(low[node], index_of[child])
+            if advanced:
+                continue
+            if low[node] == index_of[node]:
+                scc: list[str] = []
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    scc.append(top)
+                    if top == node:
+                        break
+                sccs.append(scc)
+            work.pop()
+            if parent is not None:
+                low[parent] = min(low[parent], low[node])
+    return sccs
+
+
+ALL_RULES = ALL_RULES + (
+    UnguardedSharedStateRule,
+    LockOrderInversionRule,
+    BlockingUnderLockRule,
+)
+
+
 def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
     config = config or LintConfig()
     return [
@@ -2404,4 +2774,7 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         ThresholdNoHysteresisRule(),
         HostPullInDeviceLegRule(),
         SyncOutsideDeviceWaitRule(),
+        UnguardedSharedStateRule(config.concurrency_dirs),
+        LockOrderInversionRule(config.concurrency_dirs),
+        BlockingUnderLockRule(config.concurrency_dirs),
     ]

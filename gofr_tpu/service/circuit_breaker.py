@@ -16,10 +16,12 @@ circuit the moment it opens rather than inferring it from error rates.
 from __future__ import annotations
 
 import threading
+
 import time
 from dataclasses import dataclass
 from typing import Any
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.service.wrapper import ServiceWrapper, innermost
 
 
@@ -45,7 +47,7 @@ class _CircuitBreakerService(ServiceWrapper):
         super().__init__(inner)
         self._threshold = threshold
         self._interval = interval_s
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("_CircuitBreakerService._lock")
         self._failures = 0
         self._open = False
         self._opened_at = 0.0

@@ -87,7 +87,11 @@ class OAuthConfig:
                 "client_secret": self.client_secret,
                 **({"scope": " ".join(self.scopes)} if self.scopes else {}),
             }).encode()
-            with urllib.request.urlopen(
+            # Single-flight by design: the lock held across the fetch is
+            # what stops N threads with an expired token from minting N
+            # tokens; waiters get the fresh token from the cache. The
+            # urlopen timeout bounds the convoy.
+            with urllib.request.urlopen(  # graftlint: disable=GL022 — single-flight token refresh; bounded by timeout=10
                 urllib.request.Request(self.token_url, data=data), timeout=10
             ) as resp:
                 payload = jsonlib.loads(resp.read())

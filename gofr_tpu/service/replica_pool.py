@@ -78,9 +78,11 @@ from __future__ import annotations
 import concurrent.futures as cf
 import random
 import threading
+
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu import faults
 from gofr_tpu.errors import (
     ErrorDeadlineExceeded,
@@ -558,7 +560,7 @@ class HTTPReplica(Replica):
         self.idle_timeout_s = float(idle_timeout_s)
         self._metrics = metrics
         self._logger = logger
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("HTTPReplica._lock")
         self._inflight = 0
         self._state = "SERVING"
         self._adapters: frozenset[str] = frozenset()
@@ -1393,11 +1395,11 @@ class ReplicaPool:
         self._metrics = metrics
         self._logger = logger
         self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = lockcheck.make_lock("ReplicaPool._rr_lock")
         # Guards replica-list MUTATION (scaler add/drain). Readers
         # iterate the current list object; mutators swap in a new list
         # atomically so routing never sees a half-edited one.
-        self._replicas_lock = threading.Lock()
+        self._replicas_lock = lockcheck.make_lock("ReplicaPool._replicas_lock")
         self._probe_stop = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
         # Replicas whose synthetic probe was brownout-skipped LAST

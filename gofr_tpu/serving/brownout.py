@@ -62,9 +62,11 @@ Discipline:
 
 from __future__ import annotations
 
-import threading
+
 import time
 from typing import Any, Callable, Mapping, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 #: The SLO-class vocabulary (bounded: it appears in metric labels).
 SLO_CLASSES = ("interactive", "standard", "batch")
@@ -150,7 +152,7 @@ class BrownoutController:
         self._metrics = metrics
         self._logger = logger
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("BrownoutController._lock")
         self.level = 0
         #: AIMD multiplier on the admission budget: 1.0 nominal, cut
         #: multiplicatively on each climb into L2+, recovered

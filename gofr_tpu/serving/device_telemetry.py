@@ -57,10 +57,11 @@ counts.
 from __future__ import annotations
 
 import math
-import threading
+
 import time
 from typing import Any, Callable, Optional
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.serving.observability import tracer_active
 from gofr_tpu.tracing import get_tracer
 from gofr_tpu.tracing.tracer import _rand_hex, current_span
@@ -267,7 +268,7 @@ class CompileTracker:
         self._logger = logger
         self._clock = clock
         self._wall_ns = wall_ns
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("CompileTracker._lock")
         self._programs: dict[str, dict[str, Any]] = {}
         self.total = 0
         self.steady_state_recompiles = 0
@@ -319,7 +320,7 @@ class CompileTracker:
                 program, {"compiles": 0, "seconds_total": 0.0}
             )
         signatures: set = set()
-        sig_lock = threading.Lock()
+        sig_lock = lockcheck.make_lock("CompileTracker.sig_lock")
 
         def cache_size() -> Optional[int]:
             if shared:

@@ -26,12 +26,14 @@ import asyncio
 import os
 import queue
 import threading
+
 import time
 from functools import partial
 from typing import Any, AsyncIterator, Optional
 
 import numpy as np
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu import faults
 from gofr_tpu.serving.batcher import DynamicBatcher
 from gofr_tpu.serving.tokenizer import tokenizer_from_config
@@ -322,7 +324,7 @@ class InferenceEngine(
         self._fatal: Optional[BaseException] = None  # scheduler death reason
         # Serializes submission against the scheduler's final drain, so a
         # request can never be enqueued after the drain has already run.
-        self._submit_lock = threading.Lock()
+        self._submit_lock = lockcheck.make_lock("InferenceEngine._submit_lock")
         self._drained = False
         # Supervision (serving/supervisor.py): the attached supervisor (if
         # any) owns the restart policy; the scheduler epoch brands each

@@ -26,9 +26,12 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+
 import time
 from collections import deque
 from typing import Callable, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 
 class Deadline:
@@ -119,7 +122,7 @@ class AggregateThroughput:
         self.window_s = float(window_s)
         self._bucket_s = float(bucket_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("AggregateThroughput._lock")
         # (bucket start time, tokens in bucket); _total mirrors the sum.
         self._buckets: deque[tuple[float, int]] = deque()
         self._total = 0
@@ -191,7 +194,7 @@ class HedgeBudget:
         self.burst = max(0.0, float(burst))
         self.rate_per_s = max(0.0, float(rate_per_s))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("HedgeBudget._lock")
         self._tokens = self.burst
         self._last = clock()
 
@@ -266,7 +269,7 @@ class ClassPriorityQueue:
         self.promote_after_s = float(promote_after_s)
         self._clock = clock
         self._classify = classify
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("ClassPriorityQueue._lock")
         # rank → FIFO of (enqueued_at, request). Rank 1 doubles as THE
         # queue when classing is off.
         self._lanes: dict[int, deque] = {0: deque(), 1: deque(), 2: deque()}

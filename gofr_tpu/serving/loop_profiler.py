@@ -56,11 +56,13 @@ math, stall hysteresis, and ring bounds with stated clocks.
 
 from __future__ import annotations
 
-import threading
+
 import time
 from collections import deque
 from itertools import islice
 from typing import Any, Callable, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 #: The bounded phase vocabulary (it appears in metric labels — GL016
 #: discipline): the scheduler loop's boundaries, in pass order, plus
@@ -152,7 +154,7 @@ class LoopProfiler:
         #: loop-stall anomaly, or every boot would open with one.
         self.compiles: Optional[Callable[[], int]] = None
         self._last_compiles = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("LoopProfiler._lock")
         # Current-pass accumulation (scheduler thread only — no lock).
         self._pass_start: Optional[float] = None
         self._last_stamp = 0.0

@@ -44,11 +44,12 @@ monotonic request ids, so eviction/pinning tests are exact.
 
 from __future__ import annotations
 
-import threading
+
 import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.tracing import get_tracer
 from gofr_tpu.tracing.tracer import Tracer, _rand_hex, current_span
 
@@ -194,7 +195,7 @@ class RequestTimeline:
         # feed per-tenant SLO overrides (serving/slo.py) without a
         # second measurement path.
         self.tenant = tenant
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("RequestTimeline._lock")
         self._finished = False
 
     # -- scheduler-thread marks (timestamps passed in; see class doc) --
@@ -364,7 +365,7 @@ class FlightRecorder:
         self.capacity = max(1, int(capacity))
         self.pin_capacity = max(1, int(pin_capacity))
         self.slow_s = float(slow_s)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("FlightRecorder._lock")
         self._ring: deque[dict[str, Any]] = deque(maxlen=self.capacity)
         self._pinned: deque[dict[str, Any]] = deque(
             maxlen=self.pin_capacity
@@ -419,7 +420,7 @@ class RequestObservability:
         self.recorder = recorder
         self._clock = clock
         self._wall_ns = wall_ns
-        self._seq_lock = threading.Lock()
+        self._seq_lock = lockcheck.make_lock("RequestObservability._seq_lock")
         self._seq = 0
         # SLO evaluation (serving/slo.py): when the engine configures
         # objectives, finalize feeds every retired timeline's outcome

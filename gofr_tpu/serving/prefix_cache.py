@@ -27,10 +27,12 @@ purges its entries.
 
 from __future__ import annotations
 
-import threading
+
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Optional, Sequence
+
+from gofr_tpu.analysis import lockcheck
 
 _COPY_BUCKET = 256  # positions per copy bucket (one compile per bucket)
 
@@ -52,7 +54,7 @@ class PrefixPool:
         # The lock serializes registry access: lookup/store run in the
         # scheduler thread, but purge_aid runs in whichever thread calls
         # load_lora/unload_lora.
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("PrefixPool._lock")
         self._registry: "OrderedDict[_PrefixKey, int]" = OrderedDict()
 
         def make_pool() -> tuple[Any, ...]:

@@ -39,8 +39,11 @@ from __future__ import annotations
 
 import tempfile
 import threading
+
 import time
 from typing import Any, Callable, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 
 class ProfilerCapture:
@@ -74,10 +77,10 @@ class ProfilerCapture:
         # threading (not asyncio) lock — the auto-trigger fires from
         # the scheduler thread; the async endpoint polls it
         # non-blocking and replies 409 instead of queueing.
-        self._busy = threading.Lock()
+        self._busy = lockcheck.make_lock("ProfilerCapture._busy")
         # Bookkeeping (counters + cooldown anchor) under its own lock
         # so trigger() stays race-free against note_manual_capture().
-        self._state_lock = threading.Lock()
+        self._state_lock = lockcheck.make_lock("ProfilerCapture._state_lock")
         self.captures = 0
         self.suppressed = 0
         self.last_capture_at: Optional[float] = None
@@ -192,7 +195,7 @@ class ProfilerCapture:
 
 
 _capture: Optional[ProfilerCapture] = None
-_capture_lock = threading.Lock()
+_capture_lock = lockcheck.make_lock("profiler_capture._capture_lock")
 
 
 def get_capture(cooldown_s: Optional[float] = None) -> ProfilerCapture:

@@ -47,9 +47,10 @@ they retire.
 
 from __future__ import annotations
 
-import threading
+
 from typing import Iterator, Optional
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.ops.kv_cache import BlockAllocator
 
 
@@ -87,7 +88,7 @@ class RadixPrefixIndex:
         self.block = int(block)
         self.max_blocks = max(0, int(max_blocks))  # 0 = pool-bounded only
         self._alloc = allocator
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("RadixPrefixIndex._lock")
         # One root per adapter slot; roots carry no block (block -1).
         self._roots: dict[int, _RadixNode] = {}
         self._tick = 0

@@ -15,6 +15,7 @@ from typing import Any, Optional
 import numpy as np
 
 from gofr_tpu import faults
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.serving.types import (
     _ActiveSeq,
     _GenRequest,
@@ -1870,6 +1871,7 @@ class SchedulerMixin:
             except AttributeError:  # older jax / fake backends
                 pass
         if self._lockstep:
+            lockcheck.note_device_sync("lockstep_block_until_ready")
             self._jax.block_until_ready(emitted)
         return emitted, counts, list(self._slots), t0, wrun, etops
 
@@ -1903,6 +1905,7 @@ class SchedulerMixin:
                 time.sleep(0.001)  # graftlint: disable=GL004
         # Decode: [2, k, S] (mega: [2, m*k, S], first wrun*k valid).
         # Spec: [2, k, S, G+1] + counts [k, S].
+        lockcheck.note_device_sync("decode_window_fetch")
         emitted_host = np.asarray(emitted)
         # The fetch above is this loop's other blocking point (a wedged
         # relay stalls HERE, not only at dispatch): if the supervisor

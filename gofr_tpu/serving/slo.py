@@ -52,8 +52,10 @@ arithmetic — tests state time instead of sleeping.
 from __future__ import annotations
 
 import time
-import threading
+
 from typing import Any, Callable, Mapping, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 #: (window label, window seconds, ring buckets) — 10 s buckets for the
 #: fast window, 60 s for the sustained one.
@@ -200,7 +202,7 @@ class SLOEngine:
         self.model_name = model_name
         self._metrics = metrics
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("SLOEngine._lock")
         self.target = (
             min(max(float(availability), 0.0), 0.9999999)
             if availability > 0 else DEFAULT_TARGET

@@ -58,9 +58,11 @@ from __future__ import annotations
 import queue
 import random
 import threading
+
 import time
 from typing import Any, Callable, Optional
 
+from gofr_tpu.analysis import lockcheck
 from gofr_tpu.serving.types import _GenRequest
 
 #: State-machine order mirrored into the ``app_tpu_engine_state`` gauge.
@@ -102,7 +104,7 @@ class EngineSupervisor:
         self._metrics = metrics
         self._logger = logger
 
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("EngineSupervisor._lock")
         self._wake = threading.Event()
         self._stop_evt = threading.Event()
         # Default backoff wait doubles as the stop latch: a shutdown
@@ -110,8 +112,8 @@ class EngineSupervisor:
         self._sleep: Callable[[float], None] = (
             sleep if sleep is not None else self._default_sleep
         )
-        self._pending_reason: Optional[str] = None
-        self._stopping = False
+        self._pending_reason: Optional[str] = None  # graftlint: guarded-by=_lock
+        self._stopping = False  # graftlint: guarded-by=_lock
         self._thread: Optional[threading.Thread] = None
 
         # Policy bookkeeping (supervisor-thread-owned after start()).
@@ -129,7 +131,12 @@ class EngineSupervisor:
     def start(self) -> "EngineSupervisor":
         if self._thread is not None and self._thread.is_alive():
             return self
-        self._stopping = False
+        # Under the lock like every other _stopping write: a lock-free
+        # reset here could interleave into a concurrent stop() between
+        # its flag write and its event set, resurrecting a supervisor
+        # the operator is tearing down (GL020's first real catch).
+        with self._lock:
+            self._stopping = False
         self._stop_evt.clear()
         self._thread = threading.Thread(
             target=self._loop, name="tpu-supervisor", daemon=True
@@ -157,8 +164,10 @@ class EngineSupervisor:
     def stopping(self) -> bool:
         """True once stop() began: the scheduler's death drain consults
         this — a stopping supervisor accepts no salvage, because nothing
-        would ever requeue it."""
-        return self._stopping
+        would ever requeue it. Lock-free read: the flag only ever
+        latches False→True while the reader cares, and the scheduler's
+        death drain must not contend on the supervisor's lock."""
+        return self._stopping  # graftlint: disable=GL020 — monotonic latch read; GIL-atomic bool, stale False only delays the drain one poll
 
     def drain_parked(self) -> None:
         """Pop-and-fail everything parked for replay (idempotent: pops
@@ -337,15 +346,19 @@ class EngineSupervisor:
         # fault never burns two restart attempts.
         with self._lock:
             self._pending_reason = None
-        if self._stopping:
+        # The three bail-out probes below read the stop latch lock-free
+        # on purpose: each sits before/after a long blocking step
+        # (backoff sleep, cache realloc) and a stale False merely means
+        # stop()'s own drain_parked sweep — idempotent — cleans up.
+        if self._stopping:  # graftlint: disable=GL020 — monotonic latch probe; stop() re-drains idempotently
             self.drain_parked()
             return
         self._sleep(self.backoff_delay(attempt))
-        if self._stopping:
+        if self._stopping:  # graftlint: disable=GL020 — monotonic latch probe; stop() re-drains idempotently
             self.drain_parked()
             return
         eng.restart_sync()
-        if self._stopping:
+        if self._stopping:  # graftlint: disable=GL020 — monotonic latch probe; stop() re-drains idempotently
             # close() raced the restart (its join timed out while the
             # cache realloc ran): undo the resurrection — the operator
             # asked for a stopped engine — and fail whatever was parked
@@ -453,7 +466,7 @@ class EngineSupervisor:
             if (
                 req.retryable()
                 and not eng._running
-                and not self._stopping
+                and not self._stopping  # graftlint: disable=GL020 — monotonic latch probe; a stale False parks the request for a recovery stop() then fails itself
             ):
                 # Still retryable, but the fresh engine already died
                 # again (tight crash loop): park it back — the NEXT

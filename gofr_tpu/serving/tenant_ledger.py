@@ -46,9 +46,11 @@ scheduler's shared per-pass read) or read from the injectable ``clock``
 
 from __future__ import annotations
 
-import threading
+
 import time
 from typing import Any, Callable, Iterable, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 #: Pseudo-tenant for requests without an ``X-Tenant-Id`` — attribution
 #: must be total (conservation needs every slot accounted to someone).
@@ -124,7 +126,7 @@ class TenantLedger:
         # stays total, the table stays O(table_max).
         self.table_max = max(self.label_max, int(table_max))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("TenantLedger._lock")
         self._stats: dict[str, _TenantStats] = {}
         # tenant → exported metric label: its own id for the first
         # ``label_max`` distinct tenants, OVERFLOW after (stable for a

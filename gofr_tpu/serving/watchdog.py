@@ -28,8 +28,11 @@ bound. The background monitor thread (production) is just
 from __future__ import annotations
 
 import threading
+
 import time
 from typing import Any, Callable, Optional
+
+from gofr_tpu.analysis import lockcheck
 
 
 class Watchdog:
@@ -59,7 +62,7 @@ class Watchdog:
             if check_interval_s is not None
             else max(0.05, min(self.bound_s / 4.0, 1.0))
         )
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("Watchdog._lock")
         self._last_pet = self._clock()
         self._tripped = False
         self._reason = ""

@@ -34,6 +34,21 @@ import socket
 import pytest
 
 
+from gofr_tpu.analysis import lockcheck
+
+if lockcheck.enabled():
+    # Lock-discipline validation (TPU_LOCKCHECK=1, e.g. the CI
+    # lockcheck-chaos step): every test starts with a fresh order graph
+    # and must end with zero recorded violations — an order inversion or
+    # a device sync under an instrumented lock anywhere in the test
+    # fails THAT test, with the acquisition stacks in the message.
+    @pytest.fixture(autouse=True)
+    def _lockcheck_clean():
+        lockcheck.reset()
+        yield
+        lockcheck.assert_clean()
+
+
 @pytest.fixture
 def free_port():
     def _get():

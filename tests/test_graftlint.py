@@ -1482,3 +1482,593 @@ def test_repo_clean_against_committed_baseline(monkeypatch, capsys):
         "graftlint gate failed — new findings or baseline drift:\n"
         + captured.out + captured.err
     )
+
+
+# ----------------------------------------------------------------------
+# the project index (GL020–GL022's shared substrate)
+# ----------------------------------------------------------------------
+
+
+def _index(tmp_path, files):
+    """Build a ProjectIndex from {relpath: source} the way run_paths
+    does — via core._load_file, so suppressions/paths match production."""
+    from gofr_tpu.analysis.core import _load_file
+    from gofr_tpu.analysis.project import ProjectIndex
+
+    loaded = []
+    for rel, source in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(source))
+        got = _load_file(str(p), root=str(tmp_path))
+        assert isinstance(got, tuple), f"parse failed for {rel}: {got}"
+        loaded.append(got)
+    return ProjectIndex.build(loaded)
+
+
+def test_project_index_groups_mixins_into_one_runtime_object(tmp_path):
+    index = _index(tmp_path, {
+        "serving/engine.py": """
+            class SchedulerMixin:
+                def loop(self):
+                    pass
+
+            class Engine(SchedulerMixin):
+                def submit(self):
+                    self.loop()
+        """,
+    })
+    # One composition group; self.loop() resolves into it.
+    (leader,) = [g for g, members in index.groups.items()
+                 if {"Engine", "SchedulerMixin"} <= members]
+    submit = index.functions["serving/engine.py::Engine.submit"]
+    assert submit.group == leader
+    callees = [c.callee for c in submit.calls]
+    assert "serving/engine.py::SchedulerMixin.loop" in callees
+
+
+def test_project_index_call_edges_and_import_shadowing(tmp_path):
+    index = _index(tmp_path, {
+        "serving/a.py": """
+            import os
+
+            def helper():
+                pass
+
+            class Widget:
+                def exists(self):
+                    pass
+
+                def run(self):
+                    helper()            # module-level function
+                    os.path.exists("x")  # library call — NOT Widget.exists
+        """,
+    })
+    run = index.functions["serving/a.py::Widget.run"]
+    resolved = {c.name: c.callee for c in run.calls}
+    assert resolved["helper"] == "serving/a.py::helper"
+    # `os` is an imported name: the unique-method fallback must not
+    # resolve os.path.exists to Widget.exists.
+    assert resolved.get("os.path.exists") is None
+
+
+def test_project_index_lock_regions_subtract_release_windows(tmp_path):
+    from gofr_tpu.analysis.project import lock_regions
+
+    index = _index(tmp_path, {
+        "serving/b.py": """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flip(self):
+                    with self._lock:
+                        a = 1
+                        self._lock.release()
+                        b = 2   # NOT held here
+                        self._lock.acquire()
+                        c = 3
+        """,
+    })
+    ctx = index.files["serving/b.py"]
+    tree = __import__("ast").parse(ctx.source)
+    fn = tree.body[1].body[1]  # Box.flip
+    (region,) = lock_regions(fn)
+    held = {line: region.holds_at(line) for line in range(10, 15)}
+    assert held[10] and held[14]         # a = 1, c = 3
+    assert not held[12]                  # b = 2 — inside the window
+
+
+def test_project_index_thread_roots_and_reachability(tmp_path):
+    index = _index(tmp_path, {
+        "serving/c.py": """
+            import threading
+
+            class Prober:
+                def start(self):
+                    threading.Thread(target=self._probe).start()
+                    t = threading.Thread(None, self._watch)
+                    t.start()
+
+                def _probe(self):
+                    self._tick()
+
+                def _watch(self):
+                    pass
+
+                def _tick(self):
+                    pass
+        """,
+    })
+    assert "serving/c.py::Prober._probe" in index.thread_roots
+    assert "serving/c.py::Prober._watch" in index.thread_roots
+    # _tick runs on the probe thread (and on no caller thread: only
+    # start() is public, and it never calls _tick directly).
+    roots = index.roots_of("serving/c.py::Prober._tick")
+    assert roots == frozenset({"_probe"})  # probe thread only, no caller
+
+
+def test_project_index_entry_locks_meet_over_call_sites(tmp_path):
+    index = _index(tmp_path, {
+        "serving/d.py": """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        self._step()
+
+                def flush(self):
+                    with self._lock:
+                        self._step()
+
+                def _step(self):
+                    pass
+
+                def _orphan(self):
+                    pass
+        """,
+    })
+    # Every call site holds _lock -> the helper inherits it on entry.
+    entry = index.entry_locks("serving/d.py::Ledger._step")
+    assert any(k.endswith("._lock") for k in entry)
+    # A never-called private helper gets no guarantee.
+    assert index.entry_locks("serving/d.py::Ledger._orphan") == frozenset()
+
+
+# ----------------------------------------------------------------------
+# GL020 — unguarded shared state
+# ----------------------------------------------------------------------
+
+
+def test_gl020_flags_lock_free_write_with_inferred_guard(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/pool.py",
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def add(self):
+                with self._lock:
+                    self._count += 1
+
+            def remove(self):
+                with self._lock:
+                    self._count -= 1
+
+            def reset(self):
+                self._count = 0  # lock-free, raced by the drain thread
+
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                self.remove()
+        """,
+        select=["GL020"],
+    )
+    assert ids == ["GL020"]
+    assert "_count" in findings[0].message
+    assert "inferred" in findings[0].message
+
+
+def test_gl020_declared_guard_flags_reads_too(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/gauge.py",
+        """
+        import threading
+
+        class Gauge:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._value = 0  # graftlint: guarded-by=_lock
+
+            def bump(self):
+                with self._lock:
+                    self._value += 1
+
+            def peek(self):
+                return self._value  # declared guard: reads count
+
+            def start(self):
+                threading.Thread(target=self.bump).start()
+        """,
+        select=["GL020"],
+    )
+    assert ids == ["GL020"]
+    assert "read" in findings[0].message
+    assert "declared" in findings[0].message
+
+
+def test_gl020_quiet_on_consistent_locking_and_single_thread(tmp_path):
+    # Consistent locking: clean.
+    ids, _ = _lint(
+        tmp_path, "serving/ok.py",
+        """
+        import threading
+
+        class Ok:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n -= 1
+
+            def start(self):
+                threading.Thread(target=self.a).start()
+        """,
+        select=["GL020"],
+    )
+    assert ids == []
+    # No second thread root: a lock-free write is single-threaded
+    # discipline, not a race — stay quiet.
+    ids, _ = _lint(
+        tmp_path, "serving/solo.py",
+        """
+        import threading
+
+        class Solo:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def a(self):
+                with self._lock:
+                    self._n += 1
+
+            def b(self):
+                with self._lock:
+                    self._n -= 1
+
+            def reset(self):
+                self._n = 0
+        """,
+        select=["GL020"],
+    )
+    assert ids == []
+
+
+def test_gl020_helper_called_under_lock_is_not_flagged(tmp_path):
+    # The `# Callers hold self._lock` idiom: every call site of _step
+    # holds the lock, so its write is covered by entry_locks.
+    ids, _ = _lint(
+        tmp_path, "serving/brown.py",
+        """
+        import threading
+
+        class Brownout:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._factor = 1.0
+
+            def tighten(self):
+                with self._lock:
+                    self._step(-0.1)
+
+            def relax(self):
+                with self._lock:
+                    self._step(0.1)
+
+            def _step(self, delta):
+                self._factor += delta
+
+            def start(self):
+                threading.Thread(target=self.tighten).start()
+        """,
+        select=["GL020"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL021 — lock-order inversion
+# ----------------------------------------------------------------------
+
+
+def test_gl021_flags_pool_engine_inversion(tmp_path):
+    # The pre-PR-4 shape: the submit path holds the engine's submit
+    # lock while reserving in the pool (engine -> pool), while the
+    # scaler's drain path holds the pool lock while cancelling in the
+    # engine (pool -> engine). Two threads, opposite order: deadlock
+    # under the wrong interleaving.
+    ids, findings = _lint(
+        tmp_path, "serving/pair.py",
+        """
+        import threading
+
+        class Engine:
+            def __init__(self, pool):
+                self._submit_lock = threading.Lock()
+                self._pool = pool
+
+            def submit(self):
+                with self._submit_lock:
+                    self._pool.reserve()
+
+            def cancel_all(self):
+                with self._submit_lock:
+                    pass
+
+        class Pool:
+            def __init__(self, engine):
+                self._lock = threading.Lock()
+                self._engine = engine
+
+            def reserve(self):
+                with self._lock:
+                    pass
+
+            def scale_down(self):
+                with self._lock:
+                    self._engine.cancel_all()
+        """,
+        select=["GL021"],
+    )
+    assert ids and set(ids) == {"GL021"}
+    joined = " ".join(f.message for f in findings)
+    assert "_submit_lock" in joined and "_lock" in joined
+
+
+def test_gl021_quiet_on_consistent_order_and_rlock_reentry(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/ordered.py",
+        """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+                self._re = threading.RLock()
+
+            def a(self):
+                with self._outer:
+                    with self._inner:
+                        pass
+
+            def b(self):
+                with self._outer:
+                    self._help()
+
+            def _help(self):
+                with self._inner:
+                    pass
+
+            def reenter(self):
+                with self._re:
+                    self._again()
+
+            def _again(self):
+                with self._re:
+                    pass
+        """,
+        select=["GL021"],
+    )
+    assert ids == []
+
+
+def test_gl021_flags_blocking_self_reacquisition_of_plain_lock(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/selfhang.py",
+        """
+        import threading
+
+        class SelfHang:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    pass
+        """,
+        select=["GL021"],
+    )
+    assert ids == ["GL021"]
+    assert "deadlock" in findings[0].message.lower()
+
+
+# ----------------------------------------------------------------------
+# GL022 — blocking call under a lock
+# ----------------------------------------------------------------------
+
+
+def test_gl022_flags_direct_and_transitive_blocking_under_lock(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/blocky.py",
+        """
+        import threading
+        import time
+        import urllib.request
+
+        class Blocky:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def direct(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def transitive(self):
+                with self._lock:
+                    self._fetch()
+
+            def _fetch(self):
+                urllib.request.urlopen("http://upstream")
+        """,
+        select=["GL022"],
+    )
+    assert ids == ["GL022", "GL022"]
+    assert "time.sleep" in findings[0].message
+    assert "_fetch" in findings[1].message or "urlopen" in findings[1].message
+
+
+def test_gl022_quiet_on_conditions_nonblocking_and_release_windows(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/fine.py",
+        """
+        import queue
+        import threading
+        import time
+
+        class Fine:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def waiter(self):
+                # Conditions exist to sleep while held: exempt.
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+
+            def poll(self):
+                with self._lock:
+                    item = self._q.get(block=False)
+                return item
+
+            def around(self):
+                with self._lock:
+                    self._lock.release()
+                    time.sleep(0.1)  # lock NOT held here
+                    self._lock.acquire()
+        """,
+        select=["GL022"],
+    )
+    assert ids == []
+
+
+def test_gl022_counters_named_queued_are_not_queues(tmp_path):
+    ids, _ = _lint(
+        tmp_path, "serving/counter.py",
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tenant_queued = {}
+
+            def depth(self, tenant):
+                with self._lock:
+                    return self._tenant_queued.get(tenant, 0)
+        """,
+        select=["GL022"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
+# GL005 regression — writes in release-around windows
+# ----------------------------------------------------------------------
+
+
+def test_gl005_flags_write_inside_release_window(tmp_path):
+    # PR 4's release-around shape: the lexical with-block no longer
+    # means "held" once the body releases — a write between release()
+    # and re-acquire() is a lock-free write (the old span-based check
+    # missed these).
+    ids, findings = _lint(
+        tmp_path, "serving/engine.py",  # GL005 scopes to hot-path files
+        """
+        import threading
+
+        class Window:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = "idle"
+
+            def run(self):
+                with self._lock:
+                    self._state = "running"
+
+            def handoff(self):
+                with self._lock:
+                    self._lock.release()
+                    self._state = "detached"  # lock NOT held
+                    self._lock.acquire()
+        """,
+        select=["GL005"],
+    )
+    assert ids == ["GL005"]
+    assert findings[0].line == 16
+    assert "_state" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# SARIF output
+# ----------------------------------------------------------------------
+
+
+def test_cli_sarif_format_and_exit_semantics(tmp_path, capsys, monkeypatch):
+    import json as jsonlib
+
+    bad = tmp_path / "serving" / "hot.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent(
+        """
+        def emit(tokens_dev):
+            return tokens_dev.item()
+        """
+    ))
+    (tmp_path / "pyproject.toml").write_text("")
+    monkeypatch.chdir(tmp_path)
+    rc = main(["serving", "--format=sarif", "--no-baseline", "--select=GL001"])
+    out = capsys.readouterr().out
+    assert rc == 1  # findings still fail the run — format is reporting only
+    log = jsonlib.loads(out)
+    assert log["version"] == "2.1.0"
+    (run,) = log["runs"]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    (result,) = run["results"]
+    assert result["ruleId"] == "GL001"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "serving/hot.py"
+    assert loc["region"]["startLine"] == 3
+    # Clean tree -> SARIF with zero results, exit 0.
+    good = tmp_path / "serving" / "cold.py"
+    good.write_text("x = 1\n")
+    rc = main(
+        ["serving/cold.py", "--format=sarif", "--no-baseline", "--select=GL001"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert jsonlib.loads(out)["runs"][0]["results"] == []

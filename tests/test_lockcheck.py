@@ -1,0 +1,245 @@
+"""Runtime lock-discipline validator suite (gofr_tpu/analysis/lockcheck).
+
+Interleavings are STATED, not raced: the order graph persists for the
+registry's lifetime, so the two halves of an inversion are driven
+sequentially — one thread runs A→B to completion, then another runs
+B→A — and the detector must still catch the deadlock the collision
+would have produced. No sleeps-as-synchronization anywhere.
+"""
+
+import threading
+
+import pytest
+
+from gofr_tpu.analysis import lockcheck
+from gofr_tpu.analysis.lockcheck import InstrumentedLock, LockCheckError
+
+_PLAIN_LOCK_TYPE = type(threading.Lock())
+
+
+@pytest.fixture(autouse=True)
+def _armed(monkeypatch):
+    """Arm the validator with a FRESH registry per test (the module
+    global would otherwise leak one test's order graph into the next)."""
+    monkeypatch.setenv("TPU_LOCKCHECK", "1")
+    monkeypatch.setattr(lockcheck, "_registry", None)
+    yield
+    monkeypatch.setattr(lockcheck, "_registry", None)
+
+
+def _run(fn):
+    """Run fn on its own thread to completion (distinct thread ident)."""
+    exc = []
+
+    def wrapped():
+        try:
+            fn()
+        except BaseException as e:  # surfaced below
+            exc.append(e)
+
+    t = threading.Thread(target=wrapped)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    if exc:
+        raise exc[0]
+
+
+# ----------------------------------------------------------------------
+# construction: the disabled path builds NOTHING
+# ----------------------------------------------------------------------
+
+
+def test_disabled_make_lock_returns_plain_lock(monkeypatch):
+    # This is the whole overhead story for the BENCH_LOOP A/B: with
+    # TPU_LOCKCHECK unset there is no wrapper to measure — make_lock
+    # hands back the exact primitive the code used before.
+    for off in ("0", "", "false", "no"):
+        monkeypatch.setenv("TPU_LOCKCHECK", off)
+        lock = lockcheck.make_lock("Engine._submit_lock")
+        assert type(lock) is _PLAIN_LOCK_TYPE
+    assert lockcheck._registry is None  # not even the registry exists
+    lockcheck.note_device_sync("window_fetch")  # one is-None test, no-op
+    assert lockcheck.violations() == []
+
+
+def test_enabled_make_lock_returns_instrumented_wrapper():
+    lock = lockcheck.make_lock("Pool._lock")
+    assert isinstance(lock, InstrumentedLock)
+    assert lock.name == "Pool._lock"
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+    assert lockcheck.violations() == []
+
+
+# ----------------------------------------------------------------------
+# order inversion
+# ----------------------------------------------------------------------
+
+
+def test_inversion_detected_across_sequential_threads():
+    a = lockcheck.make_lock("Engine._submit_lock")
+    b = lockcheck.make_lock("Pool._lock")
+
+    def forward():  # the submit path: engine -> pool
+        with a:
+            with b:
+                pass
+
+    def backward():  # the scaler path: pool -> engine
+        with b:
+            with a:
+                pass
+
+    _run(forward)
+    assert lockcheck.violations() == []  # one order alone is fine
+    _run(backward)
+    (v,) = lockcheck.violations()
+    assert v.kind == "order-inversion"
+    assert "Engine._submit_lock" in v.message
+    assert "Pool._lock" in v.message
+    assert v.held == ("Pool._lock",)
+    with pytest.raises(AssertionError, match="order-inversion"):
+        lockcheck.assert_clean()
+
+
+def test_transitive_inversion_through_a_middle_lock():
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    c = lockcheck.make_lock("C")
+
+    def one():  # A -> B
+        with a, b:
+            pass
+
+    def two():  # B -> C
+        with b, c:
+            pass
+
+    def three():  # C -> A closes the 3-cycle
+        with c, a:
+            pass
+
+    _run(one)
+    _run(two)
+    assert lockcheck.violations() == []
+    _run(three)
+    kinds = [v.kind for v in lockcheck.violations()]
+    assert "order-inversion" in kinds
+
+
+def test_consistent_global_order_stays_clean():
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    for _ in range(3):
+        _run(lambda: a.acquire() and b.acquire())
+        # release from the main thread (also exercises tolerance)
+        b.release()
+        a.release()
+    lockcheck.assert_clean()
+
+
+# ----------------------------------------------------------------------
+# self-deadlock: raise, don't hang
+# ----------------------------------------------------------------------
+
+
+def test_blocking_self_reacquisition_raises_instead_of_hanging():
+    lock = lockcheck.make_lock("Ledger._lock")
+    with lock:
+        with pytest.raises(LockCheckError, match="would deadlock"):
+            lock.acquire()
+    kinds = [v.kind for v in lockcheck.violations()]
+    assert kinds == ["self-deadlock"]
+
+
+def test_nonblocking_reacquisition_just_fails_like_a_lock():
+    lock = lockcheck.make_lock("Ledger._lock")
+    with lock:
+        assert lock.acquire(blocking=False) is False
+    # try-acquire losing is normal lock behavior, not a violation
+    assert lockcheck.violations() == []
+
+
+# ----------------------------------------------------------------------
+# device sync under a held lock
+# ----------------------------------------------------------------------
+
+
+def test_device_sync_under_lock_is_recorded():
+    lock = lockcheck.make_lock("SchedulerMixin._submit_lock")
+    lockcheck.note_device_sync("decode_window_fetch")
+    assert lockcheck.violations() == []  # nothing held: fine
+    with lock:
+        lockcheck.note_device_sync("decode_window_fetch")
+    (v,) = lockcheck.violations()
+    assert v.kind == "device-sync-under-lock"
+    assert "decode_window_fetch" in v.message
+    assert v.held == ("SchedulerMixin._submit_lock",)
+
+
+# ----------------------------------------------------------------------
+# cross-thread release (the profiler capture-slot idiom)
+# ----------------------------------------------------------------------
+
+
+def test_cross_thread_release_is_tolerated():
+    busy = lockcheck.make_lock("ProfilerCapture._busy")
+    other = lockcheck.make_lock("ProfilerCapture._state_lock")
+    assert busy.acquire(blocking=False)  # scheduler thread takes the slot
+    _run(busy.release)  # capture thread releases it
+    # The slot is free again and the holder stack is clean: a later
+    # acquisition under another lock must not see a stale entry.
+    with other:
+        with busy:
+            pass
+    lockcheck.assert_clean()
+
+
+# ----------------------------------------------------------------------
+# reset / assert_clean
+# ----------------------------------------------------------------------
+
+
+def test_reset_drops_violations_and_learned_order():
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    _run(lambda: (a.acquire(), b.acquire(), b.release(), a.release()))
+    lockcheck.reset()
+    # The old A->B edge must not indict the new order: one test's lock
+    # order must not leak into another's.
+    _run(lambda: (b.acquire(), a.acquire(), a.release(), b.release()))
+    lockcheck.assert_clean()
+
+
+def test_reset_keeps_preexisting_locks_connected():
+    # InstrumentedLock captures its registry at construction; reset()
+    # must clear that registry IN PLACE, not swap in a fresh one —
+    # otherwise every lock minted before the reset (module-level locks,
+    # engine fixtures from earlier tests) reports into a registry
+    # nobody reads and its violations vanish.
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    lockcheck.reset()
+    with a:
+        lockcheck.note_device_sync("post_reset_sync")
+    found = lockcheck.violations()
+    assert [v.kind for v in found] == ["device-sync-under-lock"]
+    lockcheck.reset()
+    _run(lambda: (a.acquire(), b.acquire(), b.release(), a.release()))
+    _run(lambda: (b.acquire(), a.acquire(), a.release(), b.release()))
+    assert [v.kind for v in lockcheck.violations()] == ["order-inversion"]
+
+
+def test_assert_clean_lists_every_violation():
+    a = lockcheck.make_lock("A")
+    b = lockcheck.make_lock("B")
+    _run(lambda: (a.acquire(), b.acquire(), b.release(), a.release()))
+    _run(lambda: (b.acquire(), a.acquire(), a.release(), b.release()))
+    with a:
+        lockcheck.note_device_sync("window")
+    with pytest.raises(AssertionError) as err:
+        lockcheck.assert_clean()
+    text = str(err.value)
+    assert "order-inversion" in text and "device-sync-under-lock" in text

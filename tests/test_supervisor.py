@@ -608,6 +608,26 @@ def test_stop_mid_recovery_fails_parked_requests(metrics):
         eng.stop_sync()
 
 
+def test_start_after_stop_resets_stopping_latch(metrics):
+    """A supervisor restarted after stop() must supervise again: start()
+    resets the ``_stopping`` latch (under ``_lock``, like every other
+    write to it — a lock-free reset could interleave into a concurrent
+    stop() between its flag write and its event set, resurrecting a
+    supervisor the operator is tearing down; this is the write GL020
+    caught). The observable contract: after start(), ``stopping`` is
+    False, so the scheduler's death drain offers salvage again."""
+    eng, sup, _ = _make_supervised(metrics)
+    try:
+        sup.stop()
+        assert sup.stopping
+        sup.start()
+        assert not sup.stopping
+        assert sup._thread is not None and sup._thread.is_alive()
+    finally:
+        sup.stop()
+        eng.stop_sync()
+
+
 def test_stable_period_resets_crash_loop_counter(metrics):
     """Two crashes separated by a stable period must each count from a
     fresh window (injectable clock states the stability, no sleeping)."""
