@@ -431,6 +431,28 @@ def run_paths(
     return out
 
 
+def build_index(
+    paths: Sequence[str],
+    config: Optional[LintConfig] = None,
+    root: Optional[str] = None,
+):
+    """Parse every Python file under ``paths`` and build the
+    :class:`~gofr_tpu.analysis.project.ProjectIndex` alone — no rules
+    run. For consumers that want the static concurrency model without
+    a lint pass (``/debug/lockgraph`` diffs it against the runtime
+    lock-order graph). Returns ``None`` when nothing parsed."""
+    from gofr_tpu.analysis.project import ProjectIndex
+
+    config = config or LintConfig()
+    parsed: list[tuple[FileContext, ast.Module]] = []
+    for path in iter_python_files(paths, config.exclude, root):
+        loaded = _load_file(path, root)
+        if loaded is None or isinstance(loaded, Finding):
+            continue
+        parsed.append(loaded)
+    return ProjectIndex.build(parsed) if parsed else None
+
+
 # ----------------------------------------------------------------------
 # baseline
 # ----------------------------------------------------------------------

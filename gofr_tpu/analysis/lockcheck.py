@@ -305,6 +305,34 @@ def reset() -> None:
         reg.clear()
 
 
+def order_graph() -> dict:
+    """The learned runtime lock-order graph, for ``/debug/lockgraph``.
+
+    Returns ``{"enabled": bool, "edges": {held: [acquired, ...]},
+    "witnesses": {"held -> acquired": "thread: stack"}}``. Edges are
+    every ``A→B`` ordering the validator has OBSERVED this process —
+    the dynamic counterpart of graftlint's static may-acquire model
+    (GL021), so an operator can diff what the code could do against
+    what this run actually did. Empty (enabled=False) when
+    ``TPU_LOCKCHECK`` is off."""
+    reg = _registry
+    if reg is None:
+        return {"enabled": False, "edges": {}, "witnesses": {}}
+    with reg._mu:
+        return {
+            "enabled": True,
+            "edges": {
+                held: sorted(acquired)
+                for held, acquired in sorted(reg._edges.items())
+                if acquired
+            },
+            "witnesses": {
+                f"{a} -> {b}": w
+                for (a, b), w in sorted(reg._witness.items())
+            },
+        }
+
+
 def assert_clean() -> None:
     """Raise AssertionError listing every recorded violation."""
     found = violations()

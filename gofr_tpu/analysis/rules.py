@@ -2508,6 +2508,58 @@ class UnguardedSharedStateRule(_ConcurrencyRule):
         return best
 
 
+def may_acquire_while_holding(
+    index: ProjectIndex,
+) -> dict[tuple[str, str], tuple[str, int, tuple[str, ...]]]:
+    """The static may-acquire-while-holding edge set GL021 runs cycle
+    detection over: ``(held, acquired) -> (path, line, chain)`` — one
+    example site per ordered pair where ``acquired`` is taken (directly
+    or transitively through the call graph) inside a ``with held:``
+    region. Shared with ``/debug/lockgraph``, which diffs this model
+    against the runtime graph ``lockcheck.order_graph()`` learned."""
+    witness: dict[tuple[str, str], tuple[str, int, tuple[str, ...]]] = {}
+    for fn in index.functions.values():
+        for held_key, region in fn.regions:
+            if held_key.startswith("?."):
+                continue
+            # nested acquisitions in the same function body
+            for acq in fn.acquisitions:
+                if acq.lock == held_key or acq.lock.startswith("?."):
+                    continue
+                if region.holds_at(acq.line) or (
+                    region.lineno < acq.line <= region.end_lineno
+                ):
+                    witness.setdefault(
+                        (held_key, acq.lock),
+                        (acq.path, acq.line, (fn.name,)),
+                    )
+            # transitive acquisitions through calls made under the
+            # *lexical* region — deliberately ignoring manual
+            # release windows: a release-around seam still relies
+            # on timing, and the finding's inline disable is where
+            # that reliance gets documented.
+            for call in fn.calls:
+                if call.callee is None:
+                    continue
+                if not (
+                    region.lineno < call.line <= region.end_lineno
+                ):
+                    continue
+                for lock, chain in index.may_acquire(
+                    call.callee
+                ).items():
+                    # lock == held_key stays IN: re-acquiring a
+                    # plain Lock through a call chain is a self-
+                    # deadlock (_cycle_findings exempts RLocks).
+                    if lock.startswith("?."):
+                        continue
+                    witness.setdefault(
+                        (held_key, lock),
+                        (call.path, call.line, (fn.name,) + chain),
+                    )
+    return witness
+
+
 class LockOrderInversionRule(_ConcurrencyRule):
     """Two locks acquired in opposite orders on two code paths deadlock
     the moment both paths run concurrently — the exact hazard PR 4
@@ -2533,49 +2585,9 @@ class LockOrderInversionRule(_ConcurrencyRule):
     )
 
     def check_project(self, index: ProjectIndex) -> Iterator[Finding]:
-        # witness[(L, M)] = (path, line, chain) — one example site where
-        # M is acquired while L is held.
-        witness: dict[tuple[str, str], tuple[str, int, tuple[str, ...]]] = {}
-        for fn in index.functions.values():
-            for held_key, region in fn.regions:
-                if held_key.startswith("?."):
-                    continue
-                # nested acquisitions in the same function body
-                for acq in fn.acquisitions:
-                    if acq.lock == held_key or acq.lock.startswith("?."):
-                        continue
-                    if region.holds_at(acq.line) or (
-                        region.lineno < acq.line <= region.end_lineno
-                    ):
-                        witness.setdefault(
-                            (held_key, acq.lock),
-                            (acq.path, acq.line, (fn.name,)),
-                        )
-                # transitive acquisitions through calls made under the
-                # *lexical* region — deliberately ignoring manual
-                # release windows: a release-around seam still relies
-                # on timing, and the finding's inline disable is where
-                # that reliance gets documented.
-                for call in fn.calls:
-                    if call.callee is None:
-                        continue
-                    if not (
-                        region.lineno < call.line <= region.end_lineno
-                    ):
-                        continue
-                    for lock, chain in index.may_acquire(
-                        call.callee
-                    ).items():
-                        # lock == held_key stays IN: re-acquiring a
-                        # plain Lock through a call chain is a self-
-                        # deadlock (_cycle_findings exempts RLocks).
-                        if lock.startswith("?."):
-                            continue
-                        witness.setdefault(
-                            (held_key, lock),
-                            (call.path, call.line, (fn.name,) + chain),
-                        )
-        yield from self._cycle_findings(index, witness)
+        yield from self._cycle_findings(
+            index, may_acquire_while_holding(index)
+        )
 
     def _cycle_findings(
         self,

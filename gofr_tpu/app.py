@@ -287,6 +287,45 @@ class App:
 
     # -- metrics endpoint ---------------------------------------------------
 
+    def _static_lockgraph(self) -> dict:
+        """GL021's static may-acquire-while-holding model over this
+        installed package, built once per process and cached (it
+        re-parses every module): ``{"edges": {(held, acquired):
+        (path, line)}, "witnesses": {...}}``. Empty on any failure —
+        /debug/lockgraph degrades to runtime-only, never 500s."""
+        cached = getattr(self, "_static_lockgraph_cache", None)
+        if cached is not None:
+            return cached
+        graph: dict = {"edges": {}, "witnesses": {}}
+        try:
+            import os as _os
+
+            import gofr_tpu as _pkg
+            from gofr_tpu.analysis.core import build_index
+            from gofr_tpu.analysis.rules import may_acquire_while_holding
+
+            pkg_dir = _os.path.dirname(_os.path.abspath(_pkg.__file__))
+            index = build_index([pkg_dir], root=_os.path.dirname(pkg_dir))
+            if index is not None:
+                witness = may_acquire_while_holding(index)
+                graph = {
+                    "edges": {
+                        pair: (path, line)
+                        for pair, (path, line, _) in witness.items()
+                    },
+                    "witnesses": {
+                        f"{a} -> {b}": (
+                            f"{path}:{line} via {' -> '.join(chain)}"
+                        )
+                        for (a, b), (path, line, chain)
+                        in sorted(witness.items())
+                    },
+                }
+        except Exception:  # noqa: BLE001 — debug surface, never 500
+            pass
+        self._static_lockgraph_cache = graph
+        return graph
+
     def _metrics_handler(self):
         from gofr_tpu.http.proto import Response
         from gofr_tpu.metrics import render_prometheus
@@ -430,6 +469,69 @@ class App:
                 # where a scheduler pass's wall time goes, without an
                 # operator having to know when to run /debug/tpu-trace.
                 return engine_report("loop_report")
+            if path == "/debug/control":
+                # Control-plane state (docs/advanced-guide/
+                # resilience.md "Control plane"): per-signal guard
+                # status (ok / last_good / observe_only), each loop's
+                # state — the per-tenant brownout table, host-pressure
+                # and predictive hold-down timers — and the last
+                # decisions ring. The operator's one read for "which
+                # loop acted, on what evidence, and which sensors is
+                # it no longer trusting".
+                return engine_report("control_report")
+            if path == "/debug/lockgraph":
+                # Lock-order graphs (docs/advanced-guide/
+                # resilience.md): the RUNTIME order graph TPU_LOCKCHECK
+                # learned this process, the STATIC may-acquire-while-
+                # holding model graftlint's GL021 derives from the AST,
+                # and their diff — a runtime edge the static model
+                # lacks means the model under-approximates (or a lock
+                # bypassed make_lock); a static edge never observed is
+                # untested ordering, not a bug. The static half is
+                # built once and cached (it parses the package).
+                import json as _json
+
+                from gofr_tpu.analysis import lockcheck as _lockcheck
+
+                runtime = _lockcheck.order_graph()
+                static = self._static_lockgraph()
+                run_edges = {
+                    (a, b)
+                    for a, bs in runtime["edges"].items() for b in bs
+                }
+                static_edges = set(static["edges"])
+                body = {
+                    "runtime": runtime,
+                    "static": {
+                        "edges": sorted(
+                            f"{a} -> {b}" for a, b in static_edges
+                        ),
+                        "witnesses": static["witnesses"],
+                    },
+                    "diff": {
+                        "runtime_only": sorted(
+                            f"{a} -> {b}"
+                            for a, b in run_edges - static_edges
+                        ),
+                        "static_only": sorted(
+                            f"{a} -> {b}"
+                            for a, b in static_edges - run_edges
+                        ),
+                    },
+                    "violations": [
+                        {
+                            "kind": v.kind,
+                            "thread": v.thread,
+                            "message": v.message,
+                        }
+                        for v in _lockcheck.violations()
+                    ],
+                }
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(body).encode(),
+                )
             if path == "/ops/tier-import":
                 # Wire-leg tier transfers (docs/advanced-guide/
                 # resilience.md "Disaggregated prefill/decode"): a

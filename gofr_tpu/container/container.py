@@ -435,9 +435,9 @@ class Container:
         m.new_gauge(
             "app_tpu_loop_phase_seconds",
             "scheduler-loop pass wall seconds by phase (phase=reap|"
-            "ledger|brownout|sweep|tier_import|prefill|emit_flush|"
-            "dispatch|device_window|idle|other; sums to pass wall "
-            "time)",
+            "ledger|brownout|control|sweep|tier_import|prefill|"
+            "emit_flush|dispatch|device_window|idle|other; sums to "
+            "pass wall time)",
         )
         m.new_gauge(
             "app_tpu_loop_utilization",
@@ -453,6 +453,35 @@ class Container:
             "app_tpu_loop_stalls_total",
             "scheduler-loop stall anomalies (pass over TPU_LOOP_STALL_S "
             "or TPU_LOOP_STALL_FACTOR x rolling p95; kind=absolute|p95)",
+        )
+        # Control plane (serving/control_plane.py; docs/advanced-guide/
+        # resilience.md "Control plane"): per-signal guard health, the
+        # per-tenant brownout ladder (label set bounded by the ladder
+        # table cap, not by traffic), advertised scale pressure, and
+        # the per-loop action counters — all bounded vocabularies.
+        m.new_gauge(
+            "app_tpu_control_signal_health",
+            "control-plane signal guard health (signal=<registered "
+            "name>; 1.0 = fresh+finite, 0.5 = riding last-good value, "
+            "0.0 = observe-only: the loop it feeds holds state)",
+        )
+        m.new_gauge(
+            "app_tpu_control_tenant_level",
+            "per-tenant brownout ladder level (0 = nominal .. 3 = "
+            "full shed for that tenant; bounded by "
+            "TPU_CONTROL_TENANT_TABLE)",
+        )
+        m.new_gauge(
+            "app_tpu_control_scale_pressure",
+            "control-plane scale pressure advertised to the pool "
+            "scaler (source=host|predictive; 1 while the loop holds "
+            "sustained pressure)",
+        )
+        m.new_counter(
+            "app_tpu_control_actions_total",
+            "control-plane actions (loop=tenant_brownout|"
+            "host_pressure|predictive, action=enter|exit|clamp_tokens|"
+            "thin_admit|shed|scale_pressure)",
         )
 
     def push_system_metrics(self) -> None:

@@ -25,6 +25,12 @@ fires unconditionally and tests arm selectively:
 * ``tier.import``         — in ``engine.handoff_prefilled`` on the
   decode replica: raise = the importer rejecting the shipped blocks
   (pool pressure / version mismatch)
+* ``control.signal``      — per control-plane signal read
+  (``serving/control_plane.py``; kwarg ``signal`` names it): raise =
+  the sensor throwing; return ``"stale"`` = no fresh sample this pass;
+  return a float (NaN included) = the sensor lying with that value.
+  The control plane's guard must absorb every mode — last-good value,
+  then observe-only — without a crash or a 5xx
 
 Unarmed, ``fire`` is one dict read (the serving hot path pays nothing
 measurable). Armed, a point either **raises** the configured exception

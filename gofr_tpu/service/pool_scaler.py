@@ -46,6 +46,7 @@ pool's ``app_tpu_pool_replicas{state}`` gauge refresh every sweep.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -69,6 +70,7 @@ class PoolScaler:
         down_load_per_replica: float = 0.5,
         up_headroom_floor: float = 0.0,
         up_on_brownout: bool = True,
+        up_on_control: bool = True,
         scale_up_wait_s: float = 10.0,
         scale_down_wait_s: float = 60.0,
         drain_timeout_s: float = 30.0,
@@ -101,6 +103,13 @@ class PoolScaler:
         # sees. Sustained through the same scale_up_wait_s window, so a
         # short burn spike spawns nothing.
         self.up_on_brownout = bool(up_on_brownout)
+        # Control-plane-aware scale-up (TPU_SCALE_UP_CONTROL, default
+        # on): a serving replica whose control plane asserts pressure
+        # (sustained host-overhead saturation, or the predictive
+        # queue-trend fit projecting a breach) counts as pressure —
+        # the predictive loop is what lets the pool spawn BEFORE the
+        # reactive sustained-threshold signals trip.
+        self.up_on_control = bool(up_on_control)
         self.scale_up_wait_s = float(scale_up_wait_s)
         self.scale_down_wait_s = float(scale_down_wait_s)
         self.drain_timeout_s = float(drain_timeout_s)
@@ -138,8 +147,12 @@ class PoolScaler:
         absence of the signal must not read as pressure."""
         if self.up_headroom_floor <= 0:
             return None
+        # A non-finite advertisement (a remote echoing NaN telemetry)
+        # is a lying sensor, not pressure — same as None (ISSUE 17
+        # threshold-wiring audit).
         ratios = [
-            h for r in capacity for h in (r.headroom(),) if h is not None
+            h for r in capacity for h in (r.headroom(),)
+            if h is not None and math.isfinite(h)
         ]
         if not ratios:
             return None
@@ -161,6 +174,23 @@ class PoolScaler:
             return None
         worst = max(levels)
         return worst if worst >= 2 else None
+
+    def _max_control(self, capacity: list[Replica]) -> Optional[int]:
+        """1 when any serving replica's control plane asserts scale-up
+        pressure (host-overhead or predictive loop), else None.
+        None-advertising replicas (plane off, remotes before their
+        first probe) don't count — absence of the signal must not read
+        as pressure."""
+        if not self.up_on_control:
+            return None
+        flags = [
+            p for r in capacity
+            for p in (r.control_pressure(),) if p is not None
+        ]
+        if not flags:
+            return None
+        worst = max(flags)
+        return worst if worst >= 1 else None
 
     def load_per_replica(self) -> float:
         """Aggregate outstanding work over serving capacity — the
@@ -193,10 +223,12 @@ class PoolScaler:
 
         low_headroom = self._min_headroom(capacity)
         hot_brownout = self._max_brownout(capacity)
+        hot_control = self._max_control(capacity)
         if (
             load > self.up_load_per_replica
             or low_headroom is not None
             or hot_brownout is not None
+            or hot_control is not None
         ):
             self._idle_since = None
             if self._pressure_since is None:
@@ -220,6 +252,11 @@ class PoolScaler:
                     reason = (
                         f"brownout level {hot_brownout} (L2+ sheds "
                         f"admissions) for {self.scale_up_wait_s:.0f}s"
+                    )
+                elif hot_control is not None:
+                    reason = (
+                        f"control-plane scale pressure (host-overhead/"
+                        f"predictive loop) for {self.scale_up_wait_s:.0f}s"
                     )
                 return self._scale_up(now, reason=reason)
             return "steady"
@@ -344,6 +381,7 @@ class PoolScaler:
             "down_load_per_replica": self.down_load_per_replica,
             "up_headroom_floor": self.up_headroom_floor,
             "up_on_brownout": self.up_on_brownout,
+            "up_on_control": self.up_on_control,
             "scale_up_wait_s": self.scale_up_wait_s,
             "scale_down_wait_s": self.scale_down_wait_s,
             "spawned": [r.name for r in self._spawned],

@@ -269,6 +269,16 @@ class Replica:
         against this replica; the scaler counts L2+ as pressure."""
         return None
 
+    def control_pressure(self) -> Optional[int]:
+        """The replica's control-plane scale-up advertisement
+        (``serving/control_plane.py``: 1 while the host-overhead or
+        predictive loop asserts pressure) or ``None`` when unknown /
+        the plane is off — in-proc replicas read their engine, remote
+        ones cache the health payload's ``control`` detail from the
+        last probe. The scaler counts 1 as pressure
+        (``TPU_SCALE_UP_CONTROL``)."""
+        return None
+
     def describe(self) -> dict:
         return {
             "state": self.state(),
@@ -390,6 +400,16 @@ class EngineReplica(Replica):
         except Exception:  # noqa: BLE001 — advertisement is a routing hint only
             return None
         return None if n is None else int(n)
+
+    def control_pressure(self) -> Optional[int]:
+        pressure = getattr(self.engine, "control_scale_pressure", None)
+        if not callable(pressure):
+            return None
+        try:
+            p = pressure()
+        except Exception:  # noqa: BLE001 — advertisement is a routing hint only
+            return None
+        return None if p is None else int(p)
 
     def load_adapter(self, name: str, source: Any) -> bool:
         try:
@@ -571,6 +591,7 @@ class HTTPReplica(Replica):
         self._hbm_headroom: Optional[float] = None
         self._slo_compliant: Optional[bool] = None
         self._brownout_level: Optional[int] = None
+        self._control_pressure: Optional[int] = None
         self._handoff: Optional[Callable[[Any], bool]] = None
 
     def state(self) -> str:
@@ -594,6 +615,9 @@ class HTTPReplica(Replica):
 
     def brownout_level(self) -> Optional[int]:
         return self._brownout_level
+
+    def control_pressure(self) -> Optional[int]:
+        return self._control_pressure
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -1243,6 +1267,19 @@ class HTTPReplica(Replica):
         )
         self._brownout_level = (
             int(level) if isinstance(level, (int, float)) else None
+        )
+        # Control-plane advertisement (serving/control_plane.py): the
+        # remote's scale-pressure bit, so this pool's scaler sees the
+        # host-overhead/predictive verdict — same unconditional-assign
+        # discipline (a probe after the remote disabled its plane must
+        # clear the cached flag, not hold it forever).
+        control = details.get("control")
+        pressure = (
+            control.get("scale_pressure")
+            if isinstance(control, dict) else None
+        )
+        self._control_pressure = (
+            int(pressure) if isinstance(pressure, (int, float)) else None
         )
         if (
             self._brownout_level is not None

@@ -332,6 +332,14 @@ def _new_tpu_pool_from_config(
             up_on_brownout=config.get_or_default(
                 "TPU_SCALE_UP_BROWNOUT", "1"
             ).lower() not in ("0", "false", "no"),
+            # Control-plane scale-up (serving/control_plane.py): a
+            # replica whose host-overhead or predictive loop holds
+            # scale pressure is asking for capacity BEFORE the queue
+            # shows it. Default on; the signal only exists when
+            # TPU_CONTROL_PLANE is armed.
+            up_on_control=config.get_or_default(
+                "TPU_SCALE_UP_CONTROL", "1"
+            ).lower() not in ("0", "false", "no"),
             scale_up_wait_s=float(config.get_or_default(
                 "TPU_SCALE_UP_WAIT_S", "10"
             )),

@@ -63,6 +63,7 @@ Discipline:
 from __future__ import annotations
 
 
+import math
 import time
 from typing import Any, Callable, Mapping, Optional
 
@@ -196,6 +197,7 @@ class BrownoutController:
             headroom_pressure = (
                 self.min_headroom > 0.0
                 and headroom is not None
+                and math.isfinite(headroom)
                 and headroom < self.min_headroom
             )
             over = burn_5m >= self.enter_burn or headroom_pressure

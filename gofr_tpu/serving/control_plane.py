@@ -1,0 +1,957 @@
+"""The fault-tolerant control plane: every signal, one seam (ISSUE 17).
+
+PRs 10–14 grew a rich sensor suite — HBM headroom, per-tenant burn
+rates, the brownout ladder, queue depth/throughput, the loop profiler's
+``host_overhead_ratio`` — but the closed loops were four ad-hoc
+threshold wirings and several signals stayed observe-only. This module
+is the one deterministic controller that ingests every signal through a
+typed :class:`SignalSource` registry and drives every actuator through
+one seam, closing three loops the sensors already paid for:
+
+* **Per-tenant brownout** — per-tenant SLO burn rates (``slo.py``'s
+  tenant-tracked rings, judged against the GLOBAL objectives) drive a
+  per-tenant degradation ladder mirroring ``brownout.py``'s discipline:
+  L1 clamps the burning tenant's ``max_new_tokens``, L2 thins its
+  admissions with a deterministic AIMD credit (fraction
+  ``budget_factor × CLASS_ADMIT_FRACTION``), L3 sheds its new work
+  (429 ``reason=tenant_brownout``). The hog degrades; every other
+  tenant's streams stay byte-identical and the POD ladder stays at L0.
+* **Host-overhead pressure** — sustained high ``host_overhead_ratio``
+  at high loop utilization (the scheduler is busy doing bookkeeping,
+  not feeding the device) asserts scale-up pressure through the same
+  hysteretic sustain-window discipline as every other loop (GL017).
+* **Predictive scaling** — a bounded-window least-squares fit over
+  queue-depth samples projects the depth ``horizon_s`` ahead; a
+  positive trend crossing the threshold asserts scale-up pressure
+  BEFORE the sustained-threshold breach the reactive scaler waits for.
+  Stated-clock testable; a hold-down timer stops flapping.
+
+**Robustness is the headline.** Every signal read is wrapped in a
+staleness/NaN/exception guard: a sensor that goes stale, returns
+non-finite values, or raises moves its consumers to last-good-value
+(within ``TPU_CONTROL_STALE_S``) and then to **observe-only** — the
+loop's actuators all return neutral (no clamp, admit everything, no
+pressure), so a lying sensor can never cause a crash, a wedged
+scheduler pass, or a 5xx. The degraded-sensor set exports as
+``app_tpu_control_signal_health{signal}`` (1 = healthy, 0.5 = serving
+last-good, 0 = observe-only) and on ``/debug/control`` next to
+per-loop state, last decisions, and hold-down timers. The ``faults``
+harness's ``control.signal`` point (stale / NaN / raise / flap) lets
+chaos tests prove each guard.
+
+Discipline (shared with ``brownout.py``/``loop_profiler.py``):
+
+* one evaluation per scheduler pass, one clock read (GL011);
+* hysteresis with sustain-window anchors everywhere (GL017);
+* injectable clock — tests state time, never sleep;
+* **off is off**: ``TPU_CONTROL_PLANE=0`` builds nothing, every hook
+  degrades to one ``is not None``, and with no tenant above L0 /
+  no pressure asserted the actuators are byte-identically neutral.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Any, Callable, Mapping, Optional, Union
+
+from gofr_tpu import faults
+from gofr_tpu.analysis import lockcheck
+from gofr_tpu.serving.brownout import CLASS_ADMIT_FRACTION, MAX_LEVEL
+
+#: Signal health gauge values (``app_tpu_control_signal_health``).
+HEALTH_OK = 1.0          #: fresh, finite sample this pass
+HEALTH_LAST_GOOD = 0.5   #: degraded but serving last-good (still acting)
+HEALTH_OBSERVE_ONLY = 0.0  #: past the stale window — loop is neutral
+
+#: A signal's sampled value: a scalar or a per-tenant map.
+SignalValue = Union[float, dict[str, float]]
+
+
+class SignalSource:
+    """One registered sensor: a name, a zero-arg read callable, a type
+    (``scalar`` | ``map``), and the guard state the control plane
+    maintains around it (last-good value, staleness, health)."""
+
+    __slots__ = (
+        "name", "read", "kind", "stale_after_s",
+        "last_good", "last_good_at", "status", "errors", "last_error",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        read: Callable[[], Any],
+        *,
+        kind: str = "scalar",
+        stale_after_s: float = 10.0,
+    ) -> None:
+        if kind not in ("scalar", "map"):
+            raise ValueError(f"unknown signal kind {kind!r}")
+        self.name = name
+        self.read = read
+        self.kind = kind
+        self.stale_after_s = max(0.0, float(stale_after_s))
+        self.last_good: Optional[SignalValue] = None
+        self.last_good_at: Optional[float] = None
+        #: "ok" | "last_good" | "observe_only"
+        self.status = "ok"
+        self.errors = 0
+        self.last_error = ""
+
+    def health(self) -> float:
+        if self.status == "ok":
+            return HEALTH_OK
+        if self.status == "last_good":
+            return HEALTH_LAST_GOOD
+        return HEALTH_OBSERVE_ONLY
+
+
+class _Reading:
+    """One pass's guarded sample of one signal."""
+
+    __slots__ = ("value", "usable", "fresh")
+
+    def __init__(
+        self, value: Optional[SignalValue], usable: bool, fresh: bool
+    ) -> None:
+        self.value = value
+        #: May a loop ACT on this value? (fresh, or last-good within
+        #: the stale window). False → the consuming loop observes only.
+        self.usable = usable
+        self.fresh = fresh
+
+
+def _validate(kind: str, raw: Any) -> SignalValue:
+    """Clamp a sensor's raw return to its declared type; raises on
+    anything non-finite (a lying sensor is an error, not a value)."""
+    if kind == "scalar":
+        value = float(raw)
+        if not math.isfinite(value):
+            raise ValueError(f"non-finite scalar {value!r}")
+        return value
+    if not isinstance(raw, Mapping):
+        raise TypeError(f"map signal returned {type(raw).__name__}")
+    out: dict[str, float] = {}
+    for key, v in raw.items():
+        f = float(v)
+        if not math.isfinite(f):
+            raise ValueError(f"non-finite value for {key!r}")
+        out[str(key)] = f
+    return out
+
+
+class _TenantLadder:
+    """One tenant's degradation ladder state (the per-tenant mirror of
+    ``BrownoutController``'s hysteresis + AIMD, small enough to keep a
+    bounded table of)."""
+
+    __slots__ = (
+        "level", "budget_factor", "credit", "over_since", "clear_since",
+        "last_burn",
+    )
+
+    def __init__(self) -> None:
+        self.level = 0
+        self.budget_factor = 1.0
+        #: L2 admission credit: each submit adds the tenant's admit
+        #: fraction; a request is admitted when a full credit is
+        #: banked. Deterministic thinning — no randomness.
+        self.credit = 1.0
+        self.over_since: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.last_burn = 0.0
+
+
+class TenantBrownoutLoop:
+    """Per-tenant burn → per-tenant ladder. All state mutation happens
+    under the owning :class:`ControlPlane`'s lock."""
+
+    def __init__(
+        self,
+        *,
+        enter_burn: float = 2.0,
+        exit_burn: float = 1.0,
+        sustain_s: float = 10.0,
+        exit_sustain_s: float = 30.0,
+        max_new_tokens: int = 256,
+        aimd_cut: float = 0.5,
+        recover_per_s: float = 0.02,
+        table_max: int = 64,
+    ) -> None:
+        self.enter_burn = max(0.0, float(enter_burn))
+        self.exit_burn = min(self.enter_burn, max(0.0, float(exit_burn)))
+        self.sustain_s = max(0.0, float(sustain_s))
+        self.exit_sustain_s = max(0.0, float(exit_sustain_s))
+        self.max_new_tokens = max(0, int(max_new_tokens))
+        self.aimd_cut = min(1.0, max(0.05, float(aimd_cut)))
+        self.recover_per_s = max(1e-4, float(recover_per_s))
+        self.table_max = max(1, int(table_max))
+        self.table: dict[str, _TenantLadder] = {}
+        self.transitions = {"up": 0, "down": 0}
+
+    def evaluate(
+        self, burns: Mapping[str, float], now: float, dt: float
+    ) -> list[tuple[str, int, int]]:
+        """One control decision per tenant; returns the transitions
+        ``(tenant, prev_level, new_level)`` this pass made. Tenants in
+        the table but absent from ``burns`` read burn 0 (idle tenants
+        recover); tenants beyond the table bound are ignored (bounded
+        memory beats complete coverage of a label-cardinality attack).
+        """
+        moves: list[tuple[str, int, int]] = []
+        seen = set(self.table)
+        for tenant, burn in burns.items():
+            ladder = self.table.get(tenant)
+            if ladder is None:
+                if len(self.table) >= self.table_max:
+                    continue
+                ladder = self.table[tenant] = _TenantLadder()
+            seen.discard(tenant)
+            self._step_tenant(tenant, ladder, burn, now, dt, moves)
+        for tenant in seen:
+            self._step_tenant(
+                tenant, self.table[tenant], 0.0, now, dt, moves
+            )
+        # Drop fully-recovered idle entries so the table stays
+        # O(misbehaving tenants), not O(every tenant ever seen).
+        for tenant in [
+            t for t, lad in self.table.items()
+            if lad.level == 0 and lad.budget_factor >= 1.0
+            and t not in burns
+        ]:
+            del self.table[tenant]
+        return moves
+
+    def _step_tenant(
+        self,
+        tenant: str,
+        ladder: _TenantLadder,
+        burn: float,
+        now: float,
+        dt: float,
+        moves: list[tuple[str, int, int]],
+    ) -> None:
+        ladder.last_burn = float(burn)
+        over = burn >= self.enter_burn
+        clear = burn <= self.exit_burn
+        if not over and ladder.budget_factor < 1.0:
+            ladder.budget_factor = min(
+                1.0, ladder.budget_factor + self.recover_per_s * dt
+            )
+        if over:
+            ladder.clear_since = None
+            if ladder.over_since is None:
+                ladder.over_since = now
+            elif (
+                now - ladder.over_since >= self.sustain_s
+                and ladder.level < MAX_LEVEL
+            ):
+                moves.append(
+                    (tenant, ladder.level, self._move(ladder, +1))
+                )
+                ladder.over_since = now  # re-arm for the next rung
+        elif clear:
+            ladder.over_since = None
+            if ladder.clear_since is None:
+                ladder.clear_since = now
+            elif (
+                now - ladder.clear_since >= self.exit_sustain_s
+                and ladder.level > 0
+            ):
+                moves.append(
+                    (tenant, ladder.level, self._move(ladder, -1))
+                )
+                ladder.clear_since = now
+        else:
+            # Hysteresis dead band: hold, reset both anchors (GL017 —
+            # band time counts toward neither sustain window).
+            ladder.over_since = None
+            ladder.clear_since = None
+
+    def _move(self, ladder: _TenantLadder, direction: int) -> int:
+        prev = ladder.level
+        ladder.level = min(MAX_LEVEL, max(0, ladder.level + direction))
+        if ladder.level != prev:
+            if direction > 0 and ladder.level >= 2:
+                ladder.budget_factor = max(
+                    0.01, ladder.budget_factor * self.aimd_cut
+                )
+                ladder.credit = 1.0  # L2 entry: first request admits
+            if ladder.level == 0:
+                ladder.budget_factor = 1.0  # byte-identity at L0
+            self.transitions["up" if direction > 0 else "down"] += 1
+        return ladder.level
+
+
+class HostPressureLoop:
+    """Sustained high host-overhead ratio at high utilization →
+    scale-up pressure. The exit threshold sits a fixed margin below the
+    enter one (hysteresis band)."""
+
+    EXIT_MARGIN = 0.1
+
+    def __init__(
+        self,
+        *,
+        ratio: float = 0.85,
+        util: float = 0.75,
+        sustain_s: float = 30.0,
+    ) -> None:
+        self.ratio = min(1.0, max(0.0, float(ratio)))
+        self.util = min(1.0, max(0.0, float(util)))
+        self.exit_ratio = max(0.0, self.ratio - self.EXIT_MARGIN)
+        self.sustain_s = max(0.0, float(sustain_s))
+        self.pressure = False
+        self.over_since: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.last_ratio = 0.0
+        self.last_util = 0.0
+
+    def evaluate(self, ratio: float, util: float, now: float) -> bool:
+        self.last_ratio = float(ratio)
+        self.last_util = float(util)
+        over = ratio >= self.ratio and util >= self.util
+        clear = ratio <= self.exit_ratio or util < self.util
+        if over:
+            self.clear_since = None
+            if self.over_since is None:
+                self.over_since = now
+            elif now - self.over_since >= self.sustain_s:
+                self.pressure = True
+        elif clear:
+            self.over_since = None
+            if self.clear_since is None:
+                self.clear_since = now
+            elif now - self.clear_since >= self.sustain_s:
+                self.pressure = False
+        else:
+            self.over_since = None
+            self.clear_since = None
+        return self.pressure
+
+
+class PredictiveLoop:
+    """Queue-depth trend fit → early scale-up pressure. A bounded
+    sample window, a least-squares slope, and a fixed projection
+    horizon: fire when the projected depth crosses the threshold while
+    the trend is rising. Deterministic from the stated clock."""
+
+    MIN_SAMPLES = 4
+
+    def __init__(
+        self,
+        *,
+        window_s: float = 60.0,
+        horizon_s: float = 30.0,
+        depth_threshold: float = 64.0,
+        hold_s: float = 30.0,
+    ) -> None:
+        self.window_s = max(1.0, float(window_s))
+        self.horizon_s = max(1.0, float(horizon_s))
+        self.depth_threshold = max(1.0, float(depth_threshold))
+        self.hold_s = max(0.0, float(hold_s))
+        self.samples: deque[tuple[float, float]] = deque()
+        self.pressure = False
+        self.fired_at: Optional[float] = None
+        self.last_slope = 0.0
+        self.last_projected = 0.0
+        self.last_throughput = 0.0
+
+    def evaluate(
+        self, depth: float, throughput: float, now: float
+    ) -> bool:
+        self.last_throughput = float(throughput)
+        self.samples.append((now, float(depth)))
+        horizon = now - self.window_s
+        while self.samples and self.samples[0][0] < horizon:
+            self.samples.popleft()
+        slope = self._slope()
+        self.last_slope = slope
+        projected = depth + slope * self.horizon_s
+        self.last_projected = projected
+        if (
+            len(self.samples) >= self.MIN_SAMPLES
+            and slope > 0.0
+            and projected >= self.depth_threshold
+        ):
+            self.pressure = True
+            self.fired_at = now
+        elif self.pressure and (
+            self.fired_at is None or now - self.fired_at >= self.hold_s
+        ):
+            # Hold-down elapsed and the trend no longer projects a
+            # breach: release.
+            self.pressure = False
+            self.fired_at = None
+        return self.pressure
+
+    def _slope(self) -> float:
+        """Least-squares depth/second over the retained window — pure
+        arithmetic over ≤ O(window/pass) points, no allocation beyond
+        the deque itself."""
+        n = len(self.samples)
+        if n < 2:
+            return 0.0
+        t0 = self.samples[0][0]
+        sum_t = sum_d = sum_tt = sum_td = 0.0
+        for t, d in self.samples:
+            x = t - t0
+            sum_t += x
+            sum_d += d
+            sum_tt += x * x
+            sum_td += x * d
+        denom = n * sum_tt - sum_t * sum_t
+        if denom <= 1e-12:
+            return 0.0
+        return (n * sum_td - sum_t * sum_d) / denom
+
+
+class ControlPlane:
+    """The one controller (see the module docstring). ``evaluate`` runs
+    on the scheduler thread once per pass; the actuator reads
+    (``tenant_admit``, ``tenant_clamp_max_new``, ``scale_pressure``)
+    run on submit/probe threads — all state is mutated under one lock,
+    and signal reads happen OUTSIDE it (sensors take their own locks;
+    holding ours across theirs would mint lock-order edges for free).
+    """
+
+    def __init__(
+        self,
+        model_name: str,
+        *,
+        stale_s: float = 10.0,
+        tenant_enter: float = 2.0,
+        tenant_exit: float = 1.0,
+        tenant_sustain_s: float = 10.0,
+        tenant_exit_sustain_s: float = 30.0,
+        tenant_max_new: int = 256,
+        tenant_aimd_cut: float = 0.5,
+        tenant_recover_per_s: float = 0.02,
+        tenant_table_max: int = 64,
+        host_ratio: float = 0.85,
+        host_util: float = 0.75,
+        host_sustain_s: float = 30.0,
+        predict_window_s: float = 60.0,
+        predict_horizon_s: float = 30.0,
+        predict_depth: float = 64.0,
+        predict_hold_s: float = 30.0,
+        decision_records: int = 64,
+        metrics: Any = None,
+        logger: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.model_name = model_name
+        self.stale_s = max(0.0, float(stale_s))
+        self._metrics = metrics
+        self._logger = logger
+        self._clock = clock
+        self._lock = lockcheck.make_lock("ControlPlane._lock")
+        self._signals: dict[str, SignalSource] = {}
+        self.tenant_loop = TenantBrownoutLoop(
+            enter_burn=tenant_enter,
+            exit_burn=tenant_exit,
+            sustain_s=tenant_sustain_s,
+            exit_sustain_s=tenant_exit_sustain_s,
+            max_new_tokens=tenant_max_new,
+            aimd_cut=tenant_aimd_cut,
+            recover_per_s=tenant_recover_per_s,
+            table_max=tenant_table_max,
+        )
+        self.host_loop = HostPressureLoop(
+            ratio=host_ratio, util=host_util, sustain_s=host_sustain_s
+        )
+        self.predict_loop = PredictiveLoop(
+            window_s=predict_window_s,
+            horizon_s=predict_horizon_s,
+            depth_threshold=predict_depth,
+            hold_s=predict_hold_s,
+        )
+        #: Per-loop mode: "active" | "observe_only" | "off" (no signal
+        #: registered for it). Observe-only means every actuator the
+        #: loop owns returns neutral — the zero-5xx guarantee.
+        self._modes = {
+            "tenant_brownout": "off",
+            "host_pressure": "off",
+            "predictive": "off",
+        }
+        self._decisions: deque[dict[str, Any]] = deque(
+            maxlen=max(8, int(decision_records))
+        )
+        self._passes = 0
+        self._eval_errors = 0
+        self._last_eval: Optional[float] = None
+        self._published_tenants: set[str] = set()
+
+    # -- registry -------------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        read: Callable[[], Any],
+        *,
+        kind: str = "scalar",
+        stale_after_s: Optional[float] = None,
+    ) -> SignalSource:
+        """Add one sensor to the typed registry. Registration order is
+        boot-deterministic; names are the bounded metric-label set."""
+        src = SignalSource(
+            name, read, kind=kind,
+            stale_after_s=(
+                self.stale_s if stale_after_s is None else stale_after_s
+            ),
+        )
+        self._signals[name] = src
+        return src
+
+    # -- the guarded read ----------------------------------------------
+
+    def _sample_raw(
+        self, src: SignalSource
+    ) -> tuple[str, Any]:
+        """Read one sensor OUTSIDE the control lock. Returns
+        ``("ok", value)`` | ``("stale", None)`` | ``("error", msg)``.
+        The ``control.signal`` fault point lets chaos tests substitute
+        any failure mode: an armed action returning ``"stale"`` skips
+        the read, a returned float (NaN included) replaces the value,
+        and an armed ``raises`` exercises the exception guard."""
+        try:
+            directive = faults.fire("control.signal", signal=src.name)
+            if directive == "stale":
+                return ("stale", None)
+            raw = src.read() if directive is None else directive
+            return ("ok", _validate(src.kind, raw))
+        except Exception as exc:  # noqa: BLE001 — the guard IS the contract: no sensor failure may escape
+            return ("error", f"{type(exc).__name__}: {exc}")
+
+    def _absorb(
+        self, src: SignalSource, outcome: tuple[str, Any], now: float
+    ) -> _Reading:
+        """Fold one raw sample into the source's guard state (call
+        under the lock) and return the reading its consumers see."""
+        status, payload = outcome
+        if status == "ok":
+            src.last_good = payload
+            src.last_good_at = now
+            src.status = "ok"
+            src.last_error = ""
+            return _Reading(payload, usable=True, fresh=True)
+        src.errors += 1
+        if status == "error":
+            src.last_error = str(payload)
+        elif not src.last_error:
+            src.last_error = "stale"
+        within = (
+            src.last_good_at is not None
+            and now - src.last_good_at <= src.stale_after_s
+        )
+        if within:
+            src.status = "last_good"
+            return _Reading(src.last_good, usable=True, fresh=False)
+        src.status = "observe_only"
+        return _Reading(src.last_good, usable=False, fresh=False)
+
+    # -- the control pass ----------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> None:
+        """One control pass: sample every signal through its guard,
+        run the loops whose inputs are usable, publish health/state.
+        NEVER raises — a control-plane bug degrades to a logged count,
+        not a dead scheduler."""
+        t = self._clock() if now is None else now
+        try:
+            self._evaluate(t)
+        except Exception as exc:  # noqa: BLE001 — the scheduler pass must survive any controller bug
+            self._eval_errors += 1
+            if self._logger is not None:
+                self._logger.errorf("control plane pass failed: %s", exc)
+
+    def _evaluate(self, t: float) -> None:
+        raw = {
+            name: self._sample_raw(src)
+            for name, src in self._signals.items()
+        }
+        moves: list[tuple[str, int, int]] = []
+        with self._lock:
+            dt = (
+                max(0.0, t - self._last_eval)
+                if self._last_eval is not None else 0.0
+            )
+            self._last_eval = t
+            self._passes += 1
+            readings = {
+                name: self._absorb(self._signals[name], raw[name], t)
+                for name in raw
+            }
+            moves = self._run_tenant_loop(readings, t, dt)
+            self._run_scale_loops(readings, t)
+            decisions = [
+                {
+                    "t": round(t, 3),
+                    "loop": "tenant_brownout",
+                    "action": (
+                        f"level {prev} -> {new}"
+                    ),
+                    "tenant": tenant,
+                }
+                for tenant, prev, new in moves
+            ]
+            for d in decisions:
+                self._decisions.append(d)
+        self._publish(moves, t)
+
+    def _run_tenant_loop(
+        self, readings: dict[str, _Reading], t: float, dt: float
+    ) -> list[tuple[str, int, int]]:
+        reading = readings.get("tenant_burn")
+        if reading is None:
+            self._modes["tenant_brownout"] = "off"
+            return []
+        if not reading.usable or not isinstance(reading.value, Mapping):
+            # Observe-only: hold the table (no climbs, no descents —
+            # acting on a dead sensor in EITHER direction is guessing)
+            # and let the actuators read neutral.
+            self._modes["tenant_brownout"] = "observe_only"
+            return []
+        self._modes["tenant_brownout"] = "active"
+        return self.tenant_loop.evaluate(reading.value, t, dt)
+
+    def _run_scale_loops(
+        self, readings: dict[str, _Reading], t: float
+    ) -> None:
+        ratio = readings.get("host_overhead_ratio")
+        util = readings.get("loop_utilization")
+        if ratio is None or util is None:
+            self._modes["host_pressure"] = "off"
+        elif not (ratio.usable and util.usable):
+            self._modes["host_pressure"] = "observe_only"
+        else:
+            self._modes["host_pressure"] = "active"
+            assert isinstance(ratio.value, float)
+            assert isinstance(util.value, float)
+            self.host_loop.evaluate(ratio.value, util.value, t)
+        depth = readings.get("queue_depth")
+        tput = readings.get("throughput")
+        if depth is None:
+            self._modes["predictive"] = "off"
+        elif not depth.usable:
+            self._modes["predictive"] = "observe_only"
+        else:
+            self._modes["predictive"] = "active"
+            assert isinstance(depth.value, float)
+            tput_v = (
+                tput.value
+                if tput is not None and tput.usable
+                and isinstance(tput.value, float) else 0.0
+            )
+            self.predict_loop.evaluate(depth.value, tput_v, t)
+
+    # -- actuator surface (submit / probe threads) ----------------------
+
+    def tenant_level(self, tenant: str) -> int:
+        """The tenant's current ladder rung (0 = nominal/unknown)."""
+        key = str(tenant or "").lower()
+        with self._lock:
+            ladder = self.tenant_loop.table.get(key)
+            return ladder.level if ladder is not None else 0
+
+    def tenant_clamp_max_new(self, tenant: str, requested: int) -> int:
+        """L1+ clamp on the BURNING tenant's generation budget — the
+        per-tenant mirror of ``BrownoutController.clamp_max_new``.
+        Neutral (identity) below L1, in observe-only mode, and for
+        every tenant not on the ladder."""
+        key = str(tenant or "").lower()
+        with self._lock:
+            if self._modes["tenant_brownout"] != "active":
+                return int(requested)
+            ladder = self.tenant_loop.table.get(key)
+            if (
+                ladder is None or ladder.level < 1
+                or self.tenant_loop.max_new_tokens <= 0
+            ):
+                return int(requested)
+            return min(int(requested), self.tenant_loop.max_new_tokens)
+
+    def tenant_admit(self, tenant: str, slo_class: str) -> bool:
+        """May this tenant's request enter the queue? True below L2
+        (byte-identical admission) and in observe-only mode; at L2 a
+        deterministic credit admits ``budget_factor × class fraction``
+        of the tenant's submissions (batch thinned hardest); at L3 the
+        tenant's new work is shed outright (fair-share shed — its own
+        429s, everyone else's admissions untouched)."""
+        key = str(tenant or "").lower()
+        with self._lock:
+            if self._modes["tenant_brownout"] != "active":
+                return True
+            ladder = self.tenant_loop.table.get(key)
+            if ladder is None or ladder.level < 2:
+                return True
+            if ladder.level >= MAX_LEVEL:
+                return False
+            frac = ladder.budget_factor * CLASS_ADMIT_FRACTION.get(
+                slo_class, CLASS_ADMIT_FRACTION["standard"]
+            )
+            ladder.credit += min(1.0, max(0.0, frac))
+            if ladder.credit >= 1.0:
+                ladder.credit -= 1.0
+                return True
+            return False
+
+    def tenant_recovery_s(self, tenant: str) -> float:
+        """Retry-After floor for a ``tenant_brownout`` shed: one
+        exit-sustain period per rung above L1 plus the AIMD credit's
+        additive recovery — the per-tenant twin of
+        ``BrownoutController.projected_recovery_s``."""
+        key = str(tenant or "").lower()
+        loop = self.tenant_loop
+        with self._lock:
+            ladder = loop.table.get(key)
+            if ladder is None or ladder.level == 0:
+                return 0.0
+            rungs = max(0, ladder.level - 1)
+            wait = rungs * loop.exit_sustain_s
+            wait += (1.0 - ladder.budget_factor) / loop.recover_per_s
+            return max(1.0, wait)
+
+    def force_tenant_level(self, tenant: str, level: int) -> None:
+        """Jump one tenant's ladder (ops drills / deterministic tests;
+        the next pass resumes normal hysteresis from here)."""
+        key = str(tenant or "").lower()
+        level = min(MAX_LEVEL, max(0, int(level)))
+        with self._lock:
+            ladder = self.tenant_loop.table.get(key)
+            if ladder is None:
+                ladder = self.tenant_loop.table[key] = _TenantLadder()
+            while ladder.level < level:
+                self.tenant_loop._move(ladder, +1)
+            while ladder.level > level:
+                self.tenant_loop._move(ladder, -1)
+            ladder.over_since = None
+            ladder.clear_since = None
+            if self._modes["tenant_brownout"] == "off":
+                self._modes["tenant_brownout"] = "active"
+
+    def scale_pressure(self) -> int:
+        """1 while either scaling loop (host-overhead or predictive)
+        asserts pressure, else 0. Observe-only loops assert nothing —
+        neutral is the degraded mode's contract."""
+        with self._lock:
+            host = (
+                self._modes["host_pressure"] == "active"
+                and self.host_loop.pressure
+            )
+            predictive = (
+                self._modes["predictive"] == "active"
+                and self.predict_loop.pressure
+            )
+            return 1 if (host or predictive) else 0
+
+    def signal_health(self) -> dict[str, float]:
+        """``{signal: health}`` — the exported degraded-sensor set."""
+        with self._lock:
+            return {
+                name: src.health()
+                for name, src in self._signals.items()
+            }
+
+    # -- publication ----------------------------------------------------
+
+    def _tenant_label(self, tenant: str) -> str:
+        """Bounded label mapper (GL016 discipline): only tenants with a
+        live ladder entry reach the gauge, and the ladder table is hard
+        bounded (``tenant_table_max``, idle entries evicted) — request
+        traffic cannot mint unbounded series through this path."""
+        return tenant
+
+    def _publish(
+        self, moves: list[tuple[str, int, int]], now: float
+    ) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        with self._lock:
+            health = {
+                name: src.health()
+                for name, src in self._signals.items()
+            }
+            levels = {
+                tenant: ladder.level
+                for tenant, ladder in self.tenant_loop.table.items()
+            }
+            host = (
+                self._modes["host_pressure"] == "active"
+                and self.host_loop.pressure
+            )
+            predictive = (
+                self._modes["predictive"] == "active"
+                and self.predict_loop.pressure
+            )
+        for name, value in health.items():
+            m.set_gauge(
+                "app_tpu_control_signal_health", value,
+                "model", self.model_name, "signal", name,
+            )
+        # Per-tenant level gauges: the label set is bounded by the
+        # ladder table (table_max), and a tenant leaving the table
+        # zeroes its gauge first so stale levels never linger.
+        for tenant in self._published_tenants - set(levels):
+            m.set_gauge(
+                "app_tpu_control_tenant_level", 0.0,
+                "model", self.model_name,
+                "tenant", self._tenant_label(tenant),
+            )
+        for tenant, level in levels.items():
+            m.set_gauge(
+                "app_tpu_control_tenant_level", float(level),
+                "model", self.model_name,
+                "tenant", self._tenant_label(tenant),
+            )
+        self._published_tenants = set(levels)
+        m.set_gauge(
+            "app_tpu_control_scale_pressure", 1.0 if host else 0.0,
+            "model", self.model_name, "source", "host",
+        )
+        m.set_gauge(
+            "app_tpu_control_scale_pressure",
+            1.0 if predictive else 0.0,
+            "model", self.model_name, "source", "predictive",
+        )
+        for _tenant, prev, new in moves:
+            m.increment_counter(
+                "app_tpu_control_actions_total",
+                "model", self.model_name,
+                "loop", "tenant_brownout",
+                "action", "up" if new > prev else "down",
+            )
+
+    def note_action(self, loop: str, action: str) -> None:
+        """Count one actuation (clamp/shed) from the engine's hooks —
+        the bounded (loop, action) label pair."""
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_control_actions_total",
+                "model", self.model_name, "loop", loop, "action", action,
+            )
+        with self._lock:
+            self._decisions.append({
+                "t": round(self._clock(), 3),
+                "loop": loop,
+                "action": action,
+            })
+
+    # -- rendering ------------------------------------------------------
+
+    def describe(self) -> dict[str, Any]:
+        """The compact health-detail form (rides probes — the headroom
+        idiom): scale pressure, degraded sensors, browning tenants."""
+        with self._lock:
+            degraded = sorted(
+                name for name, src in self._signals.items()
+                if src.status != "ok"
+            )
+            browned = sum(
+                1 for lad in self.tenant_loop.table.values()
+                if lad.level > 0
+            )
+            host = (
+                self._modes["host_pressure"] == "active"
+                and self.host_loop.pressure
+            )
+            predictive = (
+                self._modes["predictive"] == "active"
+                and self.predict_loop.pressure
+            )
+            return {
+                "scale_pressure": 1 if (host or predictive) else 0,
+                "degraded_signals": degraded,
+                "tenants_browned_out": browned,
+            }
+
+    def snapshot(self) -> dict[str, Any]:
+        """The full ``/debug/control`` form: per-signal guard state,
+        per-loop mode + state + hold-down timers, the decision ring."""
+        t = self._clock()
+        with self._lock:
+            signals = {
+                name: {
+                    "kind": src.kind,
+                    "status": src.status,
+                    "health": src.health(),
+                    "stale_after_s": src.stale_after_s,
+                    "errors": src.errors,
+                    "last_error": src.last_error,
+                    "age_s": (
+                        None if src.last_good_at is None
+                        else round(max(0.0, t - src.last_good_at), 3)
+                    ),
+                }
+                for name, src in self._signals.items()
+            }
+            tenant = {
+                "mode": self._modes["tenant_brownout"],
+                "enter_burn": self.tenant_loop.enter_burn,
+                "exit_burn": self.tenant_loop.exit_burn,
+                "sustain_s": self.tenant_loop.sustain_s,
+                "exit_sustain_s": self.tenant_loop.exit_sustain_s,
+                "max_new_tokens": self.tenant_loop.max_new_tokens,
+                "aimd_cut": self.tenant_loop.aimd_cut,
+                "table_max": self.tenant_loop.table_max,
+                "transitions": dict(self.tenant_loop.transitions),
+                "tenants": {
+                    name: {
+                        "level": lad.level,
+                        "budget_factor": round(lad.budget_factor, 6),
+                        "last_burn": round(lad.last_burn, 6),
+                    }
+                    for name, lad in self.tenant_loop.table.items()
+                },
+            }
+            host = {
+                "mode": self._modes["host_pressure"],
+                "pressure": self.host_loop.pressure,
+                "ratio_enter": self.host_loop.ratio,
+                "ratio_exit": self.host_loop.exit_ratio,
+                "util_floor": self.host_loop.util,
+                "sustain_s": self.host_loop.sustain_s,
+                "last_ratio": round(self.host_loop.last_ratio, 6),
+                "last_util": round(self.host_loop.last_util, 6),
+                "over_for_s": (
+                    None if self.host_loop.over_since is None
+                    else round(max(0.0, t - self.host_loop.over_since), 3)
+                ),
+            }
+            predictive = {
+                "mode": self._modes["predictive"],
+                "pressure": self.predict_loop.pressure,
+                "window_s": self.predict_loop.window_s,
+                "horizon_s": self.predict_loop.horizon_s,
+                "depth_threshold": self.predict_loop.depth_threshold,
+                "hold_s": self.predict_loop.hold_s,
+                "samples": len(self.predict_loop.samples),
+                "last_slope": round(self.predict_loop.last_slope, 6),
+                "last_projected": round(
+                    self.predict_loop.last_projected, 3
+                ),
+                "hold_down_left_s": (
+                    None if self.predict_loop.fired_at is None
+                    else round(max(
+                        0.0,
+                        self.predict_loop.hold_s
+                        - (t - self.predict_loop.fired_at),
+                    ), 3)
+                ),
+            }
+            return {
+                "enabled": True,
+                "passes": self._passes,
+                "eval_errors": self._eval_errors,
+                "stale_s": self.stale_s,
+                "signals": signals,
+                "loops": {
+                    "tenant_brownout": tenant,
+                    "host_pressure": host,
+                    "predictive": predictive,
+                },
+                "decisions": list(self._decisions),
+            }

@@ -23,6 +23,7 @@ Design:
 from __future__ import annotations
 
 import asyncio
+import math
 import os
 import queue
 import threading
@@ -124,6 +125,24 @@ class InferenceEngine(
         brownout_aimd_cut: float = 0.5,
         brownout_recover_per_s: float = 0.02,
         brownout_min_headroom: float = 0.0,
+        control_plane: Optional[bool] = None,
+        control_stale_s: float = 10.0,
+        control_tenant_enter: float = 2.0,
+        control_tenant_exit: float = 1.0,
+        control_tenant_sustain_s: float = 10.0,
+        control_tenant_exit_sustain_s: float = 30.0,
+        control_tenant_max_new: int = 256,
+        control_tenant_aimd_cut: float = 0.5,
+        control_tenant_recover_per_s: float = 0.02,
+        control_tenant_table: int = 64,
+        control_host_ratio: float = 0.85,
+        control_host_util: float = 0.75,
+        control_host_sustain_s: float = 30.0,
+        control_predict_window_s: float = 60.0,
+        control_predict_horizon_s: float = 30.0,
+        control_predict_depth: float = 0.0,
+        control_predict_hold_s: float = 30.0,
+        queue_prefix_aware: bool = False,
         tenant_slo_class: str = "",
         compile_cache_dir: str = "",
         expected_tps: float = 0.0,
@@ -461,6 +480,15 @@ class InferenceEngine(
         self.tenant_fair_share = max(0.0, min(1.0, tenant_fair_share))
         from gofr_tpu.serving.slo import SLOEngine
 
+        # Control-plane master switch, resolved HERE because the
+        # SLOEngine below needs to know whether to auto-track per-tenant
+        # burn rings (the per-tenant brownout loop's signal). Off
+        # (TPU_CONTROL_PLANE=0) builds nothing: no tracking, no
+        # controller, every hook one `is not None`.
+        if control_plane is None:
+            control_plane = os.environ.get(
+                "TPU_CONTROL_PLANE", "1"
+            ).lower() not in ("0", "false", "no")
         self._slo: Optional[SLOEngine] = None
         if (
             slo_ttft_ms > 0 or slo_e2e_ms > 0 or slo_availability > 0
@@ -472,6 +500,10 @@ class InferenceEngine(
                 e2e_ms=slo_e2e_ms,
                 availability=slo_availability,
                 tenant_objectives=slo_tenant_objectives,
+                track_tenants=(
+                    max(0, int(control_tenant_table))
+                    if control_plane else 0
+                ),
                 metrics=metrics,
             )
         # The observability hub feeds every retired timeline's phases
@@ -585,6 +617,11 @@ class InferenceEngine(
         self.admit_min_headroom = max(0.0, admit_min_headroom)
         self.hbm_budget_bytes = max(0, hbm_budget_bytes)
         self.effective_evict_watermark = 0
+        # Prefix-hit-aware admission ordering (TPU_QUEUE_PREFIX_AWARE,
+        # off by default): within one SLO class, pop requests with a
+        # known radix-prefix hit first. Read by
+        # _init_llm_serving_state's queue build (survives warm restart).
+        self.queue_prefix_aware = bool(queue_prefix_aware)
 
         if self.family == "llm":
             self.max_len = min(max_len, self.cfg.max_len)
@@ -798,6 +835,69 @@ class InferenceEngine(
             # ledger (params + batcher workspace is negligible) builds
             # once here.
             self._build_hbm_ledger()
+        # The fault-tolerant control plane (serving/control_plane.py;
+        # docs/advanced-guide/resilience.md "Control plane"): built
+        # LAST so its signal closures capture sensors that only exist
+        # after _init_llm_serving_state (queue, throughput meter, HBM
+        # ledger). LLM-family only — every loop it closes is a
+        # scheduler-loop loop. TPU_CONTROL_PLANE=0 builds nothing.
+        self._control: Any = None
+        if control_plane and self.family == "llm":
+            from gofr_tpu.serving.control_plane import ControlPlane
+
+            cp = ControlPlane(
+                model_name,
+                stale_s=control_stale_s,
+                tenant_enter=control_tenant_enter,
+                tenant_exit=control_tenant_exit,
+                tenant_sustain_s=control_tenant_sustain_s,
+                tenant_exit_sustain_s=control_tenant_exit_sustain_s,
+                tenant_max_new=control_tenant_max_new,
+                tenant_aimd_cut=control_tenant_aimd_cut,
+                tenant_recover_per_s=control_tenant_recover_per_s,
+                tenant_table_max=control_tenant_table,
+                host_ratio=control_host_ratio,
+                host_util=control_host_util,
+                host_sustain_s=control_host_sustain_s,
+                predict_window_s=control_predict_window_s,
+                predict_horizon_s=control_predict_horizon_s,
+                # The predictive threshold defaults to half the queue
+                # bound: fire while the reactive sustained-threshold
+                # path still has runway.
+                predict_depth=(
+                    float(control_predict_depth)
+                    if control_predict_depth > 0
+                    else max(1.0, 0.5 * float(self.queue_max))
+                ),
+                predict_hold_s=control_predict_hold_s,
+                metrics=metrics,
+                logger=logger,
+                clock=self._obs.now,
+            )
+            slo = self._slo
+            if slo is not None:
+                cp.register(
+                    "tenant_burn",
+                    lambda: slo.tenant_burns("5m"),
+                    kind="map",
+                )
+            prof = self._loop_prof
+            if prof is not None:
+                cp.register("host_overhead_ratio", prof.host_overhead_ratio)
+                cp.register("loop_utilization", prof.utilization)
+            cp.register(
+                "queue_depth", lambda: float(self._pending.qsize())
+            )
+            cp.register(
+                "throughput",
+                lambda: float(self._tput.rate(self._obs.now())),
+            )
+            if self._ledger is not None:
+                cp.register(
+                    "hbm_headroom",
+                    lambda: float(self.hbm_headroom_ratio()),
+                )
+            self._control = cp
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -1020,6 +1120,73 @@ class InferenceEngine(
             brownout_min_headroom=float(
                 config.get_or_default("TPU_BROWNOUT_MIN_HEADROOM", "0")
             ),
+            # The fault-tolerant control plane (docs/advanced-guide/
+            # resilience.md "Control plane"): the master switch, the
+            # signal staleness window, the per-tenant brownout ladder's
+            # thresholds/AIMD, the host-overhead pressure loop, and the
+            # predictive-scaling trend fit.
+            control_plane=config.get_or_default(
+                "TPU_CONTROL_PLANE", "1"
+            ).lower() not in ("0", "false", "no"),
+            control_stale_s=float(
+                config.get_or_default("TPU_CONTROL_STALE_S", "10")
+            ),
+            control_tenant_enter=float(
+                config.get_or_default("TPU_CONTROL_TENANT_ENTER", "2")
+            ),
+            control_tenant_exit=float(
+                config.get_or_default("TPU_CONTROL_TENANT_EXIT", "1")
+            ),
+            control_tenant_sustain_s=float(
+                config.get_or_default("TPU_CONTROL_TENANT_SUSTAIN_S", "10")
+            ),
+            control_tenant_exit_sustain_s=float(
+                config.get_or_default(
+                    "TPU_CONTROL_TENANT_EXIT_SUSTAIN_S", "30"
+                )
+            ),
+            control_tenant_max_new=int(
+                config.get_or_default("TPU_CONTROL_TENANT_MAX_NEW", "256")
+            ),
+            control_tenant_aimd_cut=float(
+                config.get_or_default("TPU_CONTROL_TENANT_AIMD_CUT", "0.5")
+            ),
+            control_tenant_recover_per_s=float(
+                config.get_or_default(
+                    "TPU_CONTROL_TENANT_RECOVER_PER_S", "0.02"
+                )
+            ),
+            control_tenant_table=int(
+                config.get_or_default("TPU_CONTROL_TENANT_TABLE", "64")
+            ),
+            control_host_ratio=float(
+                config.get_or_default("TPU_CONTROL_HOST_RATIO", "0.85")
+            ),
+            control_host_util=float(
+                config.get_or_default("TPU_CONTROL_HOST_UTIL", "0.75")
+            ),
+            control_host_sustain_s=float(
+                config.get_or_default("TPU_CONTROL_HOST_SUSTAIN_S", "30")
+            ),
+            control_predict_window_s=float(
+                config.get_or_default("TPU_CONTROL_PREDICT_WINDOW_S", "60")
+            ),
+            control_predict_horizon_s=float(
+                config.get_or_default(
+                    "TPU_CONTROL_PREDICT_HORIZON_S", "30"
+                )
+            ),
+            control_predict_depth=float(
+                config.get_or_default("TPU_CONTROL_PREDICT_DEPTH", "0")
+            ),
+            control_predict_hold_s=float(
+                config.get_or_default("TPU_CONTROL_PREDICT_HOLD_S", "30")
+            ),
+            # Prefix-hit-aware admission ordering (off by default —
+            # byte-identical pop order when off).
+            queue_prefix_aware=config.get_or_default(
+                "TPU_QUEUE_PREFIX_AWARE", "0"
+            ).lower() not in ("", "0", "false", "no"),
             tenant_slo_class=config.get_or_default(
                 "TPU_TENANT_SLO_CLASS", ""
             ),
@@ -1293,9 +1460,21 @@ class InferenceEngine(
         # interactive-first dequeue and a max-wait starvation bound.
         # With class_promote_s=0 (or uniform-class traffic) the pop
         # order is exactly the old FIFO.
+        # Hit-aware admission ordering (TPU_QUEUE_PREFIX_AWARE, off by
+        # default): the pop tie-break probes the radix index through
+        # the NON-MUTATING peek — no increfs, no LRU perturbation. The
+        # closure captures THIS boot's index (both rebuild together on
+        # a warm restart). Off → probe None → byte-identical pop order.
+        prefix_probe: Optional[Any] = None
+        if self.queue_prefix_aware and self._radix is not None:
+            _radix_now = self._radix
+            prefix_probe = lambda req: _radix_now.peek(  # noqa: E731
+                list(req.prompt_ids), getattr(req, "aid", 0)
+            ) > 0
         self._pending: ClassPriorityQueue = ClassPriorityQueue(
             maxsize=self.queue_max,
             promote_after_s=self.class_promote_s,
+            prefix_probe=prefix_probe,
         )
         self._work = threading.Event()
         self._tokens_dev = self._up(np.zeros((n_slots,), dtype=np.int32))
@@ -1998,7 +2177,7 @@ class InferenceEngine(
                     )
             wait += inflight / tps
         if (
-            reason in ("tenant_quota", "tenant_fair_share")
+            reason in ("tenant_quota", "tenant_fair_share", "tenant_brownout")
             and tenant
             and self._tenant_ledger is not None
         ):
@@ -2009,6 +2188,11 @@ class InferenceEngine(
         bc = self._brownout
         if bc is not None and bc.level > 0:
             wait = max(wait, bc.projected_recovery_s())
+        # A tenant-brownout 429 is floored at the TENANT's own ladder
+        # recovery — a retry must not land while its rungs still stand.
+        cp = self._control
+        if reason == "tenant_brownout" and cp is not None and tenant:
+            wait = max(wait, cp.tenant_recovery_s(tenant))
         return max(wait, 0.5)
 
     def _shed(self, reason: str, retry_after_s: float) -> None:
@@ -2106,14 +2290,41 @@ class InferenceEngine(
                     f"reason=tenant_fair_share",
                     retry_after_s=retry,
                 )
+            # Per-tenant brownout (serving/control_plane.py): the
+            # BURNING tenant's own ladder thins (L2, deterministic AIMD
+            # credit) or sheds (L3) its admissions while every other
+            # tenant's requests fall straight through — below L2 (and
+            # with the plane off or its burn sensor degraded) this is
+            # byte-identically admit-everything.
+            cp = self._control
+            if cp is not None and req.tenant and not cp.tenant_admit(
+                req.tenant, req.slo_class
+            ):
+                retry = self.shed_retry_after_s(
+                    "tenant_brownout", cost, req.tenant
+                )
+                cp.note_action(
+                    "tenant_brownout", f"shed_{req.slo_class}"
+                )
+                self._shed("tenant_brownout", retry)
+                raise ErrorTooManyRequests(
+                    f"tenant {req.tenant!r} is browned out at level "
+                    f"{cp.tenant_level(req.tenant)} (its SLO burn, not "
+                    f"the pod's); reason=tenant_brownout",
+                    retry_after_s=retry,
+                )
             if self.admit_min_headroom > 0:
                 # Saturation-aware admission (TPU_ADMIT_MIN_HEADROOM):
                 # below the HBM headroom floor new work is shed 429 —
                 # the honest answer when the paged pool is nearly full
                 # is "retry elsewhere", not a mid-stream
                 # kv_pool_exhausted failure after a slot was burned.
+                # A non-finite ratio (a telemetry backend answering
+                # NaN) must read as "no signal", never as pressure.
                 headroom = self.hbm_headroom_ratio()
-                if headroom < self.admit_min_headroom:
+                if math.isfinite(headroom) and (
+                    headroom < self.admit_min_headroom
+                ):
                     retry = self.shed_retry_after_s("hbm_headroom", cost)
                     self._shed("hbm_headroom", retry)
                     raise ErrorTooManyRequests(
@@ -2349,6 +2560,16 @@ class InferenceEngine(
                 max_new_tokens = clamped
                 brownout_clamped = True
                 bc.note_action("clamp_tokens")
+        # Per-tenant L1+ clamp (serving/control_plane.py): the BURNING
+        # tenant's generation budget is cut while everyone else's (and
+        # every request below its L1) passes through untouched.
+        cp = self._control
+        if cp is not None and tenant:
+            clamped = cp.tenant_clamp_max_new(tenant, int(max_new_tokens))
+            if clamped < int(max_new_tokens):
+                max_new_tokens = clamped
+                brownout_clamped = True
+                cp.note_action("tenant_brownout", "clamp_tokens")
         req = _GenRequest(
             prompt_ids=ids,
             max_new_tokens=max_new_tokens,
@@ -2638,6 +2859,25 @@ class InferenceEngine(
             return {"enabled": False}
         return dict(self._brownout.snapshot())
 
+    def control_report(self) -> dict:
+        """The control plane's full state (``/debug/control`` on the
+        ops port): per-signal guard state, per-loop mode + hold-down
+        timers, the decision ring. ``{"enabled": False}`` when the
+        layer is off (``TPU_CONTROL_PLANE=0`` or a non-LLM family)."""
+        if self._control is None:
+            return {"enabled": False}
+        return dict(self._control.snapshot())
+
+    def control_scale_pressure(self) -> Optional[int]:
+        """The control plane's scale-up advertisement (1 = the
+        host-overhead or predictive loop asserts pressure), ``None``
+        when the plane is off — the pool scaler's None-vs-0 distinction
+        (signal absent vs armed-and-calm), mirroring
+        :meth:`brownout_level`."""
+        if self._control is None:
+            return None
+        return int(self._control.scale_pressure())
+
     def brownout_level(self) -> Optional[int]:
         """The current degradation level, ``None`` when the layer is
         off (``TPU_BROWNOUT=0`` / no SLOs) — the distinction matters to
@@ -2678,6 +2918,15 @@ class InferenceEngine(
         }
         if self.kv_block:
             ctx["kv_blocks_free"] = int(self._allocator.n_free)
+        if self._control is not None:
+            # Which sensors were degraded at the stall instant — a
+            # stall that coincides with a lying sensor is a different
+            # investigation than one under healthy signals.
+            ctx["control_degraded"] = sorted(
+                name
+                for name, health in self._control.signal_health().items()
+                if health < 1.0
+            )
         return ctx
 
     def loop_report(self) -> dict:
@@ -2713,6 +2962,10 @@ class InferenceEngine(
             # "Is this pod browning out" next to "is it breaking its
             # promise" — the actuator's state beside its signal.
             report["brownout"] = self._brownout.describe()
+        if self._control is not None:
+            # The control plane's headline: scale pressure, degraded
+            # sensors, and how many tenants are on their own ladder.
+            report["control"] = self._control.describe()
         if self.family == "llm" and self.kv_block:
             total, used, cached = self._kv_pool_counts()
             pool: dict[str, Any] = {
@@ -2865,6 +3118,12 @@ class InferenceEngine(
             # pools lift the level to suppress hedges/probes against a
             # browning-out replica and to deprioritize it at L3.
             details["brownout"] = self._brownout.describe()
+        if self._control is not None:
+            # Control-plane advertisement (the same probe path): remote
+            # pools lift `scale_pressure` into their descriptors so the
+            # scaler sees the host-overhead/predictive loops' verdict
+            # without another endpoint.
+            details["control"] = self._control.describe()
         if self._loop_prof is not None:
             # Scheduler-loop advertisement (the headroom idiom): probes
             # and health readers see utilization / host-overhead /

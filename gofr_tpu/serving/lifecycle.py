@@ -255,6 +255,11 @@ class ClassPriorityQueue:
     promotion) is testable with stated times.
     """
 
+    #: Prefix-aware pop scans at most this many entries from the chosen
+    #: lane's head — the tie-break stays O(1)-ish however deep the
+    #: backlog gets.
+    PREFIX_SCAN = 16
+
     def __init__(
         self,
         maxsize: int = 0,
@@ -264,11 +269,17 @@ class ClassPriorityQueue:
         classify: Callable[[object], str] = (
             lambda req: str(getattr(req, "slo_class", "standard"))
         ),
+        prefix_probe: Optional[Callable[[object], bool]] = None,
     ) -> None:
         self.maxsize = int(maxsize)
         self.promote_after_s = float(promote_after_s)
         self._clock = clock
         self._classify = classify
+        # Hit-aware admission ordering (TPU_QUEUE_PREFIX_AWARE): within
+        # the chosen class, pop a request with a known radix-prefix hit
+        # ahead of its same-class peers (the probe is a host-side trie
+        # walk — cheap). None (default) keeps pop order byte-identical.
+        self._prefix_probe = prefix_probe
         self._lock = lockcheck.make_lock("ClassPriorityQueue._lock")
         # rank → FIFO of (enqueued_at, request). Rank 1 doubles as THE
         # queue when classing is off.
@@ -296,6 +307,7 @@ class ClassPriorityQueue:
         with self._lock:
             now = self._clock()
             pick: Optional[int] = None
+            promoted = False
             if self.promote_after_s > 0:
                 # Starvation bound first: among heads past the
                 # promotion age, the oldest wins whatever its class.
@@ -308,13 +320,31 @@ class ClassPriorityQueue:
                         oldest is None or at < oldest
                     ):
                         oldest, pick = at, rank
+                promoted = pick is not None
             if pick is None:
                 pick = next(
                     (r for r in (0, 1, 2) if self._lanes[r]), None
                 )
             if pick is None:
                 raise _queue.Empty
-            return self._lanes[pick].popleft()[1]
+            lane = self._lanes[pick]
+            if self._prefix_probe is not None and not promoted:
+                # WITHIN the class, break the FIFO tie toward a request
+                # with a known prefix hit (its prefill is mostly free).
+                # Promotion picks are exempt — the starvation bound is
+                # a hard ordering contract, not a tie.
+                for i in range(min(len(lane), self.PREFIX_SCAN)):
+                    try:
+                        hit = bool(self._prefix_probe(lane[i][1]))
+                    except Exception:  # noqa: BLE001 — a probe bug must not wedge dequeue
+                        hit = False
+                    if hit:
+                        if i == 0:
+                            break
+                        req = lane[i][1]
+                        del lane[i]
+                        return req
+            return lane.popleft()[1]
 
 
 def coalesce_deadline(

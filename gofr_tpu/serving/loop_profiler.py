@@ -72,6 +72,7 @@ PHASES = (
     "reap",           # lifecycle reap (cancel/deadline retirement)
     "ledger",         # tenant-ledger occupancy tick
     "brownout",       # brownout-controller evaluation
+    "control",        # control-plane pass (signal sampling + loops)
     "sweep",          # radix-eviction watermark sweep
     "tier_import",    # disaggregated-tier payload apply
     "prefill",        # admission + chunked-prefill dispatch

@@ -148,6 +148,25 @@ class RadixPrefixIndex:
                 node = child
             return blocks, len(blocks) * self.block
 
+    def peek(self, ids: list[int], aid: int = 0) -> int:
+        """Matched-token count of the longest cached full-block prefix
+        — the NON-MUTATING twin of :meth:`lookup`: no increfs, no LRU
+        refresh, so an admission-ordering probe
+        (``TPU_QUEUE_PREFIX_AWARE``) can ask "would this hit?" without
+        pinning blocks or perturbing eviction order."""
+        with self._lock:
+            node = self._roots.get(aid)
+            if node is None:
+                return 0
+            matched = 0
+            for chunk in self._chunks(ids):
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                matched += self.block
+                node = child
+            return matched
+
     def insert(
         self, ids: list[int], blocks: list[int], aid: int = 0
     ) -> list[bool]:

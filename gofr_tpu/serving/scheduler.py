@@ -106,6 +106,7 @@ class SchedulerMixin:
     _ledger: Any  # Optional[serving.device_telemetry.HBMLedger]
     _slo: Any  # Optional[serving.slo.SLOEngine]
     _brownout: Any  # Optional[serving.brownout.BrownoutController]
+    _control: Any  # Optional[serving.control_plane.ControlPlane]
     _compiles: Any  # serving.device_telemetry.CompileTracker
     _logger: Any
     _tput: Any  # lifecycle.AggregateThroughput
@@ -234,6 +235,16 @@ class SchedulerMixin:
                     self._brownout_tick()
                     if prof is not None:
                         prof.lap("brownout", self._obs.now())
+                # Control plane (serving/control_plane.py): ONE guarded
+                # pass over every registered signal + the three closed
+                # loops, right after the sensors it consumes ticked.
+                # Off (TPU_CONTROL_PLANE=0) = this one check; evaluate
+                # never raises (a lying sensor degrades its loop to
+                # observe-only instead of wedging this pass).
+                if self._control is not None:
+                    self._control.evaluate(self._obs.now())
+                    if prof is not None:
+                        prof.lap("control", self._obs.now())
                 if self.kv_block:
                     # Proactive prefix-eviction sweep: keep the free
                     # list above the watermark so admission finds free

@@ -196,6 +196,7 @@ class SLOEngine:
         tenant_objectives: Optional[
             Mapping[str, Mapping[str, float]]
         ] = None,
+        track_tenants: int = 0,
         metrics: Any = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -233,6 +234,18 @@ class SLOEngine:
                 else self.target
             )
             self._tenant_budget[key] = max(1e-7, 1.0 - target)
+        # Automatic per-tenant tracking (ISSUE 17): with
+        # ``track_tenants > 0`` every observed tenant (up to the bound)
+        # gets its own ring set judged against the GLOBAL objectives —
+        # the control plane's per-tenant burn signal. Deliberately a
+        # SEPARATE table from ``_tenant_slos``: these entries are
+        # traffic-derived, so they never join the configuration-bounded
+        # metric/debug label set (GL016).
+        self.track_tenants = max(0, int(track_tenants))
+        self._auto_slos: dict[str, dict[str, _SLO]] = {}
+        self._ttft_ms = float(ttft_ms)
+        self._e2e_ms = float(e2e_ms)
+        self._availability = float(availability)
         # Cached GLOBAL compliance bit, refreshed by every
         # observation/health/describe pass (_publish_counts): the
         # routing hot path (ReplicaPool.pick via engine.slo_compliant)
@@ -297,7 +310,35 @@ class SLOEngine:
             tslos = self._tenant_slos.get(tkey) if tkey else None
             if tslos is not None:
                 self._judge(tslos, outcome, phases, t)
+            if tkey and self.track_tenants > 0 and self._slos:
+                auto = self._auto_slos.get(tkey)
+                if auto is None:
+                    if len(self._auto_slos) >= self.track_tenants:
+                        self._evict_idle_auto(t)
+                    if len(self._auto_slos) < self.track_tenants:
+                        auto = self._auto_slos[tkey] = _build_slos(
+                            self._ttft_ms,
+                            self._e2e_ms,
+                            self._availability,
+                        )
+                if auto is not None:
+                    self._judge(auto, outcome, phases, t)
         self._publish(t)
+
+    def _evict_idle_auto(self, now: float) -> None:
+        """Drop auto-tracked tenants whose rings are all empty (call
+        under the lock): the table stays bounded by ``track_tenants``
+        without ever evicting a tenant that still has in-window data."""
+        idle = [
+            tenant for tenant, slos in self._auto_slos.items()
+            if all(
+                ring.counts(now)[1] == 0
+                for obj in slos.values()
+                for ring in obj.rings.values()
+            )
+        ]
+        for tenant in idle:
+            del self._auto_slos[tenant]
 
     # -- evaluation -----------------------------------------------------
 
@@ -376,6 +417,30 @@ class SLOEngine:
         if not counts:
             return 0.0
         return max(self._burn(c) for c in counts)
+
+    def tenant_burns(
+        self, window: str = "5m", now: Optional[float] = None
+    ) -> dict[str, float]:
+        """Per-tenant maximum burn over the window, from the
+        auto-tracked rings (``track_tenants``) — the control plane's
+        per-tenant brownout signal. Every tenant is judged against the
+        GLOBAL objectives and budget, so the numbers are comparable
+        across tenants; empty when tracking is off."""
+        t = self._clock() if now is None else now
+        with self._lock:
+            per_tenant = {
+                tenant: [
+                    obj.rings[window].counts(t)
+                    for obj in slos.values()
+                    if window in obj.rings
+                ]
+                for tenant, slos in self._auto_slos.items()
+            }
+        return {
+            tenant: max(self._burn(c) for c in counts)
+            for tenant, counts in per_tenant.items()
+            if counts
+        }
 
     def compliant(self, now: Optional[float] = None) -> bool:
         """True while every GLOBAL (slo, window) burn rate is ≤ 1 —

@@ -33,6 +33,7 @@ if command -v mypy >/dev/null 2>&1; then
     gofr_tpu/serving/types.py gofr_tpu/serving/lifecycle.py \
     gofr_tpu/serving/engine.py gofr_tpu/serving/backend.py \
     gofr_tpu/serving/batcher.py gofr_tpu/serving/brownout.py \
+    gofr_tpu/serving/control_plane.py \
     gofr_tpu/serving/supervisor.py \
     gofr_tpu/serving/watchdog.py gofr_tpu/serving/scheduler.py \
     gofr_tpu/serving/observability.py gofr_tpu/serving/radix_cache.py \

@@ -578,6 +578,9 @@ class FakeReplica:
     def brownout_level(self):
         return self._level
 
+    def control_pressure(self):
+        return None
+
     def set_handoff(self, handoff):
         pass
 

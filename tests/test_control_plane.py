@@ -1,0 +1,875 @@
+"""The fault-tolerant control plane (serving/control_plane.py;
+docs/advanced-guide/resilience.md "Control plane").
+
+Three layers of coverage, all deterministic:
+
+* **loop math** — stated-clock units for the per-tenant ladder
+  (hysteresis, AIMD, L0 byte-identity snap), the host-overhead
+  pressure loop, and the predictive trend fit (which must fire while
+  the depth itself is still far below the reactive threshold);
+* **the signal guard** — fresh → last-good → observe-only transitions,
+  NaN/type lies rejected as errors, and one chaos test per
+  ``control.signal`` fault mode (stale / NaN / raise / flap), each
+  ending with the loop observe-only and ZERO 5xx;
+* **per-tenant acceptance** — a real flooding hog burns its own
+  availability SLO and climbs ITS ladder while every other tenant's
+  seeded greedy stream stays byte-identical and the pod ladder holds
+  L0.
+
+Plus the satellite regressions this PR's audit pinned: a None/NaN
+headroom advertisement never counts as pressure anywhere (engine
+admission, pool scaler, brownout controller), and the
+prefix-hit-aware queue ordering is byte-identical when off."""
+
+from __future__ import annotations
+
+import math
+import time
+
+import pytest
+
+from gofr_tpu import faults
+from gofr_tpu.errors import ErrorTooManyRequests
+from gofr_tpu.metrics.manager import Manager
+from gofr_tpu.serving.brownout import MAX_LEVEL, BrownoutController
+from gofr_tpu.serving.control_plane import (
+    ControlPlane,
+    HostPressureLoop,
+    PredictiveLoop,
+)
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.lifecycle import ClassPriorityQueue
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def control_metrics() -> Manager:
+    m = Manager()
+    m.new_counter("app_tpu_control_actions_total")
+    for name in (
+        "app_tpu_control_signal_health",
+        "app_tpu_control_tenant_level",
+        "app_tpu_control_scale_pressure",
+    ):
+        m.new_gauge(name)
+    return m
+
+
+def gauge_value(m: Manager, name: str, **labels: str) -> float:
+    inst = [i for i in m.instruments() if i.name == name]
+    if not inst:
+        return float("nan")
+    want = set(labels.items())
+    for k, v in inst[0].collect().items():
+        if want <= set(k):
+            return v
+    return float("nan")
+
+
+def make_plane(**kw):
+    clock = FakeClock(1000.0)
+    defaults = dict(
+        tenant_enter=2.0, tenant_exit=1.0, tenant_sustain_s=5.0,
+        tenant_exit_sustain_s=20.0, tenant_max_new=8,
+        tenant_aimd_cut=0.5, tenant_recover_per_s=0.05,
+        host_ratio=0.85, host_util=0.75, host_sustain_s=5.0,
+        predict_window_s=60.0, predict_horizon_s=30.0,
+        predict_depth=64.0, predict_hold_s=10.0,
+        clock=clock,
+    )
+    defaults.update(kw)
+    return ControlPlane("m", **defaults), clock
+
+
+def make_engine(**kw):
+    defaults = dict(
+        n_slots=2, max_len=128, kv_block=16,
+        tokenizer=ByteTokenizer(), seed=0,
+        slo_availability=0.999,
+        control_plane=True,
+        # Hold a reached level against the scheduler's continuous
+        # re-evaluation (the brownout-test idiom): with burn 0 the
+        # ladder would descend after the exit sustain.
+        control_tenant_exit_sustain_s=100_000.0,
+        # The POD ladder must hold L0 through the per-tenant tests —
+        # the hog's sheds burn the GLOBAL availability SLO too, and
+        # the isolation contract is per-tenant action, pod inaction.
+        brownout_sustain_s=100_000.0,
+    )
+    defaults.update(kw)
+    eng = InferenceEngine("llama-tiny", **defaults)
+    eng.start_sync()
+    return eng
+
+
+def wait_for(predicate, timeout_s: float = 30.0) -> None:
+    """Bound a poll on the scheduler thread observing a condition —
+    the OUTCOME is deterministic, only the thread interleaving isn't."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    assert predicate(), "condition never became true"
+
+
+def _greedy(eng, prompt: str = "byte identical", tenant: str = ""):
+    return eng.generate_sync(
+        prompt, max_new_tokens=8, temperature=0.0, stop_on_eos=False,
+        tenant=tenant, timeout=300,
+    ).token_ids
+
+
+# ----------------------------------------------------------------------
+# loop math: the per-tenant ladder (stated clock)
+# ----------------------------------------------------------------------
+
+
+def test_tenant_ladder_one_bad_tick_never_flips_a_level():
+    cp, clock = make_plane()
+    burns = {"hog": 50.0}
+    cp.register("tenant_burn", lambda: burns, kind="map")
+    cp.evaluate(now=clock.t)                    # over, anchor only
+    assert cp.tenant_level("hog") == 0
+    cp.evaluate(now=clock.advance(4.9))         # inside the sustain
+    assert cp.tenant_level("hog") == 0
+    cp.evaluate(now=clock.advance(0.2))         # sustained → L1
+    assert cp.tenant_level("hog") == 1
+    # One clean tick does NOT descend either (exit sustain).
+    burns["hog"] = 0.0
+    cp.evaluate(now=clock.advance(1.0))
+    assert cp.tenant_level("hog") == 1
+
+
+def test_tenant_ladder_climbs_per_sustain_caps_and_isolates():
+    cp, clock = make_plane()
+    burns = {"hog": 10.0, "clean": 0.0}
+    cp.register("tenant_burn", lambda: burns, kind="map")
+    cp.evaluate(now=clock.t)
+    for expected in (1, 2, 3, 3):               # re-armed per rung; caps
+        cp.evaluate(now=clock.advance(5.1))
+        assert cp.tenant_level("hog") == expected
+    assert cp.tenant_level("hog") == MAX_LEVEL
+    # Isolation: the clean tenant never left L0, and its actuators are
+    # byte-identically neutral.
+    assert cp.tenant_level("clean") == 0
+    assert cp.tenant_clamp_max_new("clean", 32) == 32
+    assert all(cp.tenant_admit("clean", "standard") for _ in range(20))
+    snap = cp.snapshot()
+    assert snap["loops"]["tenant_brownout"]["tenants"]["hog"]["level"] == 3
+    assert snap["loops"]["tenant_brownout"]["transitions"]["up"] == 3
+
+
+def test_tenant_hysteresis_band_holds_and_exit_needs_sustained_clean():
+    cp, clock = make_plane()
+    burns = {"hog": 10.0}
+    cp.register("tenant_burn", lambda: burns, kind="map")
+    cp.evaluate(now=clock.t)
+    cp.evaluate(now=clock.advance(5.1))
+    assert cp.tenant_level("hog") == 1
+    # Between exit (1.0) and enter (2.0): the band holds the level and
+    # resets BOTH anchors — band time counts toward neither sustain.
+    burns["hog"] = 1.5
+    for _ in range(5):
+        cp.evaluate(now=clock.advance(30.0))
+        assert cp.tenant_level("hog") == 1
+    # Clean signal: one rung only after a full exit-sustain period.
+    burns["hog"] = 0.2
+    cp.evaluate(now=clock.advance(0.0))
+    cp.evaluate(now=clock.advance(19.9))
+    assert cp.tenant_level("hog") == 1
+    cp.evaluate(now=clock.advance(0.2))
+    assert cp.tenant_level("hog") == 0
+
+
+def test_tenant_aimd_cut_recovery_and_l0_snap():
+    cp, clock = make_plane()
+    burns = {"hog": 10.0}
+    cp.register("tenant_burn", lambda: burns, kind="map")
+    cp.evaluate(now=clock.t)
+    cp.evaluate(now=clock.advance(5.1))         # L1: no budget action
+    table = cp.tenant_loop.table
+    assert table["hog"].budget_factor == 1.0
+    cp.evaluate(now=clock.advance(5.1))         # L2: multiplicative cut
+    assert table["hog"].budget_factor == pytest.approx(0.5)
+    # Additive recovery while the signal is below enter: 10s at
+    # 0.05/s → +0.5, capped at 1.0 only at L0.
+    burns["hog"] = 0.0
+    cp.evaluate(now=clock.advance(10.0))
+    assert table["hog"].budget_factor == pytest.approx(1.0)
+    # Descend to L0 (two exit-sustain periods) snaps the factor to
+    # exactly 1.0 — the byte-identity contract.
+    cp.evaluate(now=clock.advance(20.1))
+    cp.evaluate(now=clock.advance(20.1))
+    assert cp.tenant_level("hog") == 0
+    burns.clear()
+    cp.evaluate(now=clock.advance(1.0))
+    # Fully-recovered idle entries leave the table (bounded memory).
+    assert "hog" not in table
+
+
+def test_l2_admission_credit_is_deterministic_and_class_aware():
+    cp, _clock = make_plane()
+    cp.force_tenant_level("hog", 2)
+    ladder = cp.tenant_loop.table["hog"]
+    assert ladder.budget_factor == pytest.approx(0.5)   # one AIMD cut
+    # standard: 0.5 × 0.8 = 0.4 credit per submit, starting bank 1.0 —
+    # the exact admit pattern is stated, not sampled.
+    got = [cp.tenant_admit("hog", "standard") for _ in range(10)]
+    assert got == [
+        True, False, True, False, True, False, False, True, False, True,
+    ]
+    # interactive (fraction 1.0): 0.5 credit/call after the AIMD cut —
+    # the starting bank of 1.0 buys two admissions up front, then one
+    # in two.
+    cp.force_tenant_level("ivy", 2)
+    got = [cp.tenant_admit("ivy", "interactive") for _ in range(6)]
+    assert got == [True, True, False, True, False, True]
+    # L3: shed outright; L1: admit everything.
+    cp.force_tenant_level("hog", 3)
+    assert not any(cp.tenant_admit("hog", "interactive") for _ in range(5))
+    cp.force_tenant_level("hog", 1)
+    assert all(cp.tenant_admit("hog", "batch") for _ in range(5))
+
+
+def test_tenant_recovery_floor_scales_with_level():
+    cp, _clock = make_plane()
+    assert cp.tenant_recovery_s("unknown") == 0.0
+    cp.force_tenant_level("hog", 2)
+    at_l2 = cp.tenant_recovery_s("hog")
+    cp.force_tenant_level("hog", 3)
+    at_l3 = cp.tenant_recovery_s("hog")
+    assert at_l3 > at_l2 >= 1.0
+
+
+def test_tenant_table_is_bounded_against_label_cardinality():
+    cp, clock = make_plane(tenant_table_max=4)
+    burns = {f"t{i}": 10.0 for i in range(32)}
+    cp.register("tenant_burn", lambda: burns, kind="map")
+    cp.evaluate(now=clock.t)
+    cp.evaluate(now=clock.advance(5.1))
+    assert len(cp.tenant_loop.table) == 4
+
+
+# ----------------------------------------------------------------------
+# loop math: host-overhead pressure + predictive scaling
+# ----------------------------------------------------------------------
+
+
+def test_host_pressure_needs_sustained_ratio_at_high_util():
+    hl = HostPressureLoop(ratio=0.85, util=0.75, sustain_s=5.0)
+    assert hl.evaluate(0.9, 0.8, 0.0) is False      # anchor only
+    assert hl.evaluate(0.9, 0.8, 4.9) is False
+    assert hl.evaluate(0.9, 0.8, 5.1) is True       # sustained
+    # Hysteresis band (exit = enter − 0.1): holds, resets anchors.
+    assert hl.evaluate(0.80, 0.8, 10.0) is True
+    # Clean below the exit ratio: released only after the sustain.
+    assert hl.evaluate(0.5, 0.8, 20.0) is True
+    assert hl.evaluate(0.5, 0.8, 24.9) is True
+    assert hl.evaluate(0.5, 0.8, 25.1) is False
+    # High ratio at LOW utilization is not pressure (an idle loop's
+    # bookkeeping share is large by construction).
+    hl2 = HostPressureLoop(ratio=0.85, util=0.75, sustain_s=5.0)
+    hl2.evaluate(0.99, 0.1, 0.0)
+    assert hl2.evaluate(0.99, 0.1, 60.0) is False
+
+
+def test_predictive_fires_on_trend_before_reactive_threshold():
+    pl = PredictiveLoop(
+        window_s=60.0, horizon_s=30.0, depth_threshold=64.0, hold_s=10.0
+    )
+    # Rising ~2 req/s: projected = depth + 2×30 crosses 64 while the
+    # depth itself is only 6 — the LEAD the loop exists to provide.
+    assert pl.evaluate(0.0, 0.0, 0.0) is False      # < MIN_SAMPLES
+    assert pl.evaluate(2.0, 0.0, 1.0) is False
+    assert pl.evaluate(4.0, 0.0, 2.0) is False
+    assert pl.evaluate(6.0, 0.0, 3.0) is True
+    assert 6.0 < pl.depth_threshold                 # fired early
+    assert pl.last_slope == pytest.approx(2.0)
+    # Hold-down: the trend breaking does not release before hold_s.
+    assert pl.evaluate(0.0, 0.0, 4.0) is True
+    # Past the hold with no projected breach: released.
+    assert pl.evaluate(0.0, 0.0, 14.0) is False
+
+
+def test_predictive_flat_backlog_below_threshold_never_fires():
+    pl = PredictiveLoop(
+        window_s=60.0, horizon_s=30.0, depth_threshold=64.0, hold_s=10.0
+    )
+    for t in range(10):
+        assert pl.evaluate(20.0, 0.0, float(t)) is False
+    assert pl.last_slope == pytest.approx(0.0)
+
+
+def test_scale_pressure_follows_loops_and_modes():
+    cp, clock = make_plane(
+        host_sustain_s=1.0, predict_depth=8.0, predict_horizon_s=10.0
+    )
+    sensors = {"host_overhead_ratio": 0.95, "loop_utilization": 0.9}
+    cp.register(
+        "host_overhead_ratio", lambda: sensors["host_overhead_ratio"]
+    )
+    cp.register("loop_utilization", lambda: sensors["loop_utilization"])
+    cp.evaluate(now=clock.t)
+    assert cp.scale_pressure() == 0
+    cp.evaluate(now=clock.advance(1.1))
+    assert cp.scale_pressure() == 1
+    # The signal dying moves the loop to observe-only → neutral, even
+    # though the loop's internal latch still says pressure.
+    sensors["host_overhead_ratio"] = float("nan")
+    cp.evaluate(now=clock.advance(cp.stale_s + 1.0))
+    assert cp.host_loop.pressure is True
+    assert cp.scale_pressure() == 0
+    assert cp.snapshot()["loops"]["host_pressure"]["mode"] == "observe_only"
+
+
+# ----------------------------------------------------------------------
+# the signal guard: fresh → last-good → observe-only
+# ----------------------------------------------------------------------
+
+
+def test_guard_walks_ok_last_good_observe_only_and_recovers():
+    cp, clock = make_plane(stale_s=10.0)
+    sensor = {"value": 5.0, "raise": False}
+
+    def read():
+        if sensor["raise"]:
+            raise RuntimeError("sensor died")
+        return sensor["value"]
+
+    cp.register("queue_depth", read)
+    cp.evaluate(now=clock.t)
+    assert cp.signal_health() == {"queue_depth": 1.0}
+    # Failure within the stale window: last-good, loop still active.
+    sensor["raise"] = True
+    cp.evaluate(now=clock.advance(5.0))
+    assert cp.signal_health() == {"queue_depth": 0.5}
+    snap = cp.snapshot()["signals"]["queue_depth"]
+    assert snap["status"] == "last_good"
+    assert "RuntimeError" in snap["last_error"]
+    assert cp.snapshot()["loops"]["predictive"]["mode"] == "active"
+    # Past the window: observe-only, the consuming loop goes neutral.
+    cp.evaluate(now=clock.advance(10.1))
+    assert cp.signal_health() == {"queue_depth": 0.0}
+    assert cp.snapshot()["loops"]["predictive"]["mode"] == "observe_only"
+    # Recovery is immediate on the next good sample.
+    sensor["raise"] = False
+    cp.evaluate(now=clock.advance(1.0))
+    assert cp.signal_health() == {"queue_depth": 1.0}
+    assert cp.snapshot()["signals"]["queue_depth"]["errors"] == 2
+
+
+def test_nan_and_type_lies_are_errors_not_values():
+    cp, clock = make_plane(stale_s=0.0)
+    values = {"scalar": float("nan"), "map": {"hog": float("inf")}}
+    cp.register("queue_depth", lambda: values["scalar"])
+    cp.register("tenant_burn", lambda: values["map"], kind="map")
+    cp.evaluate(now=clock.t)
+    health = cp.signal_health()
+    assert health["queue_depth"] == 0.0
+    assert health["tenant_burn"] == 0.0
+    # A map sensor answering a scalar (and vice versa) is an error too.
+    values["map"] = 3.0
+    values["scalar"] = {"not": 1.0}
+    cp.evaluate(now=clock.advance(1.0))
+    assert cp.signal_health() == {
+        "queue_depth": 0.0, "tenant_burn": 0.0,
+    }
+    # Guarded failures are NOT controller bugs: eval_errors stays 0.
+    assert cp.snapshot()["eval_errors"] == 0
+
+
+def test_tenant_loop_observes_only_holds_table_on_dead_sensor():
+    cp, clock = make_plane(stale_s=5.0)
+    state = {"burns": {"hog": 10.0}, "fail": False}
+
+    def read():
+        if state["fail"]:
+            raise RuntimeError("burn sensor gone")
+        return state["burns"]
+
+    cp.register("tenant_burn", read, kind="map")
+    cp.evaluate(now=clock.t)
+    cp.evaluate(now=clock.advance(5.1))
+    assert cp.tenant_level("hog") == 1
+    # Sensor dies past the stale window: the table HOLDS (no climbs,
+    # no descents) and every actuator reads neutral.
+    state["fail"] = True
+    cp.evaluate(now=clock.advance(6.0))
+    mode = cp.snapshot()["loops"]["tenant_brownout"]["mode"]
+    assert mode == "observe_only"
+    assert cp.tenant_loop.table["hog"].level == 1
+    assert cp.tenant_clamp_max_new("hog", 32) == 32   # neutral at L1
+    for _ in range(10):
+        cp.evaluate(now=clock.advance(30.0))
+    assert cp.tenant_loop.table["hog"].level == 1     # held, not moved
+
+
+def test_evaluate_never_raises_even_on_controller_bugs():
+    cp, clock = make_plane()
+    cp.register("tenant_burn", lambda: {}, kind="map")
+    # Sabotage the loop itself — not just a sensor — and evaluate must
+    # still return (the scheduler pass survives; the bug is counted).
+    cp.tenant_loop.evaluate = None  # type: ignore[assignment]
+    cp.evaluate(now=clock.advance(1.0))
+    assert cp.snapshot()["eval_errors"] == 1
+
+
+def test_metrics_export_health_levels_and_pressure():
+    m = control_metrics()
+    cp, clock = make_plane(metrics=m, stale_s=0.0)
+    state = {"burns": {"hog": 10.0}, "depth_ok": True}
+    cp.register("tenant_burn", lambda: state["burns"], kind="map")
+    cp.register(
+        "queue_depth",
+        lambda: 1.0 if state["depth_ok"] else float("nan"),
+    )
+    cp.evaluate(now=clock.t)
+    cp.evaluate(now=clock.advance(5.1))
+    assert gauge_value(
+        m, "app_tpu_control_signal_health", signal="tenant_burn"
+    ) == 1.0
+    assert gauge_value(
+        m, "app_tpu_control_tenant_level", tenant="hog"
+    ) == 1.0
+    assert gauge_value(
+        m, "app_tpu_control_scale_pressure", source="predictive"
+    ) == 0.0
+    # The health gauge NAMES the degraded signal.
+    state["depth_ok"] = False
+    cp.evaluate(now=clock.advance(1.0))
+    assert gauge_value(
+        m, "app_tpu_control_signal_health", signal="queue_depth"
+    ) == 0.0
+    assert gauge_value(
+        m, "app_tpu_control_signal_health", signal="tenant_burn"
+    ) == 1.0
+    # A tenant leaving the table zeroes its gauge (no stale levels).
+    state["burns"] = {}
+    burn_clock = clock.advance(100_000.0)
+    for _ in range(4):
+        burn_clock = clock.advance(100_000.0)
+        cp.evaluate(now=burn_clock)
+    assert gauge_value(
+        m, "app_tpu_control_tenant_level", tenant="hog"
+    ) == 0.0
+
+
+# ----------------------------------------------------------------------
+# chaos: the control.signal fault point, one test per failure mode
+# ----------------------------------------------------------------------
+
+
+def _plane_with_live_sensor():
+    cp, clock = make_plane(stale_s=5.0)
+    cp.register("queue_depth", lambda: 7.0)
+    return cp, clock
+
+
+def test_fault_stale_starves_one_signal_to_observe_only():
+    cp, clock = _plane_with_live_sensor()
+    cp.evaluate(now=clock.t)
+    with faults.armed(
+        "control.signal",
+        action=lambda signal: "stale" if signal == "queue_depth" else None,
+    ):
+        cp.evaluate(now=clock.advance(1.0))
+        assert cp.signal_health()["queue_depth"] == 0.5   # last-good
+        cp.evaluate(now=clock.advance(10.0))
+        assert cp.signal_health()["queue_depth"] == 0.0
+        assert (
+            cp.snapshot()["loops"]["predictive"]["mode"] == "observe_only"
+        )
+        assert cp.scale_pressure() == 0
+    cp.evaluate(now=clock.advance(1.0))
+    assert cp.signal_health()["queue_depth"] == 1.0       # recovered
+
+
+def test_fault_nan_lie_is_rejected_not_consumed():
+    cp, clock = _plane_with_live_sensor()
+    cp.evaluate(now=clock.t)
+    with faults.armed(
+        "control.signal",
+        action=lambda signal: (
+            float("nan") if signal == "queue_depth" else None
+        ),
+    ):
+        cp.evaluate(now=clock.advance(10.0))
+        assert cp.signal_health()["queue_depth"] == 0.0
+        snap = cp.snapshot()["signals"]["queue_depth"]
+        assert "non-finite" in snap["last_error"]
+    assert cp.snapshot()["eval_errors"] == 0
+
+
+def test_fault_raise_is_absorbed_by_the_guard():
+    cp, clock = _plane_with_live_sensor()
+    cp.evaluate(now=clock.t)
+
+    def blow_up(signal):
+        if signal == "queue_depth":
+            raise RuntimeError("sensor exploded")
+        return None
+
+    with faults.armed("control.signal", action=blow_up):
+        cp.evaluate(now=clock.advance(10.0))   # never raises
+        assert cp.signal_health()["queue_depth"] == 0.0
+    assert cp.snapshot()["eval_errors"] == 0
+
+
+def test_fault_flap_never_wedges_or_errors():
+    cp, clock = _plane_with_live_sensor()
+    cp.evaluate(now=clock.t)
+    flap = {"n": 0}
+
+    def flapping(signal):
+        if signal != "queue_depth":
+            return None
+        flap["n"] += 1
+        return "stale" if flap["n"] % 2 else None
+
+    with faults.armed("control.signal", action=flapping):
+        for _ in range(20):
+            cp.evaluate(now=clock.advance(1.0))
+            assert cp.signal_health()["queue_depth"] in (0.5, 1.0)
+    assert cp.snapshot()["eval_errors"] == 0
+    assert cp.snapshot()["passes"] >= 21
+
+
+def test_engine_chaos_dead_burn_sensor_zero_5xx_observe_only():
+    """The headline acceptance: arm the ``control.signal`` fault
+    against a REAL engine's burn sensor mid-flight — no crash, no
+    wedged scheduler, zero 5xx; the tenant loop parks observe-only
+    (even a forced L3 admits — acting on a dead sensor is guessing),
+    and the health surface names the lying signal."""
+    eng = make_engine(control_stale_s=0.05)
+    try:
+        cp = eng._control
+        assert cp is not None
+        cp.force_tenant_level("hog", 3)
+
+        def kill_burn(signal):
+            if signal == "tenant_burn":
+                raise RuntimeError("burn sensor died")
+            return None
+
+        with faults.armed("control.signal", action=kill_burn):
+            wait_for(lambda: (
+                eng.control_report()["signals"]["tenant_burn"]["status"]
+                == "observe_only"
+            ))
+            # Zero 5xx: every tenant — the forced-L3 hog included —
+            # serves normally while the loop observes only.
+            for tenant in ("hog", "clean"):
+                result = eng.generate_sync(
+                    f"chaos {tenant}", max_new_tokens=4,
+                    temperature=0.0, stop_on_eos=False, tenant=tenant,
+                    timeout=300,
+                )
+                assert len(result.token_ids) == 4
+            report = eng.control_report()
+            assert report["loops"]["tenant_brownout"]["mode"] == (
+                "observe_only"
+            )
+            assert report["signals"]["tenant_burn"]["health"] == 0.0
+            assert eng.capacity_report()["control"][
+                "degraded_signals"
+            ] == ["tenant_burn"]
+            assert report["eval_errors"] == 0
+        # Disarmed: the sensor heals and the loop re-activates.
+        wait_for(lambda: (
+            eng.control_report()["signals"]["tenant_burn"]["status"]
+            == "ok"
+        ))
+        wait_for(lambda: (
+            eng.control_report()["loops"]["tenant_brownout"]["mode"]
+            == "active"
+        ))
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# engine integration: off-is-off, per-tenant actuation, acceptance
+# ----------------------------------------------------------------------
+
+
+def test_off_switch_and_neutral_plane_are_byte_identical():
+    base = make_engine(control_plane=False, slo_availability=0.0)
+    try:
+        assert base._control is None
+        assert base.control_report() == {"enabled": False}
+        # Plane off = signal ABSENT (None), not "armed at 0".
+        assert base.control_scale_pressure() is None
+        reference = _greedy(base)
+    finally:
+        base.close()
+    armed = make_engine()
+    try:
+        assert armed._control is not None
+        assert armed.control_scale_pressure() == 0
+        assert _greedy(armed) == reference
+        report = armed.control_report()
+        assert report["enabled"] is True
+        assert set(report["signals"]) >= {
+            "tenant_burn", "queue_depth", "throughput",
+        }
+    finally:
+        armed.close()
+
+
+def test_tenant_l1_clamps_only_the_burning_tenant():
+    eng = make_engine(control_tenant_max_new=4)
+    try:
+        eng._control.force_tenant_level("hog", 1)
+        hog = eng.generate_sync(
+            "clamp me", max_new_tokens=32, temperature=0.0,
+            stop_on_eos=False, tenant="hog", timeout=300,
+        )
+        assert len(hog.token_ids) == 4
+        assert hog.brownout is True           # deliberate, advertised
+        clean = eng.generate_sync(
+            "clamp me", max_new_tokens=32, temperature=0.0,
+            stop_on_eos=False, tenant="clean", timeout=300,
+        )
+        assert len(clean.token_ids) == 32
+        assert clean.brownout is False
+    finally:
+        eng.close()
+
+
+def test_tenant_l3_sheds_with_429_reason_and_retry_after():
+    eng = make_engine()
+    try:
+        eng._control.force_tenant_level("hog", 3)
+        with pytest.raises(ErrorTooManyRequests) as exc:
+            eng.submit_generate(
+                "shed me", max_new_tokens=4, temperature=0.0,
+                stop_on_eos=False, tenant="hog",
+            )
+        assert "tenant_brownout" in str(exc.value)
+        assert exc.value.retry_after_s >= 1
+        # Everyone else admits untouched while the hog sheds.
+        other = eng.generate_sync(
+            "not the hog", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False, tenant="clean", timeout=300,
+        )
+        assert len(other.token_ids) == 4
+        assert eng.health_check()["details"]["control"][
+            "tenants_browned_out"
+        ] == 1
+    finally:
+        eng.close()
+
+
+def test_acceptance_hog_burns_climb_its_ladder_others_byte_identical():
+    """The per-tenant acceptance: a flooding hog's admission sheds
+    burn ITS availability SLO, its ladder climbs, and the clean
+    tenants' seeded greedy streams match a control-off run byte for
+    byte while the POD ladder holds L0."""
+    reference = {}
+    base = make_engine(control_plane=False)
+    try:
+        for t in ("clean-a", "clean-b"):
+            reference[t] = _greedy(base, f"isolation {t}", tenant=t)
+    finally:
+        base.close()
+    eng = make_engine(
+        queue_max_tokens=96,
+        control_tenant_sustain_s=0.01,
+    )
+    try:
+        hog_prompt = "H" * 40
+        handles, sheds = [], 0
+        for i in range(12):
+            try:
+                handles.append(eng.submit_generate(
+                    hog_prompt + f" {i:02d}", max_new_tokens=16,
+                    temperature=0.0, stop_on_eos=False, tenant="hog",
+                ))
+            except ErrorTooManyRequests:
+                sheds += 1
+        assert sheds >= 1               # the flood overran the queue
+        # The hog's OWN availability burn drives ITS ladder.
+        wait_for(lambda: eng._control.tenant_level("hog") >= 1)
+        for h in handles:
+            try:
+                h.future.result(timeout=300)
+            except ErrorTooManyRequests:
+                sheds += 1              # L3 sheds count too
+        burns = eng._slo.tenant_burns("5m")
+        assert burns.get("hog", 0.0) > 2.0
+        assert burns.get("clean-a", 0.0) == 0.0
+        # Pod-level inaction: the hog degrades, the POD does not.
+        assert eng.brownout_level() == 0
+        for t in ("clean-a", "clean-b"):
+            assert _greedy(eng, f"isolation {t}", tenant=t) == (
+                reference[t]
+            )
+            assert eng._control.tenant_level(t) == 0
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: None/NaN headroom is never pressure (audit regressions)
+# ----------------------------------------------------------------------
+
+
+def test_brownout_none_or_nan_headroom_is_not_pressure():
+    clock = FakeClock(0.0)
+    bc = BrownoutController(
+        "m", min_headroom=0.2, sustain_s=5.0, clock=clock,
+    )
+    for headroom in (None, float("nan")):
+        bc.force_level(0)
+        bc.evaluate(0.0, headroom=headroom)
+        clock.advance(60.0)
+        assert bc.evaluate(0.0, headroom=headroom) == 0
+    # A real low advertisement still counts.
+    bc.evaluate(0.0, headroom=0.05)
+    clock.advance(5.1)
+    assert bc.evaluate(0.0, headroom=0.05) == 1
+
+
+def test_scaler_nan_headroom_is_not_pressure():
+    from gofr_tpu.service.pool_scaler import PoolScaler
+    from gofr_tpu.service.replica_pool import Replica, ReplicaPool
+
+    class Stub(Replica):
+        supports_stream = True
+
+        def __init__(self, name, headroom):
+            super().__init__(name)
+            self._headroom = headroom
+
+        def state(self):
+            return "SERVING"
+
+        def load(self):
+            return 0
+
+        def headroom(self):
+            return self._headroom
+
+        def set_handoff(self, handoff):
+            pass
+
+    a = Stub("a", float("nan"))
+    pool = ReplicaPool([a], probe_interval_s=0)
+    try:
+        scaler = PoolScaler(
+            pool, lambda: Stub("x", 0.9), max_replicas=3,
+            up_headroom_floor=0.2, scale_up_wait_s=10.0, interval_s=0,
+            sleep=lambda s: None,
+        )
+        for t in (0.0, 10.1, 60.0):
+            assert scaler.evaluate(now=t) == "steady"
+        assert len(pool.replicas) == 1
+        # The same floor WITH a finite violation still scales.
+        a._headroom = 0.05
+        assert scaler.evaluate(now=100.0) == "steady"
+        assert scaler.evaluate(now=110.1) == "up"
+    finally:
+        pool.close()
+
+
+def test_engine_admission_nan_headroom_never_sheds():
+    eng = make_engine()
+    try:
+        eng.admit_min_headroom = 0.99
+        eng.hbm_headroom_ratio = lambda: float("nan")  # lying telemetry
+        result = eng.generate_sync(
+            "admit me", max_new_tokens=4, temperature=0.0,
+            stop_on_eos=False, timeout=300,
+        )
+        assert len(result.token_ids) == 4
+    finally:
+        eng.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: prefix-hit-aware admission ordering (off = byte-identical)
+# ----------------------------------------------------------------------
+
+
+class _Req:
+    def __init__(self, name, slo_class="standard", hit=False):
+        self.name = name
+        self.slo_class = slo_class
+        self.hit = hit
+
+
+def test_queue_without_probe_is_byte_identical_fifo():
+    plain = ClassPriorityQueue()
+    probed = ClassPriorityQueue(prefix_probe=lambda req: False)
+    reqs = [_Req(f"r{i}", hit=(i == 3)) for i in range(6)]
+    for q in (plain, probed):
+        for r in reqs:
+            q.put_nowait(r)
+    order_plain = [plain.get_nowait().name for _ in range(6)]
+    order_probed = [probed.get_nowait().name for _ in range(6)]
+    assert order_plain == order_probed == [f"r{i}" for i in range(6)]
+
+
+def test_prefix_hit_jumps_within_its_class_lane():
+    q = ClassPriorityQueue(prefix_probe=lambda req: req.hit)
+    for i in range(5):
+        q.put_nowait(_Req(f"r{i}", hit=(i == 3)))
+    # The hit pops first; the misses keep their FIFO order after it.
+    assert [q.get_nowait().name for _ in range(5)] == [
+        "r3", "r0", "r1", "r2", "r4",
+    ]
+
+
+def test_prefix_probe_never_overrides_starvation_promotion():
+    clock = FakeClock(0.0)
+    q = ClassPriorityQueue(
+        promote_after_s=5.0, clock=clock,
+        prefix_probe=lambda req: req.hit,
+    )
+    q.put_nowait(_Req("old-batch", slo_class="batch"))
+    clock.advance(6.0)
+    q.put_nowait(_Req("hot-hit", slo_class="interactive", hit=True))
+    # The over-age batch head outranks the interactive prefix hit —
+    # the starvation bound is a hard contract, not a tie to break.
+    assert q.get_nowait().name == "old-batch"
+    assert q.get_nowait().name == "hot-hit"
+
+
+def test_prefix_probe_exception_is_a_miss_not_a_wedge():
+    def bad_probe(req):
+        raise RuntimeError("trie corrupted")
+
+    q = ClassPriorityQueue(prefix_probe=bad_probe)
+    q.put_nowait(_Req("a"))
+    q.put_nowait(_Req("b"))
+    assert q.get_nowait().name == "a"
+    assert q.get_nowait().name == "b"
+
+
+def test_engine_knob_defaults_off_and_wires_probe_when_on():
+    off = make_engine()
+    try:
+        assert off.queue_prefix_aware is False
+        assert off._pending._prefix_probe is None
+    finally:
+        off.close()
+    on = make_engine(
+        queue_prefix_aware=True, auto_prefix=True, prefix_cache_blocks=8
+    )
+    try:
+        assert on.queue_prefix_aware is True
+        assert on._pending._prefix_probe is not None
+    finally:
+        on.close()

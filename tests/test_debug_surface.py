@@ -251,6 +251,55 @@ def test_debug_tpu_trace_validates_and_captures(debug_app):
         assert out["captured_ms"] == 50 and out["trace_dir"]
 
 
+def test_debug_control_reports_the_control_plane(debug_app):
+    """/debug/control (docs/advanced-guide/resilience.md): the control
+    plane is default-on, so the ops port serves its full snapshot —
+    per-signal guard status, per-loop mode, the bounded decision log."""
+    st, body = _metrics_get(debug_app, "/debug/control")
+    assert st == 200
+    snap = json.loads(body)["tpu"]
+    assert snap["enabled"] is True
+    assert snap["passes"] >= 1 or snap["passes"] == 0  # shape, not timing
+    assert set(snap["signals"]) >= {
+        "tenant_burn", "queue_depth", "throughput",
+    }
+    for sig in snap["signals"].values():
+        assert sig["status"] in ("ok", "last_good", "observe_only", "init")
+        assert 0.0 <= sig["health"] <= 1.0
+    loops = snap["loops"]
+    assert loops["tenant_brownout"]["mode"] in (
+        "off", "observe_only", "active"
+    )
+    assert "pressure" in loops["host_pressure"]
+    assert "depth_threshold" in loops["predictive"]
+    assert isinstance(snap["decisions"], list)
+
+
+def test_debug_lockgraph_diffs_runtime_against_static(debug_app):
+    """/debug/lockgraph: the runtime lock-order graph (what lockcheck
+    actually witnessed) diffed against graftlint's static GL021 model —
+    runtime_only edges are blind spots in the static model, static_only
+    edges are paths this process never exercised."""
+    st, body = _metrics_get(debug_app, "/debug/lockgraph")
+    assert st == 200
+    report = json.loads(body)
+    assert set(report) >= {"runtime", "static", "diff", "violations"}
+    # TPU_LOCKCHECK is not set in this app: the runtime side says so
+    # explicitly instead of masquerading as "no edges observed".
+    assert report["runtime"]["enabled"] is False
+    assert report["runtime"]["edges"] == {}
+    static = report["static"]
+    assert isinstance(static["edges"], list)
+    for edge in static["edges"]:
+        assert " -> " in edge
+    diff = report["diff"]
+    assert isinstance(diff["runtime_only"], list)
+    assert isinstance(diff["static_only"], list)
+    # With runtime observation off, nothing can be runtime-only.
+    assert diff["runtime_only"] == []
+    assert isinstance(report["violations"], list)
+
+
 def test_run_async_stops_on_stop_event():
     """The signal-driven run loop: start → stop_event → graceful stop
     (the path run() drives under SIGINT/SIGTERM)."""
