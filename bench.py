@@ -60,7 +60,14 @@ over a small queue with BENCH_TENANTS=3 well-behaved tenants, run with
 the control plane off then on — the JSON line carries per-tenant
 goodput min/max under both policies, the hog's highest per-tenant
 ladder level, the predictive loop's scale lead time, and the plane's
-degraded-signal / eval-error counts).
+degraded-signal / eval-error counts),
+BENCH_ASYNC_WORKLOAD=1 (durable async-serving idle-soak A/B: the same
+interactive trickle with the async plane off then on against a
+request-topic backlog — with poison messages riding along so the
+redelivery/dead-letter path is priced too — emitting async_tps,
+interactive_ttft_p95_{off,on}_ms, redelivered and dead_lettered; the
+claim priced is that async soaks idle capacity WITHOUT moving
+interactive TTFT).
 Workload: BENCH_ARRIVAL_MS / BENCH_TOKEN_SPREAD (TPU default 25 / 0.5 —
 steady-state; the reported value is then the mid-window sustained rate,
 with the end-to-end rate in e2e_tps; set both to 0 for the synchronized
@@ -1507,6 +1514,157 @@ def _control_workload(on_tpu: bool) -> None:
     os._exit(0)
 
 
+def _async_workload(on_tpu: bool) -> None:
+    """BENCH_ASYNC_WORKLOAD=1: durable async-serving idle-soak A/B
+    (serving/async_serving.py) — the same interactive trickle measured
+    with the async plane off, then on against a request-topic backlog.
+    Poison messages ride along so the redelivery/dead-letter machinery
+    is priced too, not just the happy path. The claim the A/B prices:
+    async (batch-class) work soaks the idle capacity between
+    interactive arrivals WITHOUT moving interactive TTFT — the p95
+    pair off/on is the headline, async_tps is what that idle capacity
+    bought, redelivered/dead_lettered prove the contract machinery ran.
+    Self-contained: paged engine, in-memory broker, CPU-safe."""
+    from gofr_tpu.pubsub import InMemoryBroker
+    from gofr_tpu.serving.async_serving import AsyncServingPlane
+    from gofr_tpu.serving.engine import InferenceEngine
+    from gofr_tpu.serving.tokenizer import ByteTokenizer
+    from gofr_tpu.service.options import RetryConfig
+
+    model = os.environ.get(
+        "BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny"
+    )
+    n_interactive = int(os.environ.get("BENCH_REQUESTS", "12"))
+    n_async = int(os.environ.get("BENCH_ASYNC_BACKLOG", "24"))
+    n_poison = int(os.environ.get("BENCH_ASYNC_POISON", "2"))
+    new_tokens = int(os.environ.get(
+        "BENCH_NEW_TOKENS", "16" if on_tpu else "8"
+    ))
+    n_slots = int(os.environ.get("BENCH_SLOTS", "4"))
+    # The trickle's inter-arrival gap IS the idle capacity async soaks.
+    arrival_s = float(os.environ.get("BENCH_ARRIVAL_MS", "150")) / 1000.0
+
+    log(f"bench[async]: model={model} interactive={n_interactive} "
+        f"backlog={n_async}+{n_poison} poison arrival_ms="
+        f"{arrival_s * 1000:.0f}")
+
+    def run(async_on: bool) -> dict:
+        _set_stage(f"engine-init-async{int(async_on)}")
+        engine = InferenceEngine(
+            model, n_slots=n_slots,
+            max_len=int(os.environ.get("BENCH_MAX_LEN", "256")),
+            tokenizer=ByteTokenizer(),
+            window_k=int(os.environ.get("BENCH_WINDOW", "8")),
+            pipeline_depth=int(os.environ.get("BENCH_DEPTH", "2")),
+            kv_block=int(os.environ.get("BENCH_KV_BLOCK", "32")),
+            seed=0,
+        )
+        engine.start_sync()
+        _set_stage(f"warmup-async{int(async_on)}")
+        engine.generate_sync(
+            "w" * 8, max_new_tokens=2, temperature=0.0, stop_on_eos=False
+        )
+        engine.mark_steady_state()
+        plane = None
+        if async_on:
+            broker = InMemoryBroker()
+            plane = AsyncServingPlane(
+                engine, broker,
+                redelivery_max=2, lease_s=60.0, max_inflight=n_slots,
+                # Fast backoff so poison reaches the DLQ inside the
+                # bench window (production default is 1s base).
+                retry=RetryConfig(
+                    backoff_s=0.05, jitter=0.5, max_backoff_s=0.5
+                ),
+                poll_s=0.005,
+            )
+            for i in range(n_async):
+                broker.publish(plane.request_topic, json.dumps({
+                    "prompt": f"async soak {i:03d} " + "a" * 24,
+                    "max_new_tokens": new_tokens,
+                    "temperature": 0.0, "stop_on_eos": False,
+                }))
+            for i in range(n_poison):
+                broker.publish(plane.request_topic, f"poison {i}")
+            plane.start()
+        _set_stage(f"measure-async{int(async_on)}")
+        t0 = time.time()
+        ttfts_ms = []
+        for i in range(n_interactive):
+            r = engine.generate_sync(
+                f"interactive trickle {i:03d}",
+                max_new_tokens=new_tokens, temperature=0.0,
+                stop_on_eos=False, slo_class="interactive", timeout=1800,
+            )
+            ttfts_ms.append(r.ttft_s * 1000.0)
+            time.sleep(arrival_s)
+        async_tokens = 0
+        replies = 0
+        counters: dict = {}
+        if plane is not None:
+            # Soak until the backlog fully drains (replied or parked).
+            drain_deadline = time.time() + float(
+                os.environ.get("BENCH_ASYNC_DRAIN_S", "300")
+            )
+            while (
+                time.time() < drain_deadline
+                and plane.broker.size(plane.request_topic) > 0
+            ):
+                time.sleep(0.02)
+            wall = time.time() - t0
+            for m in plane.broker.peek_all(plane.reply_topic):
+                replies += 1
+                async_tokens += len(
+                    json.loads(m.value).get("token_ids") or []
+                )
+            counters = dict(plane.counters)
+            plane.stop(drain_s=10.0)
+        else:
+            wall = time.time() - t0
+        ttfts_ms.sort()
+        p95 = ttfts_ms[min(len(ttfts_ms) - 1, int(0.95 * len(ttfts_ms)))]
+        _recompile_guard(engine)
+        engine.stop_sync()
+        out = {
+            "wall_s": round(wall, 2),
+            "ttft_p95_ms": round(p95, 2),
+            "async_tps": round(async_tokens / wall, 2) if wall > 0 else 0.0,
+            "async_replies": replies,
+            "redelivered": int(counters.get("redelivered", 0)),
+            "dead_lettered": int(counters.get("dead_lettered", 0)),
+        }
+        log(f"bench[async]: async={async_on} → ttft_p95="
+            f"{out['ttft_p95_ms']}ms async_tps={out['async_tps']} "
+            f"replies={replies} redelivered={out['redelivered']} "
+            f"dead_lettered={out['dead_lettered']}")
+        return out
+
+    off = run(False)
+    on = run(True)
+    _set_stage("done")
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": on["async_tps"],
+        "unit": "tok/s/chip",
+        "vs_baseline": round(on["async_tps"] / 1000.0, 4),
+        "platform": "tpu" if on_tpu else "cpu",
+        "degraded": not on_tpu,
+        "model": model,
+        "workload": "async",
+        # The idle-soak A/B: async throughput bought from idle capacity,
+        # priced against the interactive-TTFT pair it must not move.
+        "async_tps": on["async_tps"],
+        "interactive_ttft_p95_off_ms": off["ttft_p95_ms"],
+        "interactive_ttft_p95_on_ms": on["ttft_p95_ms"],
+        "redelivered": on["redelivered"],
+        "dead_lettered": on["dead_lettered"],
+        "async_replies": on["async_replies"],
+        "async_backlog": n_async + n_poison,
+        "interactive_requests": n_interactive,
+    }), flush=True)
+    os._exit(0)
+
+
 def main() -> None:
     # Whole-run watchdog (round-2 lesson: the old init-only watchdog
     # released after jax.devices(), then engine-init remote compiles hung
@@ -1588,6 +1746,9 @@ def main() -> None:
         return  # unreachable (os._exit) — keeps the control flow obvious
     if os.environ.get("BENCH_CONTROL_WORKLOAD", "") in ("1", "true", "yes"):
         _control_workload(on_tpu)
+        return  # unreachable (os._exit) — keeps the control flow obvious
+    if os.environ.get("BENCH_ASYNC_WORKLOAD", "") in ("1", "true", "yes"):
+        _async_workload(on_tpu)
         return  # unreachable (os._exit) — keeps the control flow obvious
     model = os.environ.get("BENCH_MODEL", "llama-1b" if on_tpu else "llama-tiny")
     n_requests = int(os.environ.get("BENCH_REQUESTS", "64"))

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL022).
+"""The graftlint rule set (GL001–GL023).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -7,7 +7,7 @@ a rule should only fire where a human reviewer would at least pause —
 anything intentional gets an inline ``# graftlint: disable=RULE`` with
 its justification, which doubles as documentation at the call site.
 
-GL001–GL019 are per-file :class:`Rule`\\ s; GL020–GL022 are
+GL001–GL019 and GL023 are per-file :class:`Rule`\\ s; GL020–GL022 are
 :class:`ProjectRule`\\ s running against the cross-file
 :class:`~gofr_tpu.analysis.project.ProjectIndex` (call graph, lock
 model, thread roots) built by the two-phase runner.
@@ -2389,6 +2389,102 @@ class SyncOutsideDeviceWaitRule(Rule):
 ALL_RULES = ALL_RULES + (SyncOutsideDeviceWaitRule,)
 
 
+class AckBeforeResultRule(Rule):
+    """At-least-once delivery dies at exactly one line: the consumer
+    that acks a message *before* its result is safely out. An ack is
+    the broker's permission to forget — if the handler then crashes
+    between the ack and the reply publish (or the terminal future
+    resolution), the message is gone and the reply never happens: the
+    silent-loss bug class the async serving plane (ISSUE 18) exists to
+    prevent. The correct order is always publish-then-ack; a replayed
+    duplicate is the dedup ledger's problem, a lost message is nobody's.
+
+    Heuristic: inside one function body in ``pubsub/``/``serving/``
+    scope, flag a ``.ack(`` call that lexically precedes a result seam
+    — a ``publish``-named call (``publish``/``_publish_reply``/...), a
+    dead-letter handoff, or a terminal ``set_result``/``set_exception``
+    — later in the same body. A function that only acks (the dedup
+    replay path, where the reply already went out) has no seam after
+    the ack and does not fire; nested defs are separate bodies.
+    Deliberate ack-first consumers (at-MOST-once by design) carry an
+    inline disable — the justification doubles as documentation.
+    """
+
+    rule_id = "GL023"
+    name = "ack-before-result"
+    rationale = (
+        "acking a message before its result publish / terminal seam "
+        "converts at-least-once into at-most-once: a crash between the "
+        "ack and the publish loses the message with no redelivery; "
+        "publish the result first and let the dedup ledger absorb "
+        "replayed duplicates, or justify at-most-once inline"
+    )
+
+    #: Call names that terminate a handler's result: the reply/DLQ
+    #: publish and the future's terminal transitions.
+    _SEAMS = ("publish", "dead_letter", "set_result", "set_exception")
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(
+            f"/{d}/" in norm or norm.startswith(f"{d}/")
+            for d in ("pubsub", "serving")
+        )
+
+    @classmethod
+    def _is_seam(cls, call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        short = name.rsplit(".", 1)[-1].lstrip("_")
+        return any(s in short for s in cls._SEAMS)
+
+    @staticmethod
+    def _is_ack(call: ast.Call) -> bool:
+        return (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "ack"
+        )
+
+    @staticmethod
+    def _body_calls(fn: ast.AST) -> "list[ast.Call]":
+        """Every Call in ``fn``'s own body, nested defs excluded (a
+        nested handler is its own consumer body)."""
+        calls: list[ast.Call] = []
+        stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return calls
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = self._body_calls(fn)
+            seam_lines = [c.lineno for c in calls if self._is_seam(c)]
+            if not seam_lines:
+                continue
+            last_seam = max(seam_lines)
+            for call in calls:
+                if self._is_ack(call) and call.lineno < last_seam:
+                    yield self.finding(
+                        ctx, call,
+                        f"`{fn.name}` acks before its result publish / "
+                        "terminal seam in the same body — a crash "
+                        "between this ack and the publish loses the "
+                        "message with no redelivery (at-least-once "
+                        "becomes at-most-once); publish first, ack "
+                        "last, and let the dedup ledger absorb "
+                        "replays",
+                    )
+
+
+ALL_RULES = ALL_RULES + (AckBeforeResultRule,)
+
+
 # ----------------------------------------------------------------------
 # GL020–GL022 — project-wide concurrency rules (two-phase engine)
 # ----------------------------------------------------------------------
@@ -2786,6 +2882,7 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         ThresholdNoHysteresisRule(),
         HostPullInDeviceLegRule(),
         SyncOutsideDeviceWaitRule(),
+        AckBeforeResultRule(),
         UnguardedSharedStateRule(config.concurrency_dirs),
         LockOrderInversionRule(config.concurrency_dirs),
         BlockingUnderLockRule(config.concurrency_dirs),

@@ -77,6 +77,10 @@ class App:
         from gofr_tpu.subscriber import SubscriptionManager
 
         self._subscriptions = SubscriptionManager(self.container)
+        # The durable async serving plane (serving/async_serving.py;
+        # TPU_ASYNC=1). Built in start() AFTER the engine so its
+        # consumer loop never races engine warm-up; None when off.
+        self._async_plane = None
         self._grpc_services: list = []
         self._grpc_server = None
         self._http_server: Optional[HTTPServer] = None
@@ -240,6 +244,24 @@ class App:
             if engine is not None and hasattr(engine, "start"):
                 await engine.start()
 
+        if self.container.tpu is not None:
+            from gofr_tpu.serving.async_serving import (
+                new_async_plane_from_config,
+            )
+
+            self._async_plane = new_async_plane_from_config(
+                self.config, self.container.tpu,
+                metrics=self.container.metrics, logger=self.logger,
+            )
+            if self._async_plane is not None:
+                self._async_plane.start()
+                self.logger.infof(
+                    "async serving plane consuming %r -> %r (dlq %r)",
+                    self._async_plane.request_topic,
+                    self._async_plane.reply_topic,
+                    self._async_plane.dlq_topic,
+                )
+
         self._subscriptions.start()
 
     async def stop(self) -> None:
@@ -248,6 +270,14 @@ class App:
         # complete (up to the deadline) while new submissions get 503,
         # so a rolling restart doesn't fail live requests.
         drain_s = float(self.config.get_or_default("TPU_DRAIN_S", "0"))
+        if self._async_plane is not None:
+            # Drain BEFORE the engine stops: finished async work still
+            # publishes its replies, and unfinished leases are nacked
+            # back to the broker (budget refunded) instead of dropped.
+            await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._async_plane.stop(drain_s)
+            )
+            self._async_plane.broker.close()
         for engine in (self.container.tpu, self.container.tpu_embed):
             if engine is not None and hasattr(engine, "stop"):
                 import inspect
@@ -479,6 +509,26 @@ class App:
                 # loop acted, on what evidence, and which sensors is
                 # it no longer trusting".
                 return engine_report("control_report")
+            if path == "/debug/async":
+                # Async serving plane state (docs/advanced-guide/
+                # resilience.md "Async serving & delivery semantics"):
+                # topics + delivery knobs, consumer lag, in-flight
+                # leases, the delivery counters (consumed / published /
+                # redelivered / dead-lettered), and the dedup ledger's
+                # occupancy — the operator's one read for "is async
+                # traffic flowing, backing up, or dead-lettering".
+                import json as _json
+
+                plane = self._async_plane
+                body_async = (
+                    {"enabled": False} if plane is None
+                    else plane.report()
+                )
+                return Response(
+                    status=200,
+                    headers={"Content-Type": "application/json"},
+                    body=_json.dumps(body_async).encode(),
+                )
             if path == "/debug/lockgraph":
                 # Lock-order graphs (docs/advanced-guide/
                 # resilience.md): the RUNTIME order graph TPU_LOCKCHECK

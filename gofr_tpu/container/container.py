@@ -132,6 +132,37 @@ class Container:
         m.new_counter("app_pubsub_publish_success_count", "publish successes")
         m.new_counter("app_pubsub_subscribe_total_count", "subscribe polls")
         m.new_counter("app_pubsub_subscribe_success_count", "messages handled")
+        # Durable async serving plane (serving/async_serving.py;
+        # TPU_ASYNC; docs/advanced-guide/resilience.md "Async serving &
+        # delivery semantics"): the at-least-once delivery counters and
+        # the two live-state gauges the lag control signal reads.
+        m.new_counter(
+            "app_tpu_async_consumed_total",
+            "async request messages consumed (acked) by the serving plane",
+        )
+        m.new_counter(
+            "app_tpu_async_published_total",
+            "async reply messages published to the reply topic",
+        )
+        m.new_counter(
+            "app_tpu_async_redelivered_total",
+            "async request messages re-leased after a nack or an "
+            "expired lease (at-least-once redelivery)",
+        )
+        m.new_counter(
+            "app_tpu_async_dead_lettered_total",
+            "async request messages parked on the dead-letter topic "
+            "after exhausting their redelivery budget",
+        )
+        m.new_gauge(
+            "app_tpu_async_lag",
+            "request-topic backlog (ready messages) the async plane "
+            "has not yet leased — the consumer-lag scale signal",
+        )
+        m.new_gauge(
+            "app_tpu_async_inflight_leases",
+            "async request messages leased and riding the engine",
+        )
         # Net-new TPU serving metrics (SURVEY §2.6 per-chip observability).
         m.new_gauge("app_tpu_queue_depth", "dynamic batcher queue depth")
         m.new_gauge("app_tpu_hbm_used_bytes", "per-chip HBM in use")

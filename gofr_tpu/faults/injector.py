@@ -31,6 +31,20 @@ fires unconditionally and tests arm selectively:
   return a float (NaN included) = the sensor lying with that value.
   The control plane's guard must absorb every mode — last-good value,
   then observe-only — without a crash or a 5xx
+* ``pubsub.deliver``      — in the async serving plane
+  (``serving/async_serving.py``), after a request-topic lease before
+  the payload is parsed/admitted (kwargs ``topic``/``message_id``/
+  ``attempt``): raise = a broker read error or poison payload — the
+  message must nack onto the jittered-backoff redelivery path, never
+  be lost
+* ``pubsub.publish``      — before a reply or dead-letter publish
+  (kwargs ``topic``/``message_id``): raise = the broker rejecting the
+  write; the request's lease must survive for redelivery (the reply
+  is NOT recorded in the dedup ledger, so the retry republishes)
+* ``pubsub.ack``          — before the request-topic ack: raise = the
+  consumer dying between publish and ack; the lease expires, the
+  broker redelivers, and the dedup ledger must swallow the replay
+  without a second reply publish
 
 Unarmed, ``fire`` is one dict read (the serving hot path pays nothing
 measurable). Armed, a point either **raises** the configured exception

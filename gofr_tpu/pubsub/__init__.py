@@ -1,0 +1,32 @@
+"""At-least-once pubsub for the serving plane (ISSUE 18).
+
+This package is the serving side's broker abstraction — distinct from
+``gofr_tpu/datasource/pubsub`` (the GoFr-compatible fire-and-forget
+``Subscribe`` surface) because inference work needs *delivery
+semantics*: explicit ack/nack leases, lease-expiry redelivery, and
+crash-safe resumption. Two brokers ship:
+
+* :class:`~gofr_tpu.pubsub.broker.InMemoryBroker` — deterministic
+  (injectable clock, no timers, no threads) for tests and CPU runs;
+* :class:`~gofr_tpu.pubsub.durable.DurableBroker` — the same core
+  behind an append-only per-topic op journal, so a process crash
+  resumes with every unacked message ready again (at-least-once).
+
+``make_broker`` is the config seam (``TPU_ASYNC_BROKER=memory|file``).
+"""
+
+from gofr_tpu.pubsub.broker import (
+    InMemoryBroker,
+    LeasedMessage,
+    Subscription,
+    make_broker,
+)
+from gofr_tpu.pubsub.durable import DurableBroker
+
+__all__ = [
+    "DurableBroker",
+    "InMemoryBroker",
+    "LeasedMessage",
+    "Subscription",
+    "make_broker",
+]

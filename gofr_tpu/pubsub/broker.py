@@ -1,0 +1,394 @@
+"""The lease-based broker core: at-least-once, deterministic, bounded.
+
+Delivery model (the contract ``serving/async_serving.py`` builds on):
+
+* ``publish`` appends a message to a topic and returns its id (caller
+  may pin one — idempotent replays reuse the same id).
+* ``subscribe`` returns a :class:`Subscription`; ``lease()`` hands out
+  the oldest *ready* message and starts a lease clock. A message is
+  redelivered when its lease expires (consumer died) or it is nacked
+  (consumer failed); ``ack`` retires it for good.
+* ``attempt`` counts *deliveries* (increments at lease time), so a
+  redelivery budget reads directly off the message. A drain-time nack
+  may set ``penalize=False`` so handing work back does not burn the
+  message's budget.
+* Every lifecycle event is appended to the message's bounded
+  ``history`` ring — the redelivery record the dead-letter annotation
+  carries.
+
+Determinism rules (the repo-wide discipline): an injectable clock, no
+timers and no broker threads — expired leases are collected lazily at
+the next ``lease()`` call, so tests *state* time instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Any, Callable, Optional
+
+from gofr_tpu.analysis import lockcheck
+
+#: Per-message lifecycle-history bound: enough for a full redelivery
+#: budget's worth of lease/nack pairs without unbounded growth on a
+#: message that flaps for hours.
+HISTORY_MAX = 64
+
+
+class LeasedMessage:
+    """One delivery: an immutable view handed to the consumer. ``ack``/
+    ``nack`` go through the :class:`Subscription` keyed by ``id``."""
+
+    __slots__ = (
+        "id", "topic", "value", "headers", "attempt", "enqueued_at",
+        "history",
+    )
+
+    def __init__(
+        self,
+        id: str,
+        topic: str,
+        value: str,
+        headers: dict[str, str],
+        attempt: int,
+        enqueued_at: float,
+        history: list[dict[str, Any]],
+    ) -> None:
+        self.id = id
+        self.topic = topic
+        self.value = value
+        self.headers = headers
+        #: Deliveries so far, THIS one included (1 = first delivery).
+        self.attempt = attempt
+        self.enqueued_at = enqueued_at
+        #: Copy of the lifecycle ring at lease time (DLQ annotation).
+        self.history = history
+
+
+class _Entry:
+    """A message's broker-side state."""
+
+    __slots__ = (
+        "id", "value", "headers", "attempt", "enqueued_at", "ready_at",
+        "lease_expires_at", "history",
+    )
+
+    def __init__(
+        self, id: str, value: str, headers: dict[str, str], now: float
+    ) -> None:
+        self.id = id
+        self.value = value
+        self.headers = headers
+        self.attempt = 0
+        self.enqueued_at = now
+        self.ready_at: float = now
+        #: None = ready (not leased).
+        self.lease_expires_at: Optional[float] = None
+        self.history: list[dict[str, Any]] = []
+
+    def note(self, event: str, now: float, **attrs: Any) -> None:
+        self.history.append({"event": event, "at": round(now, 3), **attrs})
+        if len(self.history) > HISTORY_MAX:
+            del self.history[: len(self.history) - HISTORY_MAX]
+
+
+class _Topic:
+    __slots__ = ("entries", "heap", "seq")
+
+    def __init__(self) -> None:
+        self.entries: dict[str, _Entry] = {}
+        #: Lazy-deletion min-heap of (ready_at, seq, id) over READY
+        #: entries; leased/acked ids are skipped at pop time.
+        self.heap: list[tuple[float, int, str]] = []
+        self.seq = 0
+
+
+class Subscription:
+    """One consumer's handle on a topic: ``lease``/``ack``/``nack``.
+
+    Leases are process-volatile by design — a consumer crash simply
+    stops renewing them, and every unacked message returns to ready
+    when its lease clock runs out (the at-least-once half of the
+    contract; the consumer's dedup ledger supplies the other half).
+    """
+
+    def __init__(
+        self, broker: "InMemoryBroker", topic: str, lease_s: float
+    ) -> None:
+        self._broker = broker
+        self.topic = topic
+        self.lease_s = max(0.001, float(lease_s))
+
+    def lease(self) -> Optional[LeasedMessage]:
+        """The oldest ready message, leased for ``lease_s`` — or None
+        when the topic has nothing ready (never blocks)."""
+        return self._broker._lease(self.topic, self.lease_s)
+
+    def ack(self, msg_id: str) -> bool:
+        """Retire ``msg_id`` for good. False = unknown id (already
+        acked, or re-leased after this consumer's lease expired)."""
+        return self._broker._ack(self.topic, msg_id)
+
+    def nack(
+        self,
+        msg_id: str,
+        *,
+        delay_s: float = 0.0,
+        note: str = "",
+        penalize: bool = True,
+    ) -> bool:
+        """Hand ``msg_id`` back: ready again after ``delay_s``.
+        ``penalize=False`` (graceful drain) refunds the delivery so the
+        redelivery budget only counts real failures."""
+        return self._broker._nack(
+            self.topic, msg_id, delay_s=delay_s, note=note,
+            penalize=penalize,
+        )
+
+    def inflight(self) -> int:
+        """Messages currently leased (not yet acked/nacked/expired)."""
+        return self._broker.inflight(self.topic)
+
+
+class InMemoryBroker:
+    """The deterministic single-process broker (module docstring)."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._lock = lockcheck.make_lock("InMemoryBroker._lock")
+        self._topics: dict[str, _Topic] = {}
+        self._published = 0
+
+    # -- journal hook (DurableBroker overrides) ------------------------
+
+    def _journal(self, topic: str, op: dict[str, Any]) -> None:
+        """Persistence seam: the in-memory broker keeps nothing."""
+
+    # -- producer surface ----------------------------------------------
+
+    def publish(
+        self,
+        topic: str,
+        value: str,
+        headers: Optional[dict[str, str]] = None,
+        *,
+        message_id: Optional[str] = None,
+    ) -> str:
+        """Append one message; returns its id. A pinned ``message_id``
+        that already exists on the topic is a no-op returning the same
+        id — publish is idempotent per id, the replay-safety seam the
+        consumer's dedup ledger keys on."""
+        now = self._clock()
+        with self._lock:
+            t = self._topics.setdefault(topic, _Topic())
+            self._published += 1
+            mid = message_id or f"{topic}-{self._published:08d}"
+            if mid in t.entries:
+                return mid
+            entry = _Entry(mid, value, dict(headers or {}), now)
+            entry.note("published", now)
+            t.entries[mid] = entry
+            t.seq += 1
+            heapq.heappush(t.heap, (entry.ready_at, t.seq, mid))
+            self._journal(topic, {
+                "op": "pub", "id": mid, "value": value,
+                "headers": entry.headers,
+            })
+            return mid
+
+    # -- consumer surface (via Subscription) ---------------------------
+
+    def subscribe(self, topic: str, *, lease_s: float = 30.0) -> Subscription:
+        with self._lock:
+            self._topics.setdefault(topic, _Topic())
+        return Subscription(self, topic, lease_s)
+
+    def _collect_expired(self, t: _Topic, now: float) -> None:
+        """Return every expired lease to ready (call under the lock).
+        Lazy — runs at lease time, so expiry needs no broker thread."""
+        for entry in t.entries.values():
+            exp = entry.lease_expires_at
+            if exp is not None and exp <= now:
+                entry.lease_expires_at = None
+                entry.ready_at = now
+                entry.note("lease_expired", now, attempt=entry.attempt)
+                t.seq += 1
+                heapq.heappush(t.heap, (now, t.seq, entry.id))
+
+    def _lease(self, topic: str, lease_s: float) -> Optional[LeasedMessage]:
+        now = self._clock()
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return None
+            self._collect_expired(t, now)
+            while t.heap:
+                ready_at, _seq, mid = t.heap[0]
+                if ready_at > now:
+                    return None
+                heapq.heappop(t.heap)
+                entry = t.entries.get(mid)
+                if (
+                    entry is None
+                    or entry.lease_expires_at is not None
+                    or entry.ready_at > now
+                ):
+                    continue  # acked, re-leased, or re-scheduled later
+                entry.attempt += 1
+                entry.lease_expires_at = now + lease_s
+                entry.note("leased", now, attempt=entry.attempt)
+                self._journal(topic, {"op": "lease", "id": mid})
+                return LeasedMessage(
+                    entry.id, topic, entry.value, dict(entry.headers),
+                    entry.attempt, entry.enqueued_at,
+                    list(entry.history),
+                )
+            return None
+
+    def _ack(self, topic: str, msg_id: str) -> bool:
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None or msg_id not in t.entries:
+                return False
+            del t.entries[msg_id]
+            self._journal(topic, {"op": "ack", "id": msg_id})
+            return True
+
+    def _nack(
+        self,
+        topic: str,
+        msg_id: str,
+        *,
+        delay_s: float,
+        note: str,
+        penalize: bool,
+    ) -> bool:
+        now = self._clock()
+        with self._lock:
+            t = self._topics.get(topic)
+            entry = t.entries.get(msg_id) if t is not None else None
+            if t is None or entry is None:
+                return False
+            entry.lease_expires_at = None
+            entry.ready_at = now + max(0.0, float(delay_s))
+            if not penalize:
+                entry.attempt = max(0, entry.attempt - 1)
+            entry.note(
+                "nacked", now, delay_s=round(max(0.0, delay_s), 3),
+                note=note, penalize=penalize,
+            )
+            t.seq += 1
+            heapq.heappush(t.heap, (entry.ready_at, t.seq, msg_id))
+            self._journal(topic, {
+                "op": "nack", "id": msg_id,
+                "delay_s": max(0.0, float(delay_s)), "note": note,
+                "penalize": penalize,
+            })
+            return True
+
+    # -- introspection --------------------------------------------------
+
+    def depth(self, topic: str) -> int:
+        """Ready (unleased) messages — the consumer-lag signal."""
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return 0
+            return sum(
+                1 for e in t.entries.values()
+                if e.lease_expires_at is None
+            )
+
+    def inflight(self, topic: str) -> int:
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return 0
+            return sum(
+                1 for e in t.entries.values()
+                if e.lease_expires_at is not None
+            )
+
+    def size(self, topic: str) -> int:
+        """All live messages on the topic (ready + leased)."""
+        with self._lock:
+            t = self._topics.get(topic)
+            return 0 if t is None else len(t.entries)
+
+    def topics(self) -> list[str]:
+        with self._lock:
+            return sorted(self._topics)
+
+    def peek_all(self, topic: str) -> list[LeasedMessage]:
+        """Non-mutating snapshot of a topic (tests / debug surface) —
+        leases and attempts are untouched."""
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return []
+            return [
+                LeasedMessage(
+                    e.id, topic, e.value, dict(e.headers), e.attempt,
+                    e.enqueued_at, list(e.history),
+                )
+                for e in t.entries.values()
+            ]
+
+    def close(self) -> None:
+        """Release any persistence resources (no-op in memory)."""
+
+    # -- replay seam (DurableBroker) ------------------------------------
+
+    def _replay_op(self, topic: str, op: dict[str, Any]) -> None:
+        """Apply one journaled op to the in-memory state WITHOUT
+        re-journaling. Replay semantics are the crash contract: every
+        unacked message comes back *ready* (leases are volatile) with
+        its delivery count preserved, so a crash-looping consumer still
+        runs out of redelivery budget."""
+        now = self._clock()
+        t = self._topics.setdefault(topic, _Topic())
+        kind = op.get("op")
+        mid = str(op.get("id", ""))
+        if kind == "pub":
+            if mid in t.entries:
+                return
+            entry = _Entry(
+                mid, str(op.get("value", "")),
+                dict(op.get("headers") or {}), now,
+            )
+            entry.note("replayed", now)
+            t.entries[mid] = entry
+            t.seq += 1
+            heapq.heappush(t.heap, (entry.ready_at, t.seq, mid))
+        elif kind == "lease":
+            entry_l = t.entries.get(mid)
+            if entry_l is not None:
+                entry_l.attempt += 1
+        elif kind == "ack":
+            t.entries.pop(mid, None)
+        elif kind == "nack":
+            entry_n = t.entries.get(mid)
+            if entry_n is not None and not bool(op.get("penalize", True)):
+                entry_n.attempt = max(0, entry_n.attempt - 1)
+
+
+def make_broker(
+    kind: str,
+    *,
+    dir: str = "",
+    clock: Callable[[], float] = time.monotonic,
+) -> InMemoryBroker:
+    """The ``TPU_ASYNC_BROKER`` seam: ``memory`` (default) or ``file``
+    (requires ``TPU_ASYNC_BROKER_DIR``)."""
+    kind = (kind or "memory").strip().lower()
+    if kind in ("", "memory", "inmemory", "mem"):
+        return InMemoryBroker(clock=clock)
+    if kind == "file":
+        if not dir:
+            raise ValueError(
+                "TPU_ASYNC_BROKER=file requires TPU_ASYNC_BROKER_DIR"
+            )
+        from gofr_tpu.pubsub.durable import DurableBroker
+
+        return DurableBroker(dir, clock=clock)
+    raise ValueError(f"unknown async broker kind {kind!r}")

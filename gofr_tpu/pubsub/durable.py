@@ -1,0 +1,139 @@
+"""The file-backed durable broker: single-host crash-safe resumption.
+
+One append-only JSONL journal per topic (``<dir>/<topic>.jsonl``; topic
+names are sanitized into filenames). Every mutating op — ``pub``,
+``lease``, ``ack``, ``nack`` — is appended and flushed before the call
+returns, and construction replays the journals front to back:
+
+* published but unacked  → ready again (leases are volatile by design —
+  a crashed consumer's lease dies with its process, which IS the
+  at-least-once redelivery path);
+* acked                  → gone;
+* lease count            → preserved, so a consumer that crash-loops on
+  a poison message still exhausts its redelivery budget and the message
+  still reaches the dead-letter topic.
+
+``compact()`` rewrites a journal to just the live messages — the bound
+on journal growth for long-running hosts. Durability is flush-on-append
+(``fsync=True`` upgrades to fsync for hosts that need power-loss
+safety at the cost of per-op latency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Callable, IO, Optional
+
+from gofr_tpu.pubsub.broker import InMemoryBroker
+
+_SAFE = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def _topic_file(dir_: str, topic: str) -> str:
+    return os.path.join(dir_, _SAFE.sub("_", topic) + ".jsonl")
+
+
+class DurableBroker(InMemoryBroker):
+    """The in-memory core behind a per-topic op journal."""
+
+    def __init__(
+        self,
+        dir: str,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        fsync: bool = False,
+    ) -> None:
+        super().__init__(clock=clock)
+        self.dir = dir
+        self._fsync = fsync
+        self._files: dict[str, IO[str]] = {}
+        self._replaying = False
+        os.makedirs(dir, exist_ok=True)
+        self._replay_all()
+
+    # -- journal ---------------------------------------------------------
+
+    def _journal(self, topic: str, op: dict[str, Any]) -> None:
+        if self._replaying:
+            return
+        f = self._files.get(topic)
+        if f is None:
+            f = open(  # noqa: SIM115 — held open across ops, closed in close()
+                _topic_file(self.dir, topic), "a", encoding="utf-8"
+            )
+            self._files[topic] = f
+        f.write(json.dumps(op, separators=(",", ":")) + "\n")
+        f.flush()
+        if self._fsync:
+            os.fsync(f.fileno())
+
+    def _replay_all(self) -> None:
+        self._replaying = True
+        try:
+            for name in sorted(os.listdir(self.dir)):
+                if not name.endswith(".jsonl"):
+                    continue
+                topic = name[: -len(".jsonl")]
+                path = os.path.join(self.dir, name)
+                with open(path, encoding="utf-8") as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            op = json.loads(line)
+                        except ValueError:
+                            continue  # torn tail write mid-crash
+                        if isinstance(op, dict):
+                            self._replay_op(topic, op)
+        finally:
+            self._replaying = False
+
+    # -- maintenance -----------------------------------------------------
+
+    def compact(self, topic: str) -> int:
+        """Rewrite ``topic``'s journal to just its live messages (one
+        ``pub`` plus ``attempt`` leases each); returns the live count.
+        Safe at any quiet point — the rewritten journal replays to the
+        same state the broker holds now."""
+        entries = self.peek_all(topic)
+        path = _topic_file(self.dir, topic)
+        old = self._files.pop(topic, None)
+        if old is not None:
+            old.close()
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for e in entries:
+                f.write(json.dumps(
+                    {"op": "pub", "id": e.id, "value": e.value,
+                     "headers": e.headers},
+                    separators=(",", ":"),
+                ) + "\n")
+                for _ in range(e.attempt):
+                    f.write(json.dumps(
+                        {"op": "lease", "id": e.id},
+                        separators=(",", ":"),
+                    ) + "\n")
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return len(entries)
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files.clear()
+
+
+def open_durable(
+    dir: str, clock: Optional[Callable[[], float]] = None
+) -> DurableBroker:
+    """Convenience constructor mirroring ``make_broker("file", ...)``."""
+    return DurableBroker(dir, clock=clock or time.monotonic)

@@ -1,0 +1,527 @@
+"""Durable event-driven inference: the async serving plane (ISSUE 18).
+
+``AsyncServingPlane`` closes ROADMAP direction 4: it subscribes to a
+request topic on a lease-based broker (``gofr_tpu/pubsub``), admits
+each message through the engine/pool facade as SLO class ``batch`` (so
+the brownout ladder and the per-tenant control plane shed async work
+first, exactly as the storm A/B proves), and publishes results to a
+reply topic. The headline is the delivery contract:
+
+* **at-least-once consume** — a message is acked only after its reply
+  is on the reply topic; a consumer killed mid-inference simply stops
+  renewing its lease and the broker redelivers;
+* **bounded redelivery** — failures nack with jittered exponential
+  backoff (the ``RetryConfig`` idiom: injectable rng, stated clocks);
+  past ``TPU_ASYNC_REDELIVERY_MAX`` deliveries the message parks on
+  the dead-letter topic with its failure and full redelivery history
+  annotated — zero lost, zero silently-retried-forever;
+* **exactly-once publish** — the reply publish is idempotent per
+  message id AND a bounded dedup ledger records ids already replied,
+  so a consumer that dies after inference but before ack cannot
+  double-publish on replay;
+* **graceful drain** — ``stop`` hands unfinished leases back to the
+  broker (nack, budget refunded) instead of dropping them.
+
+Wired through the whole robustness surface: ``pubsub.deliver`` /
+``pubsub.publish`` / ``pubsub.ack`` fault points, the request's
+``RequestTimeline`` trace id carried broker→engine→reply (traceparent
+in message headers, a ``tpu.async_consume`` annotation), tenant
+attribution from headers into the ledger, async metrics + the
+``/debug/async`` ops read, and a consumer-lag control-plane signal
+feeding ``PoolScaler`` pressure.
+
+Off is off: ``TPU_ASYNC=0`` builds nothing — the app holds ``None``
+and every hook costs one ``is not None``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from gofr_tpu import faults
+from gofr_tpu.analysis import lockcheck
+from gofr_tpu.pubsub.broker import InMemoryBroker, LeasedMessage, make_broker
+from gofr_tpu.serving.lifecycle import CancelToken, Deadline
+from gofr_tpu.serving.observability import emit_instant_span
+from gofr_tpu.service.options import RetryConfig
+
+#: Request-payload keys forwarded to the engine facade verbatim.
+_GEN_KEYS = (
+    "max_new_tokens", "temperature", "stop_on_eos", "stop", "top_p",
+    "seed", "adapter",
+)
+
+
+class _Inflight:
+    """One leased message riding the engine."""
+
+    __slots__ = ("msg", "req", "cancel", "submitted_at")
+
+    def __init__(
+        self, msg: LeasedMessage, req: Any, cancel: CancelToken,
+        submitted_at: float,
+    ) -> None:
+        self.msg = msg
+        self.req = req
+        self.cancel = cancel
+        self.submitted_at = submitted_at
+
+
+class AsyncServingPlane:
+    """The pubsub→engine→reply pump (module docstring).
+
+    Deterministically steppable: ``step()`` runs one lease/complete
+    pass and is what both the background thread and the tests drive —
+    the thread adds liveness, never semantics. ``kill()`` abandons all
+    state without nacking (the simulated crash the at-least-once
+    acceptance test uses); the broker's lease expiry is the recovery.
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        broker: InMemoryBroker,
+        *,
+        request_topic: str = "tpu.requests",
+        reply_topic: str = "tpu.replies",
+        dlq_topic: str = "tpu.dlq",
+        redelivery_max: int = 5,
+        lease_s: float = 30.0,
+        max_inflight: int = 4,
+        deadline_s: float = 300.0,
+        retry: Optional[RetryConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        poll_s: float = 0.05,
+        dedup_max: int = 2048,
+        metrics: Any = None,
+        logger: Any = None,
+        model_name: str = "",
+    ) -> None:
+        self.engine = engine
+        self.broker = broker
+        self.request_topic = request_topic
+        self.reply_topic = reply_topic
+        self.dlq_topic = dlq_topic
+        #: Max deliveries before dead-letter: first attempt + this many
+        #: redeliveries.
+        self.redelivery_max = max(0, int(redelivery_max))
+        self.lease_s = max(0.001, float(lease_s))
+        self.max_inflight = max(1, int(max_inflight))
+        self.deadline_s = max(0.0, float(deadline_s))
+        self.retry = retry if retry is not None else RetryConfig(
+            backoff_s=1.0, jitter=0.5, max_backoff_s=60.0
+        )
+        self.poll_s = max(0.001, float(poll_s))
+        self.dedup_max = max(1, int(dedup_max))
+        self._clock = clock
+        self._metrics = metrics
+        self._logger = logger
+        self.model_name = model_name or str(
+            getattr(engine, "model_name", "") or ""
+        )
+        self._sub = broker.subscribe(request_topic, lease_s=self.lease_s)
+        self._lock = lockcheck.make_lock("AsyncServingPlane._lock")
+        self._inflight: list[_Inflight] = []
+        #: The bounded dedup ledger: message id → reply-publish stamp.
+        #: Consulted BEFORE inference so a replay after a lost ack skips
+        #: straight to ack — the exactly-once-publish half.
+        self._ledger: dict[str, float] = {}
+        self._ledger_order: list[str] = []
+        self.counters: dict[str, int] = {
+            "consumed": 0, "published": 0, "redelivered": 0,
+            "dead_lettered": 0, "nacked": 0, "deduped": 0,
+            "deliver_errors": 0, "publish_errors": 0, "ack_errors": 0,
+        }
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._draining = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._draining = False
+        self._thread = threading.Thread(
+            target=self._run, name="async-serving", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                did = self.step()
+            except Exception as exc:  # noqa: BLE001 — the pump must survive any single-message bug
+                did = 0
+                if self._logger is not None:
+                    self._logger.errorf("async plane step failed: %s", exc)
+            if did == 0:
+                self._stop.wait(self.poll_s)
+
+    def stop(self, drain_s: float = 5.0) -> None:
+        """Graceful drain: stop leasing, give in-flight work up to
+        ``drain_s`` wall seconds to finish (replies publish normally),
+        then cancel and *nack* whatever remains — leases go back to the
+        broker with their budget refunded, never dropped."""
+        self._draining = True
+        deadline = time.monotonic() + max(0.0, float(drain_s))
+        if self._thread is not None:
+            while self.inflight_count() and time.monotonic() < deadline:
+                self._stop.wait(min(0.01, self.poll_s))
+            self._stop.set()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.step()  # final completion pass (publishes finished work)
+        self._release_unfinished()
+
+    def kill(self) -> None:
+        """Simulated crash (chaos/tests): drop everything on the floor —
+        no nack, no cancel, leases left to expire. The broker's lease
+        clock is the recovery path this models."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            self._inflight.clear()
+
+    def _release_unfinished(self) -> None:
+        with self._lock:
+            leftover = list(self._inflight)
+            self._inflight.clear()
+        for entry in leftover:
+            entry.cancel.cancel()
+            self._sub.nack(
+                entry.msg.id, delay_s=0.0, note="drain", penalize=False
+            )
+            self._count("nacked")
+        self._publish_gauges()
+
+    # -- the pump --------------------------------------------------------
+
+    def step(self) -> int:
+        """One pass: complete finished work, then lease new work up to
+        ``max_inflight``. Returns the number of messages handled (0 =
+        idle pass)."""
+        did = 0
+        with self._lock:
+            done = [e for e in self._inflight if e.req.future.done()]
+            for e in done:
+                self._inflight.remove(e)
+        for e in done:
+            self._complete(e)
+            did += 1
+        while not self._draining:
+            with self._lock:
+                if len(self._inflight) >= self.max_inflight:
+                    break
+            msg = self._sub.lease()
+            if msg is None:
+                break
+            did += 1
+            self._admit(msg)
+        self._publish_gauges()
+        return did
+
+    def _admit(self, msg: LeasedMessage) -> None:
+        if msg.attempt > 1:
+            self._count("redelivered")
+            self._inc_metric("app_tpu_async_redelivered_total")
+        # Replay after a lost ack: the reply already went out — ack and
+        # move on, never a second publish (the dedup-ledger contract).
+        with self._lock:
+            replayed = msg.id in self._ledger
+        if replayed:
+            self._count("deduped")
+            self._ack(msg)
+            return
+        if msg.attempt > 1 + self.redelivery_max:
+            # Crash-loop redeliveries (lease expiry, no nack recorded)
+            # exhaust the budget exactly like nacked failures do.
+            self._dead_letter(msg, "redelivery budget exhausted")
+            return
+        try:
+            faults.fire(
+                "pubsub.deliver",
+                topic=msg.topic, message_id=msg.id, attempt=msg.attempt,
+            )
+            payload = json.loads(msg.value)
+            if not isinstance(payload, dict) or "prompt" not in payload:
+                raise ValueError("request payload must be an object with a 'prompt'")
+        except Exception as exc:  # noqa: BLE001 — any delivery failure takes the nack/DLQ path, never kills the pump
+            self._count("deliver_errors")
+            self._fail(msg, exc)
+            return
+        cancel = CancelToken()
+        deadline_s = float(payload.get("deadline_s", self.deadline_s) or 0.0)
+        deadline = (
+            Deadline.after(deadline_s, clock=self._clock)
+            if deadline_s > 0 else None
+        )
+        kwargs: dict[str, Any] = {
+            k: payload[k] for k in _GEN_KEYS if k in payload
+        }
+        try:
+            req = self.engine.submit_generate(
+                payload["prompt"],
+                slo_class="batch",
+                tenant=str(msg.headers.get("tenant", "")),
+                traceparent=msg.headers.get("traceparent"),
+                deadline=deadline,
+                cancel=cancel,
+                **kwargs,
+            )
+        except Exception as exc:  # noqa: BLE001 — sheds/param errors take the nack/DLQ path, never kill the pump
+            self._fail(msg, exc)
+            return
+        now = self._clock()
+        timeline = getattr(req, "timeline", None)
+        if timeline is not None:
+            timeline.annotate(
+                "tpu.async_consume", now,
+                topic=msg.topic, message_id=msg.id, attempt=msg.attempt,
+            )
+            emit_instant_span(
+                "tpu.async_consume", timeline.traceparent(),
+                {"topic": msg.topic, "message_id": msg.id,
+                 "attempt": msg.attempt},
+            )
+        with self._lock:
+            self._inflight.append(_Inflight(msg, req, cancel, now))
+
+    def _complete(self, entry: _Inflight) -> None:
+        msg = entry.msg
+        try:
+            result = entry.req.future.result(timeout=0)
+        except Exception as exc:  # noqa: BLE001 — deadline/cancel/engine errors take the nack/DLQ path
+            self._fail(msg, exc)
+            return
+        timeline = getattr(entry.req, "timeline", None)
+        reply_headers = {
+            "message_id": msg.id,
+            "tenant": str(msg.headers.get("tenant", "")),
+            "traceparent": (
+                timeline.traceparent() if timeline is not None
+                else str(msg.headers.get("traceparent", ""))
+            ),
+        }
+        reply = json.dumps({
+            "id": msg.id,
+            "text": getattr(result, "text", ""),
+            "token_ids": list(getattr(result, "token_ids", []) or []),
+            "finish_reason": getattr(result, "finish_reason", ""),
+            "prompt_tokens": int(getattr(result, "prompt_tokens", 0)),
+            "attempt": msg.attempt,
+        })
+        try:
+            faults.fire(
+                "pubsub.publish", topic=self.reply_topic, message_id=msg.id,
+            )
+            self.broker.publish(
+                self.reply_topic, reply, reply_headers,
+                message_id=f"reply-{msg.id}",
+            )
+        except Exception as exc:  # noqa: BLE001 — a failed reply publish is retried via redelivery
+            self._count("publish_errors")
+            self._fail(msg, exc)
+            return
+        self._ledger_put(msg.id)
+        self._count("published")
+        self._inc_metric("app_tpu_async_published_total")
+        self._ack(msg)
+
+    def _ack(self, msg: LeasedMessage) -> None:
+        try:
+            faults.fire(
+                "pubsub.ack", topic=msg.topic, message_id=msg.id,
+            )
+            self._sub.ack(msg.id)
+        except Exception:  # noqa: BLE001 — a lost ack is recovered by lease expiry + the dedup ledger
+            self._count("ack_errors")
+            return
+        self._count("consumed")
+        self._inc_metric("app_tpu_async_consumed_total")
+
+    def _fail(self, msg: LeasedMessage, exc: BaseException) -> None:
+        if msg.attempt >= 1 + self.redelivery_max:
+            self._dead_letter(msg, f"{type(exc).__name__}: {exc}")
+            return
+        # Jittered exponential backoff before the redelivery (the
+        # RetryConfig idiom: injectable rng decorrelates, stated clocks
+        # keep tests deterministic). attempt is 1-based.
+        delay = self.retry.delay_s(max(0, msg.attempt - 1))
+        self._sub.nack(
+            msg.id, delay_s=delay, note=f"{type(exc).__name__}: {exc}"
+        )
+        self._count("nacked")
+        if self._logger is not None:
+            self._logger.debugf(
+                "async message %s nacked (attempt %d, retry in %.2fs): %s",
+                msg.id, msg.attempt, delay, exc,
+            )
+
+    def _dead_letter(self, msg: LeasedMessage, reason: str) -> None:
+        annotated = json.dumps({
+            "id": msg.id,
+            "topic": msg.topic,
+            "error": reason,
+            "attempts": msg.attempt,
+            "history": msg.history,
+            "value": msg.value,
+            "headers": msg.headers,
+        })
+        try:
+            faults.fire(
+                "pubsub.publish", topic=self.dlq_topic, message_id=msg.id,
+            )
+            self.broker.publish(
+                self.dlq_topic, annotated, dict(msg.headers),
+                message_id=f"dlq-{msg.id}",
+            )
+        except Exception as exc:  # noqa: BLE001 — if even the DLQ publish fails, keep the message alive
+            self._count("publish_errors")
+            self._sub.nack(
+                msg.id, delay_s=self.retry.max_backoff_s,
+                note=f"dlq publish failed: {exc}", penalize=False,
+            )
+            return
+        self._count("dead_lettered")
+        self._inc_metric("app_tpu_async_dead_lettered_total")
+        if self._logger is not None:
+            self._logger.errorf(
+                "async message %s dead-lettered after %d deliveries: %s",
+                msg.id, msg.attempt, reason,
+            )
+        self._ack(msg)
+
+    # -- bookkeeping -----------------------------------------------------
+
+    def _ledger_put(self, msg_id: str) -> None:
+        with self._lock:
+            if msg_id in self._ledger:
+                return
+            self._ledger[msg_id] = self._clock()
+            self._ledger_order.append(msg_id)
+            while len(self._ledger_order) > self.dedup_max:
+                evicted = self._ledger_order.pop(0)
+                self._ledger.pop(evicted, None)
+
+    def _count(self, name: str) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + 1
+
+    def _inc_metric(self, name: str) -> None:
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                name, "model", self.model_name
+            )
+
+    def _publish_gauges(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.set_gauge(
+            "app_tpu_async_lag", float(self.lag()),
+            "model", self.model_name,
+        )
+        self._metrics.set_gauge(
+            "app_tpu_async_inflight_leases", float(self._sub.inflight()),
+            "model", self.model_name,
+        )
+
+    # -- signals / introspection ----------------------------------------
+
+    def lag(self) -> int:
+        """Request-topic backlog (ready, unleased) — the control-plane
+        consumer-lag signal."""
+        return self.broker.depth(self.request_topic)
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def dedup_ledger(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._ledger)
+
+    def report(self) -> dict[str, Any]:
+        """The ``/debug/async`` read: topics, knobs, live state, the
+        delivery counters, and the dedup ledger's occupancy."""
+        with self._lock:
+            inflight = [e.msg.id for e in self._inflight]
+            counters = dict(self.counters)
+            ledger_size = len(self._ledger)
+        return {
+            "enabled": True,
+            "model": self.model_name,
+            "request_topic": self.request_topic,
+            "reply_topic": self.reply_topic,
+            "dlq_topic": self.dlq_topic,
+            "redelivery_max": self.redelivery_max,
+            "lease_s": self.lease_s,
+            "max_inflight": self.max_inflight,
+            "deadline_s": self.deadline_s,
+            "running": self._thread is not None,
+            "draining": self._draining,
+            "lag": self.lag(),
+            "inflight_leases": self._sub.inflight(),
+            "inflight": inflight,
+            "counters": counters,
+            "dedup_ledger": {"size": ledger_size, "max": self.dedup_max},
+        }
+
+
+def new_async_plane_from_config(
+    config: Any,
+    engine: Any,
+    metrics: Any = None,
+    logger: Any = None,
+) -> Optional[AsyncServingPlane]:
+    """Container seam (the ``new_tpu_from_config`` idiom): every knob a
+    ``TPU_ASYNC_*`` env key; ``TPU_ASYNC`` off (the default) builds
+    nothing and the app's hooks cost one ``is not None``."""
+    enabled = str(
+        config.get_or_default("TPU_ASYNC", "0")
+    ).strip().lower() in ("1", "true", "yes")
+    if not enabled or engine is None:
+        return None
+    broker = make_broker(
+        str(config.get_or_default("TPU_ASYNC_BROKER", "memory")),
+        dir=str(config.get_or_default("TPU_ASYNC_BROKER_DIR", "")),
+    )
+    plane = AsyncServingPlane(
+        engine,
+        broker,
+        request_topic=str(config.get_or_default(
+            "TPU_ASYNC_REQUEST_TOPIC", "tpu.requests")),
+        reply_topic=str(config.get_or_default(
+            "TPU_ASYNC_REPLY_TOPIC", "tpu.replies")),
+        dlq_topic=str(config.get_or_default(
+            "TPU_ASYNC_DLQ_TOPIC", "tpu.dlq")),
+        redelivery_max=int(config.get_or_default(
+            "TPU_ASYNC_REDELIVERY_MAX", "5")),
+        lease_s=float(config.get_or_default("TPU_ASYNC_LEASE_S", "30")),
+        max_inflight=int(config.get_or_default(
+            "TPU_ASYNC_MAX_INFLIGHT", "4")),
+        deadline_s=float(config.get_or_default(
+            "TPU_ASYNC_DEADLINE_S", "300")),
+        poll_s=float(config.get_or_default("TPU_ASYNC_POLL_S", "0.05")),
+        dedup_max=int(config.get_or_default("TPU_ASYNC_DEDUP_MAX", "2048")),
+        metrics=metrics,
+        logger=logger,
+    )
+    # Sustained consumer lag feeds PoolScaler pressure through the
+    # engine's control plane (None-guarded: pools and control-off
+    # engines simply skip the signal).
+    attach = getattr(engine, "attach_async_lag", None)
+    if attach is not None:
+        attach(
+            lambda: float(plane.lag()),
+            depth=float(config.get_or_default("TPU_ASYNC_LAG_DEPTH", "0")),
+            sustain_s=float(config.get_or_default(
+                "TPU_ASYNC_LAG_SUSTAIN_S", "0")),
+        )
+    return plane

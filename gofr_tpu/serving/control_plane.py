@@ -25,6 +25,10 @@ one seam, closing three loops the sensors already paid for:
   positive trend crossing the threshold asserts scale-up pressure
   BEFORE the sustained-threshold breach the reactive scaler waits for.
   Stated-clock testable; a hold-down timer stops flapping.
+* **Async consumer lag** (ISSUE 18) — sustained request-topic backlog
+  on the async serving plane (``serving/async_serving.py``) asserts
+  the same scale-up pressure: batch work waiting is idle capacity the
+  pool could add, through the identical hysteretic discipline.
 
 **Robustness is the headline.** Every signal read is wrapped in a
 staleness/NaN/exception guard: a sensor that goes stale, returns
@@ -332,6 +336,54 @@ class HostPressureLoop:
         return self.pressure
 
 
+class AsyncLagLoop:
+    """Sustained async consumer lag (request-topic backlog the serving
+    plane has not leased; ``serving/async_serving.py``) → scale-up
+    pressure. Same hysteretic sustain discipline as
+    :class:`HostPressureLoop`; the exit threshold sits at a fixed
+    fraction of the enter one so a backlog oscillating at the line
+    never flaps the scaler."""
+
+    EXIT_FRACTION = 0.5
+
+    def __init__(
+        self, *, depth: float = 64.0, sustain_s: float = 30.0
+    ) -> None:
+        self.configure(depth, sustain_s)
+        self.pressure = False
+        self.over_since: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.last_lag = 0.0
+
+    def configure(self, depth: float, sustain_s: float) -> None:
+        """Re-point the thresholds (the async plane's config seam runs
+        after the control plane is built)."""
+        self.depth = max(1.0, float(depth))
+        self.exit_depth = self.depth * self.EXIT_FRACTION
+        self.sustain_s = max(0.0, float(sustain_s))
+
+    def evaluate(self, lag: float, now: float) -> bool:
+        self.last_lag = float(lag)
+        over = lag >= self.depth
+        clear = lag <= self.exit_depth
+        if over:
+            self.clear_since = None
+            if self.over_since is None:
+                self.over_since = now
+            elif now - self.over_since >= self.sustain_s:
+                self.pressure = True
+        elif clear:
+            self.over_since = None
+            if self.clear_since is None:
+                self.clear_since = now
+            elif now - self.clear_since >= self.sustain_s:
+                self.pressure = False
+        else:
+            self.over_since = None
+            self.clear_since = None
+        return self.pressure
+
+
 class PredictiveLoop:
     """Queue-depth trend fit → early scale-up pressure. A bounded
     sample window, a least-squares slope, and a fixed projection
@@ -437,6 +489,8 @@ class ControlPlane:
         predict_horizon_s: float = 30.0,
         predict_depth: float = 64.0,
         predict_hold_s: float = 30.0,
+        async_lag_depth: float = 64.0,
+        async_lag_sustain_s: float = 30.0,
         decision_records: int = 64,
         metrics: Any = None,
         logger: Any = None,
@@ -468,6 +522,9 @@ class ControlPlane:
             depth_threshold=predict_depth,
             hold_s=predict_hold_s,
         )
+        self.async_loop = AsyncLagLoop(
+            depth=async_lag_depth, sustain_s=async_lag_sustain_s
+        )
         #: Per-loop mode: "active" | "observe_only" | "off" (no signal
         #: registered for it). Observe-only means every actuator the
         #: loop owns returns neutral — the zero-5xx guarantee.
@@ -475,6 +532,7 @@ class ControlPlane:
             "tenant_brownout": "off",
             "host_pressure": "off",
             "predictive": "off",
+            "async_lag": "off",
         }
         self._decisions: deque[dict[str, Any]] = deque(
             maxlen=max(8, int(decision_records))
@@ -646,6 +704,15 @@ class ControlPlane:
                 and isinstance(tput.value, float) else 0.0
             )
             self.predict_loop.evaluate(depth.value, tput_v, t)
+        lag = readings.get("async_lag")
+        if lag is None:
+            self._modes["async_lag"] = "off"
+        elif not lag.usable:
+            self._modes["async_lag"] = "observe_only"
+        else:
+            self._modes["async_lag"] = "active"
+            assert isinstance(lag.value, float)
+            self.async_loop.evaluate(lag.value, t)
 
     # -- actuator surface (submit / probe threads) ----------------------
 
@@ -733,9 +800,10 @@ class ControlPlane:
                 self._modes["tenant_brownout"] = "active"
 
     def scale_pressure(self) -> int:
-        """1 while either scaling loop (host-overhead or predictive)
-        asserts pressure, else 0. Observe-only loops assert nothing —
-        neutral is the degraded mode's contract."""
+        """1 while any scaling loop (host-overhead, predictive, or
+        async consumer lag) asserts pressure, else 0. Observe-only
+        loops assert nothing — neutral is the degraded mode's
+        contract."""
         with self._lock:
             host = (
                 self._modes["host_pressure"] == "active"
@@ -745,7 +813,11 @@ class ControlPlane:
                 self._modes["predictive"] == "active"
                 and self.predict_loop.pressure
             )
-            return 1 if (host or predictive) else 0
+            async_lag = (
+                self._modes["async_lag"] == "active"
+                and self.async_loop.pressure
+            )
+            return 1 if (host or predictive or async_lag) else 0
 
     def signal_health(self) -> dict[str, float]:
         """``{signal: health}`` — the exported degraded-sensor set."""
@@ -787,6 +859,10 @@ class ControlPlane:
                 self._modes["predictive"] == "active"
                 and self.predict_loop.pressure
             )
+            async_lag = (
+                self._modes["async_lag"] == "active"
+                and self.async_loop.pressure
+            )
         for name, value in health.items():
             m.set_gauge(
                 "app_tpu_control_signal_health", value,
@@ -816,6 +892,11 @@ class ControlPlane:
             "app_tpu_control_scale_pressure",
             1.0 if predictive else 0.0,
             "model", self.model_name, "source", "predictive",
+        )
+        m.set_gauge(
+            "app_tpu_control_scale_pressure",
+            1.0 if async_lag else 0.0,
+            "model", self.model_name, "source", "async",
         )
         for _tenant, prev, new in moves:
             m.increment_counter(
@@ -862,8 +943,14 @@ class ControlPlane:
                 self._modes["predictive"] == "active"
                 and self.predict_loop.pressure
             )
+            async_lag = (
+                self._modes["async_lag"] == "active"
+                and self.async_loop.pressure
+            )
             return {
-                "scale_pressure": 1 if (host or predictive) else 0,
+                "scale_pressure": (
+                    1 if (host or predictive or async_lag) else 0
+                ),
                 "degraded_signals": degraded,
                 "tenants_browned_out": browned,
             }
@@ -942,6 +1029,20 @@ class ControlPlane:
                     ), 3)
                 ),
             }
+            async_lag = {
+                "mode": self._modes["async_lag"],
+                "pressure": self.async_loop.pressure,
+                "depth_enter": self.async_loop.depth,
+                "depth_exit": self.async_loop.exit_depth,
+                "sustain_s": self.async_loop.sustain_s,
+                "last_lag": round(self.async_loop.last_lag, 3),
+                "over_for_s": (
+                    None if self.async_loop.over_since is None
+                    else round(
+                        max(0.0, t - self.async_loop.over_since), 3
+                    )
+                ),
+            }
             return {
                 "enabled": True,
                 "passes": self._passes,
@@ -952,6 +1053,7 @@ class ControlPlane:
                     "tenant_brownout": tenant,
                     "host_pressure": host,
                     "predictive": predictive,
+                    "async_lag": async_lag,
                 },
                 "decisions": list(self._decisions),
             }

@@ -30,7 +30,7 @@ import threading
 
 import time
 from functools import partial
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 import numpy as np
 
@@ -2877,6 +2877,34 @@ class InferenceEngine(
         if self._control is None:
             return None
         return int(self._control.scale_pressure())
+
+    def attach_async_lag(
+        self,
+        read: "Callable[[], float]",
+        *,
+        depth: Optional[float] = None,
+        sustain_s: Optional[float] = None,
+    ) -> bool:
+        """Register the async serving plane's consumer-lag sensor with
+        the control plane (``serving/async_serving.py`` calls this at
+        plane construction): sustained backlog then feeds PoolScaler
+        pressure through :meth:`control_scale_pressure` like any other
+        scaling loop. ``depth``/``sustain_s`` > 0 re-point the lag
+        loop's thresholds; False = control plane off (signal skipped —
+        off is off)."""
+        cp = self._control
+        if cp is None:
+            return False
+        if (depth is not None and depth > 0) or (
+            sustain_s is not None and sustain_s > 0
+        ):
+            cp.async_loop.configure(
+                depth if depth and depth > 0 else cp.async_loop.depth,
+                sustain_s if sustain_s and sustain_s > 0
+                else cp.async_loop.sustain_s,
+            )
+        cp.register("async_lag", read)
+        return True
 
     def brownout_level(self) -> Optional[int]:
         """The current degradation level, ``None`` when the layer is

@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL022 (per-file TPU rules
+#   graftlint — TPU-correctness rules GL001–GL023 (per-file TPU rules
 #               plus project-wide concurrency analysis) against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
@@ -42,7 +42,8 @@ if command -v mypy >/dev/null 2>&1; then
     gofr_tpu/serving/loop_profiler.py \
     gofr_tpu/serving/profiler_capture.py \
     gofr_tpu/serving/tenant_ledger.py gofr_tpu/serving/slo.py \
-    gofr_tpu/serving/openai_compat.py || failed=1
+    gofr_tpu/serving/openai_compat.py \
+    gofr_tpu/pubsub gofr_tpu/serving/async_serving.py || failed=1
 else
   echo "== mypy == SKIPPED (not installed; pip install mypy)"
 fi

@@ -1,0 +1,697 @@
+"""Durable event-driven inference (gofr_tpu/pubsub +
+serving/async_serving.py; docs/advanced-guide/resilience.md "Async
+serving & delivery semantics").
+
+Four layers, all deterministic (stated clocks, jitter pinned to 0,
+``step()``-driven pump — the background thread adds liveness, never
+semantics):
+
+* **broker unit** — lease/ack/nack lifecycle, lease-expiry redelivery,
+  budget refunds, idempotent publish per pinned id, and the durable
+  journal's crash-replay contract (unacked → ready, attempts
+  preserved, torn tail lines skipped, compaction state-preserving);
+* **the delivery contract** — THE acceptance path: ``pubsub.*`` faults
+  armed and the consumer killed mid-inference, every message either
+  answered exactly once or parked in the DLQ with its redelivery
+  history — zero lost, zero duplicated, the dedup ledger proving the
+  lost-ack replay never double-publishes;
+* **integration with the real engine** — trace-id continuity
+  broker→engine→reply, expired async messages reaped within one window
+  with zero leaked leases and zero leaked KV blocks, brownout sheds
+  async (batch-class) first while interactive goodput holds, and the
+  sync path is byte-identical with the plane attached;
+* **control plane** — sustained consumer lag asserts scale pressure
+  through the same hysteretic sustain discipline as every other loop.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import pytest
+
+from gofr_tpu import faults
+from gofr_tpu.config import MockConfig
+from gofr_tpu.pubsub import DurableBroker, InMemoryBroker, make_broker
+from gofr_tpu.pubsub.durable import _topic_file
+from gofr_tpu.serving.async_serving import (
+    AsyncServingPlane,
+    new_async_plane_from_config,
+)
+from gofr_tpu.serving.control_plane import ControlPlane
+from gofr_tpu.serving.engine import InferenceEngine
+from gofr_tpu.serving.tokenizer import ByteTokenizer
+from gofr_tpu.service.options import RetryConfig
+
+REQUEST, REPLY, DLQ = "tpu.requests", "tpu.replies", "tpu.dlq"
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class FakeEngine:
+    """The engine facade seam the plane drives: ``submit_generate``
+    returning a handle with a ``future``. ``auto=False`` leaves the
+    future unresolved (work 'stuck on the device') so tests control
+    exactly when inference finishes."""
+
+    model_name = "fake-llm"
+
+    def __init__(self, auto: bool = True, raises: Exception = None):
+        self.auto = auto
+        self.raises = raises
+        self.calls = []
+        self.reqs = []
+
+    def submit_generate(self, prompt, **kw):
+        if self.raises is not None:
+            raise self.raises
+        req = SimpleNamespace(future=Future(), timeline=None)
+        self.calls.append((prompt, dict(kw)))
+        self.reqs.append(req)
+        if self.auto:
+            req.future.set_result(SimpleNamespace(
+                text="ok", token_ids=[1, 2, 3], finish_reason="stop",
+                prompt_tokens=2,
+            ))
+        return req
+
+
+def no_jitter_retry(backoff_s: float = 1.0) -> RetryConfig:
+    return RetryConfig(backoff_s=backoff_s, jitter=0.0, max_backoff_s=60.0)
+
+
+def make_plane(engine=None, clock=None, **kw):
+    clock = clock or FakeClock(1000.0)
+    broker = kw.pop("broker", None) or InMemoryBroker(clock=clock)
+    defaults = dict(
+        request_topic=REQUEST, reply_topic=REPLY, dlq_topic=DLQ,
+        redelivery_max=2, lease_s=30.0, max_inflight=4,
+        retry=no_jitter_retry(), clock=clock,
+    )
+    defaults.update(kw)
+    plane = AsyncServingPlane(
+        engine if engine is not None else FakeEngine(), broker, **defaults
+    )
+    return plane, broker, clock
+
+
+def req_json(prompt: str = "hi", **kw) -> str:
+    return json.dumps({"prompt": prompt, **kw})
+
+
+def wait_for(predicate, timeout_s: float = 60.0) -> None:
+    """Bound a poll on a real scheduler thread observing a condition —
+    the OUTCOME is deterministic, only the interleaving isn't."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    assert predicate(), "condition never became true"
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    yield
+    faults.reset()
+
+
+# ----------------------------------------------------------------------
+# broker unit: the lease lifecycle on a stated clock
+# ----------------------------------------------------------------------
+
+
+def test_broker_lease_ack_lifecycle_is_fifo():
+    clock = FakeClock()
+    b = InMemoryBroker(clock=clock)
+    sub = b.subscribe(REQUEST, lease_s=30.0)
+    first = b.publish(REQUEST, "a")
+    second = b.publish(REQUEST, "b")
+    assert b.depth(REQUEST) == 2 and b.inflight(REQUEST) == 0
+    m1 = sub.lease()
+    assert m1.id == first and m1.attempt == 1 and m1.value == "a"
+    assert b.depth(REQUEST) == 1 and sub.inflight() == 1
+    assert sub.ack(m1.id) is True
+    assert b.size(REQUEST) == 1          # acked for good
+    assert sub.ack(m1.id) is False       # unknown id now
+    m2 = sub.lease()
+    assert m2.id == second
+    assert sub.lease() is None           # nothing ready
+
+
+def test_broker_lease_expiry_redelivers_and_counts_the_attempt():
+    clock = FakeClock()
+    b = InMemoryBroker(clock=clock)
+    sub = b.subscribe(REQUEST, lease_s=10.0)
+    b.publish(REQUEST, "v")
+    m1 = sub.lease()
+    assert sub.lease() is None           # leased, not ready
+    clock.advance(10.1)                  # consumer died; lease ran out
+    m2 = sub.lease()
+    assert m2.id == m1.id and m2.attempt == 2
+    events = [h["event"] for h in m2.history]
+    assert "lease_expired" in events
+    # The dead consumer's stale ack bounces (the id was re-leased and
+    # will be re-acked by whoever holds it now).
+    assert sub.ack(m2.id) is True
+
+
+def test_broker_nack_delay_and_drain_refund():
+    clock = FakeClock()
+    b = InMemoryBroker(clock=clock)
+    sub = b.subscribe(REQUEST, lease_s=30.0)
+    b.publish(REQUEST, "v")
+    m = sub.lease()
+    assert sub.nack(m.id, delay_s=5.0, note="boom") is True
+    assert sub.lease() is None           # backoff holds it back
+    clock.advance(5.0)
+    m2 = sub.lease()
+    assert m2.attempt == 2               # a penalized nack burns budget
+    # Drain refund: penalize=False hands the delivery back.
+    sub.nack(m2.id, delay_s=0.0, note="drain", penalize=False)
+    m3 = sub.lease()
+    assert m3.attempt == 2               # refunded, re-burned by this lease
+
+
+def test_broker_publish_is_idempotent_per_pinned_id():
+    b = InMemoryBroker(clock=FakeClock())
+    a = b.publish(REPLY, "r1", message_id="reply-x")
+    c = b.publish(REPLY, "DIFFERENT", message_id="reply-x")
+    assert a == c == "reply-x"
+    msgs = b.peek_all(REPLY)
+    assert len(msgs) == 1 and msgs[0].value == "r1"
+
+
+# ----------------------------------------------------------------------
+# durable broker: crash-safe resumption off the journal
+# ----------------------------------------------------------------------
+
+
+def test_durable_replay_restores_unacked_with_attempts(tmp_path):
+    clock = FakeClock()
+    b = DurableBroker(str(tmp_path), clock=clock)
+    sub = b.subscribe(REQUEST, lease_s=30.0)
+    b.publish(REQUEST, "acked")
+    b.publish(REQUEST, "leased-then-crash")
+    b.publish(REQUEST, "never-touched")
+    sub.ack(sub.lease().id)              # first: consumed for good
+    leased = sub.lease()                 # second: lease dies with us
+    assert leased.value == "leased-then-crash"
+    b.close()                            # crash (no ack, no nack)
+
+    b2 = DurableBroker(str(tmp_path), clock=clock)
+    assert b2.depth(REQUEST) == 2        # leases are volatile → ready
+    sub2 = b2.subscribe(REQUEST, lease_s=30.0)
+    m1 = sub2.lease()
+    # Delivery count survived the crash: the in-flight lease is
+    # remembered, so a crash-looping consumer still exhausts budget.
+    assert m1.value == "leased-then-crash" and m1.attempt == 2
+    m2 = sub2.lease()
+    assert m2.value == "never-touched" and m2.attempt == 1
+    b2.close()
+
+
+def test_durable_replay_skips_torn_tail_line(tmp_path):
+    b = DurableBroker(str(tmp_path), clock=FakeClock())
+    b.publish(REQUEST, "whole")
+    b.close()
+    with open(_topic_file(str(tmp_path), REQUEST), "a") as f:
+        f.write('{"op":"pub","id":"half')  # power loss mid-append
+    b2 = DurableBroker(str(tmp_path), clock=FakeClock())
+    assert [m.value for m in b2.peek_all(REQUEST)] == ["whole"]
+    b2.close()
+
+
+def test_durable_compact_preserves_live_state(tmp_path):
+    clock = FakeClock()
+    b = DurableBroker(str(tmp_path), clock=clock)
+    sub = b.subscribe(REQUEST, lease_s=5.0)
+    for i in range(3):
+        b.publish(REQUEST, f"v{i}")
+    sub.ack(sub.lease().id)              # v0 gone
+    sub.lease()                          # v1 at attempt 1
+    clock.advance(5.1)                   # ... lease expires
+    assert b.compact(REQUEST) == 2
+    b.close()
+    b2 = DurableBroker(str(tmp_path), clock=clock)
+    by_value = {m.value: m.attempt for m in b2.peek_all(REQUEST)}
+    assert by_value == {"v1": 1, "v2": 0}
+    b2.close()
+
+
+def test_make_broker_kinds(tmp_path):
+    assert type(make_broker("memory")) is InMemoryBroker
+    assert isinstance(make_broker("file", dir=str(tmp_path)), DurableBroker)
+    with pytest.raises(ValueError):
+        make_broker("file")              # dir is mandatory
+    with pytest.raises(ValueError):
+        make_broker("kafkaesque")
+
+
+# ----------------------------------------------------------------------
+# the delivery contract (fake engine, stated clock)
+# ----------------------------------------------------------------------
+
+
+def test_happy_path_publishes_reply_then_acks():
+    plane, broker, _ = make_plane()
+    mid = broker.publish(
+        REQUEST, req_json(max_new_tokens=4), {"tenant": "acme"}
+    )
+    plane.step()                         # lease + submit (auto-resolves)
+    plane.step()                         # complete: publish, ledger, ack
+    replies = broker.peek_all(REPLY)
+    assert len(replies) == 1
+    body = json.loads(replies[0].value)
+    assert body["id"] == mid and body["token_ids"] == [1, 2, 3]
+    assert body["attempt"] == 1 and body["finish_reason"] == "stop"
+    assert replies[0].headers["tenant"] == "acme"
+    assert broker.size(REQUEST) == 0     # acked for good
+    assert mid in plane.dedup_ledger()
+    assert plane.counters["published"] == 1
+    assert plane.counters["consumed"] == 1
+    # Engine saw the batch SLO class and the tenant attribution.
+    kw = plane.engine.calls[0][1]
+    assert kw["slo_class"] == "batch" and kw["tenant"] == "acme"
+    assert kw["max_new_tokens"] == 4
+
+
+def test_consumer_killed_mid_inference_redelivers_exactly_one_reply():
+    """THE at-least-once half: a consumer crash loses nothing — the
+    lease expires, a second consumer redelivers, one reply lands."""
+    clock = FakeClock(1000.0)
+    broker = InMemoryBroker(clock=clock)
+    stuck = FakeEngine(auto=False)       # inference never finishes
+    plane1, _, _ = make_plane(stuck, clock=clock, broker=broker)
+    broker.publish(REQUEST, req_json())
+    plane1.step()
+    assert plane1.inflight_count() == 1
+    plane1.kill()                        # crash: no nack, lease leaks
+    assert broker.inflight(REQUEST) == 1
+    clock.advance(30.1)                  # the lease clock is the recovery
+    plane2, _, _ = make_plane(clock=clock, broker=broker)
+    plane2.step()
+    plane2.step()
+    assert plane2.counters["redelivered"] == 1
+    assert broker.size(REQUEST) == 0
+    assert len(broker.peek_all(REPLY)) == 1
+    assert json.loads(broker.peek_all(REPLY)[0].value)["attempt"] == 2
+
+
+def test_lost_ack_replay_is_deduped_never_double_published():
+    """THE exactly-once-publish half: die between publish and ack and
+    the replay acks off the dedup ledger — no second reply."""
+    plane, broker, clock = make_plane()
+    mid = broker.publish(REQUEST, req_json())
+    with faults.armed("pubsub.ack", raises=RuntimeError("died"), times=1):
+        plane.step()
+        plane.step()                     # publish OK, ledger OK, ack dies
+    assert plane.counters["ack_errors"] == 1
+    assert broker.inflight(REQUEST) == 1     # lease survived
+    assert len(broker.peek_all(REPLY)) == 1  # the reply DID land
+    clock.advance(30.1)                  # lease expires → redelivery
+    plane.step()
+    assert plane.counters["deduped"] == 1
+    assert broker.size(REQUEST) == 0     # replay acked, not re-run
+    assert len(broker.peek_all(REPLY)) == 1  # STILL exactly one
+    assert plane.counters["published"] == 1
+    assert mid in plane.dedup_ledger()
+
+
+def test_poison_message_parks_in_dlq_with_annotated_history():
+    plane, broker, clock = make_plane(redelivery_max=1)
+    mid = broker.publish(REQUEST, "this is not json")
+    plane.step()                         # attempt 1 → nack (backoff 1s)
+    assert plane.counters["nacked"] == 1
+    clock.advance(1.0)
+    plane.step()                         # attempt 2 = budget → DLQ
+    assert broker.size(REQUEST) == 0
+    dlq = broker.peek_all(DLQ)
+    assert len(dlq) == 1
+    parked = json.loads(dlq[0].value)
+    assert parked["id"] == mid and parked["attempts"] == 2
+    assert "ValueError" in parked["error"] or "JSON" in parked["error"]
+    assert parked["value"] == "this is not json"
+    events = [h["event"] for h in parked["history"]]
+    assert "nacked" in events            # the redelivery record rode along
+    assert plane.counters["dead_lettered"] == 1
+
+
+def test_redelivery_backoff_is_exponential_and_gates_readiness():
+    plane, broker, clock = make_plane(redelivery_max=5)
+    broker.publish(REQUEST, "poison")
+    plane.step()                         # attempt 1 → delay 1.0
+    assert plane.step() == 0             # not ready yet
+    clock.advance(1.0)
+    plane.step()                         # attempt 2 → delay 2.0
+    clock.advance(1.0)
+    assert plane.step() == 0             # exponential: 2s, not 1s
+    clock.advance(1.0)
+    assert plane.step() == 1
+    assert plane.counters["deliver_errors"] == 3
+
+
+def test_broker_fault_points_flap_and_recover():
+    """deliver and publish each raise once (flap); the message rides
+    the redelivery path and still lands exactly once."""
+    plane, broker, clock = make_plane()
+    broker.publish(REQUEST, req_json())
+    with faults.armed("pubsub.deliver", raises=OSError("read"), times=1):
+        plane.step()
+    assert plane.counters["deliver_errors"] == 1
+    clock.advance(1.0)
+    with faults.armed("pubsub.publish", raises=OSError("write"), times=1):
+        plane.step()                     # redelivered, submitted
+        plane.step()                     # reply publish dies → nack
+    assert plane.counters["publish_errors"] == 1
+    assert len(broker.peek_all(REPLY)) == 0
+    # The reply was NOT ledgered — the retry must republish for real.
+    assert plane.dedup_ledger() == {}
+    clock.advance(2.0)
+    plane.step()
+    plane.step()
+    assert len(broker.peek_all(REPLY)) == 1
+    assert broker.size(REQUEST) == 0     # zero lost, zero duplicated
+    assert plane.counters["redelivered"] == 2
+
+
+def test_acceptance_chaos_every_message_answered_or_parked():
+    """THE acceptance path: pubsub.* faults armed AND a consumer killed
+    mid-batch — every message is either answered exactly once or parked
+    in the DLQ with its history. Zero lost, zero duplicated."""
+    clock = FakeClock(1000.0)
+    broker = InMemoryBroker(clock=clock)
+    stuck = FakeEngine(auto=False)
+    plane1, _, _ = make_plane(stuck, clock=clock, broker=broker)
+    ids = [broker.publish(REQUEST, req_json(f"p{i}")) for i in range(4)]
+    poison = broker.publish(REQUEST, "poison pill")
+    plane1.step()                        # everything leased / nacked once
+    plane1.kill()                        # crash with 4 inference in flight
+    clock.advance(30.1)
+    plane2, _, _ = make_plane(clock=clock, broker=broker)
+    faults.arm("pubsub.deliver", raises=OSError("flaky read"), times=1)
+    faults.arm("pubsub.ack", raises=OSError("flaky ack"), times=1)
+    for _ in range(40):                  # drive to quiescence
+        if plane2.step() == 0:
+            clock.advance(31.0)          # backoffs AND lost-ack leases
+    assert broker.size(REQUEST) == 0     # nothing in limbo
+    replies = {
+        json.loads(m.value)["id"] for m in broker.peek_all(REPLY)
+    }
+    assert replies == set(ids)           # answered exactly once each...
+    assert len(broker.peek_all(REPLY)) == len(ids)
+    parked = [json.loads(m.value) for m in broker.peek_all(DLQ)]
+    assert [p["id"] for p in parked] == [poison]  # ...or parked
+    assert parked[0]["attempts"] >= 3
+    assert plane2.counters["dead_lettered"] == 1
+
+
+def test_graceful_drain_nacks_unstarted_leases_with_budget_refund():
+    stuck = FakeEngine(auto=False)
+    plane, broker, _ = make_plane(stuck)
+    broker.publish(REQUEST, req_json())
+    plane.step()
+    assert broker.inflight(REQUEST) == 1
+    plane.stop(drain_s=0.0)              # in-flight work can't finish
+    assert plane.inflight_count() == 0
+    assert broker.inflight(REQUEST) == 0
+    msgs = broker.peek_all(REQUEST)
+    assert len(msgs) == 1                # handed back, not dropped
+    assert msgs[0].attempt == 0          # penalize=False refunded it
+    assert msgs[0].history[-1]["note"] == "drain"
+    # The engine-side work was cancelled so the device isn't wedged.
+    assert stuck.calls[0][1]["cancel"].cancelled
+    # Draining plane leases nothing new.
+    assert plane.step() == 0
+
+
+def test_submit_rejection_takes_the_redelivery_path():
+    shedding = FakeEngine(raises=RuntimeError("queue full"))
+    plane, broker, clock = make_plane(shedding, redelivery_max=1)
+    broker.publish(REQUEST, req_json())
+    plane.step()
+    assert plane.counters["nacked"] == 1
+    clock.advance(1.0)
+    plane.step()                         # budget exhausted → DLQ
+    assert len(broker.peek_all(DLQ)) == 1
+    assert "queue full" in json.loads(
+        broker.peek_all(DLQ)[0].value
+    )["error"]
+
+
+def test_dedup_ledger_is_bounded():
+    plane, broker, clock = make_plane(dedup_max=3)
+    for i in range(5):
+        broker.publish(REQUEST, req_json(f"p{i}"))
+        plane.step()
+        plane.step()
+    assert len(plane.dedup_ledger()) == 3    # oldest two evicted
+    assert plane.report()["dedup_ledger"] == {"size": 3, "max": 3}
+
+
+def test_report_shape_for_debug_surface():
+    plane, broker, _ = make_plane()
+    broker.publish(REQUEST, req_json())
+    report = plane.report()
+    assert report["enabled"] is True
+    assert report["request_topic"] == REQUEST
+    assert report["lag"] == 1 and report["inflight_leases"] == 0
+    for key in ("redelivery_max", "lease_s", "max_inflight", "counters",
+                "running", "draining", "inflight", "dedup_ledger"):
+        assert key in report
+
+
+# ----------------------------------------------------------------------
+# config seam
+# ----------------------------------------------------------------------
+
+
+def test_async_off_builds_nothing():
+    cfg = MockConfig({"TPU_ASYNC": "0"})
+    assert new_async_plane_from_config(cfg, FakeEngine()) is None
+    assert new_async_plane_from_config(MockConfig({}), FakeEngine()) is None
+    # Enabled but no engine: still nothing (metrics-only apps).
+    assert new_async_plane_from_config(
+        MockConfig({"TPU_ASYNC": "1"}), None
+    ) is None
+
+
+def test_config_knobs_reach_the_plane(tmp_path):
+    cfg = MockConfig({
+        "TPU_ASYNC": "1",
+        "TPU_ASYNC_BROKER": "file",
+        "TPU_ASYNC_BROKER_DIR": str(tmp_path),
+        "TPU_ASYNC_REQUEST_TOPIC": "in",
+        "TPU_ASYNC_REPLY_TOPIC": "out",
+        "TPU_ASYNC_DLQ_TOPIC": "dead",
+        "TPU_ASYNC_REDELIVERY_MAX": "7",
+        "TPU_ASYNC_LEASE_S": "12",
+        "TPU_ASYNC_MAX_INFLIGHT": "2",
+        "TPU_ASYNC_DEADLINE_S": "9",
+    })
+    plane = new_async_plane_from_config(cfg, FakeEngine())
+    try:
+        assert isinstance(plane.broker, DurableBroker)
+        assert (plane.request_topic, plane.reply_topic, plane.dlq_topic) \
+            == ("in", "out", "dead")
+        assert plane.redelivery_max == 7 and plane.lease_s == 12.0
+        assert plane.max_inflight == 2 and plane.deadline_s == 9.0
+    finally:
+        plane.broker.close()
+
+
+# ----------------------------------------------------------------------
+# real engine: trace continuity, deadline reap, brownout, byte-identity
+# ----------------------------------------------------------------------
+
+
+def _make_engine(**kw):
+    defaults = dict(
+        n_slots=2, max_len=128, kv_block=16,
+        tokenizer=ByteTokenizer(), seed=0,
+    )
+    defaults.update(kw)
+    eng = InferenceEngine("llama-tiny", **defaults)
+    eng.start_sync()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = _make_engine()
+    eng.generate_sync("warm", max_new_tokens=2, temperature=0.0,
+                      stop_on_eos=False, timeout=300)
+    yield eng
+    eng.stop_sync()
+
+
+def _pump(plane, done, timeout_s: float = 120.0) -> None:
+    """Drive step() until ``done()`` — the deterministic alternative to
+    the background thread when a real scheduler is in the loop."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        plane.step()
+        if done():
+            return
+        time.sleep(0.01)
+    assert done(), "plane never reached the expected state"
+
+
+def test_one_trace_id_from_broker_to_reply(engine):
+    plane, broker, _ = make_plane(engine, clock=FakeClock())
+    trace = "ab" * 16
+    broker.publish(
+        REQUEST, req_json("trace me", max_new_tokens=3,
+                          temperature=0.0, stop_on_eos=False),
+        {"traceparent": f"00-{trace}-{'cd' * 8}-01", "tenant": "t1"},
+    )
+    _pump(plane, lambda: len(broker.peek_all(REPLY)) == 1)
+    reply = broker.peek_all(REPLY)[0]
+    # The engine's timeline adopted the broker message's trace id and
+    # the reply carries it out — one trace broker→engine→reply.
+    assert reply.headers["traceparent"].split("-")[1] == trace
+    assert reply.headers["tenant"] == "t1"
+    body = json.loads(reply.value)
+    assert len(body["token_ids"]) == 3
+
+
+def test_expired_async_message_reaped_within_window_no_leaks(engine):
+    """Satellite regression: an async admission carries Deadline +
+    CancelToken, so the scheduler reap retires it like any interactive
+    request — the lease is nacked back (not leaked) and the paged KV
+    pool is whole again."""
+    clock = FakeClock(1000.0)
+    free0 = len(engine._free_blocks)
+    plane, broker, _ = make_plane(engine, clock=clock, lease_s=1e9)
+    broker.publish(REQUEST, req_json(
+        "deadline", max_new_tokens=100, temperature=0.0,
+        stop_on_eos=False, deadline_s=3600,
+    ))
+    plane.step()
+    assert plane.inflight_count() == 1
+    clock.advance(7200.0)                # the deadline's stated clock
+    _pump(plane, lambda: plane.counters["nacked"] == 1)
+    assert plane.inflight_count() == 0
+    assert broker.inflight(REQUEST) == 0     # lease handed back, not leaked
+    assert broker.size(REQUEST) == 1         # queued for redelivery
+    assert "ErrorDeadlineExceeded" in \
+        broker.peek_all(REQUEST)[0].history[-1]["note"]
+    wait_for(lambda: len(engine._free_blocks) == free0)
+
+
+def test_brownout_storm_sheds_async_first_interactive_holds():
+    eng = _make_engine(
+        queue_max_tokens=400, slo_availability=0.999,
+        brownout_exit_sustain_s=100_000.0,
+    )
+    try:
+        eng._brownout.force_level(2)
+        plane, broker, clock = make_plane(eng)
+        # Cost ~ prompt + max_new ≈ 150: over batch's L2 allowance,
+        # within interactive's — the async plane IS batch class.
+        broker.publish(REQUEST, req_json(
+            "B" * 10, max_new_tokens=140, temperature=0.0,
+            stop_on_eos=False,
+        ))
+        plane.step()
+        assert plane.counters["nacked"] == 1     # shed → redelivery path
+        assert eng._brownout.shed_count("batch") == 1
+        assert len(broker.peek_all(REPLY)) == 0
+        # Interactive goodput holds through the same storm.
+        res = eng.submit_generate(
+            "I" * 10, max_new_tokens=140, temperature=0.0,
+            stop_on_eos=False, slo_class="interactive",
+        ).future.result(timeout=300)
+        assert res.token_ids                 # admitted and served
+        assert eng._brownout.shed_count("interactive") == 0
+        # The shed message is still owed a redelivery, not lost.
+        clock.advance(1.0)
+        assert broker.depth(REQUEST) == 1
+    finally:
+        eng.close()
+
+
+def test_sync_path_byte_identical_with_plane_attached(engine):
+    def greedy():
+        return engine.generate_sync(
+            "byte identical", max_new_tokens=8, temperature=0.0,
+            stop_on_eos=False, timeout=300,
+        ).token_ids
+
+    reference = greedy()
+    cfg = MockConfig({"TPU_ASYNC": "1", "TPU_ASYNC_POLL_S": "0.01"})
+    plane = new_async_plane_from_config(cfg, engine)
+    assert plane is not None
+    plane.start()
+    try:
+        assert greedy() == reference     # idle plane: zero interference
+    finally:
+        plane.stop(drain_s=1.0)
+        plane.broker.close()
+    assert greedy() == reference         # and clean after detach
+
+
+# ----------------------------------------------------------------------
+# control plane: sustained consumer lag → scale pressure
+# ----------------------------------------------------------------------
+
+
+def test_async_lag_loop_sustained_hysteresis():
+    clock = FakeClock(1000.0)
+    cp = ControlPlane(
+        "m", async_lag_depth=10.0, async_lag_sustain_s=5.0, clock=clock,
+    )
+    lag = [20.0]
+    cp.register("async_lag", lambda: lag[0])
+    cp.evaluate(now=clock.t)             # over: anchor only
+    assert cp.scale_pressure() == 0
+    cp.evaluate(now=clock.advance(4.9))  # inside the sustain
+    assert cp.scale_pressure() == 0
+    cp.evaluate(now=clock.advance(0.2))  # sustained → pressure
+    assert cp.scale_pressure() == 1
+    snap = cp.snapshot()["loops"]["async_lag"]
+    assert snap["mode"] == "active" and snap["pressure"] is True
+    assert snap["last_lag"] == 20.0
+    # The dead band (between exit 5.0 and enter 10.0) holds pressure.
+    lag[0] = 7.0
+    cp.evaluate(now=clock.advance(100.0))
+    assert cp.scale_pressure() == 1
+    # Below the exit threshold, sustained → clears.
+    lag[0] = 2.0
+    cp.evaluate(now=clock.advance(1.0))
+    cp.evaluate(now=clock.advance(5.1))
+    assert cp.scale_pressure() == 0
+
+
+def test_engine_attach_async_lag_feeds_scale_pressure():
+    eng = _make_engine(control_plane=True)
+    try:
+        # sustain_s must be a small POSITIVE value: the attach seam
+        # treats 0 as "keep the default" (30s — a real half-minute on
+        # the engine's wall clock).
+        assert eng.attach_async_lag(
+            lambda: 100.0, depth=10.0, sustain_s=0.05
+        ) is True
+        assert eng._control.async_loop.depth == 10.0
+        wait_for(lambda: eng._control.scale_pressure() == 1)
+    finally:
+        eng.close()
+    # Control-off engines skip the signal (None-guarded).
+    eng2 = _make_engine(control_plane=False)
+    try:
+        assert eng2.attach_async_lag(lambda: 0.0) is False
+    finally:
+        eng2.close()

@@ -10,6 +10,7 @@ import asyncio
 import http.client
 import json
 import threading
+import time
 
 import pytest
 
@@ -298,6 +299,63 @@ def test_debug_lockgraph_diffs_runtime_against_static(debug_app):
     # With runtime observation off, nothing can be runtime-only.
     assert diff["runtime_only"] == []
     assert isinstance(report["violations"], list)
+
+
+def test_debug_async_reports_disabled_when_off(debug_app):
+    """/debug/async with TPU_ASYNC unset: the plane was never built
+    and the surface says so instead of 404ing."""
+    st, body = _metrics_get(debug_app, "/debug/async")
+    assert st == 200
+    assert json.loads(body) == {"enabled": False}
+
+
+def test_debug_async_serves_plane_state():
+    """/debug/async with TPU_ASYNC=1 (docs/advanced-guide/resilience.md
+    "Async serving & delivery semantics"): topics, knobs, lag,
+    in-flight leases, the delivery counters, and the dedup ledger's
+    occupancy — the operator's one read for "is async healthy"."""
+    app = App(config=MockConfig({
+        "APP_NAME": "async-debug-test", "HTTP_PORT": "0",
+        "METRICS_PORT": "0", "TPU_MODEL": "llama-tiny",
+        "TPU_KV_SLOTS": "2", "TPU_MAX_LEN": "64",
+        "TPU_ASYNC": "1", "TPU_ASYNC_POLL_S": "0.01",
+    }))
+    loop = asyncio.new_event_loop()
+    threading.Thread(target=loop.run_forever, daemon=True).start()
+    asyncio.run_coroutine_threadsafe(app.start(), loop).result(timeout=120)
+    try:
+        plane = app._async_plane
+        assert plane is not None and plane.report()["running"] is True
+        plane.broker.publish(plane.request_topic, json.dumps({
+            "prompt": "async debug", "max_new_tokens": 2,
+            "temperature": 0.0, "stop_on_eos": False,
+        }))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if plane.counters["published"] >= 1:
+                break
+            time.sleep(0.02)
+        st, body = _metrics_get(app, "/debug/async")
+        assert st == 200
+        report = json.loads(body)
+        assert report["enabled"] is True
+        assert report["model"] == "llama-tiny"
+        assert report["request_topic"] == "tpu.requests"
+        assert report["reply_topic"] == "tpu.replies"
+        assert report["dlq_topic"] == "tpu.dlq"
+        assert report["counters"]["published"] >= 1
+        assert report["counters"]["consumed"] >= 1
+        assert report["counters"]["dead_lettered"] == 0
+        assert report["dedup_ledger"]["size"] >= 1
+        for key in ("redelivery_max", "lease_s", "max_inflight",
+                    "deadline_s", "lag", "inflight_leases", "inflight",
+                    "draining"):
+            assert key in report, key
+        # The reply actually landed on the reply topic.
+        assert plane.broker.size(plane.reply_topic) == 1
+    finally:
+        asyncio.run_coroutine_threadsafe(app.stop(), loop).result(timeout=60)
+        loop.call_soon_threadsafe(loop.stop)
 
 
 def test_run_async_stops_on_stop_event():

@@ -1295,6 +1295,76 @@ def test_gl019_accepts_seam_waits_and_host_reads(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL023 — ack before the result publish / terminal seam
+# ----------------------------------------------------------------------
+
+
+def test_gl023_flags_ack_before_result_seam(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "serving/consumer.py",
+        """
+        def handle(self, msg):
+            self._sub.ack(msg.id)  # broker forgets the message here
+            reply = self._run(msg)
+            self.broker.publish("tpu.replies", reply)
+
+        def park(self, msg, exc):
+            self._sub.ack(msg.id)  # crash here and the DLQ entry is lost
+            self._dead_letter(msg, exc)
+
+        def resolve(self, msg, result):
+            self.sub.ack(msg.id)
+            msg.future.set_result(result)
+        """,
+        select=["GL023"],
+    )
+    assert ids == ["GL023", "GL023", "GL023"]
+    assert "at-least-once" in findings[0].message
+
+
+def test_gl023_accepts_publish_then_ack_and_ack_only(tmp_path):
+    # Publish-first-ack-last is the contract; an ack with no later seam
+    # (the dedup replay path, where the reply already went out) is the
+    # negative space; nested defs are separate bodies; out-of-scope
+    # files are untouched; deliberate at-most-once carries a disable.
+    ids, _ = _lint(
+        tmp_path, "pubsub/consumer.py",
+        """
+        def handle(self, msg):
+            reply = self._run(msg)
+            self.broker.publish("tpu.replies", reply)
+            self._sub.ack(msg.id)  # reply is durable; safe to forget
+
+        def replay(self, msg):
+            if msg.id in self._ledger:
+                self._sub.ack(msg.id)  # reply already published
+
+        def outer(self, msg):
+            self._sub.ack(msg.id)
+            def emit(r):
+                self.broker.publish("tpu.replies", r)
+            return emit
+
+        def at_most_once(self, msg):
+            self._sub.ack(msg.id)  # graftlint: disable=GL023 — metrics tick, loss-tolerant by contract
+            self.broker.publish("tpu.metrics", msg.value)
+        """,
+        select=["GL023"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "datasource/consumer.py",
+        """
+        def handle(self, msg):
+            self._sub.ack(msg.id)
+            self.broker.publish("tpu.replies", msg.value)
+        """,
+        select=["GL023"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
