@@ -184,6 +184,64 @@ def _extract_json_line(out: str) -> str | None:
     return None
 
 
+def _previous_bench_row(metric: str) -> "tuple[str | None, dict | None]":
+    """Latest committed ``BENCH_*.json`` row for ``metric``. Each
+    committed artifact wraps one run ({n, cmd, rc, tail, parsed}); the
+    row is the wrapper's ``parsed`` object when the harvester filled
+    it, else the last JSON line fished out of ``tail``. Runs that
+    never emitted a row (wedged init, watchdog exits) simply don't
+    match — the trajectory is computed against the newest run that
+    actually reported."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(
+        glob.glob(os.path.join(here, "BENCH_*.json")), reverse=True
+    ):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                wrapper = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        row = wrapper.get("parsed") if isinstance(wrapper, dict) else None
+        if not isinstance(row, dict):
+            line = _extract_json_line(str(
+                (wrapper or {}).get("tail", "")
+                if isinstance(wrapper, dict) else ""
+            ))
+            row = json.loads(line) if line else None
+        if isinstance(row, dict) and row.get("metric") == metric:
+            return os.path.basename(path), row
+    return None, None
+
+
+def _trajectory_fields(current: dict) -> dict:
+    """Run-over-run trajectory (ISSUE 19 satellite): compare this run's
+    resource fields against the newest committed ``BENCH_*.json`` row
+    of the same metric — peak-HBM delta and per-phase scheduler-loop
+    p50 deltas — so a regression shows up IN the row that introduced
+    it, not three PRs later when someone diffs artifacts by hand.
+    ``trajectory: null`` when no prior run of this metric ever
+    reported."""
+    prev_name, prev = _previous_bench_row(str(current.get("metric", "")))
+    if prev is None:
+        return {"trajectory": None}
+    traj: dict = {"prev_run": prev_name}
+    if "hbm_peak_bytes" in current and "hbm_peak_bytes" in prev:
+        traj["hbm_peak_delta_bytes"] = (
+            int(current["hbm_peak_bytes"]) - int(prev["hbm_peak_bytes"])
+        )
+    cur_p = current.get("loop_phase_p50_ms")
+    prev_p = prev.get("loop_phase_p50_ms")
+    if isinstance(cur_p, dict) and isinstance(prev_p, dict):
+        traj["loop_phase_p50_delta_ms"] = {
+            k: round(float(cur_p[k]) - float(prev_p[k]), 3)
+            for k in cur_p if k in prev_p
+        }
+    if "value" in prev:
+        traj["prev_value"] = prev["value"]
+    return {"trajectory": traj}
+
+
 def run_with_retry() -> int:
     """Round-2 lesson (VERDICT weak #1): a wedged axon relay made the child
     hang ~26 minutes in engine-init remote compiles — PAST the old
@@ -1115,7 +1173,10 @@ def _tier_workload(on_tpu: bool) -> None:
         rng=random.Random(7), metrics=metrics,
     )
 
-    _SALTS = {"host": 0, "device": 101, "warm-host": 53, "warm-device": 157}
+    _SALTS = {
+        "host": 0, "device": 101, "dma": 211, "source": 271,
+        "warm-host": 53, "warm-device": 157, "warm-dma": 59,
+    }
 
     def prompt(leg: str, i: int) -> list:
         # Distinct per (leg, request): every transfer ships cold
@@ -1155,11 +1216,48 @@ def _tier_workload(on_tpu: bool) -> None:
             f"transfers_{leg}": len(xfer_ms),
         }
 
+    def run_source() -> dict:
+        """The remote-source pull seam's data path, in-proc: the
+        prefill tier exports cached blocks (``export_cached``), stages
+        them on the loopback transfer server, the decode tier redeems
+        the claim ticket (``dma_fetch``) and applies it
+        (``import_payload``) — the full ``/ops/tier-export`` cycle
+        minus the HTTP control round-trip."""
+        from gofr_tpu.service.dma import dma_fetch, get_transfer_server
+
+        times, hits = [], 0
+        for i in range(n_requests):
+            ids = prompt("source", i)
+            # Populate the prefill tier's radix the way a real source
+            # has it populated: by serving the request.
+            pf.generate_sync(ids, max_new_tokens=2, temperature=0.0)
+            t0 = time.time()
+            payload = pf.export_cached(ids, timeout_s=10.0)
+            if payload is None:
+                continue
+            handle = get_transfer_server().offer(payload, src="pf")
+            fetched = dma_fetch(
+                handle, connect_timeout_s=2.0, read_timeout_s=10.0,
+            )
+            if dc.import_payload(fetched, wait_s=5.0) == "imported":
+                hits += 1
+            times.append((time.time() - t0) * 1e3)
+        ms = sorted(times)
+        return {
+            "source_pull_ms": {
+                "p50": round(_pct(ms, 0.50), 3),
+                "p95": round(_pct(ms, 0.95), 3),
+            },
+            "source_pulls": len(ms),
+            "source_hits": hits,
+        }
+
     _set_stage("warmup")
     # One transfer per leg compiles extract/move (device) and the
     # insert path (host) BEFORE the fence — a steady-state transfer
-    # must never hide a recompile (exit 6 below if one does).
-    for warm_leg in ("host", "device"):
+    # must never hide a recompile (exit 6 below if one does). The dma
+    # leg's warm run also brings up the loopback transfer server.
+    for warm_leg in ("host", "device", "dma"):
         pool.transfer_leg = warm_leg
         pool.generate_sync(
             prompt(f"warm-{warm_leg}", 0), max_new_tokens=new_tokens,
@@ -1172,28 +1270,35 @@ def _tier_workload(on_tpu: bool) -> None:
     t0 = time.time()
     host = run_leg("host")
     device = run_leg("device")
+    dma = run_leg("dma")
+    source = run_source()
     wall = time.time() - t0
     # Prompts differ per leg by design (each leg must transfer COLD
     # content); the legs-move-bytes-not-meaning identity contract is
     # pinned in CI (tests/test_tier_d2d.py) against a fused reference.
     host.pop("tokens")
     device.pop("tokens")
+    dma.pop("tokens")
     counters = {}
     for inst in metrics.instruments():
         if inst.name == "app_tpu_tier_transfers_total":
             for key, value in inst.collect().items():
                 counters["|".join("=".join(p) for p in key)] = value
+    device_fields = _device_resource_fields(dc)
+    loop_fields = _loop_fields(dc)
     for eng in (pf, dc):
         _recompile_guard(eng)
     host_p50 = host["transfer_ms_host"]["p50"]
     dev_p50 = device["transfer_ms_device"]["p50"]
+    dma_p50 = dma["transfer_ms_dma"]["p50"]
     log(f"bench[tier]: transfer p50 host={host_p50}ms "
-        f"device={dev_p50}ms ({wall:.2f}s total); "
-        f"device_wins={dev_p50 < host_p50}")
+        f"device={dev_p50}ms dma={dma_p50}ms "
+        f"source_pull p50={source['source_pull_ms']['p50']}ms "
+        f"({wall:.2f}s total); device_wins={dev_p50 < host_p50}")
     pf.close()
     dc.close()
     _set_stage("done")
-    print(json.dumps({
+    row = {
         "metric": "tier_transfer_ms_p50_device",
         "value": dev_p50,
         "unit": "ms",
@@ -1206,9 +1311,15 @@ def _tier_workload(on_tpu: bool) -> None:
         "workload": "tier_legs",
         **{k: v for k, v in host.items()},
         **{k: v for k, v in device.items()},
+        **{k: v for k, v in dma.items()},
+        **source,
         "device_leg_faster": bool(dev_p50 < host_p50),
         "tier_transfers_total": counters,
-    }), flush=True)
+        **device_fields,
+        **loop_fields,
+    }
+    row.update(_trajectory_fields(row))
+    print(json.dumps(row), flush=True)
     os._exit(0)
 
 
@@ -1981,7 +2092,7 @@ def main() -> None:
     # platform/degraded: a CPU fallback number must never impersonate the
     # TPU tok/s/chip artifact (VERDICT r2 weak #3).
     headline = steady_tps if steady_tps is not None else tps
-    print(json.dumps({
+    row = {
         "metric": "decode_tokens_per_sec_per_chip",
         "value": round(headline, 2),
         "unit": "tok/s/chip",
@@ -1995,7 +2106,9 @@ def main() -> None:
         **device_fields,
         **loop_fields,
         **({"lora": n_lora} if n_lora else {}),
-    }), flush=True)
+    }
+    row.update(_trajectory_fields(row))
+    print(json.dumps(row), flush=True)
 
     # Skip interpreter teardown: the TPU runtime client keeps background
     # threads that can panic when Python finalizes while they unwind,

@@ -1,4 +1,4 @@
-"""The graftlint rule set (GL001–GL023).
+"""The graftlint rule set (GL001–GL024).
 
 Each rule encodes one class of TPU-serving bug that generic linters
 cannot see because it is a *semantic* property of the jax programming
@@ -7,8 +7,8 @@ a rule should only fire where a human reviewer would at least pause —
 anything intentional gets an inline ``# graftlint: disable=RULE`` with
 its justification, which doubles as documentation at the call site.
 
-GL001–GL019 and GL023 are per-file :class:`Rule`\\ s; GL020–GL022 are
-:class:`ProjectRule`\\ s running against the cross-file
+GL001–GL019, GL023, and GL024 are per-file :class:`Rule`\\ s;
+GL020–GL022 are :class:`ProjectRule`\\ s running against the cross-file
 :class:`~gofr_tpu.analysis.project.ProjectIndex` (call graph, lock
 model, thread roots) built by the two-phase runner.
 """
@@ -2486,6 +2486,88 @@ ALL_RULES = ALL_RULES + (AckBeforeResultRule,)
 
 
 # ----------------------------------------------------------------------
+# GL024 — transfer-handle acquisition without a budget
+# ----------------------------------------------------------------------
+
+
+class HandleNoDeadlineRule(Rule):
+    """The multi-host disaggregation plane (ISSUE 19) moves KV blocks
+    through *acquisition* calls — redeeming a dma claim ticket
+    (``dma_fetch``), asking a remote prefill source for blocks
+    (``fetch_prefilled``), waiting on the exporting scheduler
+    (``export_cached``) — and every one of them blocks on another
+    PROCESS. A stalled exporter, a partitioned source, or a
+    half-killed pod parks the caller forever unless the call carries
+    its budget; unlike an in-proc lock there is no supervisor on the
+    other side to break the wait. The failure matrix's slow-loris and
+    partition rows only degrade one rung because every acquisition
+    site states a ``deadline=``/``timeout_s=`` bound.
+
+    Heuristic: in ``serving/``/``service/`` scope, flag a call whose
+    name ends in one of the acquisition verbs unless it carries a
+    budget keyword (``deadline`` / ``timeout`` / ``timeout_s`` /
+    ``wait_s`` / ``read_timeout_s`` / ``connect_timeout_s``) or a
+    ``**kwargs`` splat that may. Raw socket/HTTP calls stay GL012's
+    business — this rule is about the transfer-handle layer above
+    them, where the budget is a ``Deadline`` threaded from the
+    request.
+    """
+
+    rule_id = "GL024"
+    name = "handle-no-deadline"
+    rationale = (
+        "cross-process transfer-handle acquisitions (dma_fetch / "
+        "fetch_prefilled / export_cached) block on another process; "
+        "without a deadline= / timeout_s= budget a stalled or "
+        "partitioned peer parks the caller forever and the failure "
+        "matrix's one-rung degradation contract breaks"
+    )
+
+    #: Call-name suffixes that acquire a cross-process transfer
+    #: handle or wait on one being produced.
+    _ACQUIRERS = frozenset(
+        ("dma_fetch", "fetch_prefilled", "export_cached")
+    )
+    #: Keywords that state the budget.
+    _BUDGET_KWARGS = frozenset((
+        "deadline", "timeout", "timeout_s", "wait_s",
+        "read_timeout_s", "connect_timeout_s",
+    ))
+
+    def applies_to(self, path: str) -> bool:
+        norm = path.replace("\\", "/")
+        return any(
+            f"/{d}/" in norm or norm.startswith(f"{d}/")
+            for d in ("serving", "service")
+        )
+
+    def check(self, tree: ast.Module, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            short = name.rsplit(".", 1)[-1]
+            if short not in self._ACQUIRERS:
+                continue
+            if any(
+                kw.arg is None or kw.arg in self._BUDGET_KWARGS
+                for kw in node.keywords
+            ):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"`{name}(...)` acquires a cross-process transfer "
+                "handle without a budget — thread the request's "
+                "`deadline=` (or a `timeout_s=` bound) into the call "
+                "so a stalled/partitioned peer degrades one rung "
+                "instead of parking this thread forever",
+            )
+
+
+ALL_RULES = ALL_RULES + (HandleNoDeadlineRule,)
+
+
+# ----------------------------------------------------------------------
 # GL020–GL022 — project-wide concurrency rules (two-phase engine)
 # ----------------------------------------------------------------------
 
@@ -2883,6 +2965,7 @@ def default_rules(config: Optional[LintConfig] = None) -> list[Rule]:
         HostPullInDeviceLegRule(),
         SyncOutsideDeviceWaitRule(),
         AckBeforeResultRule(),
+        HandleNoDeadlineRule(),
         UnguardedSharedStateRule(config.concurrency_dirs),
         LockOrderInversionRule(config.concurrency_dirs),
         BlockingUnderLockRule(config.concurrency_dirs),

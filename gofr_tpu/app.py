@@ -602,10 +602,50 @@ class App:
                         headers={"Allow": "POST"},
                         body=b'{"error": "POST a KVB1 payload"}',
                     )
-                from gofr_tpu.ops.kv_cache import payload_from_wire
+                from gofr_tpu.ops.kv_cache import (
+                    HANDLE_MAGIC,
+                    handle_from_wire,
+                    payload_from_wire,
+                )
 
+                body_bytes = raw.body or b""
                 try:
-                    payload = payload_from_wire(raw.body or b"")
+                    if body_bytes[:4] == HANDLE_MAGIC:
+                        # The dma leg: the exporter POSTs a claim
+                        # TICKET; this side pulls the bytes directly
+                        # from the exporter's transfer server. Every
+                        # redemption failure is a 200 "stale" — the
+                        # exporter's ladder bans the dma rung and
+                        # reships the same blocks inline via wire.
+                        from gofr_tpu.service.dma import (
+                            DmaError,
+                            dma_fetch,
+                        )
+                        from gofr_tpu.serving.lifecycle import Deadline
+
+                        handle = handle_from_wire(body_bytes)
+                        fetch_s = float(self.config.get_or_default(
+                            "TPU_DMA_FETCH_TIMEOUT_S", "5.0"
+                        ))
+                        try:
+                            payload = dma_fetch(
+                                handle,
+                                deadline=Deadline.after(fetch_s),
+                            )
+                        except DmaError as exc:
+                            return Response(
+                                status=200,
+                                headers={
+                                    "Content-Type": "application/json"
+                                },
+                                body=_json.dumps({
+                                    "result": "stale",
+                                    "kind": exc.kind,
+                                    "error": str(exc),
+                                }).encode(),
+                            )
+                    else:
+                        payload = payload_from_wire(body_bytes)
                 except Exception as exc:  # noqa: BLE001 — ANY malformed body is a 400 rejection, never a 5xx
                     return Response(
                         status=400,
@@ -624,6 +664,117 @@ class App:
                         "result": result,
                         "blocks": payload.n_blocks,
                     }).encode(),
+                )
+            if path == "/ops/tier-export":
+                # The tier-import codec in REVERSE: a remote decode pod
+                # asks THIS pod for the KV blocks of a prompt prefix it
+                # is about to prefill (docs/advanced-guide/
+                # resilience.md "Multi-host disaggregation"). POST a
+                # JSON body {"token_ids": [...], "mode": "dma"|"wire",
+                # "timeout_s": n} (or GET with ?token_ids=1,2,3&mode=)
+                # and the reply is a KVH1 claim ticket (mode=dma, dma
+                # available), a KVB1 inline payload (mode=wire or dma
+                # unavailable), or JSON {"result": "miss"} — misses and
+                # unsupported engines are 200s: "prefill it yourself"
+                # is a normal answer, not an error.
+                import json as _json
+
+                if raw.method == "POST":
+                    try:
+                        spec = _json.loads(raw.body or b"{}")
+                        ids = [int(t) for t in spec["token_ids"]]
+                    except Exception:  # noqa: BLE001 — ANY malformed body is a 400, never a 5xx
+                        return Response(
+                            status=400,
+                            headers={"Content-Type": "application/json"},
+                            body=b'{"error": "POST JSON with '
+                                 b'token_ids: [int, ...]"}',
+                        )
+                elif raw.method == "GET":
+                    import urllib.parse
+
+                    q = urllib.parse.parse_qs(
+                        raw.target.partition("?")[2]
+                    )
+                    try:
+                        ids = [
+                            int(t)
+                            for t in q.get("token_ids", [""])[0].split(",")
+                            if t
+                        ]
+                    except ValueError:
+                        return Response(
+                            status=400,
+                            headers={"Content-Type": "application/json"},
+                            body=b'{"error": "token_ids must be '
+                                 b'comma-separated integers"}',
+                        )
+                    spec = {"mode": q.get("mode", ["wire"])[0]}
+                else:
+                    return Response(
+                        status=405,
+                        headers={"Allow": "GET, POST"},
+                        body=b'{"error": "GET or POST"}',
+                    )
+                mode = str(spec.get("mode", "wire"))
+                try:
+                    timeout_s = min(
+                        10.0, max(0.1, float(spec.get("timeout_s", 2.0)))
+                    )
+                except (TypeError, ValueError):
+                    timeout_s = 2.0
+                eng = container.tpu
+                fn = getattr(eng, "export_cached", None)
+                if not ids or not callable(fn):
+                    return Response(
+                        status=200,
+                        headers={"Content-Type": "application/json"},
+                        body=b'{"result": "unsupported"}',
+                    )
+                payload = fn(ids, timeout_s=timeout_s)
+                if payload is None:
+                    return Response(
+                        status=200,
+                        headers={"Content-Type": "application/json"},
+                        body=b'{"result": "miss"}',
+                    )
+                from gofr_tpu.ops.kv_cache import (
+                    handle_to_wire,
+                    payload_to_wire,
+                )
+
+                if mode == "dma":
+                    # Stage the bytes on this pod's transfer server and
+                    # reply with the tiny claim ticket; the caller
+                    # fetches the body over the dedicated data socket.
+                    # Staging trouble degrades to the inline wire body
+                    # — same bytes, one rung down.
+                    try:
+                        from gofr_tpu.service.dma import (
+                            get_transfer_server,
+                        )
+
+                        handle = get_transfer_server().offer(
+                            payload, src=str(getattr(
+                                eng, "model_name", ""
+                            )),
+                        )
+                        return Response(
+                            status=200,
+                            headers={
+                                "Content-Type":
+                                    "application/octet-stream",
+                            },
+                            body=handle_to_wire(handle),
+                        )
+                    except Exception:  # noqa: BLE001 — dma staging failure degrades to the wire body
+                        pass
+                return Response(
+                    status=200,
+                    headers={
+                        "Content-Type": "application/octet-stream",
+                    },
+                    body=payload_to_wire(payload),
                 )
             if path == "/debug/tpu-trace":
                 import asyncio as _aio

@@ -333,12 +333,18 @@ class Container:
             "app_tpu_tier_transfers_total",
             "prefill→decode KV-block transfers by outcome (result="
             "ok|fused|failed_over|local_fused|expired) and leg "
-            "(leg=device|wire|host|none)",
+            "(leg=dma|device|wire|host|none)",
         )
         m.new_counter(
             "app_tpu_tier_transfer_bytes_total",
             "KV-cache bytes shipped by successful tier transfers, per "
-            "leg (leg=device|wire|host)",
+            "leg (leg=dma|device|wire|host)",
+        )
+        m.new_counter(
+            "app_tpu_tier_sources_total",
+            "remote prefill-source pulls by outcome (kind="
+            "hit|miss|rejected|error|expired) — the pull-mode twin of "
+            "app_tpu_tier_transfers_total",
         )
         m.new_histogram(
             "app_tpu_tier_transfer_seconds",

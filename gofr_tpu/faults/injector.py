@@ -25,6 +25,25 @@ fires unconditionally and tests arm selectively:
 * ``tier.import``         — in ``engine.handoff_prefilled`` on the
   decode replica: raise = the importer rejecting the shipped blocks
   (pool pressure / version mismatch)
+* ``transfer.dma.offer``  — in ``DmaTransferServer.offer``
+  (``service/dma.py``), before a payload stages for the dma leg:
+  raise = the transfer server refusing/unreachable at export time —
+  the ladder bans the dma rung and retries the same target via wire
+* ``transfer.dma.fetch``  — in ``dma_fetch`` before the data socket
+  opens (kwargs ``key``/``address``): raise = connect-refused/reset
+  without a socket; raising ``DmaError(kind=...)`` picks the matrix
+  row (connect / read / stale) deterministically
+* ``transfer.dma.serve``  — server side, after the fetch key is read
+  and before the reply frame (kwargs ``key``/``server``): a blocking
+  action = a stalled exporter mid-transfer (slow-loris / partition) —
+  the importer's read budget must cut the wait; the subprocess chaos
+  suite parks a stall here then ``kill -9``s the exporter for the
+  died-mid-DMA cell
+* ``transfer.source.pull`` — in the pool's remote prefill-source pull
+  (``replica_pool._source_prefill``), before the export request
+  (kwargs ``source``/``mode``): raise = the source dying between
+  discovery and pull — the request must fall back to local prefill
+  with zero 5xx
 * ``control.signal``      — per control-plane signal read
   (``serving/control_plane.py``; kwarg ``signal`` names it): raise =
   the sensor throwing; return ``"stale"`` = no fresh sample this pass;

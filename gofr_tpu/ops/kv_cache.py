@@ -734,6 +734,124 @@ def payload_from_wire(data: bytes) -> KVBlockPayload:
         ) from exc
 
 
+# ----------------------------------------------------------------------
+# DMA leg: handle-bearing wire variant (cross-process transfer server)
+# ----------------------------------------------------------------------
+
+#: Handle wire format magic/version. A ``KVH1`` body carries NO plane
+#: bytes — only a claim ticket against the exporter's transfer server
+#: (``service/dma.py``); the importer redeems it with a bounded fetch
+#: and only then sees a full ``KVB1`` payload. Sharing the first-4-byte
+#: dispatch with :data:`WIRE_MAGIC` lets ``POST /ops/tier-import``
+#: accept either form on the same endpoint.
+HANDLE_MAGIC = b"KVH1"
+
+
+@dataclass(frozen=True)
+class KVHandlePayload:
+    """A CLAIM TICKET for KV blocks staged on the exporting process's
+    transfer server — the ``dma`` leg's transfer unit. Where
+    :class:`KVBlockPayload` ships the plane bytes inline, this ships
+    only an (address, key) pair plus the content metadata the importer
+    needs for admission decisions *before* paying for the fetch:
+    geometry fingerprint, token chain, byte count, and the exporter's
+    checksum (re-verified against the fetched bytes, so a transfer
+    server handing back the wrong staging entry is caught as a stale
+    handle, never aliased as garbage).
+
+    The fields deliberately mirror the host-bounce payload's metadata
+    so validation code (``compatible_with``/``n_blocks``) reads the
+    same; only ``verify()`` differs — structurally true here, because
+    integrity is proven after the fetch, on the real bytes."""
+
+    address: str  # "host:port" of the exporter's DmaTransferServer
+    key: str      # opaque staging key (single-use, TTL-bounded)
+    block: int
+    token_ids: tuple[int, ...]
+    src: str = ""
+    checksum: int = 0
+    geometry: tuple = field(default_factory=tuple)
+    nbytes_hint: int = 0  # staged wire-body size (flow-control budget)
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.token_ids) // self.block if self.block else 0
+
+    def compatible_with(self, cache: "PagedKVCache") -> bool:
+        """Same geometry gate as the inline payload — a handle whose
+        fingerprint can't match is rejected before any socket opens."""
+        return (
+            self.block == cache.block
+            and self.geometry == cache_geometry(cache)
+        )
+
+    def nbytes(self) -> int:
+        return int(self.nbytes_hint)
+
+    def verify(self) -> bool:
+        """Structural check only: the token chain must tile the blocks.
+        Byte integrity is decided by the post-fetch CRC against
+        ``checksum`` (``service/dma.py`` raises ``stale`` on mismatch)."""
+        return (
+            self.block > 0
+            and len(self.token_ids) % self.block == 0
+            and len(self.token_ids) > 0
+        )
+
+
+def handle_to_wire(handle: KVHandlePayload) -> bytes:
+    """Serialize a transfer-server claim ticket: ``KVH1`` magic + a
+    u32-length-prefixed JSON header, no plane bytes. Tiny by design —
+    the dma leg's HTTP POST carries O(100) bytes however many blocks
+    the staged payload holds."""
+    header = {
+        "address": handle.address,
+        "key": handle.key,
+        "block": handle.block,
+        "token_ids": list(handle.token_ids),
+        "src": handle.src,
+        "checksum": handle.checksum,
+        "geometry": list(handle.geometry),
+        "nbytes": handle.nbytes_hint,
+    }
+    head = json.dumps(header).encode()
+    return b"".join([HANDLE_MAGIC, struct.pack(">I", len(head)), head])
+
+
+def handle_from_wire(data: bytes) -> KVHandlePayload:
+    """Parse a ``KVH1`` body back into a :class:`KVHandlePayload`.
+    Exactly :func:`payload_from_wire`'s contract: every malformed shape
+    raises ``ValueError`` — the import endpoint's one rejection
+    currency, mapped to a 400 ``rejected`` reply."""
+    if len(data) < 8 or data[:4] != HANDLE_MAGIC:
+        raise ValueError("tier-import body lacks the KVH1 magic")
+    (head_len,) = struct.unpack(">I", data[4:8])
+    if len(data) < 8 + head_len:
+        raise ValueError("tier-import handle header truncated")
+    try:
+        header = json.loads(data[8:8 + head_len].decode())
+        address = str(header["address"])
+        if ":" not in address:
+            raise ValueError(f"handle address {address!r} lacks a port")
+        return KVHandlePayload(
+            address=address,
+            key=str(header["key"]),
+            block=int(header["block"]),
+            token_ids=tuple(int(t) for t in header.get("token_ids", ())),
+            src=str(header.get("src", "")),
+            checksum=int(header.get("checksum", 0)),
+            geometry=tuple(header.get("geometry", ())),
+            nbytes_hint=int(header.get("nbytes", 0)),
+        )
+    except ValueError:
+        raise
+    except (KeyError, TypeError, AttributeError, OverflowError,
+            struct.error, UnicodeDecodeError) as exc:
+        raise ValueError(
+            f"tier-import handle header malformed: {exc!r}"
+        ) from exc
+
+
 def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Absmax-int8 quantize K/V rows over the trailing head_dim axis.
 

@@ -131,6 +131,20 @@ class Replica:
     #: replica that serves fused end-to-end while the real decode tier
     #: idles.
     supports_tier_export = False
+    #: Can this backend redeem a transfer-server HANDLE
+    #: (``ops.kv_cache.KVHandlePayload`` — the ``dma`` leg)? Remote
+    #: replicas advertise it through health details (``tier_source.
+    #: dma``); in-proc paged engines redeem loopback handles when the
+    #: leg is pinned. A target without it simply never gets the dma
+    #: rung — the ladder starts at device/wire for it.
+    supports_dma_import = False
+    #: Can this backend be PULLED FROM as a remote prefill source
+    #: (``GET/POST /ops/tier-export``)? Remote prefill-role replicas
+    #: whose health probe advertises ``tier_source.export`` — the
+    #: multi-host reverse of ``supports_tier_export``, where the local
+    #: decode engine asks a prefill pod for blocks it already computed
+    #: instead of the pod pushing them.
+    supports_tier_source = False
 
     def __init__(self, name: str, role: str = "fused") -> None:
         self.name = name
@@ -321,6 +335,16 @@ class EngineReplica(Replica):
         rejected at validation)."""
         return bool(getattr(self.engine, "kv_block", 0))
 
+    @property
+    def supports_dma_import(self) -> bool:  # type: ignore[override]
+        """Loopback dma target: a paged in-proc engine can redeem a
+        handle minted by this process's transfer server (the auto
+        ladder never picks dma for in-proc targets — the device leg is
+        strictly better there — but a ``TPU_TRANSFER_LEG=dma`` pin must
+        be servable single-process so the rung is CI-testable and
+        benchable without a second pod)."""
+        return bool(getattr(self.engine, "kv_block", 0))
+
     def state(self) -> str:
         return str(self.engine.state)
 
@@ -429,6 +453,20 @@ class EngineReplica(Replica):
             return None
         if self.state() not in ("SERVING", "DEGRADED"):
             return None
+        from gofr_tpu.ops.kv_cache import KVHandlePayload
+
+        if isinstance(payload, KVHandlePayload):
+            # dma leg, in-proc target: redeem the claim ticket HERE, on
+            # the transfer path, not on the scheduler thread — a fetch
+            # failure (stale key, dead server) raises DmaError out to
+            # the pool's attempt loop, which bans the dma rung and
+            # retries this same target one rung down with the inline
+            # payload. The fetch carries the request's own deadline.
+            from gofr_tpu.service.dma import dma_fetch
+
+            payload = dma_fetch(
+                payload, deadline=getattr(req, "deadline", None)
+            )
         return self.engine.handoff_prefilled(req, payload)
 
     def submit(self, prompt: Any, **kw: Any) -> Any:
@@ -576,6 +614,16 @@ class HTTPReplica(Replica):
         self.supports_tier_import = bool(
             self.supports_stream and import_service is not None
         )
+        # Reverse direction of the same ops-port seam: a prefill-role
+        # remote advertising tier_source in its health details can be
+        # PULLED from (/ops/tier-export) — the local decode engine asks
+        # it for blocks it already computed. Both flags are probe-fed
+        # (unconditional-assign in probe()); until a probe sees the
+        # advertisement the replica is neither a dma target nor a
+        # source.
+        self.export_path = "ops/tier-export"
+        self._tier_source = False
+        self._tier_dma = False
         self.tokenizer = tokenizer
         self.idle_timeout_s = float(idle_timeout_s)
         self._metrics = metrics
@@ -618,6 +666,23 @@ class HTTPReplica(Replica):
 
     def control_pressure(self) -> Optional[int]:
         return self._control_pressure
+
+    @property
+    def supports_dma_import(self) -> bool:  # type: ignore[override]
+        """dma-leg target: the remote's health probe advertised the
+        handle protocol (``tier_source.dma`` — one codebase version
+        speaks it both directions), and the ops-port import service is
+        wired so the claim ticket has somewhere to land. Un-probed or
+        older pods simply never get the dma rung."""
+        return bool(self.supports_tier_import and self._tier_dma)
+
+    @property
+    def supports_tier_source(self) -> bool:  # type: ignore[override]
+        """Pull-source capability: the remote advertised
+        ``tier_source.export`` and this side holds an ops-port service
+        to ask through. The pool additionally requires the prefill role
+        and routability before pulling."""
+        return bool(self._tier_source and self._import_service is not None)
 
     def set_handoff(self, handoff: Optional[Callable[[Any], bool]]) -> None:
         self._handoff = handoff
@@ -1079,31 +1144,63 @@ class HTTPReplica(Replica):
             return None
         verdict = "fused"
         if payload is not None:
-            from gofr_tpu.ops.kv_cache import payload_to_wire
+            from gofr_tpu.ops.kv_cache import (
+                KVHandlePayload,
+                handle_to_wire,
+                payload_to_wire,
+            )
+            from gofr_tpu.service.dma import DmaError
 
+            # dma leg: the POST carries only the claim ticket; the
+            # remote redeems it with a direct fetch from the exporter's
+            # transfer server. Inline (wire leg) otherwise.
+            is_handle = isinstance(payload, KVHandlePayload)
+            body = (
+                handle_to_wire(payload) if is_handle
+                else payload_to_wire(payload)
+            )
             headers = {"Content-Type": "application/octet-stream"}
             if traceparent:
                 headers["traceparent"] = str(traceparent)
             try:
                 resp = self._import_service.post(
-                    self.import_path, body=payload_to_wire(payload),
-                    headers=headers,
+                    self.import_path, body=body, headers=headers,
                 )
                 if resp.status_code < 400 and (
                     resp.json().get("result") == "imported"
                 ):
                     verdict = "imported"
+                elif is_handle:
+                    # The remote could not REDEEM the ticket (stale
+                    # key, fetch failure on its side, geometry drift).
+                    # Unlike a rejected inline body, a strictly better
+                    # rung exists on this SAME target — the wire POST
+                    # ships the actual bytes — so raise instead of
+                    # adopting fused: the pool bans the dma rung and
+                    # retries here one rung down.
+                    raise DmaError(
+                        f"remote {self.name} did not redeem the dma "
+                        f"handle (http {resp.status_code})",
+                        kind="stale",
+                    )
                 elif self._logger is not None:
                     self._logger.warnf(
                         "wire tier import to %s rejected (%d); the "
                         "request will re-prefill there",
                         self.name, resp.status_code,
                     )
+            except DmaError:
+                raise
             except Exception as exc:  # noqa: BLE001 — every wire failure has a fused/ladder fallback
                 if getattr(exc, "kind", "") == "connect":
                     # Nothing listening: the remote is dead, not merely
                     # rejecting — let the pool try another target.
                     return None
+                if is_handle:
+                    # A handle POST that died mid-wire shipped nothing:
+                    # rung descent (retry via wire), never a fused
+                    # adoption that silently forfeits the transfer.
+                    raise
                 if self._logger is not None:
                     self._logger.warnf(
                         "wire tier import to %s failed mid-POST (%s); "
@@ -1139,6 +1236,61 @@ class HTTPReplica(Replica):
         )
         worker.start()
         return verdict
+
+    def fetch_prefilled(
+        self,
+        token_ids: "list[int]",
+        *,
+        deadline: Optional[Deadline] = None,
+        timeout_s: float = 2.0,
+        traceparent: Optional[str] = None,
+        mode: str = "dma",
+    ) -> Any:
+        """Remote prefill-source pull: ask this replica's ops port for
+        the longest cached prefix of ``token_ids`` (``POST
+        /ops/tier-export`` — the tier-import codec run in reverse).
+        Returns the decoded payload — a ``KVHandlePayload`` claim
+        ticket in ``mode="dma"``, the inline ``KVBlockPayload`` in
+        ``mode="wire"`` — or None on a miss/unsupported reply. The
+        budget (``timeout_s`` clamped to ``deadline``) travels IN the
+        request so the remote's own radix-walk wait is bounded by it
+        too, not just our socket read. Transport errors propagate
+        (typed, ``kind``-tagged) — the pool's pull loop degrades them
+        one rung at a time, terminally to local prefill."""
+        if self._import_service is None:
+            return None
+        budget = float(timeout_s)
+        if deadline is not None:
+            budget = min(budget, float(deadline.remaining()))
+        if budget <= 0:
+            return None
+        headers: dict[str, str] = {}
+        if traceparent:
+            headers["traceparent"] = str(traceparent)
+        resp = self._import_service.post(
+            self.export_path,
+            json={
+                "token_ids": [int(t) for t in token_ids],
+                "mode": mode,
+                "timeout_s": budget,
+            },
+            headers=headers,
+        )
+        body = resp.body or b""
+        if resp.status_code >= 400 or len(body) < 4:
+            return None
+        from gofr_tpu.ops.kv_cache import (
+            HANDLE_MAGIC,
+            WIRE_MAGIC,
+            handle_from_wire,
+            payload_from_wire,
+        )
+
+        if body[:4] == HANDLE_MAGIC:
+            return handle_from_wire(body)
+        if body[:4] == WIRE_MAGIC:
+            return payload_from_wire(body)
+        return None  # JSON miss/unsupported reply
 
     def _run_unary(
         self, req: Any, prompt: Any, kw: dict, deadline: Optional[Deadline]
@@ -1281,6 +1433,20 @@ class HTTPReplica(Replica):
         self._control_pressure = (
             int(pressure) if isinstance(pressure, (int, float)) else None
         )
+        # Multi-host disaggregation advertisement: can this pod be
+        # pulled from as a prefill source (/ops/tier-export), and does
+        # it speak the KVH1 handle protocol (the dma leg)? Same
+        # unconditional-assign discipline — a pod restarted without a
+        # paged pool stops being a source/dma target on the next probe.
+        tier_source = details.get("tier_source")
+        self._tier_source = bool(
+            tier_source.get("export")
+            if isinstance(tier_source, dict) else False
+        )
+        self._tier_dma = bool(
+            tier_source.get("dma")
+            if isinstance(tier_source, dict) else False
+        )
         if (
             self._brownout_level is not None
             and self._brownout_level >= 3
@@ -1386,11 +1552,16 @@ class ReplicaPool:
         transfer_timeout_s: float = 10.0,
         transfer_backoff_s: float = 0.05,
         # Transfer-leg pin (TPU_TRANSFER_LEG): "" = automatic ladder
-        # (device → wire → host-bounce per target), or exactly one of
-        # "device" / "wire" / "host" to pin every transfer to that leg
-        # (targets that cannot serve it are skipped; the fused
-        # degradation rungs below the ladder are unchanged).
+        # (dma → device/wire → host-bounce per target), or exactly one
+        # of "dma" / "device" / "wire" / "host" to pin every transfer
+        # to that leg (targets that cannot serve it are skipped; the
+        # fused degradation rungs below the ladder are unchanged).
         transfer_leg: str = "",
+        # Remote prefill-source pull budget (TPU_SOURCE_TIMEOUT_S):
+        # wall-clock bound on asking a prefill-role remote for cached
+        # blocks before a fresh request admits locally; 0 disables the
+        # pull plane entirely.
+        source_timeout_s: float = 2.0,
         sleep: Callable[[float], None] = time.sleep,
         clock: Callable[[], float] = time.monotonic,
         rng: Optional[random.Random] = None,
@@ -1418,12 +1589,13 @@ class ReplicaPool:
         self.transfer_timeout_s = max(0.0, float(transfer_timeout_s))
         self.transfer_backoff_s = max(0.0, float(transfer_backoff_s))
         leg = str(transfer_leg or "").strip().lower()
-        if leg and leg not in ("device", "wire", "host"):
+        if leg and leg not in ("dma", "device", "wire", "host"):
             raise ValueError(
-                f"transfer_leg must be device|wire|host or empty, "
+                f"transfer_leg must be dma|device|wire|host or empty, "
                 f"got {transfer_leg!r}"
             )
         self.transfer_leg = leg
+        self.source_timeout_s = max(0.0, float(source_timeout_s))
         self._sleep = sleep
         # Last published tier mode (gauge updates only on change).
         self._tier_mode_last: Optional[str] = None
@@ -1680,6 +1852,16 @@ class ReplicaPool:
         prefer: tuple = ()
         if not adapter and self.tier_mode == "tiered":
             prefer = ("prefill",)
+        elif (
+            not adapter
+            and self.source_timeout_s > 0
+            and self.tier_sources()
+        ):
+            # Pull-mode disaggregation: remote prefill SOURCES exist but
+            # the pool itself is not tiered (no local prefill role).
+            # Fresh work prefers local decode replicas, whose prefix
+            # caches the source pull below warms before admission.
+            prefer = ("decode",)
         last: Optional[BaseException] = None
         reconciled = False
         while True:
@@ -1714,8 +1896,31 @@ class ReplicaPool:
                     ]) from None
                 raise
             tried.append(replica)
+            notes: list = []
+            if (
+                not adapter
+                and isinstance(replica, EngineReplica)
+                and self.source_timeout_s > 0
+            ):
+                try:
+                    notes = self._source_prefill(replica, prompt, kw)
+                except Exception as exc:  # noqa: BLE001 — the pull plane must never fail a submit
+                    self._count_source("error")
+                    if self._logger is not None:
+                        self._logger.warnf(
+                            "prefill-source pull errored (%s); serving "
+                            "local-fused", exc,
+                        )
             try:
-                return replica, replica.submit(prompt, **kw)
+                req = replica.submit(prompt, **kw)
+                timeline = getattr(req, "timeline", None)
+                if timeline is not None:
+                    # note_transfer takes explicit timestamps, so the
+                    # pull annotations (recorded before the request
+                    # object existed) land on THIS request's trace.
+                    for note in notes:
+                        timeline.note_transfer(*note)
+                return replica, req
             except Exception as exc:
                 if not _is_reroutable(exc):
                     raise
@@ -2241,16 +2446,35 @@ class ReplicaPool:
         """The best transfer leg this target can serve, honoring the
         ``TPU_TRANSFER_LEG`` pin and the legs already ``banned`` by a
         failure during this transfer — the per-target half of the
-        device → wire → host-bounce ladder. None = unreachable (the
-        pool picks another target or falls to the fused rungs)."""
+        dma → device/wire → host-bounce ladder. None = unreachable
+        (the pool picks another target or falls to the fused rungs).
+
+        The ``dma`` rung tops the ladder for REMOTE targets that
+        advertise the handle protocol: control (a tiny claim-ticket
+        POST) and data (a direct transfer-server fetch) travel
+        separate paths, so the ops-port POST stops scaling with the
+        payload. In-proc targets get dma only under an explicit pin —
+        the device leg is strictly better inside one process, and the
+        automatic ladder must not regress it to a loopback socket."""
         order: "tuple[str, ...]" = (
             (self.transfer_leg,) if self.transfer_leg
-            else ("device", "wire", "host")
+            else ("dma", "device", "wire", "host")
         )
         for leg in order:
             if leg in banned:
                 continue
-            if leg == "device":
+            if leg == "dma":
+                if target.remote and getattr(
+                    target, "supports_dma_import", False
+                ):
+                    return leg
+                if (
+                    not target.remote
+                    and self.transfer_leg == "dma"
+                    and getattr(target, "supports_dma_import", False)
+                ):
+                    return leg  # pinned loopback (CI/bench single-process)
+            elif leg == "device":
                 if not target.remote and getattr(
                     target, "supports_device_import", False
                 ):
@@ -2341,8 +2565,32 @@ class ReplicaPool:
         # a plane to host at all.
         payloads: dict[str, Any] = {}
 
+        def memo_key(leg: str) -> str:
+            return leg if leg in ("device", "dma") else "host"
+
         def payload_for(leg: str) -> Any:
-            key = "device" if leg == "device" else "host"
+            if leg == "dma":
+                # The dma leg stages the HOST form on this process's
+                # transfer server and ships only the claim ticket. The
+                # host bytes memoize under their own key, so a dma →
+                # wire descent re-ships the same extraction without a
+                # second device pull; a staging failure (the
+                # transfer.dma.offer fault, server down) raises out to
+                # the attempt loop, which bans the rung.
+                if "dma" not in payloads:
+                    host = payload_for("host")
+                    if host is None:
+                        payloads["dma"] = None
+                    else:
+                        from gofr_tpu.service.dma import (
+                            get_transfer_server,
+                        )
+
+                        payloads["dma"] = get_transfer_server().offer(
+                            host, src=source.name
+                        )
+                return payloads["dma"]
+            key = memo_key(leg)
             if key not in payloads:
                 if callable(payload_src):
                     try:
@@ -2422,9 +2670,7 @@ class ReplicaPool:
                     self._metrics.record_histogram(
                         "app_tpu_tier_transfer_seconds", duration
                     )
-                    payload = payloads.get(
-                        "device" if leg == "device" else "host"
-                    )
+                    payload = payloads.get(memo_key(leg))
                     nbytes = getattr(payload, "nbytes", None)
                     if outcome == "ok" and callable(nbytes):
                         self._metrics.add_counter(
@@ -2438,9 +2684,7 @@ class ReplicaPool:
                         outcome, leg,
                     )
                 if self._logger is not None:
-                    payload = payloads.get(
-                        "device" if leg == "device" else "host"
-                    )
+                    payload = payloads.get(memo_key(leg))
                     self._logger.infof(
                         "tier transfer %s → %s [%s]: %s (%d block(s), "
                         "attempt %d)",
@@ -2484,6 +2728,166 @@ class ReplicaPool:
             return True
         self._count_transfer("local_fused")
         return False
+
+    # -- remote prefill sources (the multi-host pull plane) ---------------
+
+    def tier_sources(self) -> "list[Replica]":
+        """Routable remote prefill-role replicas that can be PULLED
+        from (``/ops/tier-export``): the reverse of the push-transfer
+        plane — here the LOCAL decode engine asks a remote prefill pod
+        for blocks it already computed, so independently scaled prefill
+        and decode fleets across hosts share work without a shared
+        process or a shared JAX runtime."""
+        return [
+            r for r in self._replicas
+            if r.remote
+            and r.role == "prefill"
+            and getattr(r, "supports_tier_source", False)
+            and not r.probe_failed
+            and not r.draining
+            and r.state() in ("SERVING", "DEGRADED")
+        ]
+
+    def _count_source(self, kind: str) -> None:
+        """``app_tpu_tier_sources_total{kind}``: hit / miss / rejected /
+        error / expired — the pull plane's outcome counter (the push
+        plane's twin of ``app_tpu_tier_transfers_total``)."""
+        if self._metrics is not None:
+            self._metrics.increment_counter(
+                "app_tpu_tier_sources_total", "kind", kind,
+            )
+
+    def _source_prefill(
+        self, replica: Replica, prompt: Any, kw: dict
+    ) -> "list[tuple[str, str, float, float, str, str]]":
+        """Before admitting a FRESH request on in-proc ``replica``, try
+        to warm its prefix cache with blocks pulled from a remote
+        prefill source. Per source the pull descends its own two-rung
+        ladder — a ``dma`` claim ticket (tiny control reply + direct
+        transfer-server fetch) first, the inline ``wire`` body on any
+        dma failure — and EVERY failure mode ends at the same terminal
+        rung: the request prefills locally, byte-identical, zero 5xx.
+        Returns timeline annotations ``(src, dst, start, end, result,
+        leg)`` the caller attaches to the request once it exists, so
+        the whole descent shows on ONE trace's ``/debug/flight``
+        record."""
+        notes: "list[tuple[str, str, float, float, str, str]]" = []
+        if self.source_timeout_s <= 0:
+            return notes
+        sources = self.tier_sources()
+        if not sources:
+            return notes
+        engine = getattr(replica, "engine", None)
+        if engine is None or not getattr(engine, "kv_block", 0):
+            return notes
+        B = int(engine.kv_block)
+        if isinstance(prompt, str):
+            tok = getattr(engine, "tokenizer", None)
+            if tok is None:
+                return notes
+            try:
+                ids = [int(t) for t in tok.encode(prompt)]
+            except Exception:  # noqa: BLE001 — the submit itself will surface a tokenize error
+                return notes
+        else:
+            try:
+                ids = [int(t) for t in prompt]
+            except (TypeError, ValueError):
+                return notes
+        if len(ids) < B:
+            return notes  # shorter than one block: nothing to pull
+        radix = getattr(engine, "_radix", None)
+        if radix is not None and radix.peek(ids) >= (len(ids) // B) * B:
+            # Everything cacheable is already warm locally (peek is the
+            # non-mutating probe): a pull would ship bytes the import
+            # will skip anyway.
+            return notes
+        deadline = kw.get("deadline")
+        budget = Deadline.after(self.source_timeout_s, clock=self._clock)
+        traceparent = kw.get("traceparent")
+        from gofr_tpu.ops.kv_cache import KVHandlePayload
+        from gofr_tpu.service.dma import dma_fetch
+
+        for source in sources:
+            modes = (
+                ("dma", "wire")
+                if getattr(source, "_tier_dma", False) else ("wire",)
+            )
+            for mode in modes:
+                if budget.expired() or (
+                    deadline is not None and deadline.expired()
+                ):
+                    self._count_source("expired")
+                    return notes
+                start = self._clock()
+                try:
+                    # Fault seam: the source dying between discovery
+                    # and pull.
+                    faults.fire(
+                        "transfer.source.pull", source=source.name,
+                        mode=mode,
+                    )
+                    payload = source.fetch_prefilled(
+                        ids, deadline=budget,
+                        timeout_s=self.source_timeout_s,
+                        traceparent=traceparent, mode=mode,
+                    )
+                    if isinstance(payload, KVHandlePayload):
+                        payload = dma_fetch(payload, deadline=budget)
+                except Exception as exc:  # noqa: BLE001 — every pull failure degrades to local prefill
+                    self._count_source("error")
+                    notes.append((
+                        source.name, replica.name, start, self._clock(),
+                        "source_error", mode,
+                    ))
+                    if self._logger is not None:
+                        self._logger.warnf(
+                            "prefill-source pull from %s [%s] failed "
+                            "(%s); degrading one rung",
+                            source.name, mode, exc,
+                        )
+                    if getattr(exc, "kind", "") == "connect":
+                        break  # the source is GONE: next source, not next rung
+                    continue  # one rung down: the inline wire body
+                end = self._clock()
+                if payload is None:
+                    self._count_source("miss")
+                    notes.append((
+                        source.name, replica.name, start, end,
+                        "source_miss", mode,
+                    ))
+                    break  # an authoritative miss: re-asking via wire cannot hit
+                # Bounded wait for the APPLY (never past the budget):
+                # the submit that follows must deterministically
+                # admission-alias the warm blocks.
+                verdict = engine.import_payload(
+                    payload,
+                    wait_s=max(0.0, min(1.0, budget.remaining())),
+                )
+                if verdict == "imported":
+                    self._count_source("hit")
+                    notes.append((
+                        source.name, replica.name, start, self._clock(),
+                        "source_hit", mode,
+                    ))
+                    if self._metrics is not None:
+                        nbytes = getattr(payload, "nbytes", None)
+                        if callable(nbytes):
+                            self._metrics.add_counter(
+                                "app_tpu_tier_transfer_bytes_total",
+                                float(nbytes()), "leg", mode,
+                            )
+                    return notes
+                # Geometry drift / corrupt body: the wire rung would
+                # reject identically, so stop descending — local
+                # prefill is the rung below.
+                self._count_source("rejected")
+                notes.append((
+                    source.name, replica.name, start, self._clock(),
+                    "source_rejected", mode,
+                ))
+                return notes
+        return notes
 
     # -- membership (scaler spawn/drain) ----------------------------------
 
@@ -2952,5 +3356,6 @@ class ReplicaPool:
                 "total": len(self._replicas),
                 "hedge_budget": round(self.hedge_budget.available(), 3),
                 "tier_mode": self.tier_mode,
+                "tier_sources": [r.name for r in self.tier_sources()],
             },
         }

@@ -96,6 +96,7 @@ class AsyncServingPlane:
         clock: Callable[[], float] = time.monotonic,
         poll_s: float = 0.05,
         dedup_max: int = 2048,
+        tenant_queue_max: int = 0,
         metrics: Any = None,
         logger: Any = None,
         model_name: str = "",
@@ -116,6 +117,13 @@ class AsyncServingPlane:
         )
         self.poll_s = max(0.001, float(poll_s))
         self.dedup_max = max(1, int(dedup_max))
+        #: Per-tenant leased+ready backlog bound
+        #: (``TPU_ASYNC_TENANT_QUEUE_MAX``; 0 = unbounded): one
+        #: misbehaving publisher must not occupy every lease slot and
+        #: starve other tenants' queues. Over-quota deliveries park a
+        #: quota-annotated DLQ record immediately — redelivering them
+        #: would just re-collide with the same full backlog.
+        self.tenant_queue_max = max(0, int(tenant_queue_max))
         self._clock = clock
         self._metrics = metrics
         self._logger = logger
@@ -130,10 +138,18 @@ class AsyncServingPlane:
         #: straight to ack — the exactly-once-publish half.
         self._ledger: dict[str, float] = {}
         self._ledger_order: list[str] = []
+        #: tenant → ids of messages this consumer has seen and not yet
+        #: terminally resolved (in flight, or nacked and awaiting
+        #: redelivery) — the "leased+ready" backlog the quota bounds.
+        #: Ids survive nacks (a redelivery is the same logical message)
+        #: and leave at the terminal ack (reply published or
+        #: dead-lettered).
+        self._tenant_backlog: dict[str, set[str]] = {}
         self.counters: dict[str, int] = {
             "consumed": 0, "published": 0, "redelivered": 0,
             "dead_lettered": 0, "nacked": 0, "deduped": 0,
             "deliver_errors": 0, "publish_errors": 0, "ack_errors": 0,
+            "quota_rejected": 0,
         }
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -244,6 +260,29 @@ class AsyncServingPlane:
             # exhaust the budget exactly like nacked failures do.
             self._dead_letter(msg, "redelivery budget exhausted")
             return
+        tenant = str(msg.headers.get("tenant", ""))
+        if tenant and self.tenant_queue_max > 0:
+            with self._lock:
+                backlog = self._tenant_backlog.setdefault(tenant, set())
+                over = (
+                    msg.id not in backlog
+                    and len(backlog) >= self.tenant_queue_max
+                )
+                if not over:
+                    backlog.add(msg.id)
+            if over:
+                self._count("quota_rejected")
+                self._dead_letter(
+                    msg,
+                    f"tenant {tenant!r} backlog quota exceeded",
+                    extra={
+                        "quota": {
+                            "tenant": tenant,
+                            "max": self.tenant_queue_max,
+                        },
+                    },
+                )
+                return
         try:
             faults.fire(
                 "pubsub.deliver",
@@ -343,6 +382,17 @@ class AsyncServingPlane:
         except Exception:  # noqa: BLE001 — a lost ack is recovered by lease expiry + the dedup ledger
             self._count("ack_errors")
             return
+        # Terminal: the message leaves its tenant's backlog. (On an ack
+        # error it stays counted — the redelivery is the same logical
+        # message and must not open a quota slot.)
+        tenant = str(msg.headers.get("tenant", ""))
+        if tenant:
+            with self._lock:
+                backlog = self._tenant_backlog.get(tenant)
+                if backlog is not None:
+                    backlog.discard(msg.id)
+                    if not backlog:
+                        del self._tenant_backlog[tenant]
         self._count("consumed")
         self._inc_metric("app_tpu_async_consumed_total")
 
@@ -364,8 +414,13 @@ class AsyncServingPlane:
                 msg.id, msg.attempt, delay, exc,
             )
 
-    def _dead_letter(self, msg: LeasedMessage, reason: str) -> None:
-        annotated = json.dumps({
+    def _dead_letter(
+        self,
+        msg: LeasedMessage,
+        reason: str,
+        extra: Optional[dict] = None,
+    ) -> None:
+        record: dict[str, Any] = {
             "id": msg.id,
             "topic": msg.topic,
             "error": reason,
@@ -373,7 +428,10 @@ class AsyncServingPlane:
             "history": msg.history,
             "value": msg.value,
             "headers": msg.headers,
-        })
+        }
+        if extra:
+            record.update(extra)
+        annotated = json.dumps(record)
         try:
             faults.fire(
                 "pubsub.publish", topic=self.dlq_topic, message_id=msg.id,
@@ -454,6 +512,9 @@ class AsyncServingPlane:
             inflight = [e.msg.id for e in self._inflight]
             counters = dict(self.counters)
             ledger_size = len(self._ledger)
+            backlog_sizes = {
+                t: len(ids) for t, ids in self._tenant_backlog.items()
+            }
         return {
             "enabled": True,
             "model": self.model_name,
@@ -471,6 +532,10 @@ class AsyncServingPlane:
             "inflight": inflight,
             "counters": counters,
             "dedup_ledger": {"size": ledger_size, "max": self.dedup_max},
+            "tenant_backlog": {
+                "max": self.tenant_queue_max,
+                "tenants": backlog_sizes,
+            },
         }
 
 
@@ -510,6 +575,8 @@ def new_async_plane_from_config(
             "TPU_ASYNC_DEADLINE_S", "300")),
         poll_s=float(config.get_or_default("TPU_ASYNC_POLL_S", "0.05")),
         dedup_max=int(config.get_or_default("TPU_ASYNC_DEDUP_MAX", "2048")),
+        tenant_queue_max=int(config.get_or_default(
+            "TPU_ASYNC_TENANT_QUEUE_MAX", "0")),
         metrics=metrics,
         logger=logger,
     )

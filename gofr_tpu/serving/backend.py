@@ -249,8 +249,14 @@ def _new_tpu_pool_from_config(
         transfer_timeout_s=float(
             config.get_or_default("TPU_TRANSFER_TIMEOUT_S", "10")
         ),
-        # Leg pin (default: automatic device → wire → host ladder).
+        # Leg pin (default: automatic dma → device → wire → host
+        # ladder).
         transfer_leg=config.get_or_default("TPU_TRANSFER_LEG", ""),
+        # Remote prefill-source pull budget (0 disables the pull
+        # plane).
+        source_timeout_s=float(
+            config.get_or_default("TPU_SOURCE_TIMEOUT_S", "2.0")
+        ),
         metrics=metrics,
         logger=logger,
     )

@@ -1450,6 +1450,17 @@ class InferenceEngine(
         # deque is rebuilt (emptied) with the rest of the per-boot
         # state; a payload dropped by a restart simply re-prefills.
         self._tier_imports: "_deque[Any]" = _deque()
+        # Import-completion latches (import_payload(wait_s=...)): the
+        # remote-source pull waits — bounded — until the scheduler has
+        # actually applied the payload, so the request submitted right
+        # after deterministically admission-aliases the warm blocks
+        # instead of racing its own cache warm.
+        self._tier_import_done: "dict[int, Any]" = {}
+        # Prefill-source export requests (export_cached): (ids, box,
+        # event) triples serviced by the scheduler thread next to the
+        # import apply — the radix walk and the device→host block pull
+        # both touch donated planes, so no other thread may run them.
+        self._tier_exports: "_deque[Any]" = _deque()
         # Watermark-sweep fruitless latch (scheduler._radix_watermark_
         # sweep): the (free, cached) signature of the last sweep that
         # found nothing evictable, so the loop skips re-scanning the
@@ -1879,7 +1890,7 @@ class InferenceEngine(
             return None
         return "imported" if usable else "fused"
 
-    def import_payload(self, payload: Any) -> str:
+    def import_payload(self, payload: Any, wait_s: float = 0.0) -> str:
         """Wire-leg import seam: adopt a KV-block payload WITHOUT a
         request — the remote decode replica's ops-port import endpoint
         (``POST /ops/tier-import``) lands here after decoding the
@@ -1891,7 +1902,14 @@ class InferenceEngine(
         admission-aliases the blocks zero-copy. ``"imported"`` when the
         blocks queued, ``"fused"`` when they were rejected — the
         request (which travels the ordinary OpenAI wire) re-prefills
-        here either way, never a wrong answer, never a 5xx."""
+        here either way, never a wrong answer, never a 5xx.
+
+        ``wait_s`` > 0 waits — bounded, never past the budget — until
+        the scheduler has APPLIED the payload before returning: the
+        pool's remote-source pull submits its request immediately after
+        the import, and without the latch the admission alias walk
+        could race the apply and pay a redundant prefill (correct, just
+        slower and nondeterministic for the warm-hit accounting)."""
         if self.family != "llm":
             return "fused"
         faults.fire("tier.import", engine=self, request=None)
@@ -1910,11 +1928,57 @@ class InferenceEngine(
                     getattr(payload, "src", "?"),
                 )
             return "fused"
+        done: Optional[threading.Event] = None
+        if wait_s > 0:
+            done = threading.Event()
+            self._tier_import_done[id(payload)] = done
         self._tier_imports.append(payload)
         # Wake the scheduler so the import applies ahead of the
         # companion request's admission when the engine is idle.
         self._work.set()
+        if done is not None:
+            done.wait(wait_s)
+            self._tier_import_done.pop(id(payload), None)
         return "imported"
+
+    def export_cached(
+        self,
+        token_ids: Any,
+        *,
+        timeout_s: float = 2.0,
+        deadline: Optional[Any] = None,
+    ) -> Optional[Any]:
+        """Prefill-source export seam: hand back the longest cached
+        prefix of ``token_ids`` as a shippable host payload, or None on
+        a miss. This is ``import_payload`` run backwards — the ops-port
+        export endpoint (``GET/POST /ops/tier-export``) lands here when
+        a remote decode pod asks this prefill pod for blocks it already
+        computed.
+
+        The radix walk and block extraction run on the scheduler
+        thread (donated planes); this caller-thread façade enqueues the
+        request and waits on a latch BOUNDED by ``timeout_s`` (clamped
+        to ``deadline`` when given — the pull must never outlive the
+        request it warms). A timeout, a stopped scheduler, or any
+        export failure is a miss: the asking pod prefills locally,
+        never an error."""
+        if self.family != "llm" or not self.kv_block or self._radix is None:
+            return None
+        ids = [int(t) for t in token_ids]
+        if len(ids) < self.kv_block:
+            return None  # shorter than one block: nothing shippable
+        budget = float(timeout_s)
+        if deadline is not None:
+            budget = min(budget, float(deadline.remaining()))
+        if budget <= 0 or not self._running:
+            return None
+        box: list = []
+        done = threading.Event()
+        self._tier_exports.append((tuple(ids), box, done))
+        self._work.set()
+        if not done.wait(budget):
+            return None  # scheduler busy past the budget: miss, not error
+        return box[0] if box else None
 
     def synthetic_probe(self, timeout_s: float = 30.0) -> Any:
         """Active health probe: ONE cheap greedy token through the full
@@ -3108,6 +3172,18 @@ class InferenceEngine(
                         "cached_blocks": self._radix.n_cached_blocks,
                         "lookups": self._prefix_lookups,
                         "hit_tokens": self._prefix_hit_tokens,
+                    }
+                    # Prefill-source capability (export_cached): a pool
+                    # probing this replica over HTTP reads this to
+                    # discover that finished KV blocks can be PULLED
+                    # from here through /ops/tier-export — the
+                    # multi-host disaggregation seam. "dma" says the
+                    # process can stage transfer-server handles (the
+                    # cheap control-plane reply) as well as inline wire
+                    # bodies.
+                    details["tier_source"] = {
+                        "export": True,
+                        "dma": True,
                     }
         if self._ledger is not None:
             # Device-resource observability: the ledger's compact form

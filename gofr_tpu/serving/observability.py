@@ -246,9 +246,13 @@ class RequestTimeline:
         decode replica), recorded from the pool's transfer thread —
         shows up in /debug/flight and as a `tpu.transfer` child span
         between the prefill and decode phases of the request's ONE
-        trace. ``leg`` names the rung that carried the blocks (device /
-        wire / host; "none" for hops that shipped nothing, e.g. a
-        failover fallback)."""
+        trace. ``leg`` names the rung that carried the blocks (dma /
+        device / wire / host; "none" for hops that shipped nothing,
+        e.g. a failover fallback). Remote prefill-SOURCE pulls record
+        here too — result ``source_hit`` / ``source_miss`` /
+        ``source_rejected`` / ``source_error`` with ``leg`` naming the
+        pull rung (dma / wire) — so the whole pull descent shows on the
+        same trace as the request it warmed."""
         self.transfers.append((src, dst, start, end, result, leg))
 
     def traceparent(self) -> str:

@@ -98,6 +98,8 @@ class SchedulerMixin:
     _handoff: Any
     _tier_exporter: Any
     _tier_imports: Any  # deque[ops.kv_cache.KVBlockPayload]
+    _tier_import_done: Any  # dict[id(payload) -> threading.Event]
+    _tier_exports: Any  # deque[(token_ids, result_box, threading.Event)]
     _watchdog: Any
     _metrics: Any
     _obs: Any  # serving.observability.RequestObservability
@@ -822,6 +824,69 @@ class SchedulerMixin:
             except IndexError:  # raced handoff_prefilled's un-stash
                 return
             self._import_payload(payload)
+            # Release an import_payload(wait_s=...) caller parked on
+            # this payload's apply (the pool's remote-source pull): the
+            # latch is set AFTER the radix insert, so a submit that
+            # follows the wait deterministically alias-hits.
+            done = self._tier_import_done.pop(id(payload), None)
+            if done is not None:
+                done.set()
+        self._apply_tier_exports()
+
+    def _apply_tier_exports(self) -> None:
+        """Service queued prefill-source export requests
+        (``engine.export_cached``): walk the radix index for each asked
+        token chain and lift the longest cached prefix to host as a
+        shippable payload. Runs on the scheduler thread only — the
+        lookup references stay held across the block extraction so
+        pressure eviction cannot free the blocks mid-export, then every
+        reference is surrendered (export copies bytes, it never adopts
+        blocks). Any failure resolves the caller's latch with a miss —
+        the asking pod re-prefills, never sees an error."""
+        while self._tier_exports:
+            try:
+                ids_t, box, done = self._tier_exports.popleft()
+            except IndexError:
+                return
+            try:
+                payload = self._export_cached_now(list(ids_t))
+            except Exception as exc:  # noqa: BLE001 — an export failure is a source miss, never a scheduler crash
+                payload = None
+                if self._logger is not None:
+                    self._logger.warnf(
+                        "tier-source export failed (%s: %s); answering "
+                        "miss", type(exc).__name__, exc,
+                    )
+            if payload is not None:
+                box.append(payload)
+            done.set()
+
+    def _export_cached_now(self, ids: "list[int]") -> Any:
+        """The scheduler-thread half of ``export_cached``: radix lookup
+        (references held), host-bounce the matched whole blocks, then
+        surrender every lookup reference. None on a miss."""
+        radix = self._radix
+        if radix is None or not self.kv_block:
+            return None
+        B = self.kv_block
+        chain, matched = radix.lookup(ids, 0)
+        n = matched // B
+        if n <= 0:
+            for bid in chain:
+                self._allocator.decref(bid)
+            return None
+        from gofr_tpu.ops.kv_cache import export_blocks
+
+        try:
+            return export_blocks(
+                self.cache, chain[:n], ids[: n * B], src=self.model_name
+            )
+        finally:
+            # Lookup references surrendered in full: the export shipped
+            # COPIES, so the index alone decides how long the source
+            # blocks stay cached.
+            for bid in chain:
+                self._allocator.decref(bid)
 
     def _import_payload(self, payload: Any) -> int:
         """One payload → pool blocks + radix entries; returns blocks

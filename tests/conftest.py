@@ -34,6 +34,14 @@ import socket
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: subprocess/multi-minute chaos tests (their own named CI "
+        "step runs them; the default tier-1 sweep filters -m 'not slow')",
+    )
+
+
 from gofr_tpu.analysis import lockcheck
 
 if lockcheck.enabled():

@@ -1,0 +1,69 @@
+"""Subprocess prefill-source pod for the multi-host chaos suite.
+
+NOT a test module (no ``test_`` prefix): ``tests/test_tier_multihost.py``
+spawns this as a REAL separate process — its own interpreter, its own
+JAX runtime, its own transfer server — so the kill -9 cells sever live
+sockets exactly like a dead pod, not like a mocked one.
+
+Protocol (stdout, line-oriented, flushed):
+
+* ``READY http=<port> ops=<port>`` once the app serves — the parent
+  parses the ephemeral ports from this line;
+* ``DMA-SERVE-STALLED`` the moment a dma fetch lands while
+  ``MULTIHOST_CHILD_STALL=1`` — the parent's cue that the transfer is
+  mid-flight and ``SIGKILL`` now is a genuine "died mid-DMA" cell.
+
+The stall itself is the ordinary ``transfer.dma.serve`` fault seam with
+a blocking action: the serve thread parks before sending one body byte,
+pinning the importer inside its read budget.
+"""
+
+import asyncio
+import os
+import sys
+import threading
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from gofr_tpu import App, faults  # noqa: E402
+from gofr_tpu.config import MockConfig  # noqa: E402
+from gofr_tpu.serving.openai_compat import add_openai_routes  # noqa: E402
+
+
+def main() -> None:
+    if os.environ.get("MULTIHOST_CHILD_STALL") == "1":
+        def _stall(**_ctx) -> None:
+            print("DMA-SERVE-STALLED", flush=True)
+            threading.Event().wait(300.0)  # parked until SIGKILL
+
+        faults.arm("transfer.dma.serve", action=_stall)
+
+    app = App(config=MockConfig({
+        "APP_NAME": "multihost-child", "HTTP_PORT": "0",
+        "METRICS_PORT": "0", "TPU_MODEL": "llama-tiny",
+        "TPU_KV_SLOTS": "4", "TPU_MAX_LEN": "256", "TPU_KV_BLOCK": "32",
+        "TPU_AUTO_PREFIX": "true", "TPU_PREFILL_CHUNK": "32",
+    }))
+    add_openai_routes(app)
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(app.start())
+    print(f"READY http={app.http_port} ops={app.metrics_port}", flush=True)
+    try:
+        loop.run_forever()  # only SIGKILL (or the parent's terminate) ends us
+    finally:
+        loop.run_until_complete(app.stop())
+        loop.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

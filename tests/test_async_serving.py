@@ -350,6 +350,67 @@ def test_poison_message_parks_in_dlq_with_annotated_history():
     assert plane.counters["dead_lettered"] == 1
 
 
+def test_tenant_quota_parks_overflow_in_dlq_with_quota_annotation():
+    """TPU_ASYNC_TENANT_QUEUE_MAX: one tenant's flood stops at its
+    quota — the overflow parks immediately in the DLQ with a quota
+    annotation (redelivering it would re-collide with the same full
+    backlog), and OTHER tenants' messages still admit."""
+    stuck = FakeEngine(auto=False)       # leases stay in flight
+    plane, broker, _ = make_plane(stuck, tenant_queue_max=2)
+    mids = [
+        broker.publish(REQUEST, req_json(), {"tenant": "acme"})
+        for _ in range(3)
+    ]
+    broker.publish(REQUEST, req_json(), {"tenant": "zen"})
+    plane.step()
+    assert plane.inflight_count() == 3   # 2× acme + 1× zen admitted
+    assert plane.counters["quota_rejected"] == 1
+    dlq = broker.peek_all(DLQ)
+    assert len(dlq) == 1
+    parked = json.loads(dlq[0].value)
+    assert parked["id"] == mids[2]
+    assert parked["quota"] == {"tenant": "acme", "max": 2}
+    assert "quota" in parked["error"]
+    assert plane.report()["tenant_backlog"] == {
+        "max": 2, "tenants": {"acme": 2, "zen": 1},
+    }
+
+
+def test_tenant_quota_slot_frees_after_terminal_ack():
+    """The backlog entry leaves at the terminal ack: once a message's
+    reply is published and acked, the tenant's next message admits."""
+    plane, broker, _ = make_plane(tenant_queue_max=1)
+    broker.publish(REQUEST, req_json(), {"tenant": "acme"})
+    plane.step()                         # admits: the slot is taken
+    plane.step()                         # completes: publish + ack frees it
+    assert len(broker.peek_all(REPLY)) == 1
+    broker.publish(REQUEST, req_json(), {"tenant": "acme"})
+    plane.step()                         # the freed slot admits again
+    plane.step()
+    assert len(broker.peek_all(REPLY)) == 2
+    assert plane.counters["quota_rejected"] == 0
+    assert broker.peek_all(DLQ) == []
+    assert plane.report()["tenant_backlog"]["tenants"] == {}
+
+
+def test_tenant_quota_redelivery_is_not_double_counted():
+    """A redelivery is the same logical message: it must re-enter its
+    own backlog slot, not consume a second one or self-collide."""
+    plane, broker, clock = make_plane(
+        tenant_queue_max=1, redelivery_max=1,
+    )
+    broker.publish(REQUEST, "poison", {"tenant": "acme"})
+    plane.step()                         # attempt 1 → nack (slot kept)
+    assert plane.counters["nacked"] == 1
+    clock.advance(1.0)
+    plane.step()                         # attempt 2: same slot, budget DLQ
+    assert plane.counters["quota_rejected"] == 0
+    assert plane.counters["dead_lettered"] == 1
+    assert "quota" not in json.loads(broker.peek_all(DLQ)[0].value)
+    # The terminal ack cleared the slot.
+    assert plane.report()["tenant_backlog"]["tenants"] == {}
+
+
 def test_redelivery_backoff_is_exponential_and_gates_readiness():
     plane, broker, clock = make_plane(redelivery_max=5)
     broker.publish(REQUEST, "poison")

@@ -1365,6 +1365,65 @@ def test_gl023_accepts_publish_then_ack_and_ack_only(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# GL024 — transfer-handle acquisition without a budget
+# ----------------------------------------------------------------------
+
+
+def test_gl024_flags_budgetless_handle_acquisition(tmp_path):
+    ids, findings = _lint(
+        tmp_path, "service/puller.py",
+        """
+        def pull(self, handle):
+            return dma_fetch(handle)  # blocks on the exporter forever
+
+        def ask(self, source, ids):
+            return source.fetch_prefilled(ids)
+
+        def export(self, engine, ids):
+            return engine.export_cached(ids)
+        """,
+        select=["GL024"],
+    )
+    assert ids == ["GL024", "GL024", "GL024"]
+    assert "deadline" in findings[0].message
+
+
+def test_gl024_accepts_budgeted_and_out_of_scope(tmp_path):
+    # A deadline=/timeout_s= kwarg (or a **kwargs splat that may carry
+    # one) states the budget; files outside serving//service/ are not
+    # transfer-plane code; deliberate unbounded waits carry a disable.
+    ids, _ = _lint(
+        tmp_path, "service/puller.py",
+        """
+        def pull(self, handle, deadline):
+            return dma_fetch(handle, deadline=deadline)
+
+        def ask(self, source, ids, budget):
+            return source.fetch_prefilled(
+                ids, deadline=budget, timeout_s=2.0
+            )
+
+        def export(self, engine, ids, **kw):
+            return engine.export_cached(ids, **kw)
+
+        def forever(self, handle):
+            return dma_fetch(handle)  # graftlint: disable=GL024 — test harness, budget owned by the pytest timeout
+        """,
+        select=["GL024"],
+    )
+    assert ids == []
+    ids, _ = _lint(
+        tmp_path, "datasource/puller.py",
+        """
+        def pull(self, handle):
+            return dma_fetch(handle)
+        """,
+        select=["GL024"],
+    )
+    assert ids == []
+
+
+# ----------------------------------------------------------------------
 # suppressions
 # ----------------------------------------------------------------------
 
