@@ -1327,17 +1327,21 @@ def _spec_workload(on_tpu: bool) -> None:
     """BENCH_SPEC_WORKLOAD=1: n-gram speculation A/B — the SAME
     repeated-text burst (the prompt-lookup-friendly shape: the
     continuation keeps re-walking substrings of the prompt) served by a
-    spec=0 engine and a spec=G (BENCH_SPEC_G=2) engine. The JSON line
-    carries both throughputs, the speedup, the measured
-    ``app_tpu_spec_tokens_per_step`` acceptance, AND the per-request
-    greedy-identity verdict — the default-on evidence ROADMAP asks of
-    the speculation path. Identity is REPORTED rather than enforced:
-    the verify kernel computes G+1 positions in one batched pass whose
-    bf16 reduction order differs from the one-position decode window's,
-    so near-tie argmax flips are a known numeric property of the path
-    (the same class TPU_REPLAY_EXACT exists for) — and exactly the
-    field a default-on decision needs to see, run after run, instead
-    of a refused row."""
+    spec=0 engine and a spec=G (BENCH_SPEC_G=2) engine. Since the
+    exact-verify redesign (ISSUE 20) the spec window runs the literal
+    decode-step program per candidate position, so identity is
+    ENFORCED, not reported: any stream divergence vs spec=0 exits 5
+    (the BENCH_TP_WORKLOAD idiom) — a diverged run is a correctness
+    bug, never a number worth publishing. The JSON line carries both
+    throughputs, the speedup, the acceptance series summary
+    (mean + ``acc_p50``/``acc_p95`` over per-window tokens-per-step),
+    ``host_overhead_ratio_{off,on}`` (the loop profiler's
+    host-bookkeeping share — the metric the default-on gate reads,
+    since exact verify wins by DISPATCH amortization, not compute),
+    the composed ``default_on_gate`` verdict (tok/s strictly up AND
+    host overhead not regressing — exactly when
+    ``TPU_SPEC_TOKENS=auto`` resolves ON), and the run-over-run
+    trajectory vs the newest committed BENCH_*.json row."""
     from gofr_tpu.metrics import new_metrics_manager
     from gofr_tpu.serving.engine import InferenceEngine
     from gofr_tpu.serving.tokenizer import ByteTokenizer
@@ -1361,6 +1365,20 @@ def _spec_workload(on_tpu: bool) -> None:
     def serve(spec_tokens: int) -> tuple:
         metrics = new_metrics_manager()
         metrics.new_histogram("app_tpu_spec_tokens_per_step")
+        # Raw acceptance series alongside the bucketed histogram: the
+        # scheduler records one tokens-per-live-step value per window;
+        # percentiles need the raw samples, not bucket edges.
+        acc_series: list = []
+        inst = {
+            i.name: i for i in metrics.instruments()
+        }["app_tpu_spec_tokens_per_step"]
+        inner_record = inst.record
+
+        def recording(value, labels):
+            acc_series.append(float(value))
+            inner_record(value, labels)
+
+        inst.record = recording  # type: ignore[method-assign]
         eng = InferenceEngine(
             model, n_slots=8, max_len=256, window_k=4,
             tokenizer=ByteTokenizer(), spec_tokens=spec_tokens,
@@ -1383,35 +1401,50 @@ def _spec_workload(on_tpu: bool) -> None:
         results = [r.future.result(timeout=600) for r in reqs]
         wall = time.time() - t0
         _recompile_guard(eng)
+        loop = _loop_fields(eng)
+        device = _device_resource_fields(eng)
         eng.close()
         total = sum(len(r.token_ids) for r in results)
-        acceptance = None
-        for inst in metrics.instruments():
-            if inst.name == "app_tpu_spec_tokens_per_step":
-                agg_sum = agg_n = 0.0
-                for _, (_, (s_, n_)) in inst.collect().items():
-                    agg_sum += s_
-                    agg_n += n_
-                if agg_n:
-                    acceptance = agg_sum / agg_n
         return (
             total / wall,
-            acceptance,
+            sorted(acc_series),
             [list(r.token_ids) for r in results],
+            loop,
+            device,
         )
 
     _set_stage("measure")
-    plain_tps, _, plain_tokens = serve(0)
-    spec_tps, acceptance, spec_tokens_out = serve(spec_g)
+    plain_tps, _, plain_tokens, loop_off, _ = serve(0)
+    spec_tps, acc_series, spec_tokens_out, loop_on, device_on = serve(spec_g)
     diverged = sum(
         1 for a, b in zip(plain_tokens, spec_tokens_out) if a != b
+    )
+    acceptance = (
+        sum(acc_series) / len(acc_series) if acc_series else None
     )
     log(f"bench[spec]: plain={plain_tps:.1f} tok/s "
         f"spec={spec_tps:.1f} tok/s "
         f"acceptance={acceptance if acceptance is None else round(acceptance, 3)} "
         f"diverged={diverged}/{len(plain_tokens)}")
+    if diverged:
+        # The exact-verify contract is the whole point of default-on:
+        # a diverged stream means the verify path stopped reproducing
+        # decode numerics. Refuse the row (exit 5, like tp identity).
+        log(f"bench[spec]: {diverged}/{len(plain_tokens)} STREAM(S) "
+            "DIVERGED from spec=0 — the exact-verify contract is "
+            "broken; refusing to report a wrong-answer speedup")
+        os._exit(5)
+    host_off = loop_off.get("host_overhead_ratio")
+    host_on = loop_on.get("host_overhead_ratio")
+    tok_s_up = spec_tps > plain_tps
+    # "Not regressing": within 5% relative (plus epsilon absolute for
+    # near-zero ratios) of the spec=0 run's host-bookkeeping share.
+    host_flat = (
+        host_off is None or host_on is None
+        or host_on <= host_off * 1.05 + 0.005
+    )
     _set_stage("done")
-    print(json.dumps({
+    row = {
         "metric": "spec_decode_tokens_per_sec",
         "value": round(spec_tps, 2),
         "unit": "tok/s",
@@ -1427,9 +1460,23 @@ def _spec_workload(on_tpu: bool) -> None:
         "spec_tokens_per_step": (
             round(acceptance, 3) if acceptance is not None else None
         ),
-        "token_identical": diverged == 0,
+        "acc_p50": round(_pct(acc_series, 0.50), 3),
+        "acc_p95": round(_pct(acc_series, 0.95), 3),
+        "spec_identical": True,  # enforced above: divergence exits 5
         "diverged_requests": diverged,
-    }), flush=True)
+        "host_overhead_ratio_off": host_off,
+        "host_overhead_ratio_on": host_on,
+        # The two-metric verdict the TPU_SPEC_TOKENS=auto default rides
+        # on: flip on only where speculation pays on THIS platform.
+        "default_on_gate": {
+            "tok_s_up": tok_s_up,
+            "host_overhead_flat": host_flat,
+            "pass": bool(tok_s_up and host_flat),
+        },
+        **device_on,
+    }
+    row.update(_trajectory_fields(row))
+    print(json.dumps(row), flush=True)
     os._exit(0)
 
 

@@ -59,6 +59,84 @@ from gofr_tpu.serving.types import (
 )
 from gofr_tpu.serving.watchdog import Watchdog
 
+# Draft length the TPU_SPEC_TOKENS=auto default resolves to where the
+# bench gate holds (BENCH_SPEC_WORKLOAD: G=2 is the measured knee —
+# longer drafts inflate the per-step decode-forward count faster than
+# n-gram acceptance grows).
+SPEC_AUTO_TOKENS = 2
+
+
+def resolve_spec_tokens(
+    raw: str,
+    backend: str,
+    enable_penalties: bool,
+    top_logprobs: int,
+) -> "tuple[int, Optional[str]]":
+    """Resolve ``TPU_SPEC_TOKENS`` (``auto``/int) to a draft count.
+
+    ``auto`` — the default — flips speculation ON exactly where the
+    two-metric bench gate holds, and OFF where it measurably does not:
+
+    * The numerics-exact spec window runs the decode-step program once
+      per candidate position, so device compute per emitted token is
+      never below the plain decode window's; speculation's entire win
+      is per-dispatch amortization (an accepted draft means fewer
+      windows — fewer host↔device round trips and scheduler passes —
+      per token). On dispatch/host-overhead-bound TPU serving (the
+      regime ``app_tpu_loop_host_overhead_ratio`` measures) the
+      BENCH_SPEC_WORKLOAD A/B holds: tok/s up, host overhead flat. On
+      compute-bound backends (CPU) the same A/B measures tok/s DOWN —
+      the extra forwards dominate — so ``auto`` resolves to 0 there
+      rather than shipping the gate's own counterexample.
+    * Compile features the spec window's emission block excludes
+      (penalties' evolving count plane, the top_logprobs alternatives
+      plane) win over an *implicit* default: ``auto`` resolves to 0
+      with a boot note instead of refusing to boot. An EXPLICIT
+      ``TPU_SPEC_TOKENS>0`` alongside them still raises in the
+      constructor — that combination is a contradiction the user
+      typed, not one a default created.
+
+    Returns ``(spec_tokens, note)``; ``note`` explains any auto
+    resolution so boots are attributable in logs.
+    """
+    val = (raw or "auto").strip().lower()
+    if val == "auto":
+        conflicts = [
+            name
+            for name, on in (
+                ("TPU_PENALTIES", enable_penalties),
+                ("TPU_TOP_LOGPROBS", top_logprobs > 0),
+            )
+            if on
+        ]
+        if conflicts:
+            return 0, (
+                "speculative decoding default-on skipped: "
+                + "/".join(conflicts)
+                + " needs per-step planes the spec window's emission "
+                "block excludes (set TPU_SPEC_TOKENS explicitly to "
+                "choose the other way)"
+            )
+        if backend != "tpu":
+            return 0, (
+                f"speculative decoding stays off on backend={backend!r}: "
+                "the exact verify pays one decode forward per emitted "
+                "token, and the BENCH_SPEC_WORKLOAD gate (tok/s up AND "
+                "host_overhead_ratio flat) only holds on dispatch-bound "
+                "TPU serving (set TPU_SPEC_TOKENS>0 to force)"
+            )
+        return SPEC_AUTO_TOKENS, (
+            f"speculative decoding ON by default (G={SPEC_AUTO_TOKENS}, "
+            "numerics-exact verify; TPU_SPEC_TOKENS=0 disables)"
+        )
+    try:
+        n = int(val)
+    except ValueError:
+        raise ValueError(
+            f"TPU_SPEC_TOKENS={raw!r}: expected an integer or 'auto'"
+        ) from None
+    return max(0, n), None
+
 
 class InferenceEngine(
     LLMProgramsMixin, SchedulerMixin, LoRARuntimeMixin, ModalityMixin
@@ -947,6 +1025,28 @@ class InferenceEngine(
         model_name = config.get_or_default("TPU_MODEL", "llama-tiny")
         ckpt = config.get_or_default("TPU_CHECKPOINT", "")
         quant_cfg = config.get_or_default("TPU_QUANT", "")
+        # Speculative decoding defaults ON where the bench gate holds
+        # (see resolve_spec_tokens): resolve before the constructor so
+        # an implicit default can yield to explicitly-enabled features
+        # instead of raising the constructor's explicit-conflict error.
+        top_logprobs_cfg = int(
+            config.get_or_default("TPU_TOP_LOGPROBS", "0")
+        )
+        penalties_cfg = config.get_or_default(
+            "TPU_PENALTIES", "false"
+        ).lower() in ("1", "true", "yes")
+        try:
+            import jax as _jax
+
+            backend = _jax.default_backend()
+        except Exception:  # noqa: BLE001 — backend probe only steers a default
+            backend = "cpu"
+        spec_tokens_cfg, spec_note = resolve_spec_tokens(
+            config.get_or_default("TPU_SPEC_TOKENS", "auto"),
+            backend, penalties_cfg, top_logprobs_cfg,
+        )
+        if spec_note and logger is not None:
+            logger.infof("%s", spec_note)
         params = None
         if ckpt:
             from gofr_tpu.serving.hf_loader import (
@@ -1001,13 +1101,11 @@ class InferenceEngine(
                 "TPU_TRUNCATE_PROMPTS", "false"
             ).lower() in ("1", "true", "yes"),
             top_k=int(config.get_or_default("TPU_TOP_K", "0")),
-            top_logprobs=int(config.get_or_default("TPU_TOP_LOGPROBS", "0")),
+            top_logprobs=top_logprobs_cfg,
             enable_top_p=config.get_or_default("TPU_TOP_P", "false").lower()
             in ("1", "true", "yes"),
-            enable_penalties=config.get_or_default(
-                "TPU_PENALTIES", "false"
-            ).lower() in ("1", "true", "yes"),
-            spec_tokens=int(config.get_or_default("TPU_SPEC_TOKENS", "0")),
+            enable_penalties=penalties_cfg,
+            spec_tokens=spec_tokens_cfg,
             kv_block=int(config.get_or_default("TPU_KV_BLOCK", "0")),
             lora_slots=int(config.get_or_default("TPU_LORA_SLOTS", "0")),
             lora_rank=int(config.get_or_default("TPU_LORA_RANK", "16")),
@@ -2548,12 +2646,10 @@ class InferenceEngine(
                     "logit_bias must be an object mapping token ids to "
                     "numbers"
                 ])
-            if self.spec_tokens:
-                raise ErrorInvalidParam([
-                    "logit_bias is not supported with speculative "
-                    "decoding (TPU_SPEC_TOKENS) — biased greedy picks "
-                    "would invalidate the draft-acceptance rule"
-                ])
+            # logit_bias composes with speculation since the exact-verify
+            # redesign: the spec window samples through the same biased
+            # `sample` closure the decode window uses, so acceptance
+            # compares drafts against the biased choice itself.
             if len(logit_bias) > LOGIT_BIAS_K:
                 raise ErrorInvalidParam([
                     f"logit_bias supports at most {LOGIT_BIAS_K} entries"

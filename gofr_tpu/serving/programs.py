@@ -504,53 +504,85 @@ class LLMProgramsMixin:
 
         def make_spec_body(
             params: Any, active: Any, temps: Any, greedy: Any, topps: Any,
-            seeds: Any, aids: Any,
+            seeds: Any, bidx: Any, bval: Any, use_bias: bool, aids: Any,
         ) -> Any:
             """One speculative step (scan body), shared by the plain spec
-            window and the mega-spec while_loop."""
+            window and the mega-spec while_loop.
+
+            Numerics-exact verify: the G+1 candidate positions run through
+            ``transformer_decode_step`` — the SAME program the spec-off
+            decode window scans — in an inner scan, so every position's
+            logits have the decode step's accumulation shape and reduction
+            order and are bit-identical to what a spec-off engine would
+            compute at that stream position. (The previous design verified
+            all positions in one batched ``[S, G+1]`` forward whose bf16
+            reduction order differed, flipping near-tie argmaxes — the
+            ROADMAP direction-1 blocker this replaces; graftlint GL025 now
+            flags that bug class statically.) Each inner step commits its
+            K/V and advances ``lengths`` exactly like plain decode; after
+            the scan the step rewinds ``lengths`` to the accepted count, so
+            writes past it are junk beyond the live region — never
+            attended, overwritten by the next step (the commit_chunk_kv
+            discipline, inherited for free).
+
+            Because verification IS the decode-step + shared ``sample``
+            closure (counter-based keys at the same stream offsets),
+            acceptance extends beyond greedy: a seeded-SAMPLED slot accepts
+            a draft token when the categorical draw at that position picks
+            it, and per-request ``logit_bias`` rides through the same
+            ``use_bias`` compile variant the decode window uses — both
+            byte-identical to spec=0 by the same construction."""
             from gofr_tpu.models.transformer import (
-                commit_chunk_kv,
                 ngram_draft,
-                transformer_verify_step,
+                transformer_decode_step,
             )
 
             def body(carry: tuple, _: Any) -> tuple:
                 tokens, logps, cache, nsteps, history = carry
-                sub = row_keys(seeds, nsteps)
                 draft = ngram_draft(history, cache.lengths, tokens, G)
                 inputs = jnp.concatenate([tokens[:, None], draft], axis=1)
-                logits, nk, nv = transformer_verify_step(
-                    params, inputs, cache, cfg, aids=aids
+                lengths0 = cache.lengths
+
+                def pos_body(pcarry: tuple, tok_j: Any) -> tuple:
+                    cache_i, n_i = pcarry
+                    logits, cache_i = transformer_decode_step(
+                        params, tok_j, cache_i, active, cfg,
+                        dense_attn=dense_attn, aids=aids,
+                    )
+                    sub = row_keys(seeds, n_i)
+                    nxt, nlp, _, _ = sample(
+                        logits, sub, temps, greedy, topps,
+                        bias=(bidx, bval) if use_bias else None,
+                    )
+                    return (
+                        (cache_i, n_i + active.astype(jnp.int32)),
+                        (nxt, nlp),
+                    )
+
+                (cache, _), (chosen_s, chosen_lp_s) = jax.lax.scan(
+                    pos_body, (cache, nsteps), inputs.T
                 )
-                greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                samp0, samp0_lp, _, _ = sample(
-                    logits[:, 0], sub, temps, greedy, topps
-                )
-                match = draft == greedy_next[:, :G]
+                chosen = chosen_s.T  # [S, G+1] — position j's TRUE token
+                chosen_lp = chosen_lp_s.T
+                # Accept the longest prefix of drafts that match the token
+                # the decode-step program actually chose at each position
+                # (greedy slots: the exact argmax; sampled slots: the exact
+                # counter-keyed categorical draw — both identical to the
+                # spec=0 stream by construction, so acceptance is lossless
+                # for EVERY slot, not just greedy ones).
+                match = draft == chosen[:, :G]
                 acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
-                acc = jnp.where(greedy, acc, 0)  # sampled slots: no drafts
-                bonus_g = jnp.take_along_axis(
-                    greedy_next, acc[:, None], axis=1
-                )[:, 0]
-                bonus = jnp.where(greedy, bonus_g, samp0)
-                logp_all = jax.nn.log_softmax(logits, axis=-1)
-                draft_lp = jnp.take_along_axis(
-                    logp_all[:, :G], draft[..., None], axis=2
-                )[..., 0]  # [S, G]
-                pos_lp = jnp.take_along_axis(
-                    logp_all, acc[:, None, None], axis=1
-                )[:, 0]  # [S, V] — distribution at the bonus position
-                bonus_lp = jnp.where(
-                    greedy,
-                    jnp.take_along_axis(pos_lp, bonus_g[:, None], axis=1)[:, 0],
-                    samp0_lp,
-                )
                 counts = jnp.where(active, acc + 1, 0)
+                bonus = jnp.take_along_axis(chosen, acc[:, None], axis=1)[:, 0]
+                bonus_lp = jnp.take_along_axis(
+                    chosen_lp, acc[:, None], axis=1
+                )[:, 0]
                 step_tokens = inputs  # [S, G+1]; first `counts` are emitted
+                # Position j's emitted logprob is the one its token was
+                # CHOSEN with at position j-1 (accepted ⇒ draft == chosen).
                 step_logps = jnp.concatenate(
-                    [logps[:, None], draft_lp], axis=1
+                    [logps[:, None], chosen_lp[:, :G]], axis=1
                 )
-                cache = commit_chunk_kv(cache, nk, nv, active, cfg)
                 # History: current+accepted drafts at len..len+acc, bonus at
                 # len+counts — the invariant "current token sits at
                 # history[lengths]" holds into the next step. Rejected
@@ -560,8 +592,8 @@ class LLMProgramsMixin:
                 # history[max_len-1] garbage only ever wastes a draft).
                 S2, T = history.shape
                 hvals = jnp.concatenate([inputs, bonus[:, None]], axis=1)
-                hpos = cache.lengths[:, None] + jnp.arange(G + 2)[None, :]
-                hpos = hpos.at[:, G + 1].set(cache.lengths + counts)
+                hpos = lengths0[:, None] + jnp.arange(G + 2)[None, :]
+                hpos = hpos.at[:, G + 1].set(lengths0 + counts)
                 keep = jnp.concatenate(
                     [
                         jnp.arange(G + 1)[None, :] <= acc[:, None],
@@ -574,7 +606,11 @@ class LLMProgramsMixin:
                 history = history.at[
                     jnp.arange(S2)[:, None], hpos
                 ].set(hvals)
-                cache = cache._replace(lengths=cache.lengths + counts)
+                # The inner scan advanced lengths by G+1 per active slot;
+                # the stream only accepted `counts`. Rewind — junk K/V
+                # above lengths0+counts is never attended and the next
+                # step's decode writes overwrite it in order.
+                cache = cache._replace(lengths=lengths0 + counts)
                 nsteps = nsteps + counts
                 return (
                     (bonus, bonus_lp, cache, nsteps, history),
@@ -584,23 +620,25 @@ class LLMProgramsMixin:
             return body
 
         @partial(
-            jax.jit, static_argnames=("k",), donate_argnums=(3, 5, 9)
+            jax.jit, static_argnames=("k", "use_bias"),
+            donate_argnums=(3, 5, 9),
         )
         def spec_window(
             params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
             nsteps: Any, temps: Any, greedy: Any, topps: Any,
-            history: Any, seeds: Any, aids: Any, k: int,
+            history: Any, seeds: Any, bidx: Any, bval: Any, aids: Any,
+            k: int, use_bias: bool,
         ) -> tuple:
             """k speculative steps on device. Each step drafts G tokens by
             n-gram lookup in the slot's own history, verifies draft+current
-            in ONE [S, G+1] forward (cache read-only), accepts the longest
-            matching prefix (greedy slots — lossless by construction;
-            sampled slots take 0 drafts and resample position 0), commits
-            all layers' K/V in one scatter, and carries the bonus token.
-            Emits per step: tokens [S, G+1] (= the step's inputs), logps,
-            and counts [S] (=accepted+1 valid entries)."""
+            by running the DECODE-STEP program over the G+1 positions
+            (bit-exact vs spec=0 — see make_spec_body), accepts the longest
+            prefix matching the program's own choices (greedy AND sampled
+            slots), and carries the bonus token. Emits per step: tokens
+            [S, G+1] (= the step's inputs), logps, and counts [S]
+            (=accepted+1 valid entries)."""
             body = make_spec_body(params, active, temps, greedy, topps,
-                                  seeds, aids)
+                                  seeds, bidx, bval, use_bias, aids)
             ((final, final_lp, cache, nsteps, history),
              (etoks, elps, ecnt)) = jax.lax.scan(
                 body, (tokens, logps, cache, nsteps, history), length=k
@@ -612,13 +650,15 @@ class LLMProgramsMixin:
                     history)
 
         @partial(
-            jax.jit, static_argnames=("k", "m"), donate_argnums=(3, 5, 9)
+            jax.jit, static_argnames=("k", "m", "use_bias"),
+            donate_argnums=(3, 5, 9),
         )
         def mega_spec_window(
             params: Any, tokens: Any, logps: Any, cache: Any, active: Any,
             nsteps: Any, temps: Any, greedy: Any, topps: Any,
-            history: Any, seeds: Any, remaining: Any, eos_stop: Any,
-            aids: Any, k: int, m: int,
+            history: Any, seeds: Any, bidx: Any, bval: Any,
+            remaining: Any, eos_stop: Any,
+            aids: Any, k: int, m: int, use_bias: bool,
         ) -> tuple:
             """Mega × speculation: up to m k-step spec windows in ONE
             dispatch. `remaining` decrements by the ACTUAL emitted token
@@ -627,7 +667,7 @@ class LLMProgramsMixin:
             only the VALID (first `counts`) entries of each step —
             rejected draft positions must not zero a budget."""
             body = make_spec_body(params, active, temps, greedy, topps,
-                                  seeds, aids)
+                                  seeds, bidx, bval, use_bias, aids)
             S = tokens.shape[0]
             emitted0 = jnp.zeros((2, m * k, S, G + 1), dtype=jnp.float32)
             ecnt0 = jnp.zeros((m * k, S), dtype=jnp.int32)

@@ -1884,9 +1884,10 @@ class SchedulerMixin:
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
                     self._history_dev, self._seeds_dev,
+                    self._bidx_dev, self._bval_dev,
                     self._up(remaining_host), self._up(eos_stop_host),
                     self._aids_dev,
-                    k=self.window_k, m=mega,
+                    k=self.window_k, m=mega, use_bias=use_bias,
                 )
             )
         elif mega > 1:
@@ -1909,8 +1910,9 @@ class SchedulerMixin:
                     self.params, self._tokens_dev, self._logps_dev,
                     self.cache, self._active_dev, self._nsteps_dev,
                     self._temps_dev, self._greedy_dev, self._topp_dev,
-                    self._history_dev, self._seeds_dev, self._aids_dev,
-                    k=self.window_k,
+                    self._history_dev, self._seeds_dev,
+                    self._bidx_dev, self._bval_dev, self._aids_dev,
+                    k=self.window_k, use_bias=use_bias,
                 )
             )
         else:

@@ -4,7 +4,7 @@
 #               plus import sorting scoped to the analysis package;
 #   mypy      — scoped strictness (config/logging/service/scheduler strict,
 #               rest permissive; see [tool.mypy] in pyproject.toml);
-#   graftlint — TPU-correctness rules GL001–GL024 (per-file TPU rules
+#   graftlint — TPU-correctness rules GL001–GL025 (per-file TPU rules
 #               plus project-wide concurrency analysis) against the committed
 #               baseline (gofr_tpu/analysis; docs/advanced-guide/
 #               static-analysis.md).
